@@ -1,0 +1,45 @@
+"""LightGBM-TPU: a TPU-native gradient boosting framework.
+
+A from-scratch rebuild of LightGBM v2.3.2's capabilities designed for TPU
+hardware: the binned dataset lives in HBM, histogram construction / best-split
+scans / partitioning run as jitted XLA+Pallas programs, the leaf-wise tree
+grower is a single on-device lax.while_loop, and distributed training
+(data/feature/voting parallel) is expressed as jax.sharding over a device mesh
+with ICI collectives instead of socket/MPI collectives.
+
+Public API mirrors the reference python-package (python-package/lightgbm):
+Dataset, Booster, train, cv, sklearn wrappers, callbacks, plotting.
+"""
+import jax as _jax
+
+# f64 leaf/gain math for reference parity (hist arrays stay f32; see ops/)
+_jax.config.update("jax_enable_x64", True)
+
+from .utils.log import LightGBMError, Log  # noqa: E402
+from .config import Config  # noqa: E402
+
+__version__ = "0.1.0"
+__all__ = ["Config", "Log", "LightGBMError", "__version__"]
+
+
+def _register_api():
+    """Late-bound API surface; modules appended as they are built."""
+    global __all__
+    try:
+        from .basic import Booster, Dataset  # noqa
+        from .engine import cv, train  # noqa
+        globals().update(Booster=Booster, Dataset=Dataset, train=train, cv=cv)
+        __all__ += ["Booster", "Dataset", "train", "cv"]
+    except ImportError:
+        pass
+    try:
+        from .sklearn import (LGBMClassifier, LGBMModel,  # noqa
+                              LGBMRanker, LGBMRegressor)
+        globals().update(LGBMModel=LGBMModel, LGBMRegressor=LGBMRegressor,
+                         LGBMClassifier=LGBMClassifier, LGBMRanker=LGBMRanker)
+        __all__ += ["LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker"]
+    except ImportError:
+        pass
+
+
+_register_api()

@@ -1,0 +1,446 @@
+"""Parameter/config system.
+
+TPU-native rebuild of the reference config layer (include/LightGBM/config.h:32,
+src/io/config.cpp:186, src/io/config_auto.cpp). The reference generates its parser
+and docs from an annotated struct; here a single PARAMS schema table is the source
+of truth for names, types, defaults, aliases and range checks. `Config` resolves
+aliases (ParameterAlias::KeyAliasTransform, config.h:979), applies precedence
+(explicit key wins over alias), parses CLI "key=value" strings (Config::KV2Map,
+config.h:79) and exposes typed attributes.
+
+New TPU-specific parameters are added under the same scheme (device_type=tpu,
+tpu_* tuning knobs) — the analog of the reference's gpu_* block (config.h:894-902).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from .utils.log import Log
+
+
+class _P:
+    """One parameter spec: name, type tag, default, aliases, (min, max) check."""
+
+    __slots__ = ("name", "type", "default", "aliases", "lo", "hi", "lo_excl")
+
+    def __init__(self, name, type_, default, aliases=(), lo=None, hi=None, lo_excl=False):
+        self.name = name
+        self.type = type_
+        self.default = default
+        self.aliases = tuple(aliases)
+        self.lo = lo
+        self.hi = hi
+        self.lo_excl = lo_excl
+
+
+# Schema: every supported parameter. Mirrors the reference's parameter inventory
+# (config.h structured comments; alias table in config_auto.cpp).
+PARAMS: List[_P] = [
+    # ---- Core ----
+    _P("config", str, "", ("config_file",)),
+    _P("task", str, "train", ("task_type",)),
+    _P("objective", str, "regression",
+       ("objective_type", "app", "application")),
+    _P("boosting", str, "gbdt", ("boosting_type", "boost")),
+    _P("data", str, "", ("train", "train_data", "train_data_file", "data_filename")),
+    _P("valid", "vstr", [], ("test", "valid_data", "valid_data_file", "test_data",
+                             "test_data_file", "valid_filenames")),
+    _P("num_iterations", int, 100,
+       ("num_iteration", "n_iter", "num_tree", "num_trees", "num_round", "num_rounds",
+        "num_boost_round", "n_estimators"), lo=0),
+    _P("learning_rate", float, 0.1, ("shrinkage_rate", "eta"), lo=0.0, lo_excl=True),
+    _P("num_leaves", int, 31, ("num_leaf", "max_leaves", "max_leaf"), lo=2, hi=131072),
+    _P("tree_learner", str, "serial", ("tree", "tree_type", "tree_learner_type")),
+    _P("num_threads", int, 0, ("num_thread", "nthread", "nthreads", "n_jobs")),
+    _P("device_type", str, "tpu", ("device",)),
+    _P("seed", "opt_int", None, ("random_seed", "random_state")),
+    # ---- Learning control ----
+    _P("max_depth", int, -1),
+    _P("min_data_in_leaf", int, 20,
+       ("min_data_per_leaf", "min_data", "min_child_samples"), lo=0),
+    _P("min_sum_hessian_in_leaf", float, 1e-3,
+       ("min_sum_hessian_per_leaf", "min_sum_hessian", "min_hessian", "min_child_weight"),
+       lo=0.0),
+    _P("bagging_fraction", float, 1.0, ("sub_row", "subsample", "bagging"),
+       lo=0.0, hi=1.0, lo_excl=True),
+    _P("pos_bagging_fraction", float, 1.0,
+       ("pos_sub_row", "pos_subsample", "pos_bagging"), lo=0.0, hi=1.0, lo_excl=True),
+    _P("neg_bagging_fraction", float, 1.0,
+       ("neg_sub_row", "neg_subsample", "neg_bagging"), lo=0.0, hi=1.0, lo_excl=True),
+    _P("bagging_freq", int, 0, ("subsample_freq",)),
+    _P("bagging_seed", int, 3, ("bagging_fraction_seed",)),
+    _P("feature_fraction", float, 1.0, ("sub_feature", "colsample_bytree"),
+       lo=0.0, hi=1.0, lo_excl=True),
+    _P("feature_fraction_bynode", float, 1.0,
+       ("sub_feature_bynode", "colsample_bynode"), lo=0.0, hi=1.0, lo_excl=True),
+    _P("feature_fraction_seed", int, 2),
+    _P("early_stopping_round", int, 0,
+       ("early_stopping_rounds", "early_stopping", "n_iter_no_change")),
+    _P("first_metric_only", bool, False),
+    _P("max_delta_step", float, 0.0, ("max_tree_output", "max_leaf_output")),
+    _P("lambda_l1", float, 0.0, ("reg_alpha",), lo=0.0),
+    _P("lambda_l2", float, 0.0, ("reg_lambda", "lambda"), lo=0.0),
+    _P("min_gain_to_split", float, 0.0, ("min_split_gain",), lo=0.0),
+    _P("drop_rate", float, 0.1, ("rate_drop",), lo=0.0, hi=1.0),
+    _P("max_drop", int, 50),
+    _P("skip_drop", float, 0.5, lo=0.0, hi=1.0),
+    _P("xgboost_dart_mode", bool, False),
+    _P("uniform_drop", bool, False),
+    _P("drop_seed", int, 4),
+    _P("top_rate", float, 0.2, lo=0.0, hi=1.0),
+    _P("other_rate", float, 0.1, lo=0.0, hi=1.0),
+    _P("min_data_per_group", int, 100, lo=1),
+    _P("max_cat_threshold", int, 32, lo=1),
+    _P("cat_l2", float, 10.0, lo=0.0),
+    _P("cat_smooth", float, 10.0, lo=0.0),
+    _P("max_cat_to_onehot", int, 4, lo=1),
+    _P("top_k", int, 20, ("topk",), lo=1),
+    _P("monotone_constraints", "vint", [], ("mc", "monotone_constraint")),
+    _P("feature_contri", "vdouble", [],
+       ("feature_contrib", "fc", "fp", "feature_penalty")),
+    _P("forcedsplits_filename", str, "",
+       ("fs", "forced_splits_filename", "forced_splits_file", "forced_splits")),
+    _P("forcedbins_filename", str, ""),
+    _P("refit_decay_rate", float, 0.9, lo=0.0, hi=1.0),
+    _P("cegb_tradeoff", float, 1.0, lo=0.0),
+    _P("cegb_penalty_split", float, 0.0, lo=0.0),
+    _P("cegb_penalty_feature_lazy", "vdouble", []),
+    _P("cegb_penalty_feature_coupled", "vdouble", []),
+    _P("extra_trees", bool, False, ("extra_tree",)),
+    _P("extra_seed", int, 6),
+    # ---- IO / dataset ----
+    _P("verbosity", int, 1, ("verbose",)),
+    _P("max_bin", int, 255, lo=1),
+    _P("min_data_in_bin", int, 3, lo=1),
+    _P("bin_construct_sample_cnt", int, 200000, ("subsample_for_bin",), lo=1),
+    _P("histogram_pool_size", float, -1.0, ("hist_pool_size",)),
+    _P("data_random_seed", int, 1, ("data_seed",)),
+    _P("output_model", str, "LightGBM_model.txt", ("model_output", "model_out")),
+    _P("snapshot_freq", int, -1, ("save_period",)),
+    _P("input_model", str, "", ("model_input", "model_in")),
+    _P("output_result", str, "LightGBM_predict_result.txt",
+       ("predict_result", "prediction_result", "predict_name", "prediction_name",
+        "pred_name", "name_pred")),
+    _P("initscore_filename", str, "",
+       ("init_score_filename", "init_score_file", "init_score", "input_init_score")),
+    _P("valid_data_initscores", "vstr", [],
+       ("valid_data_init_scores", "valid_init_score_file", "valid_init_score")),
+    _P("pre_partition", bool, False, ("is_pre_partition",)),
+    _P("enable_bundle", bool, True, ("is_enable_bundle", "bundle")),
+    _P("max_conflict_rate", float, 0.0, lo=0.0, hi=1.0),
+    _P("is_enable_sparse", bool, True, ("is_sparse", "enable_sparse", "sparse")),
+    _P("sparse_threshold", float, 0.8, lo=0.0, hi=1.0, lo_excl=True),
+    _P("use_missing", bool, True),
+    _P("zero_as_missing", bool, False),
+    _P("two_round", bool, False, ("two_round_loading", "use_two_round_loading")),
+    _P("save_binary", bool, False, ("is_save_binary", "is_save_binary_file")),
+    _P("header", bool, False, ("has_header",)),
+    _P("label_column", str, "", ("label",)),
+    _P("weight_column", str, "", ("weight",)),
+    _P("group_column", str, "",
+       ("group", "group_id", "query_column", "query", "query_id")),
+    _P("ignore_column", str, "", ("ignore_feature", "blacklist")),
+    _P("categorical_feature", str, "",
+       ("cat_feature", "categorical_column", "cat_column")),
+    _P("predict_raw_score", bool, False,
+       ("is_predict_raw_score", "predict_rawscore", "raw_score")),
+    _P("predict_leaf_index", bool, False, ("is_predict_leaf_index", "leaf_index")),
+    _P("predict_contrib", bool, False, ("is_predict_contrib", "contrib")),
+    _P("num_iteration_predict", int, -1),
+    _P("pred_early_stop", bool, False),
+    _P("pred_early_stop_freq", int, 10),
+    _P("pred_early_stop_margin", float, 10.0),
+    _P("convert_model_language", str, ""),
+    _P("convert_model", str, "gbdt_prediction.cpp", ("convert_model_file",)),
+    # ---- Objective ----
+    _P("num_class", int, 1, ("num_classes",), lo=1),
+    _P("is_unbalance", bool, False, ("unbalance", "unbalanced_sets")),
+    _P("scale_pos_weight", float, 1.0, lo=0.0),
+    _P("sigmoid", float, 1.0, lo=0.0, lo_excl=True),
+    _P("boost_from_average", bool, True),
+    _P("reg_sqrt", bool, False),
+    _P("alpha", float, 0.9, lo=0.0, lo_excl=True),
+    _P("fair_c", float, 1.0, lo=0.0, lo_excl=True),
+    _P("poisson_max_delta_step", float, 0.7, lo=0.0, lo_excl=True),
+    _P("tweedie_variance_power", float, 1.5, lo=1.0, hi=2.0),
+    _P("max_position", int, 20, lo=1),
+    _P("lambdamart_norm", bool, True),
+    _P("label_gain", "vdouble", []),
+    _P("objective_seed", int, 5),
+    # ---- Metric ----
+    _P("metric", "vstr", [], ("metrics", "metric_types")),
+    _P("metric_freq", int, 1, ("output_freq",), lo=1),
+    _P("is_provide_training_metric", bool, False,
+       ("training_metric", "is_training_metric", "train_metric")),
+    _P("eval_at", "vint", [1, 2, 3, 4, 5],
+       ("ndcg_eval_at", "ndcg_at", "map_eval_at", "map_at")),
+    _P("multi_error_top_k", int, 1, lo=1),
+    # ---- Network ----
+    _P("num_machines", int, 1, ("num_machine",), lo=1),
+    _P("local_listen_port", int, 12400, ("local_port", "port"), lo=1),
+    _P("time_out", int, 120, lo=1),
+    _P("machine_list_filename", str, "",
+       ("machine_list_file", "machine_list", "mlist")),
+    _P("machines", str, "", ("workers", "nodes")),
+    # ---- GPU (accepted for compatibility; ignored on TPU) ----
+    _P("gpu_platform_id", int, -1),
+    _P("gpu_device_id", int, -1),
+    _P("gpu_use_dp", bool, False),
+    # ---- TPU (new; analog of the reference's gpu_* block) ----
+    _P("tpu_use_dp", bool, False),          # f64-emulated histograms vs f32
+    _P("tpu_num_devices", int, 0),           # 0 = all local devices
+    _P("tpu_mesh_axis", str, "data"),        # mesh axis name for row sharding
+    _P("tpu_rows_per_chunk", int, 0),        # 0 = auto; histogram kernel chunking
+    _P("tpu_histogram_impl", str, "auto"),   # auto | xla | pallas
+    _P("tpu_donate_buffers", bool, True),
+]
+
+_BY_NAME: Dict[str, _P] = {p.name: p for p in PARAMS}
+_ALIAS2NAME: Dict[str, str] = {}
+for _p in PARAMS:
+    for _a in _p.aliases:
+        _ALIAS2NAME[_a] = _p.name
+
+# objective aliases the reference resolves inside ParseObjectiveAlias
+_OBJECTIVE_ALIASES = {
+    "regression": "regression", "regression_l2": "regression", "l2": "regression",
+    "mean_squared_error": "regression", "mse": "regression", "l2_root": "regression",
+    "root_mean_squared_error": "regression", "rmse": "regression",
+    "regression_l1": "regression_l1", "l1": "regression_l1",
+    "mean_absolute_error": "regression_l1", "mae": "regression_l1",
+    "huber": "huber", "fair": "fair", "poisson": "poisson",
+    "quantile": "quantile", "mape": "mape",
+    "mean_absolute_percentage_error": "mape",
+    "gamma": "gamma", "tweedie": "tweedie",
+    "binary": "binary",
+    "multiclass": "multiclass", "softmax": "multiclass",
+    "multiclassova": "multiclassova", "multiclass_ova": "multiclassova",
+    "ova": "multiclassova", "ovr": "multiclassova",
+    "lambdarank": "lambdarank", "rank_xendcg": "rank_xendcg",
+    "xendcg": "rank_xendcg", "xe_ndcg": "rank_xendcg",
+    "xe_ndcg_mart": "rank_xendcg", "xendcg_mart": "rank_xendcg",
+    "cross_entropy": "cross_entropy", "xentropy": "cross_entropy",
+    "cross_entropy_lambda": "cross_entropy_lambda", "xentlambda": "cross_entropy_lambda",
+    "none": "none", "null": "none", "custom": "none", "na": "none",
+}
+
+_METRIC_ALIASES = {
+    "l1": "l1", "mean_absolute_error": "l1", "mae": "l1", "regression_l1": "l1",
+    "l2": "l2", "mean_squared_error": "l2", "mse": "l2", "regression_l2": "l2",
+    "regression": "l2",
+    "rmse": "rmse", "root_mean_squared_error": "rmse", "l2_root": "rmse",
+    "quantile": "quantile", "huber": "huber", "fair": "fair",
+    "poisson": "poisson", "mape": "mape", "mean_absolute_percentage_error": "mape",
+    "gamma": "gamma", "gamma_deviance": "gamma_deviance",
+    "tweedie": "tweedie",
+    "ndcg": "ndcg", "lambdarank": "ndcg", "rank_xendcg": "ndcg",
+    "xendcg": "ndcg", "xe_ndcg": "ndcg", "xe_ndcg_mart": "ndcg",
+    "xendcg_mart": "ndcg",
+    "map": "map", "mean_average_precision": "map",
+    "auc": "auc",
+    "binary_logloss": "binary_logloss", "binary": "binary_logloss",
+    "binary_error": "binary_error",
+    "auc_mu": "auc_mu",
+    "multi_logloss": "multi_logloss", "multiclass": "multi_logloss",
+    "softmax": "multi_logloss", "multiclassova": "multi_logloss",
+    "multiclass_ova": "multi_logloss", "ova": "multi_logloss", "ovr": "multi_logloss",
+    "multi_error": "multi_error",
+    "cross_entropy": "cross_entropy", "xentropy": "cross_entropy",
+    "cross_entropy_lambda": "cross_entropy_lambda", "xentlambda": "cross_entropy_lambda",
+    "kullback_leibler": "kldiv", "kldiv": "kldiv",
+    "none": "none", "null": "none", "custom": "none", "na": "none",
+}
+
+
+def _parse_bool(v: Any) -> bool:
+    if isinstance(v, bool):
+        return v
+    if isinstance(v, (int, float)):
+        return bool(v)
+    s = str(v).strip().lower()
+    if s in ("true", "1", "+", "yes", "y", "on"):
+        return True
+    if s in ("false", "0", "-", "no", "n", "off"):
+        return False
+    Log.fatal("Cannot parse '%s' as bool" % (v,))
+
+
+def _parse_vector(v: Any, elem) -> list:
+    if v is None or v == "":
+        return []
+    if isinstance(v, (list, tuple)):
+        return [elem(x) for x in v]
+    return [elem(x) for x in str(v).replace(",", " ").split()]
+
+
+def kv2map(args: List[str]) -> Dict[str, str]:
+    """Parse CLI-style 'key=value' tokens (reference Config::KV2Map, config.h:79)."""
+    out: Dict[str, str] = {}
+    for arg in args:
+        arg = arg.strip()
+        if not arg or arg.startswith("#"):
+            continue
+        if "=" not in arg:
+            Log.warning("Unknown parameter format '%s', ignored", arg)
+            continue
+        k, v = arg.split("=", 1)
+        k, v = k.strip(), v.split("#", 1)[0].strip()
+        if k in out and out[k] != v:
+            Log.warning("Duplicate parameter '%s': using first value '%s'", k, out[k])
+            continue
+        out[k] = v
+    return out
+
+
+def alias_transform(params: Dict[str, Any]) -> Dict[str, Any]:
+    """Resolve aliases to canonical names; canonical key wins over alias
+    (reference ParameterAlias::KeyAliasTransform, config.h:979)."""
+    out: Dict[str, Any] = {}
+    aliased: Dict[str, Tuple[str, Any]] = {}
+    for k, v in params.items():
+        if k in _BY_NAME:
+            out[k] = v
+        elif k in _ALIAS2NAME:
+            name = _ALIAS2NAME[k]
+            if name in aliased:
+                Log.warning("Parameter '%s' and '%s' are aliases; using '%s'",
+                            aliased[name][0], k, aliased[name][0])
+            else:
+                aliased[name] = (k, v)
+        else:
+            # unknown keys are kept verbatim (reference passes them through too)
+            out[k] = v
+    for name, (_, v) in aliased.items():
+        if name not in out:
+            out[name] = v
+    return out
+
+
+class Config:
+    """Typed parameter bag with LightGBM semantics.
+
+    Construct from a dict (Python API) or list of "k=v" strings (CLI). Unknown
+    keys are stored in `extra` and carried along untouched.
+    """
+
+    def __init__(self, params: Optional[Dict[str, Any]] = None, **kwargs):
+        merged = dict(params or {})
+        merged.update(kwargs)
+        merged = alias_transform(merged)
+        self.extra: Dict[str, Any] = {}
+        for p in PARAMS:
+            setattr(self, p.name, self._coerce(p, merged.get(p.name, p.default)))
+        for k, v in merged.items():
+            if k not in _BY_NAME:
+                self.extra[k] = v
+        self._post_process(merged)
+
+    # -- parsing -----------------------------------------------------------
+    def _coerce(self, p: _P, v: Any) -> Any:
+        if v is None and p.type != "opt_int":
+            v = p.default
+        try:
+            if p.type is bool:
+                v = _parse_bool(v)
+            elif p.type is int:
+                v = int(float(v))
+            elif p.type is float:
+                v = float(v)
+            elif p.type is str:
+                v = str(v)
+            elif p.type == "opt_int":
+                v = None if v in (None, "", "None") else int(float(v))
+            elif p.type == "vint":
+                v = _parse_vector(v, lambda x: int(float(x)))
+            elif p.type == "vdouble":
+                v = _parse_vector(v, float)
+            elif p.type == "vstr":
+                v = _parse_vector(v, str) if not isinstance(v, (list, tuple)) \
+                    else [str(x) for x in v]
+        except (TypeError, ValueError):
+            Log.fatal("Cannot parse parameter %s=%r" % (p.name, v))
+        if p.lo is not None and isinstance(v, (int, float)):
+            if (p.lo_excl and v <= p.lo) or (not p.lo_excl and v < p.lo):
+                Log.fatal("Parameter %s should be %s %s, got %s"
+                          % (p.name, ">" if p.lo_excl else ">=", p.lo, v))
+        if p.hi is not None and isinstance(v, (int, float)) and v > p.hi:
+            Log.fatal("Parameter %s should be <= %s, got %s" % (p.name, p.hi, v))
+        return v
+
+    def _post_process(self, merged: Dict[str, Any]) -> None:
+        # objective/boosting/metric canonicalization
+        obj = str(self.objective).lower()
+        if obj in _OBJECTIVE_ALIASES:
+            self.objective = _OBJECTIVE_ALIASES[obj]
+        booster = str(self.boosting).lower()
+        _boost_alias = {"gbdt": "gbdt", "gbrt": "gbdt", "gbm": "gbdt",
+                        "dart": "dart", "goss": "goss",
+                        "rf": "rf", "random_forest": "rf"}
+        if booster in _boost_alias:
+            self.boosting = _boost_alias[booster]
+        metrics = []
+        for m in self.metric:
+            ml = str(m).strip().lower()
+            if ml == "":
+                continue
+            metrics.append(_METRIC_ALIASES.get(ml, ml))
+        # dedupe keeping order
+        seen = set()
+        self.metric = [m for m in metrics if not (m in seen or seen.add(m))]
+        # seed cascade (reference config.cpp: seed overrides sub-seeds)
+        if self.seed is not None:
+            self.data_random_seed = self.seed + 1
+            self.bagging_seed = self.seed + 2
+            self.drop_seed = self.seed + 3
+            self.feature_fraction_seed = self.seed + 4
+            self.extra_seed = self.seed + 5
+            self.objective_seed = self.seed + 6
+        tl = str(self.tree_learner).lower()
+        _tl_alias = {"serial": "serial",
+                     "feature": "feature", "feature_parallel": "feature",
+                     "data": "data", "data_parallel": "data",
+                     "voting": "voting", "voting_parallel": "voting"}
+        if tl not in _tl_alias:
+            Log.fatal("Unknown tree learner type %s" % tl)
+        self.tree_learner = _tl_alias[tl]
+        dev = str(self.device_type).lower()
+        if dev not in ("cpu", "gpu", "tpu"):
+            Log.fatal("Unknown device type %s" % dev)
+        self.device_type = dev
+        if self.boosting == "rf":
+            if not (self.bagging_freq > 0 and 0.0 < self.bagging_fraction < 1.0):
+                Log.fatal("Random forest needs bagging_freq > 0 and "
+                          "bagging_fraction in (0, 1)")
+
+    # -- derived flags (reference config.h:910-911) ------------------------
+    @property
+    def is_parallel(self) -> bool:
+        return self.num_machines > 1 or self.tree_learner != "serial"
+
+    @property
+    def is_data_based_parallel(self) -> bool:
+        return self.tree_learner in ("data", "voting")
+
+    # -- misc --------------------------------------------------------------
+    def to_dict(self) -> Dict[str, Any]:
+        d = {p.name: getattr(self, p.name) for p in PARAMS}
+        d.update(self.extra)
+        return d
+
+    @classmethod
+    def from_cli_args(cls, argv: List[str]) -> "Config":
+        kv = kv2map(argv)
+        if "config" in kv and kv["config"]:
+            file_kv: Dict[str, str] = {}
+            with open(kv["config"]) as f:
+                file_kv = kv2map(f.read().splitlines())
+            # CLI args take precedence over config file (application.cpp:49-82)
+            file_kv.update(kv)
+            kv = file_kv
+        return cls(kv)
+
+
+def params_to_config(params: Optional[Dict[str, Any]]) -> Config:
+    if isinstance(params, Config):
+        return params
+    return Config(params or {})

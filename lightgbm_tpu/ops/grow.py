@@ -1,0 +1,379 @@
+"""Leaf-wise (best-first) tree growing as a single jitted device loop.
+
+TPU-native equivalent of SerialTreeLearner::Train
+(src/treelearner/serial_tree_learner.cpp:149-196): repeat {pick leaf with max
+cached split gain -> partition its rows -> build smaller-child histogram ->
+larger child = parent - smaller (the subtraction trick, :290-298,:380-388) ->
+scan both children for their best splits} until num_leaves-1 splits or no
+positive gain.
+
+Key TPU design decisions (vs the reference's pointer-chasing structures):
+  * rows are never physically re-ordered: a flat [N] leaf-id vector replaces
+    DataPartition (src/treelearner/data_partition.hpp:21); the split update
+    is a masked `where`, score update is a gather of leaf values;
+  * per-leaf histograms live in one [num_leaves, total_bins, 2] HBM tensor
+    (replacing HistogramPool, feature_histogram.hpp:960) updated with
+    dynamic_update_slice inside a lax.while_loop;
+  * the partition decision reproduces DenseBin::Split semantics
+    (src/io/dense_bin.hpp:112-207): missing NaN bin / zero bin travel in the
+    default_left direction, everything else compares local_bin <= threshold;
+    rows whose bundled (EFB) group value belongs to another feature fall back
+    to this feature's most_freq_bin;
+  * monotone constraint propagation follows
+    src/treelearner/monotone_constraints.hpp:15-64 (children inherit the
+    parent's range; the split midpoint tightens one side).
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from .split import (F64, I32, K_MIN_SCORE, FeatureMeta, SplitCandidate,
+                    SplitParams, _leaf_output_unconstrained,
+                    find_best_split_numerical, fix_histogram)
+
+BOOL = jnp.bool_
+
+
+class GrowConfig(NamedTuple):
+    """Static knobs that shape the compiled program."""
+    num_leaves: int
+    total_bins: int
+    num_features: int
+    use_mc: bool
+    max_depth: int          # <=0: unlimited
+    rows_per_chunk: int     # histogram chunking; 0 = one shot
+    cat_width: int          # width of categorical bitmask (1 if no cat feats)
+
+
+class FixInfo(NamedTuple):
+    """Bundled-feature histogram repair indices (empty when no EFB bundles)."""
+    mf_global: jnp.ndarray   # [K] i32 global bin of each bundled feature's most_freq
+    start: jnp.ndarray       # [K] i32 feature global bin range start
+    end: jnp.ndarray         # [K] i32 exclusive end
+
+
+class DataLayout(NamedTuple):
+    """Device-resident binned dataset layout (built once by Dataset)."""
+    bins: jnp.ndarray           # [N, G] uint8/16/32 group-local bins
+    group_offset: jnp.ndarray   # [G] i32 global bin offset per group
+    group_of: jnp.ndarray       # [F] i32 feature -> group
+    most_freq_bin: jnp.ndarray  # [F] i32 local most_freq bin (EFB fallback)
+
+
+class TreeArrays(NamedTuple):
+    """Split records + leaf state: everything the host needs to build a Tree."""
+    num_leaves: jnp.ndarray     # scalar i32 (final)
+    split_leaf: jnp.ndarray     # [L-1] i32 leaf index that was split
+    split_feature: jnp.ndarray  # [L-1] i32 inner feature index
+    threshold: jnp.ndarray      # [L-1] i32 local bin threshold
+    default_left: jnp.ndarray   # [L-1] bool
+    gain: jnp.ndarray           # [L-1] f64
+    is_cat: jnp.ndarray         # [L-1] bool
+    cat_mask: jnp.ndarray       # [L-1, CAT_W] bool
+    internal_value: jnp.ndarray  # [L-1] f64 (parent leaf output at split time)
+    internal_count: jnp.ndarray  # [L-1] i32
+    leaf_value: jnp.ndarray     # [L] f64
+    leaf_count: jnp.ndarray     # [L] i32
+    leaf_weight: jnp.ndarray    # [L] f64 (sum_hessian)
+    row_leaf: jnp.ndarray       # [N] i32 final leaf id per row
+
+
+class _LoopState(NamedTuple):
+    s: jnp.ndarray              # next split index (== current num_leaves)
+    done: jnp.ndarray           # bool
+    row_leaf: jnp.ndarray       # [N] i32
+    leaf_hist: jnp.ndarray      # [L, TB, 2] f32
+    leaf_sum_grad: jnp.ndarray  # [L] f64
+    leaf_sum_hess: jnp.ndarray  # [L] f64
+    leaf_count: jnp.ndarray     # [L] i32 (in-bag rows)
+    leaf_value: jnp.ndarray     # [L] f64
+    leaf_depth: jnp.ndarray     # [L] i32
+    leaf_cmin: jnp.ndarray      # [L] f64 monotone lower bound
+    leaf_cmax: jnp.ndarray      # [L] f64 monotone upper bound
+    best: SplitCandidate        # [L] pytree of per-leaf best splits
+    tree: TreeArrays
+
+
+def _hist_masked(bins, group_offset, grad, hess, mask, total_bins, rows_per_chunk,
+                 axis_name=None):
+    from .histogram import build_histogram
+    m = mask.astype(grad.dtype)
+    idx = bins.astype(I32) + group_offset[None, :]
+    h = build_histogram(idx, grad * m, hess * m, total_bins=total_bins,
+                        rows_per_chunk=rows_per_chunk)
+    if axis_name is not None:
+        h = jax.lax.psum(h, axis_name)
+    return h
+
+
+def _root_candidate_dummy(cat_width: int) -> SplitCandidate:
+    z64 = jnp.asarray(0.0, F64)
+    return SplitCandidate(
+        gain=jnp.asarray(K_MIN_SCORE, F64), feature=jnp.asarray(-1, I32),
+        threshold=jnp.asarray(0, I32), default_left=jnp.asarray(True),
+        left_output=z64, right_output=z64, left_sum_grad=z64,
+        left_sum_hess=z64, right_sum_grad=z64, right_sum_hess=z64,
+        left_count=jnp.asarray(0, I32), right_count=jnp.asarray(0, I32),
+        is_cat=jnp.asarray(False), cat_mask=jnp.zeros((cat_width,), BOOL))
+
+
+def _go_left_decision(local_bin, in_range, feat_meta_row, cand, cat_width):
+    """DenseBin::Split decision at the logical-bin level (dense_bin.hpp:112)."""
+    nb, missing_type, default_bin, most_freq = feat_meta_row
+    b = jnp.where(in_range, local_bin, most_freq)
+    cmp_left = b <= cand.threshold
+    is_na = (missing_type == 2) & (b == nb - 1)
+    is_zero = (missing_type == 1) & (b == default_bin)
+    go_default = is_na | is_zero
+    num_left = jnp.where(go_default, cand.default_left, cmp_left)
+    if cat_width > 1:
+        bc = jnp.clip(b, 0, cat_width - 1)
+        cat_left = cand.cat_mask[bc] & (b < cat_width)
+        return jnp.where(cand.is_cat, cat_left, num_left)
+    return num_left
+
+
+def _single_leaf_tree(n, L, cat_width, grad, hess, bag_mask, params, axis_name):
+    def psum(x):
+        return jax.lax.psum(x, axis_name) if axis_name is not None else x
+    sum_grad = psum(jnp.sum(grad.astype(jnp.float32), dtype=F64))
+    sum_hess = psum(jnp.sum(hess.astype(jnp.float32), dtype=F64))
+    count = psum(jnp.sum(bag_mask, dtype=I32))
+    root_out = _leaf_output_unconstrained(
+        sum_grad, sum_hess, params.lambda_l1, params.lambda_l2,
+        params.max_delta_step)
+    return TreeArrays(
+        num_leaves=jnp.asarray(1, I32),
+        split_leaf=jnp.zeros((L - 1,), I32),
+        split_feature=jnp.full((L - 1,), -1, I32),
+        threshold=jnp.zeros((L - 1,), I32),
+        default_left=jnp.zeros((L - 1,), BOOL),
+        gain=jnp.zeros((L - 1,), F64),
+        is_cat=jnp.zeros((L - 1,), BOOL),
+        cat_mask=jnp.zeros((L - 1, cat_width), BOOL),
+        internal_value=jnp.zeros((L - 1,), F64),
+        internal_count=jnp.zeros((L - 1,), I32),
+        leaf_value=jnp.zeros((L,), F64).at[0].set(root_out),
+        leaf_count=jnp.zeros((L,), I32).at[0].set(count),
+        leaf_weight=jnp.zeros((L,), F64).at[0].set(sum_hess),
+        row_leaf=jnp.zeros((n,), I32),
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("gc", "axis_name"),
+    donate_argnums=(),
+)
+def grow_tree(layout: DataLayout, grad: jnp.ndarray, hess: jnp.ndarray,
+              bag_mask: jnp.ndarray, meta: FeatureMeta, params: SplitParams,
+              feature_mask: jnp.ndarray, fix: FixInfo, gc: GrowConfig,
+              axis_name=None) -> TreeArrays:
+    """Grow one tree. grad/hess must already include bagging/GOSS weighting
+    and be zero on padded/out-of-bag rows; bag_mask marks in-bag valid rows.
+
+    When axis_name is set, rows are sharded across that mesh axis and
+    histograms / counts are psum-reduced — this IS the data-parallel learner
+    (reference src/treelearner/data_parallel_tree_learner.cpp) expressed as
+    sharding + one collective.
+    """
+    n = layout.bins.shape[0]
+    L = gc.num_leaves
+    TB = gc.total_bins
+    F = gc.num_features
+    if F == 0 or TB == 0:
+        # no usable features: a single-leaf tree (reference warns and trains
+        # constant trees when all features are trivial)
+        return _single_leaf_tree(n, L, gc.cat_width, grad, hess, bag_mask,
+                                 params, axis_name)
+
+    grad = grad.astype(jnp.float32)
+    hess = hess.astype(jnp.float32)
+
+    def psum(x):
+        return jax.lax.psum(x, axis_name) if axis_name is not None else x
+
+    # ---- root ----------------------------------------------------------
+    root_hist = _hist_masked(layout.bins, layout.group_offset, grad, hess,
+                             bag_mask, TB, gc.rows_per_chunk, axis_name)
+    sum_grad = psum(jnp.sum(grad, dtype=F64))
+    sum_hess = psum(jnp.sum(hess, dtype=F64))
+    root_count = psum(jnp.sum(bag_mask, dtype=I32))
+    root_hist = fix_histogram(root_hist, sum_grad, sum_hess,
+                              fix.mf_global, fix.start, fix.end)
+
+    ninf = jnp.full((L,), K_MIN_SCORE, F64)
+    state = _LoopState(
+        s=jnp.asarray(1, I32),
+        done=jnp.asarray(False),
+        row_leaf=jnp.zeros((n,), I32),
+        leaf_hist=jnp.zeros((L, TB, 2), jnp.float32).at[0].set(root_hist),
+        leaf_sum_grad=jnp.zeros((L,), F64).at[0].set(sum_grad),
+        leaf_sum_hess=jnp.zeros((L,), F64).at[0].set(sum_hess),
+        leaf_count=jnp.zeros((L,), I32).at[0].set(root_count),
+        leaf_value=jnp.zeros((L,), F64),
+        leaf_depth=jnp.zeros((L,), I32),
+        leaf_cmin=jnp.full((L,), -jnp.inf, F64),
+        leaf_cmax=jnp.full((L,), jnp.inf, F64),
+        best=jax.tree.map(
+            lambda x: jnp.broadcast_to(x, (L,) + x.shape),
+            _root_candidate_dummy(gc.cat_width)),
+        tree=TreeArrays(
+            num_leaves=jnp.asarray(1, I32),
+            split_leaf=jnp.zeros((L - 1,), I32),
+            split_feature=jnp.full((L - 1,), -1, I32),
+            threshold=jnp.zeros((L - 1,), I32),
+            default_left=jnp.zeros((L - 1,), BOOL),
+            gain=jnp.zeros((L - 1,), F64),
+            is_cat=jnp.zeros((L - 1,), BOOL),
+            cat_mask=jnp.zeros((L - 1, gc.cat_width), BOOL),
+            internal_value=jnp.zeros((L - 1,), F64),
+            internal_count=jnp.zeros((L - 1,), I32),
+            leaf_value=jnp.zeros((L,), F64),
+            leaf_count=jnp.zeros((L,), I32),
+            leaf_weight=jnp.zeros((L,), F64),
+            row_leaf=jnp.zeros((n,), I32),
+        ),
+    )
+
+    def eval_leaf(hist, sg, sh, cnt, depth, cmin, cmax):
+        """Best split of a (new) leaf; -inf gain when depth-limited."""
+        cand = find_best_split_numerical(
+            hist, sg, sh, cnt, meta, params, cmin, cmax, feature_mask,
+            num_features=F, use_mc=gc.use_mc)
+        if gc.max_depth > 0:
+            blocked = depth >= gc.max_depth
+            cand = cand._replace(
+                gain=jnp.where(blocked, K_MIN_SCORE, cand.gain))
+        return cand
+
+    # root best split
+    root_cand = eval_leaf(root_hist, sum_grad, sum_hess, root_count,
+                          jnp.asarray(0, I32), state.leaf_cmin[0],
+                          state.leaf_cmax[0])
+    state = state._replace(
+        best=jax.tree.map(lambda a, v: a.at[0].set(v), state.best, root_cand))
+
+    feat_nb = meta.bin_end - meta.bin_start
+
+    def cond(st: _LoopState):
+        return (~st.done) & (st.s < L)
+
+    def body(st: _LoopState) -> _LoopState:
+        l = jnp.argmax(st.best.gain).astype(I32)   # first max = smallest leaf
+        gain = st.best.gain[l]
+        no_split = gain <= 0.0
+
+        def do_split(st: _LoopState) -> _LoopState:
+            s = st.s
+            cand = jax.tree.map(lambda a: a[l], st.best)
+            f = cand.feature
+            g = layout.group_of[f]
+            # per-row local bin of feature f (EFB fallback to most_freq)
+            col = layout.bins[:, g].astype(I32) + layout.group_offset[g]
+            in_range = (col >= meta.bin_start[f]) & (col < meta.bin_end[f])
+            local_bin = col - meta.bin_start[f]
+            go_left = _go_left_decision(
+                local_bin, in_range,
+                (feat_nb[f], meta.missing_type[f], meta.default_bin[f],
+                 layout.most_freq_bin[f]),
+                cand, gc.cat_width)
+            in_leaf = st.row_leaf == l
+            row_leaf = jnp.where(in_leaf & ~go_left, s, st.row_leaf)
+
+            in_bag = in_leaf & bag_mask
+            left_cnt = psum(jnp.sum(in_bag & go_left, dtype=I32))
+            right_cnt = psum(jnp.sum(in_bag, dtype=I32)) - left_cnt
+
+            smaller_is_left = left_cnt <= right_cnt
+            smaller_mask = in_leaf & (go_left == smaller_is_left)
+            hist_smaller = _hist_masked(
+                layout.bins, layout.group_offset, grad, hess, smaller_mask,
+                TB, gc.rows_per_chunk, axis_name)
+            sm_sum_grad = jnp.where(smaller_is_left, cand.left_sum_grad,
+                                    cand.right_sum_grad)
+            sm_sum_hess = jnp.where(smaller_is_left, cand.left_sum_hess,
+                                    cand.right_sum_hess)
+            hist_smaller = fix_histogram(hist_smaller, sm_sum_grad, sm_sum_hess,
+                                         fix.mf_global, fix.start, fix.end)
+            parent_hist = st.leaf_hist[l]
+            hist_larger = parent_hist - hist_smaller
+            hist_left = jnp.where(smaller_is_left, hist_smaller, hist_larger)
+            hist_right = jnp.where(smaller_is_left, hist_larger, hist_smaller)
+
+            depth_child = st.leaf_depth[l] + 1
+            # monotone bound propagation (monotone_constraints.hpp:15-64)
+            cmin_p, cmax_p = st.leaf_cmin[l], st.leaf_cmax[l]
+            mono = meta.monotone[f]
+            mid = (cand.left_output + cand.right_output) / 2.0
+            l_cmax = jnp.where(mono > 0, jnp.minimum(cmax_p, mid), cmax_p)
+            r_cmin = jnp.where(mono > 0, jnp.maximum(cmin_p, mid), cmin_p)
+            l_cmin = jnp.where(mono < 0, jnp.maximum(cmin_p, mid), cmin_p)
+            r_cmax = jnp.where(mono < 0, jnp.minimum(cmax_p, mid), cmax_p)
+
+            # update leaf state: left keeps id l, right gets id s
+            leaf_hist = st.leaf_hist.at[l].set(hist_left).at[s].set(hist_right)
+            leaf_sum_grad = st.leaf_sum_grad.at[l].set(cand.left_sum_grad) \
+                                            .at[s].set(cand.right_sum_grad)
+            leaf_sum_hess = st.leaf_sum_hess.at[l].set(cand.left_sum_hess) \
+                                            .at[s].set(cand.right_sum_hess)
+            leaf_count = st.leaf_count.at[l].set(left_cnt).at[s].set(right_cnt)
+            leaf_value = st.leaf_value.at[l].set(cand.left_output) \
+                                      .at[s].set(cand.right_output)
+            leaf_depth = st.leaf_depth.at[l].set(depth_child) \
+                                      .at[s].set(depth_child)
+            leaf_cmin = st.leaf_cmin.at[l].set(l_cmin).at[s].set(r_cmin)
+            leaf_cmax = st.leaf_cmax.at[l].set(l_cmax).at[s].set(r_cmax)
+
+            # evaluate children
+            cand_l = eval_leaf(hist_left, cand.left_sum_grad,
+                               cand.left_sum_hess, left_cnt, depth_child,
+                               l_cmin, l_cmax)
+            cand_r = eval_leaf(hist_right, cand.right_sum_grad,
+                               cand.right_sum_hess, right_cnt, depth_child,
+                               r_cmin, r_cmax)
+            best = jax.tree.map(
+                lambda a, vl, vr: a.at[l].set(vl).at[s].set(vr),
+                st.best, cand_l, cand_r)
+
+            k = s - 1
+            tree = st.tree._replace(
+                num_leaves=s + 1,
+                split_leaf=st.tree.split_leaf.at[k].set(l),
+                split_feature=st.tree.split_feature.at[k].set(f),
+                threshold=st.tree.threshold.at[k].set(cand.threshold),
+                default_left=st.tree.default_left.at[k].set(cand.default_left),
+                gain=st.tree.gain.at[k].set(cand.gain),
+                is_cat=st.tree.is_cat.at[k].set(cand.is_cat),
+                cat_mask=st.tree.cat_mask.at[k].set(cand.cat_mask),
+                internal_value=st.tree.internal_value.at[k].set(st.leaf_value[l]),
+                internal_count=st.tree.internal_count.at[k].set(st.leaf_count[l]),
+            )
+            return st._replace(
+                s=s + 1, row_leaf=row_leaf, leaf_hist=leaf_hist,
+                leaf_sum_grad=leaf_sum_grad, leaf_sum_hess=leaf_sum_hess,
+                leaf_count=leaf_count, leaf_value=leaf_value,
+                leaf_depth=leaf_depth, leaf_cmin=leaf_cmin,
+                leaf_cmax=leaf_cmax, best=best, tree=tree)
+
+        return jax.lax.cond(no_split,
+                            lambda st: st._replace(done=jnp.asarray(True)),
+                            do_split, st)
+
+    # root leaf output (used when the tree ends up with a single leaf)
+    root_out = _leaf_output_unconstrained(
+        sum_grad, sum_hess, params.lambda_l1, params.lambda_l2,
+        params.max_delta_step)
+    state = state._replace(leaf_value=state.leaf_value.at[0].set(root_out))
+
+    final = jax.lax.while_loop(cond, body, state)
+    return final.tree._replace(
+        leaf_value=final.leaf_value,
+        leaf_count=final.leaf_count,
+        leaf_weight=final.leaf_sum_hess,
+        row_leaf=final.row_leaf,
+    )

@@ -1,0 +1,79 @@
+"""Gradient/hessian histogram construction on device.
+
+TPU-native equivalent of the reference histogram kernels (dense col-wise
+ConstructHistogram src/io/dense_bin.hpp:72-110, row-wise
+Dataset::ConstructHistogramsMultiVal src/io/dataset.cpp:1198, and the OpenCL
+kernels src/treelearner/ocl/histogram256.cl). Instead of per-thread/private
+sub-histograms + atomics, the whole binned matrix lives in HBM as one
+[num_data, num_groups] integer array whose entries are *global* bin ids
+(group offset + in-group bin), and the histogram is a single scatter-add
+(segment-sum) producing [total_bins] grad/hess sums. Single-feature groups
+store every bin densely, so their histograms are complete by construction;
+EFB-bundled sub-features still omit their most_freq bin (the group sentinel
+takes those rows) and are repaired afterwards by ops.split.fix_histogram —
+the analog of the reference's FixHistogram (src/io/dataset.cpp:1410).
+
+The XLA path chunks rows through `lax.fori_loop` to bound the materialized
+update tensor; a Pallas kernel drop-in lives in pallas_histogram.py.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("total_bins", "rows_per_chunk"))
+def build_histogram(bins_global: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
+                    total_bins: int, rows_per_chunk: int = 0) -> jnp.ndarray:
+    """Histogram over all features at once.
+
+    Args:
+      bins_global: [N, G] int32 global bin ids (row-major, group-bundled).
+      grad, hess: [N] float32 per-row gradient/hessian (0 for masked-out rows).
+      total_bins: static total number of global bins.
+      rows_per_chunk: rows per scatter chunk; 0 = single shot.
+
+    Returns:
+      [total_bins, 2] float32: sum_grad, sum_hess per global bin.
+    """
+    n, g = bins_global.shape
+    vals = jnp.stack([grad, hess], axis=-1)  # [N, 2]
+
+    if rows_per_chunk <= 0 or rows_per_chunk >= n:
+        return _hist_one_shot(bins_global, vals, total_bins)
+
+    num_chunks = (n + rows_per_chunk - 1) // rows_per_chunk
+    pad = num_chunks * rows_per_chunk - n
+    if pad:
+        bins_global = jnp.pad(bins_global, ((0, pad), (0, 0)))
+        vals = jnp.pad(vals, ((0, pad), (0, 0)))
+    bins_c = bins_global.reshape(num_chunks, rows_per_chunk, g)
+    vals_c = vals.reshape(num_chunks, rows_per_chunk, 2)
+
+    def body(i, acc):
+        return acc + _hist_one_shot(bins_c[i], vals_c[i], total_bins)
+
+    init = jnp.zeros((total_bins, 2), dtype=jnp.float32)
+    return jax.lax.fori_loop(0, num_chunks, body, init)
+
+
+def _hist_one_shot(bins_global: jnp.ndarray, vals: jnp.ndarray,
+                   total_bins: int) -> jnp.ndarray:
+    """One scatter-add over [N, G] -> [total_bins, 2]."""
+    n, g = bins_global.shape
+    flat_idx = bins_global.reshape(-1)                       # [N*G]
+    # each row's (grad, hess) contributes to one bin per group
+    flat_vals = jnp.broadcast_to(vals[:, None, :], (n, g, 2)).reshape(-1, 2)
+    hist = jnp.zeros((total_bins, 2), dtype=jnp.float32)
+    return hist.at[flat_idx].add(flat_vals)
+
+
+def masked_histogram(bins_global: jnp.ndarray, grad: jnp.ndarray,
+                     hess: jnp.ndarray, mask: jnp.ndarray,
+                     total_bins: int, rows_per_chunk: int = 0) -> jnp.ndarray:
+    """Histogram restricted to rows where mask is True (a leaf's rows)."""
+    m = mask.astype(grad.dtype)
+    return build_histogram(bins_global, grad * m, hess * m,
+                           total_bins=total_bins, rows_per_chunk=rows_per_chunk)

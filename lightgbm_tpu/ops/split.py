@@ -1,0 +1,361 @@
+"""Best-split search over histograms, vectorized over the global bin axis.
+
+TPU-native equivalent of the reference per-feature sequential scan
+(FeatureHistogram::FindBestThresholdSequentially,
+src/treelearner/feature_histogram.hpp:770-948, and
+FindBestThresholdCategoricalInner, :263-474). The reference walks each
+feature's bins twice (REVERSE and forward) accumulating running sums; here
+both directions become segmented prefix/suffix sums over one flat
+[total_bins] axis, the validity `continue`/`break` conditions become masks
+(all break conditions are monotone along the scan so masking is exactly
+equivalent), and the argmax tie-breaking reproduces the reference's
+first-maximum semantics:
+  * REVERSE scans thresholds high->low, ties keep the highest threshold;
+  * forward beats REVERSE only on strictly greater gain
+    (feature_histogram.hpp:924);
+  * across features, equal gain keeps the smaller feature index
+    (SplitInfo::operator>, src/treelearner/split_info.hpp:126-153).
+
+Missing-value semantics (feature_histogram.hpp:141-208):
+  * MissingType::None (or num_bin<=2): single REVERSE scan, default_left=true;
+  * MissingType::Zero & num_bin>2: both scans SKIP the default (zero) bin —
+    zeros implicitly travel with the non-accumulated side;
+  * MissingType::NaN & num_bin>2: REVERSE excludes the NaN bin from the right
+    side (missing goes left), forward never accumulates it (missing goes
+    right);
+  * MissingType::NaN & num_bin<=2: single REVERSE scan, default_left=false.
+
+Gain/leaf-output math mirrors GetSplitGains / GetLeafGain /
+CalculateSplittedLeafOutput (feature_histogram.hpp:656-768) including L1
+thresholding, max_delta_step clamping, monotone-constraint clipping, the
+kEpsilon hessian adjustments (:87, :786, :848) and the count-from-hessian
+recovery Common::RoundInt(hess * cnt_factor) (:783).
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+F64 = jnp.float64
+F32 = jnp.float32
+I32 = jnp.int32
+
+# reference include/LightGBM/meta.h:51-55
+K_EPSILON = 1e-15
+K_MIN_SCORE = -jnp.inf
+
+MISSING_NONE = 0
+MISSING_ZERO = 1
+MISSING_NAN = 2
+
+
+class FeatureMeta(NamedTuple):
+    """Static per-dataset feature layout on device (analog of FeatureMetainfo,
+    feature_histogram.hpp:25-42, plus the global-bin layout)."""
+    feat_id: jnp.ndarray        # [TB] i32: feature owning each global bin
+    bin_start: jnp.ndarray      # [F] i32 global bin range start
+    bin_end: jnp.ndarray        # [F] i32 global bin range end (exclusive)
+    missing_type: jnp.ndarray   # [F] i32
+    default_bin: jnp.ndarray    # [F] i32 (local bin of value 0.0)
+    monotone: jnp.ndarray       # [F] i32 in {-1,0,1}
+    is_categorical: jnp.ndarray  # [F] bool
+    penalty: jnp.ndarray        # [F] f64 (feature_contri)
+
+
+class SplitParams(NamedTuple):
+    """Per-config scalars (jnp 0-d arrays so value changes don't recompile)."""
+    lambda_l1: jnp.ndarray
+    lambda_l2: jnp.ndarray
+    max_delta_step: jnp.ndarray
+    min_gain_to_split: jnp.ndarray
+    min_data_in_leaf: jnp.ndarray
+    min_sum_hessian_in_leaf: jnp.ndarray
+    # categorical
+    max_cat_threshold: jnp.ndarray
+    max_cat_to_onehot: jnp.ndarray
+    cat_smooth: jnp.ndarray
+    cat_l2: jnp.ndarray
+    min_data_per_group: jnp.ndarray
+
+    @classmethod
+    def from_config(cls, cfg) -> "SplitParams":
+        return cls(
+            lambda_l1=jnp.asarray(cfg.lambda_l1, F64),
+            lambda_l2=jnp.asarray(cfg.lambda_l2, F64),
+            max_delta_step=jnp.asarray(cfg.max_delta_step, F64),
+            min_gain_to_split=jnp.asarray(cfg.min_gain_to_split, F64),
+            min_data_in_leaf=jnp.asarray(cfg.min_data_in_leaf, I32),
+            min_sum_hessian_in_leaf=jnp.asarray(cfg.min_sum_hessian_in_leaf, F64),
+            max_cat_threshold=jnp.asarray(cfg.max_cat_threshold, I32),
+            max_cat_to_onehot=jnp.asarray(cfg.max_cat_to_onehot, I32),
+            cat_smooth=jnp.asarray(cfg.cat_smooth, F64),
+            cat_l2=jnp.asarray(cfg.cat_l2, F64),
+            min_data_per_group=jnp.asarray(cfg.min_data_per_group, I32),
+        )
+
+
+class SplitCandidate(NamedTuple):
+    """Best split of one leaf (analog of SplitInfo, split_info.hpp)."""
+    gain: jnp.ndarray           # f64; -inf when unsplittable
+    feature: jnp.ndarray        # i32 inner feature id; -1 when none
+    threshold: jnp.ndarray      # i32 local bin threshold (numerical)
+    default_left: jnp.ndarray   # bool
+    left_output: jnp.ndarray    # f64
+    right_output: jnp.ndarray   # f64
+    left_sum_grad: jnp.ndarray  # f64
+    left_sum_hess: jnp.ndarray  # f64
+    right_sum_grad: jnp.ndarray
+    right_sum_hess: jnp.ndarray
+    left_count: jnp.ndarray     # i32 (hessian-recovered, reference semantics)
+    right_count: jnp.ndarray    # i32
+    is_cat: jnp.ndarray         # bool
+    cat_mask: jnp.ndarray       # [CAT_W] bool over local bins going LEFT
+
+
+def _round_int(x):
+    # Common::RoundInt: int(x + 0.5)
+    return jnp.floor(x + 0.5).astype(I32)
+
+
+def _threshold_l1(s, l1):
+    # feature_histogram.hpp:659
+    return jnp.sign(s) * jnp.maximum(0.0, jnp.abs(s) - l1)
+
+
+def _leaf_output_unconstrained(g, h, l1, l2, mds):
+    # CalculateSplittedLeafOutput, feature_histogram.hpp:664-685
+    ret = -_threshold_l1(g, l1) / (h + l2)
+    clipped = jnp.sign(ret) * jnp.minimum(jnp.abs(ret), mds)
+    return jnp.where(mds > 0, clipped, ret)
+
+
+def _leaf_output(g, h, l1, l2, mds, cmin, cmax, use_mc: bool):
+    ret = _leaf_output_unconstrained(g, h, l1, l2, mds)
+    if use_mc:
+        ret = jnp.clip(ret, cmin, cmax)
+    return ret
+
+
+def _leaf_gain_given_output(g, h, l1, l2, out):
+    # feature_histogram.hpp:757-768
+    sg = _threshold_l1(g, l1)
+    return -(2.0 * sg * out + (h + l2) * out * out)
+
+
+def _leaf_gain(g, h, l1, l2, mds):
+    # feature_histogram.hpp:739-755
+    sg = _threshold_l1(g, l1)
+    plain = sg * sg / (h + l2)
+    out = _leaf_output_unconstrained(g, h, l1, l2, mds)
+    with_mds = _leaf_gain_given_output(g, h, l1, l2, out)
+    return jnp.where(mds > 0, with_mds, plain)
+
+
+def _split_gains(gl, hl, gr, hr, l1, l2, mds, cmin, cmax, mono, use_mc: bool):
+    # GetSplitGains, feature_histogram.hpp:704-737
+    if not use_mc:
+        return _leaf_gain(gl, hl, l1, l2, mds) + _leaf_gain(gr, hr, l1, l2, mds)
+    lo = _leaf_output(gl, hl, l1, l2, mds, cmin, cmax, True)
+    ro = _leaf_output(gr, hr, l1, l2, mds, cmin, cmax, True)
+    bad = ((mono > 0) & (lo > ro)) | ((mono < 0) & (lo < ro))
+    gain = (_leaf_gain_given_output(gl, hl, l1, l2, lo)
+            + _leaf_gain_given_output(gr, hr, l1, l2, ro))
+    return jnp.where(bad, 0.0, gain)
+
+
+def fix_histogram(hist, sum_grad, sum_hess, fix_mf_global, fix_start, fix_end):
+    """Reconstruct bundled features' most_freq bins from leaf totals.
+
+    TPU equivalent of Dataset::FixHistogram (src/io/dataset.cpp:1410): rows at
+    a bundled sub-feature's most frequent bin are not materialized in the
+    group column, so hist[most_freq] = leaf_total - sum(feature's other bins).
+    fix_* arrays index only the features that live in multi-feature bundles.
+    """
+    if fix_mf_global.shape[0] == 0:
+        return hist
+    c = jnp.cumsum(hist.astype(F64), axis=0)
+    zero = jnp.zeros((1, 2), F64)
+    c = jnp.concatenate([zero, c], axis=0)          # c[i] = sum hist[:i]
+    tot = c[fix_end] - c[fix_start]                 # [K, 2] per-feature totals
+    leaf_tot = jnp.stack([sum_grad, sum_hess])      # [2]
+    corrected = leaf_tot[None, :] - (tot - hist[fix_mf_global].astype(F64))
+    return hist.at[fix_mf_global].set(corrected.astype(hist.dtype))
+
+
+def _segment_cumsum(vals, feat_id, bin_start):
+    """Inclusive prefix sum within feature segments over the flat bin axis."""
+    c = jnp.cumsum(vals, axis=0)
+    # subtract the global cumsum just before each feature's first bin
+    start_idx = bin_start[feat_id]                    # [TB]
+    before = jnp.where(start_idx > 0, c[jnp.maximum(start_idx - 1, 0)], 0)
+    return c - before
+
+
+@functools.partial(jax.jit, static_argnames=("use_mc", "num_features"))
+def find_best_split_numerical(hist, sum_grad, sum_hess, num_data,
+                              meta: FeatureMeta, p: SplitParams,
+                              cmin, cmax, feature_mask,
+                              num_features: int, use_mc: bool = False):
+    """Best numerical split for one leaf over all features at once.
+
+    hist: [TB, 2] f32; sums are leaf totals (f64); num_data i32 (reference
+    semantics: in-bag count). Returns a SplitCandidate of scalars (cat fields
+    dummy). Mirrors the dispatch in FuncForNumricalL2
+    (feature_histogram.hpp:141-208) and both scan directions.
+    """
+    tb = hist.shape[0]
+    fid = meta.feat_id
+    start = meta.bin_start[fid]
+    end = meta.bin_end[fid]
+    nb = end - start
+    t_local = jnp.arange(tb, dtype=I32) - start
+    mt = meta.missing_type[fid]
+    d_local = meta.default_bin[fid]
+    mono = meta.monotone[fid].astype(F64)
+
+    sum_hess_adj = sum_hess + 2 * K_EPSILON
+    cnt_factor = num_data.astype(F64) / sum_hess_adj
+    min_data = p.min_data_in_leaf
+    min_hess = p.min_sum_hessian_in_leaf
+
+    gain_shift = _leaf_gain(sum_grad, sum_hess_adj, p.lambda_l1, p.lambda_l2,
+                            p.max_delta_step)
+    min_gain_shift = gain_shift + p.min_gain_to_split
+
+    grad_b = hist[:, 0].astype(F64)
+    hess_b = hist[:, 1].astype(F64)
+    cnt_b = _round_int(hess_b * cnt_factor)
+
+    two_scan = (nb > 2) & (mt != MISSING_NONE)
+    skip_default = two_scan & (mt == MISSING_ZERO)
+    na_as_missing = two_scan & (mt == MISSING_NAN)
+    is_na_bin = t_local == (nb - 1)
+    is_default_bin = t_local == d_local
+
+    not_cat = ~meta.is_categorical[fid]
+    fmask_b = feature_mask[fid] & not_cat
+
+    # ---------------- REVERSE scan (right accumulates from high bins) ------
+    excl_r = (na_as_missing & is_na_bin) | (skip_default & is_default_bin)
+    keep_r = (~excl_r).astype(F64)
+    gr_c = _segment_cumsum(grad_b * keep_r, fid, meta.bin_start)
+    hr_c = _segment_cumsum(hess_b * keep_r, fid, meta.bin_start)
+    cr_c = _segment_cumsum(cnt_b * (~excl_r), fid, meta.bin_start)
+    # totals per feature broadcast to bins
+    last = jnp.maximum(end - 1, 0)
+    gr_tot = gr_c[last]
+    hr_tot = hr_c[last]
+    cr_tot = cr_c[last]
+    sum_right_grad = gr_tot - gr_c
+    sum_right_hess = hr_tot - hr_c + K_EPSILON
+    right_cnt = cr_tot - cr_c
+    left_cnt = num_data - right_cnt
+    sum_left_grad = sum_grad - sum_right_grad
+    sum_left_hess = sum_hess_adj - sum_right_hess
+
+    valid_r = (t_local >= 0) & (t_local <= nb - 2 - na_as_missing.astype(I32))
+    valid_r &= ~(skip_default & (t_local == d_local - 1))
+    valid_r &= (right_cnt >= min_data) & (sum_right_hess >= min_hess)
+    valid_r &= (left_cnt >= min_data) & (sum_left_hess >= min_hess)
+    valid_r &= fmask_b
+
+    gains_r = _split_gains(sum_left_grad, sum_left_hess, sum_right_grad,
+                           sum_right_hess, p.lambda_l1, p.lambda_l2,
+                           p.max_delta_step, cmin, cmax, mono, use_mc)
+    valid_r &= gains_r > min_gain_shift
+    gains_r = jnp.where(valid_r, gains_r, K_MIN_SCORE)
+
+    # per-feature best, ties -> HIGHEST threshold (reverse scans high->low)
+    best_gain_r = jax.ops.segment_max(gains_r, fid, num_segments=num_features)
+    at_max_r = valid_r & (gains_r == best_gain_r[fid])
+    best_t_r = jax.ops.segment_max(jnp.where(at_max_r, t_local, -1), fid,
+                                   num_segments=num_features)
+
+    # ---------------- forward scan (left accumulates from low bins) --------
+    excl_f = skip_default & is_default_bin
+    keep_f = (~excl_f).astype(F64)
+    gl_c = _segment_cumsum(grad_b * keep_f, fid, meta.bin_start)
+    hl_c = _segment_cumsum(hess_b * keep_f, fid, meta.bin_start)
+    cl_c = _segment_cumsum(cnt_b * (~excl_f), fid, meta.bin_start)
+    f_left_grad = gl_c
+    f_left_hess = hl_c + K_EPSILON
+    f_left_cnt = cl_c
+    f_right_cnt = num_data - f_left_cnt
+    f_right_grad = sum_grad - f_left_grad
+    f_right_hess = sum_hess_adj - f_left_hess
+
+    valid_f = two_scan & (t_local >= 0) & (t_local <= nb - 2)
+    valid_f &= ~(skip_default & is_default_bin)
+    valid_f &= (f_left_cnt >= min_data) & (f_left_hess >= min_hess)
+    valid_f &= (f_right_cnt >= min_data) & (f_right_hess >= min_hess)
+    valid_f &= fmask_b
+
+    gains_f = _split_gains(f_left_grad, f_left_hess, f_right_grad,
+                           f_right_hess, p.lambda_l1, p.lambda_l2,
+                           p.max_delta_step, cmin, cmax, mono, use_mc)
+    valid_f &= gains_f > min_gain_shift
+    gains_f = jnp.where(valid_f, gains_f, K_MIN_SCORE)
+
+    best_gain_f = jax.ops.segment_max(gains_f, fid, num_segments=num_features)
+    at_max_f = valid_f & (gains_f == best_gain_f[fid])
+    big = jnp.iinfo(jnp.int32).max
+    best_t_f = jax.ops.segment_min(jnp.where(at_max_f, t_local, big), fid,
+                                   num_segments=num_features)
+
+    # ---------------- combine directions per feature -----------------------
+    has_r = best_t_r >= 0
+    has_f = best_t_f < big
+    best_gain_r = jnp.where(has_r, best_gain_r, K_MIN_SCORE)
+    best_gain_f = jnp.where(has_f, best_gain_f, K_MIN_SCORE)
+    use_f = best_gain_f > best_gain_r       # strict: ties keep REVERSE (:924)
+    feat_gain = jnp.where(use_f, best_gain_f, best_gain_r)
+    feat_t = jnp.where(use_f, best_t_f, best_t_r)
+    # default_left=REVERSE(:946); NaN num_bin<=2 forces false (:205)
+    f_nb = meta.bin_end - meta.bin_start
+    forced_right = (meta.missing_type == MISSING_NAN) & (f_nb <= 2)
+    feat_default_left = (~use_f) & (~forced_right)
+    feat_valid = has_r | has_f
+
+    # gain reported = best - shift, then * penalty (:89, :945)
+    feat_gain_out = jnp.where(feat_valid,
+                              (feat_gain - min_gain_shift) * meta.penalty,
+                              K_MIN_SCORE)
+
+    # ---------------- best feature (ties -> smaller index) -----------------
+    best_f = jnp.argmax(feat_gain_out)      # first max = smallest feature id
+    best_valid = feat_valid[best_f] & (feat_gain_out[best_f] > K_MIN_SCORE)
+    bt = feat_t[best_f]
+    bt_global = meta.bin_start[best_f] + bt
+    b_use_f = use_f[best_f]
+
+    # recover left sums at the chosen threshold
+    lg = jnp.where(b_use_f, gl_c[bt_global], sum_grad - (gr_tot[bt_global] - gr_c[bt_global]))
+    lh = jnp.where(b_use_f, hl_c[bt_global] + K_EPSILON,
+                   sum_hess_adj - (hr_tot[bt_global] - hr_c[bt_global] + K_EPSILON))
+    lc = jnp.where(b_use_f, cl_c[bt_global], num_data - (cr_tot[bt_global] - cr_c[bt_global]))
+    rg = sum_grad - lg
+    rh = sum_hess_adj - lh
+    rc = num_data - lc
+
+    cm_b, cx_b = (cmin, cmax) if use_mc else (-jnp.inf, jnp.inf)
+    lo = _leaf_output(lg, lh, p.lambda_l1, p.lambda_l2, p.max_delta_step,
+                      cm_b, cx_b, use_mc)
+    ro = _leaf_output(rg, rh, p.lambda_l1, p.lambda_l2, p.max_delta_step,
+                      cm_b, cx_b, use_mc)
+
+    neg = jnp.asarray(K_MIN_SCORE, F64)
+    return SplitCandidate(
+        gain=jnp.where(best_valid, feat_gain_out[best_f], neg),
+        feature=jnp.where(best_valid, best_f.astype(I32), -1),
+        threshold=jnp.where(best_valid, bt, 0),
+        default_left=jnp.where(best_valid, feat_default_left[best_f], True),
+        left_output=lo, right_output=ro,
+        left_sum_grad=lg, left_sum_hess=lh - K_EPSILON,
+        right_sum_grad=rg, right_sum_hess=rh - K_EPSILON,
+        left_count=lc.astype(I32), right_count=rc.astype(I32),
+        is_cat=jnp.asarray(False),
+        cat_mask=jnp.zeros((1,), dtype=bool),
+    )

@@ -1,0 +1,1 @@
+"""lightgbm_tpu.utils"""

@@ -1,0 +1,15 @@
+"""Test environment: 8 virtual CPU devices for sharding tests.
+
+The host image pins JAX_PLATFORMS=axon via sitecustomize (one real TPU chip
+behind a tunnel); tests must run on a virtual CPU mesh instead, so force the
+platform back to cpu before any backend is initialized.
+"""
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
