@@ -1,0 +1,22 @@
+"""Boosting drivers (src/boosting/ rebuild, TPU-native)."""
+from typing import Optional
+
+from ..utils.log import Log
+from .dart import DART
+from .gbdt import GBDT
+from .goss import GOSS
+from .rf import RF
+
+__all__ = ["GBDT", "DART", "GOSS", "RF", "create_boosting"]
+
+
+def create_boosting(boosting_type: str, input_model: Optional[str] = None):
+    """Boosting::CreateBoosting (src/boosting/boosting.cpp)."""
+    cls = {"gbdt": GBDT, "dart": DART, "goss": GOSS, "rf": RF}.get(boosting_type)
+    if cls is None:
+        Log.fatal("Unknown boosting type %s" % boosting_type)
+    booster = cls()
+    if input_model:
+        with open(input_model) as f:
+            booster.load_model_from_string(f.read())
+    return booster

@@ -1,0 +1,127 @@
+"""DART: dropouts meet multiple additive regression trees.
+
+TPU-native rebuild of src/boosting/dart.hpp. Per iteration: select dropped
+trees (DroppingTrees, dart.hpp:97-146: weighted or uniform drop, skip_drop,
+max_drop cap, xgboost_dart_mode shrinkage), subtract them from the cached
+scores, train on the modified gradients, then Normalize (dart.hpp:155-200)
+rescales dropped trees by k/(k+1) (or the xgboost variant) and fixes both
+train and valid scores. No early stopping (dart.hpp:88-95).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..utils.log import Log
+from .gbdt import GBDT
+
+
+class DART(GBDT):
+    sub_model_name = "dart"
+
+    def init(self, config, train_data, objective, training_metrics=()):
+        super().init(config, train_data, objective, training_metrics)
+        self.drop_index = []
+        self.tree_weight = []
+        self.sum_weight = 0.0
+        self._drop_rng = np.random.default_rng(config.drop_seed)
+        Log.info("Using DART")
+
+    def _compute_gradients(self):
+        # drop trees before gradients are taken (GetTrainingScore override,
+        # dart.hpp:78-86)
+        self._dropping_trees()
+        return super()._compute_gradients()
+
+    def train_one_iter(self, gradients=None, hessians=None) -> bool:
+        ret = super().train_one_iter(gradients, hessians)
+        if ret:
+            return ret
+        self._normalize()
+        if not self.config.uniform_drop:
+            self.tree_weight.append(self.shrinkage_rate)
+            self.sum_weight += self.shrinkage_rate
+        return False
+
+    def eval_and_check_early_stopping(self) -> bool:
+        # DART never early-stops (dart.hpp:88-95)
+        self.output_metric(self.iter)
+        return False
+
+    # ------------------------------------------------------------------
+    def _subtract_tree(self, model_idx: int, tree_id: int) -> None:
+        tree = self.models[model_idx]
+        tree.shrink(-1.0)
+        self.train_score.add_score_np(
+            tree.predict_binned(self.train_data), tree_id)
+
+    def _dropping_trees(self) -> None:
+        cfg = self.config
+        self.drop_index = []
+        is_skip = self._drop_rng.random() < cfg.skip_drop
+        if not is_skip:
+            drop_rate = cfg.drop_rate
+            if not cfg.uniform_drop:
+                if self.sum_weight > 0:
+                    inv_avg = len(self.tree_weight) / self.sum_weight
+                    if cfg.max_drop > 0:
+                        drop_rate = min(drop_rate,
+                                        cfg.max_drop * inv_avg / self.sum_weight)
+                    for i in range(self.iter):
+                        if self._drop_rng.random() < \
+                                drop_rate * self.tree_weight[i] * inv_avg:
+                            self.drop_index.append(self.num_init_iteration + i)
+                            if len(self.drop_index) >= cfg.max_drop:
+                                break
+            else:
+                if cfg.max_drop > 0 and self.iter > 0:
+                    drop_rate = min(drop_rate, cfg.max_drop / self.iter)
+                for i in range(self.iter):
+                    if self._drop_rng.random() < drop_rate:
+                        self.drop_index.append(self.num_init_iteration + i)
+                        if len(self.drop_index) >= cfg.max_drop:
+                            break
+        ntpi = self.num_tree_per_iteration
+        for i in self.drop_index:
+            for k in range(ntpi):
+                self._subtract_tree(i * ntpi + k, k)
+        k = len(self.drop_index)
+        if not cfg.xgboost_dart_mode:
+            self.shrinkage_rate = cfg.learning_rate / (1.0 + k)
+        else:
+            if k == 0:
+                self.shrinkage_rate = cfg.learning_rate
+            else:
+                self.shrinkage_rate = cfg.learning_rate / \
+                    (cfg.learning_rate + k)
+
+    def _normalize(self) -> None:
+        cfg = self.config
+        k = float(len(self.drop_index))
+        ntpi = self.num_tree_per_iteration
+        for i in self.drop_index:
+            for t in range(ntpi):
+                tree = self.models[i * ntpi + t]
+                if not cfg.xgboost_dart_mode:
+                    # shrink to -1/(k+1), fix valid, then to k/(k+1), fix train
+                    tree.shrink(1.0 / (k + 1.0))
+                    for su in self.valid_score:
+                        su.add_tree(tree, t)
+                    tree.shrink(-k)
+                    self.train_score.add_score_np(
+                        tree.predict_binned(self.train_data), t)
+                else:
+                    tree.shrink(self.shrinkage_rate)
+                    for su in self.valid_score:
+                        su.add_tree(tree, t)
+                    tree.shrink(-k / cfg.learning_rate)
+                    self.train_score.add_score_np(
+                        tree.predict_binned(self.train_data), t)
+            if not cfg.uniform_drop:
+                j = i - self.num_init_iteration
+                if not cfg.xgboost_dart_mode:
+                    self.sum_weight -= self.tree_weight[j] * (1.0 / (k + 1.0))
+                    self.tree_weight[j] *= k / (k + 1.0)
+                else:
+                    self.sum_weight -= self.tree_weight[j] * \
+                        (1.0 / (k + cfg.learning_rate))
+                    self.tree_weight[j] *= k / (k + cfg.learning_rate)

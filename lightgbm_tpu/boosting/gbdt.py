@@ -1,0 +1,584 @@
+"""GBDT: the boosting driver.
+
+TPU-native rebuild of src/boosting/gbdt.{h,cpp}. The per-iteration control
+flow mirrors GBDT::TrainOneIter (gbdt.cpp:338-420): BoostFromAverage (:302) ->
+objective gradients (Boosting, :152) -> Bagging (:210) -> per-class tree
+growth -> leaf renewal (serial_tree_learner.cpp:628-666) -> shrinkage ->
+score update (:459). The heavy steps (gradients, tree growth, train-score
+update) are jitted device programs; the scalar orchestration stays host-side
+Python, like the reference's C++ driver around OpenMP/GPU kernels.
+
+Model text IO follows gbdt_model_text.cpp (SaveModelToString :301,
+LoadModelFromString :385) so models interoperate with LightGBM tooling.
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..config import Config
+from ..models.tree import Tree
+from ..objectives import create_objective, parse_objective_string
+from ..treelearner import create_tree_learner
+from ..utils.log import Log
+from .score_updater import HostScoreUpdater, ScoreUpdater
+
+K_EPSILON = 1e-15
+K_MODEL_VERSION = "v3"
+
+
+class GBDT:
+    """Gradient Boosting Decision Tree driver (gbdt.h)."""
+
+    sub_model_name = "tree"
+    average_output = False
+
+    def __init__(self):
+        self.config: Optional[Config] = None
+        self.train_data = None
+        self.objective = None
+        self.models: List[Tree] = []
+        self.iter = 0
+        self.num_init_iteration = 0
+        self.num_class = 1
+        self.num_tree_per_iteration = 1
+        self.shrinkage_rate = 0.1
+        self.max_feature_idx = 0
+        self.label_idx = 0
+        self.feature_names: List[str] = []
+        self.feature_infos: List[str] = []
+        self.monotone_constraints: List[int] = []
+        self.loaded_parameter = ""
+        self.train_score: Optional[ScoreUpdater] = None
+        self.valid_score: List[HostScoreUpdater] = []
+        self.valid_metrics: List[List] = []
+        self.valid_names: List[str] = []
+        self.training_metrics: List = []
+        self.best_iter_by_metric: Dict[str, int] = {}
+        self.best_score_by_metric: Dict[str, float] = {}
+        self.evals_output: List[tuple] = []   # (iter, dataset, name, value)
+
+    # ------------------------------------------------------------------
+    def init(self, config: Config, train_data, objective,
+             training_metrics=()) -> None:
+        self.config = config
+        self.train_data = train_data
+        self.objective = objective
+        self.training_metrics = list(training_metrics)
+        self.iter = 0
+        self.num_class = int(config.num_class)
+        self.shrinkage_rate = float(config.learning_rate)
+        self.num_tree_per_iteration = (
+            objective.num_model_per_iteration if objective is not None
+            else self.num_class)
+        self.tree_learner = create_tree_learner(
+            config.tree_learner, config.device_type, config, train_data)
+        n = train_data.num_data
+        self.num_data = n
+        self.max_feature_idx = train_data.num_total_features - 1
+        self.feature_names = list(train_data.feature_names)
+        self.feature_infos = [self._feature_info(m)
+                              for m in train_data.bin_mappers]
+        self.monotone_constraints = list(config.monotone_constraints)
+        init_score = (train_data.metadata.init_score
+                      if train_data.metadata else None)
+        self.train_score = ScoreUpdater(n, self.num_tree_per_iteration,
+                                        init_score)
+        self.class_need_train = [True] * self.num_tree_per_iteration
+        if objective is not None:
+            self.class_need_train = [
+                objective.class_need_train(k)
+                for k in range(self.num_tree_per_iteration)]
+        # bagging state
+        self._bag_mask_dev = jnp.ones(n, dtype=bool)
+        self._bag_weight_dev = None   # GOSS amplification weights
+        self.bag_data_cnt = n
+        self.balanced_bagging = False
+        self._bagging_rng = np.random.default_rng(config.bagging_seed)
+        self.need_re_bagging = False
+        if (config.bagging_fraction < 1.0 and config.bagging_freq > 0):
+            self.bag_data_cnt = max(1, int(config.bagging_fraction * n))
+            self.need_re_bagging = True
+        if (config.pos_bagging_fraction < 1.0
+                or config.neg_bagging_fraction < 1.0):
+            if config.bagging_freq <= 0:
+                Log.warning("pos/neg bagging needs bagging_freq > 0")
+            else:
+                self.balanced_bagging = True
+                self.bag_data_cnt = 0  # computed at bagging time
+                self.need_re_bagging = True
+        self._grad_rows = None
+
+    @staticmethod
+    def _feature_info(mapper) -> str:
+        """Dataset::get feature_infos: [min:max] or category list."""
+        if mapper.is_trivial:
+            return "none"
+        if mapper.is_categorical:
+            return ":".join(str(c) for c in sorted(
+                c for c in mapper.bin_2_categorical if c >= 0))
+        return "[%s:%s]" % (repr(float(mapper.min_val)),
+                            repr(float(mapper.max_val)))
+
+    # ------------------------------------------------------------------
+    def add_valid_dataset(self, valid_data, valid_metrics, name="valid") -> None:
+        self.valid_score.append(
+            HostScoreUpdater(valid_data, self.num_tree_per_iteration))
+        ms = []
+        for m in valid_metrics:
+            m.init(valid_data.metadata, valid_data.num_data)
+            ms.append(m)
+        self.valid_metrics.append(ms)
+        self.valid_names.append(name)
+        # replay existing model onto the new valid scores
+        su = self.valid_score[-1]
+        for i, tree in enumerate(self.models):
+            su.add_tree(tree, i % self.num_tree_per_iteration)
+
+    # ------------------------------------------------------------------
+    def boost_from_average(self, class_id: int, update_scorer: bool) -> float:
+        """gbdt.cpp:302-336."""
+        cfg = self.config
+        if (not self.models and not self.train_score.has_init_score
+                and self.objective is not None):
+            if cfg.boost_from_average or self.train_data.num_features == 0:
+                init_score = self.objective.boost_from_score(class_id)
+                if abs(init_score) > K_EPSILON:
+                    if update_scorer:
+                        self.train_score.add_score_const(init_score, class_id)
+                        for su in self.valid_score:
+                            su.add_score_const(init_score, class_id)
+                    Log.info("Start training from score %f" % init_score)
+                    return init_score
+            elif self.objective.name in ("regression_l1", "quantile", "mape"):
+                Log.warning("Disabling boost_from_average in %s may cause the "
+                            "slow convergence" % self.objective.name)
+        return 0.0
+
+    def _compute_gradients(self):
+        """Boosting() (gbdt.cpp:152): objective grad/hess from cached score."""
+        if self.objective is None:
+            Log.fatal("No objective function provided")
+        if self.num_tree_per_iteration > 1:
+            score = self.train_score.score_matrix()
+        else:
+            score = self.train_score.score_device(0)
+        g, h = self.objective.get_gradients(score)
+        if self.num_tree_per_iteration == 1:
+            g = g.reshape(1, -1)
+            h = h.reshape(1, -1)
+        return g, h
+
+    # ------------------------------------------------------------------
+    def bagging(self, it: int) -> None:
+        """GBDT::Bagging (gbdt.cpp:210-244) as a boolean mask."""
+        cfg = self.config
+        do_bag = (self.bag_data_cnt < self.num_data or self.balanced_bagging)
+        if not ((do_bag and cfg.bagging_freq > 0
+                 and it % cfg.bagging_freq == 0) or self.need_re_bagging):
+            return
+        self.need_re_bagging = False
+        n = self.num_data
+        u = self._bagging_rng.random(n)
+        if self.balanced_bagging:
+            label = self.train_data.metadata.label
+            pos = label > 0
+            mask = np.where(pos, u < cfg.pos_bagging_fraction,
+                            u < cfg.neg_bagging_fraction)
+        else:
+            mask = u < cfg.bagging_fraction
+        self.bag_data_cnt = int(mask.sum())
+        if self.bag_data_cnt == 0:
+            mask[self._bagging_rng.integers(n)] = True
+            self.bag_data_cnt = 1
+        Log.debug("Re-bagging, using %d data to train" % self.bag_data_cnt)
+        self._bag_mask_dev = jnp.asarray(mask)
+        self._bag_weight_dev = None
+
+    # ------------------------------------------------------------------
+    def train_one_iter(self, gradients: Optional[np.ndarray] = None,
+                       hessians: Optional[np.ndarray] = None) -> bool:
+        """One boosting iteration; returns True when training should STOP
+        (no splittable leaves), mirroring gbdt.cpp:338-420."""
+        ntpi = self.num_tree_per_iteration
+        init_scores = [0.0] * ntpi
+        if gradients is None or hessians is None:
+            for k in range(ntpi):
+                init_scores[k] = self.boost_from_average(k, True)
+            g_dev, h_dev = self._compute_gradients()
+        else:
+            n = self.num_data
+            g_dev = jnp.asarray(
+                np.asarray(gradients, dtype=np.float32).reshape(ntpi, n))
+            h_dev = jnp.asarray(
+                np.asarray(hessians, dtype=np.float32).reshape(ntpi, n))
+
+        self._cur_grad_hess = (g_dev, h_dev)   # GOSS bagging reads these
+        self.bagging(self.iter)
+        bag_mask = self._bag_mask_dev
+        bagw = self._bag_weight_dev
+        should_continue = False
+        for k in range(ntpi):
+            grad = g_dev[k]
+            hess = h_dev[k]
+            if bagw is not None:
+                grad = grad * bagw
+                hess = hess * bagw
+            else:
+                m = bag_mask.astype(grad.dtype)
+                grad = grad * m
+                hess = hess * m
+
+            tree = None
+            row_leaf = None
+            if self.class_need_train[k] and self.train_data.num_features > 0:
+                tree, row_leaf = self.tree_learner.train(grad, hess, bag_mask)
+
+            if tree is not None and tree.num_leaves > 1:
+                should_continue = True
+                if (self.objective is not None
+                        and self.objective.is_renew_tree_output):
+                    self._renew_tree_output(tree, row_leaf, k)
+                tree.shrink(self.shrinkage_rate)
+                self.update_score(tree, row_leaf, k)
+                if abs(init_scores[k]) > K_EPSILON:
+                    tree.add_bias(init_scores[k])
+            else:
+                tree = Tree(1)
+                # constant tree: only once at the start (gbdt.cpp:396-411)
+                if len(self.models) < ntpi:
+                    output = 0.0
+                    if not self.class_need_train[k]:
+                        if self.objective is not None:
+                            output = self.objective.boost_from_score(k)
+                    else:
+                        output = init_scores[k]
+                    tree.leaf_value[0] = output
+                    self.train_score.add_score_const(output, k)
+                    for su in self.valid_score:
+                        su.add_score_const(output, k)
+            self.models.append(tree)
+
+        if not should_continue:
+            Log.warning("Stopped training because there are no more leaves "
+                        "that meet the split requirements")
+            if len(self.models) > ntpi:
+                del self.models[-ntpi:]
+            return True
+        self.iter += 1
+        return False
+
+    def _renew_tree_output(self, tree: Tree, row_leaf, tree_id: int) -> None:
+        """Leaf re-fit for L1-family objectives
+        (SerialTreeLearner::RenewTreeOutput, serial_tree_learner.cpp:628-666).
+        Residuals = label - current score over the leaf's in-bag rows."""
+        rl = np.asarray(row_leaf)
+        score = np.asarray(self.train_score.score_device(tree_id))
+        label = self.train_data.metadata.label
+        weight = self.train_data.metadata.weight
+        bag = np.asarray(self._bag_mask_dev)
+        obj = self.objective
+        if obj.name == "mape":
+            weight = obj.label_weight
+        for leaf in range(tree.num_leaves):
+            rows = np.nonzero((rl == leaf) & bag)[0]
+            if len(rows) == 0:
+                continue
+            w = weight[rows] if weight is not None else None
+            new_out = obj.renew_tree_output(score[rows], label[rows], w)
+            tree.set_leaf_output(leaf, new_out)
+
+    def update_score(self, tree: Tree, row_leaf, tree_id: int) -> None:
+        """gbdt.cpp:459-483: train scores via the leaf partition (device
+        gather), valid scores via binned tree walk."""
+        self.train_score.add_score_leaf(
+            tree.leaf_value[:max(tree.num_leaves, 1)], row_leaf, tree_id)
+        for su in self.valid_score:
+            su.add_tree(tree, tree_id)
+
+    def rollback_one_iter(self) -> None:
+        """gbdt.cpp:422-438."""
+        if self.iter <= 0:
+            return
+        ntpi = self.num_tree_per_iteration
+        for k in range(ntpi):
+            tree = self.models[len(self.models) - ntpi + k]
+            tree.shrink(-1.0)
+            # subtract from scores: re-walk tree
+            self.train_score.add_score_np(
+                tree.predict_binned(self.train_data), k)
+            for su in self.valid_score:
+                su.add_tree(tree, k)
+        del self.models[-ntpi:]
+        self.iter -= 1
+
+    # ------------------------------------------------------------------
+    def train(self) -> None:
+        """Full training loop (GBDT::Train, gbdt.cpp:246-265)."""
+        cfg = self.config
+        for it in range(self.iter, cfg.num_iterations):
+            finished = self.train_one_iter(None, None)
+            if not finished:
+                finished = self.eval_and_check_early_stopping()
+            if finished:
+                break
+            if (cfg.snapshot_freq > 0
+                    and (it + 1) % cfg.snapshot_freq == 0):
+                snapshot_out = cfg.output_model + ".snapshot_iter_%d" % (it + 1)
+                self.save_model_to_file(snapshot_out)
+
+    # ------------------------------------------------------------------
+    def eval_and_check_early_stopping(self) -> bool:
+        met_early_stop = self.output_metric(self.iter)
+        if met_early_stop:
+            Log.info("Early stopping at iteration %d, the best iteration "
+                     "round is %d"
+                     % (self.iter, self.iter - self.config.early_stopping_round))
+            cut = self.config.early_stopping_round * self.num_tree_per_iteration
+            del self.models[-cut:]
+        return met_early_stop
+
+    def output_metric(self, it: int) -> bool:
+        """GBDT::OutputMetric (gbdt.cpp:485-543): print/record metrics and
+        check early stopping. Returns True when early stop triggers."""
+        cfg = self.config
+        early_stopping_round = cfg.early_stopping_round
+        need_print = (it % cfg.metric_freq == 0)
+        met_early_stop = False
+        # training metrics
+        if need_print and cfg.is_provide_training_metric:
+            score = self.train_score.score_host()
+            for metric in self.training_metrics:
+                vals = metric.eval(score, self.objective)
+                for name, v in zip(metric.names, vals):
+                    Log.info("Iteration:%d, training %s : %g" % (it, name, v))
+                    self.evals_output.append((it, "training", name, v))
+        # validation metrics (whole loop skipped unless printing or early
+        # stopping needs them, gbdt.cpp:497)
+        if not (need_print or early_stopping_round > 0):
+            return False
+        for i, (su, metrics) in enumerate(zip(self.valid_score,
+                                              self.valid_metrics)):
+            score = su.score_host()
+            for j, metric in enumerate(metrics):
+                vals = metric.eval(score, self.objective)
+                factor = metric.factor_to_bigger_better
+                if need_print:
+                    for name, v in zip(metric.names, vals):
+                        Log.info("Iteration:%d, %s %s : %g"
+                                 % (it, self.valid_names[i], name, v))
+                        self.evals_output.append(
+                            (it, self.valid_names[i], name, v))
+                # early stopping compares only the metric's LAST sub-score
+                # (gbdt.cpp OutputMetric: factor * test_scores.back());
+                # first_metric_only restricts the check to metric 0 only
+                if early_stopping_round > 0 and not (
+                        cfg.first_metric_only and j > 0):
+                    key = "%s:%s" % (self.valid_names[i], metric.names[-1])
+                    cur = vals[-1] * factor
+                    if (key not in self.best_score_by_metric
+                            or cur > self.best_score_by_metric[key]):
+                        self.best_score_by_metric[key] = cur
+                        self.best_iter_by_metric[key] = it
+                    elif it - self.best_iter_by_metric[key] >= \
+                            early_stopping_round:
+                        met_early_stop = True
+        return met_early_stop
+
+    # ------------------------------------------------------------------
+    # prediction (gbdt_prediction.cpp)
+    # ------------------------------------------------------------------
+    def _used_models(self, start_iteration=0, num_iteration=-1):
+        ntpi = self.num_tree_per_iteration
+        total_iter = len(self.models) // ntpi
+        start = max(0, min(int(start_iteration), total_iter))
+        if num_iteration is not None and num_iteration > 0:
+            end = min(start + int(num_iteration), total_iter)
+        else:
+            end = total_iter
+        return self.models[start * ntpi:end * ntpi]
+
+    def predict_raw(self, X: np.ndarray, start_iteration=0,
+                    num_iteration=-1) -> np.ndarray:
+        """Raw scores [N, ntpi] (PredictRaw)."""
+        X = np.ascontiguousarray(X, dtype=np.float64)
+        n = X.shape[0]
+        ntpi = self.num_tree_per_iteration
+        out = np.zeros((n, ntpi))
+        models = self._used_models(start_iteration, num_iteration)
+        for i, tree in enumerate(models):
+            out[:, i % ntpi] += tree.predict(X)
+        if self.average_output:
+            niter = max(len(models) // ntpi, 1)
+            out /= niter
+        return out
+
+    def predict(self, X: np.ndarray, raw_score=False, start_iteration=0,
+                num_iteration=-1) -> np.ndarray:
+        raw = self.predict_raw(X, start_iteration, num_iteration)
+        if not raw_score and self.objective is not None:
+            if self.num_tree_per_iteration == 1:
+                return self.objective.convert_output(raw[:, 0])
+            return self.objective.convert_output(raw)
+        return raw[:, 0] if self.num_tree_per_iteration == 1 else raw
+
+    def predict_leaf_index(self, X: np.ndarray, start_iteration=0,
+                           num_iteration=-1) -> np.ndarray:
+        X = np.ascontiguousarray(X, dtype=np.float64)
+        models = self._used_models(start_iteration, num_iteration)
+        out = np.zeros((X.shape[0], len(models)), dtype=np.int32)
+        for i, tree in enumerate(models):
+            out[:, i] = tree.predict_leaf(X)
+        return out
+
+    # ------------------------------------------------------------------
+    def feature_importance(self, importance_type: str = "split",
+                           num_iteration: int = 0) -> np.ndarray:
+        """GBDT::FeatureImportance (gbdt_model_text.cpp:363-400)."""
+        models = self._used_models(0, num_iteration if num_iteration > 0 else -1)
+        imp = np.zeros(self.max_feature_idx + 1)
+        for tree in models:
+            ni = tree.num_leaves - 1
+            for k in range(ni):
+                if tree.split_gain[k] <= 0:
+                    continue
+                f = tree.split_feature[k]
+                if importance_type == "split":
+                    imp[f] += 1.0
+                else:
+                    imp[f] += tree.split_gain[k]
+        return imp
+
+    # ------------------------------------------------------------------
+    # model text IO (gbdt_model_text.cpp)
+    # ------------------------------------------------------------------
+    def save_model_to_string(self, start_iteration=0, num_iteration=-1) -> str:
+        buf = []
+        buf.append(self.sub_model_name)
+        buf.append("version=%s" % K_MODEL_VERSION)
+        buf.append("num_class=%d" % self.num_class)
+        buf.append("num_tree_per_iteration=%d" % self.num_tree_per_iteration)
+        buf.append("label_index=%d" % self.label_idx)
+        buf.append("max_feature_idx=%d" % self.max_feature_idx)
+        if self.objective is not None:
+            buf.append("objective=%s" % self.objective.to_string())
+        if self.average_output:
+            buf.append("average_output")
+        buf.append("feature_names=%s" % " ".join(self.feature_names))
+        if self.monotone_constraints:
+            buf.append("monotone_constraints=%s" % " ".join(
+                str(int(m)) for m in self.monotone_constraints))
+        buf.append("feature_infos=%s" % " ".join(self.feature_infos))
+
+        models = self._used_models(start_iteration, num_iteration)
+        tree_strs = []
+        for i, tree in enumerate(models):
+            tree_strs.append("Tree=%d\n%s\n" % (i, tree.to_string()))
+        buf.append("tree_sizes=%s" % " ".join(
+            str(len(s)) for s in tree_strs))
+        buf.append("")
+        text = "\n".join(buf) + "\n" + "".join(tree_strs)
+        text += "end of trees\n"
+        # feature importance block
+        imp = self.feature_importance("split")
+        pairs = [(int(imp[i]), self.feature_names[i])
+                 for i in range(len(imp)) if imp[i] > 0]
+        pairs.sort(key=lambda p: -p[0])
+        text += "\nfeature importances:\n"
+        for v, name in pairs:
+            text += "%s=%d\n" % (name, v)
+        params = self.loaded_parameter or ""
+        if self.config is not None:
+            params = json.dumps({k: v for k, v in self.config.to_dict().items()
+                                 if not callable(v)}, default=str)
+        text += "\nparameters:\n%s\nend of parameters\n" % params
+        return text
+
+    def save_model_to_file(self, filename: str, start_iteration=0,
+                           num_iteration=-1) -> None:
+        with open(filename, "w") as f:
+            f.write(self.save_model_to_string(start_iteration, num_iteration))
+
+    def load_model_from_string(self, text: str) -> None:
+        """GBDT::LoadModelFromString (gbdt_model_text.cpp:385+)."""
+        self.models = []
+        lines = text.splitlines()
+        kv: Dict[str, str] = {}
+        i = 0
+        while i < len(lines):
+            line = lines[i].strip()
+            if line.startswith("Tree="):
+                break
+            if "=" in line:
+                k, v = line.split("=", 1)
+                kv[k] = v
+            elif line:
+                kv[line] = ""
+            i += 1
+        if "num_class" not in kv:
+            Log.fatal("Model file doesn't specify the number of classes")
+        self.num_class = int(kv["num_class"])
+        self.num_tree_per_iteration = int(
+            kv.get("num_tree_per_iteration", self.num_class))
+        self.label_idx = int(kv.get("label_index", 0))
+        self.max_feature_idx = int(kv.get("max_feature_idx", 0))
+        if "average_output" in kv:
+            self.average_output = True
+        self.feature_names = kv.get("feature_names", "").split()
+        self.feature_infos = kv.get("feature_infos", "").split()
+        if "monotone_constraints" in kv:
+            self.monotone_constraints = [
+                int(x) for x in kv["monotone_constraints"].split()]
+        if "objective" in kv and kv["objective"]:
+            cfg = self.config if self.config is not None else Config({})
+            self.objective = parse_objective_string(kv["objective"], cfg)
+        # parse tree blocks
+        blocks: List[List[str]] = []
+        cur: List[str] = []
+        for line in lines[i:]:
+            if line.startswith("Tree="):
+                if cur:
+                    blocks.append(cur)
+                cur = []
+            elif line.strip() == "end of trees":
+                if cur:
+                    blocks.append(cur)
+                cur = []
+                break
+            else:
+                cur.append(line)
+        for b in blocks:
+            self.models.append(Tree.from_string("\n".join(b)))
+        self.iter = len(self.models) // max(self.num_tree_per_iteration, 1)
+        self.num_init_iteration = self.iter
+
+    # ------------------------------------------------------------------
+    def dump_model(self, start_iteration=0, num_iteration=-1) -> dict:
+        """GBDT::DumpModel JSON (gbdt_model_text.cpp:21-92)."""
+        models = self._used_models(start_iteration, num_iteration)
+        return {
+            "name": "tree",
+            "version": K_MODEL_VERSION,
+            "num_class": self.num_class,
+            "num_tree_per_iteration": self.num_tree_per_iteration,
+            "label_index": self.label_idx,
+            "max_feature_idx": self.max_feature_idx,
+            "objective": (self.objective.to_string()
+                          if self.objective else ""),
+            "average_output": self.average_output,
+            "feature_names": self.feature_names,
+            "monotone_constraints": self.monotone_constraints,
+            "tree_info": [t.to_json() for t in models],
+            "feature_importances": {
+                self.feature_names[i]: float(v)
+                for i, v in enumerate(self.feature_importance("split"))
+                if v > 0},
+        }
+
+    @property
+    def current_iteration(self) -> int:
+        return len(self.models) // max(self.num_tree_per_iteration, 1)

@@ -1,0 +1,116 @@
+"""Cached model scores per dataset.
+
+TPU-native rebuild of ScoreUpdater (src/boosting/score_updater.hpp:21-150).
+Train scores live on device as a [num_tree_per_iteration, num_data] f64
+array (the reference keeps a flat double buffer); the fast AddScore path —
+adding leaf outputs through the tree learner's partition without
+re-predicting (score_updater.hpp:84-99) — becomes a device gather of
+leaf_values[row_leaf]. Validation sets use the binned inner tree walk.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _add_leaf_gather(score_row, leaf_values, row_leaf):
+    return score_row + leaf_values[row_leaf]
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _add_const(score_row, val):
+    return score_row + val
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _mul_const(score_row, val):
+    return score_row * val
+
+
+class ScoreUpdater:
+    """Device-resident score cache for the training set."""
+
+    def __init__(self, num_data: int, num_tree_per_iteration: int,
+                 init_score: Optional[np.ndarray] = None):
+        self.num_data = num_data
+        self.ntpi = num_tree_per_iteration
+        self.has_init_score = init_score is not None
+        if init_score is not None:
+            init = np.asarray(init_score, dtype=np.float64)
+            if init.size == num_data * num_tree_per_iteration:
+                init = init.reshape(num_tree_per_iteration, num_data)
+            elif init.size == num_data:
+                init = np.tile(init.reshape(1, num_data),
+                               (num_tree_per_iteration, 1))
+            else:
+                raise ValueError("init_score size mismatch")
+            self._score = [jnp.asarray(init[k]) for k in range(self.ntpi)]
+        else:
+            self._score = [jnp.zeros(num_data, dtype=jnp.float64)
+                           for _ in range(self.ntpi)]
+
+    def add_score_const(self, val: float, tree_id: int) -> None:
+        self._score[tree_id] = _add_const(self._score[tree_id],
+                                          jnp.asarray(val, jnp.float64))
+
+    def add_score_leaf(self, leaf_values: np.ndarray, row_leaf,
+                       tree_id: int) -> None:
+        """score += leaf_values[row_leaf]; row_leaf stays on device."""
+        self._score[tree_id] = _add_leaf_gather(
+            self._score[tree_id], jnp.asarray(leaf_values), row_leaf)
+
+    def add_score_np(self, values: np.ndarray, tree_id: int) -> None:
+        self._score[tree_id] = self._score[tree_id] + jnp.asarray(
+            values, dtype=jnp.float64)
+
+    def multiply_score(self, val: float, tree_id: int) -> None:
+        self._score[tree_id] = _mul_const(self._score[tree_id],
+                                          jnp.asarray(val, jnp.float64))
+
+    def score_device(self, tree_id: int):
+        return self._score[tree_id]
+
+    def score_matrix(self):
+        """[ntpi, N] device matrix (class-major, reference layout)."""
+        return jnp.stack(self._score)
+
+    def score_host(self) -> np.ndarray:
+        """Flat [ntpi * N] numpy score, reference class-major layout."""
+        return np.concatenate([np.asarray(s) for s in self._score])
+
+
+class HostScoreUpdater:
+    """Host-side score cache for validation sets (binned tree walk)."""
+
+    def __init__(self, dataset, num_tree_per_iteration: int):
+        self.dataset = dataset
+        n = dataset.num_data
+        self.ntpi = num_tree_per_iteration
+        md = dataset.metadata
+        if md is not None and md.init_score is not None:
+            init = np.asarray(md.init_score, dtype=np.float64)
+            if init.size == n * num_tree_per_iteration:
+                self._score = init.reshape(num_tree_per_iteration, n).copy()
+            else:
+                self._score = np.tile(init.reshape(1, n),
+                                      (num_tree_per_iteration, 1))
+        else:
+            self._score = np.zeros((num_tree_per_iteration, n))
+
+    def add_tree(self, tree, tree_id: int) -> None:
+        self._score[tree_id] += tree.predict_binned(self.dataset)
+
+    def add_score_const(self, val: float, tree_id: int) -> None:
+        self._score[tree_id] += val
+
+    def multiply_score(self, val: float, tree_id: int) -> None:
+        self._score[tree_id] *= val
+
+    def score_host(self) -> np.ndarray:
+        return self._score.reshape(-1)

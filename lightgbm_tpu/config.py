@@ -164,7 +164,8 @@ PARAMS: List[_P] = [
     _P("poisson_max_delta_step", float, 0.7, lo=0.0, lo_excl=True),
     _P("tweedie_variance_power", float, 1.5, lo=1.0, hi=2.0),
     _P("max_position", int, 20, lo=1),
-    _P("lambdamart_norm", bool, True),
+    _P("lambdarank_truncation_level", int, 20, lo=1),
+    _P("lambdarank_norm", bool, True, ("lambdamart_norm",)),
     _P("label_gain", "vdouble", []),
     _P("objective_seed", int, 5),
     # ---- Metric ----
@@ -175,6 +176,7 @@ PARAMS: List[_P] = [
     _P("eval_at", "vint", [1, 2, 3, 4, 5],
        ("ndcg_eval_at", "ndcg_at", "map_eval_at", "map_at")),
     _P("multi_error_top_k", int, 1, lo=1),
+    _P("auc_mu_weights", "vdouble", []),
     # ---- Network ----
     _P("num_machines", int, 1, ("num_machine",), lo=1),
     _P("local_listen_port", int, 12400, ("local_port", "port"), lo=1),

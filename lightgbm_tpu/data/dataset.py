@@ -84,6 +84,16 @@ class Metadata:
     def num_queries(self) -> int:
         return 0 if self.query_boundaries is None else len(self.query_boundaries) - 1
 
+    @property
+    def query_weights(self) -> Optional[np.ndarray]:
+        """Mean row weight per query (Metadata::LoadQueryWeights,
+        src/io/metadata.cpp:455-469); None without weights or queries."""
+        if self.weight is None or self.query_boundaries is None:
+            return None
+        qb = self.query_boundaries
+        sums = np.add.reduceat(self.weight.astype(np.float64), qb[:-1])
+        return (sums / np.diff(qb)).astype(np.float32)
+
 
 def _sample_data(X: np.ndarray, sample_cnt: int, seed: int) -> np.ndarray:
     n = X.shape[0]

@@ -1,1 +1,5 @@
-"""lightgbm_tpu.metrics"""
+"""Evaluation metrics (src/metric/ rebuild, TPU-native)."""
+from .base import Metric, create_metric
+from . import multiclass, pointwise, rank  # noqa: F401
+
+__all__ = ["Metric", "create_metric"]

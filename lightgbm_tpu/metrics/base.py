@@ -1,0 +1,69 @@
+"""Metric interface + factory.
+
+TPU-native rebuild of the reference metric layer (include/LightGBM/metric.h,
+factory src/metric/metric.cpp:16-60). Metrics evaluate host-side over numpy
+score arrays (scores are pulled from device once per eval round); the sorted
+metrics (AUC, NDCG, MAP) match the reference's stable-sort tie semantics.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..utils.log import Log
+
+K_EPSILON = 1e-15
+
+
+class Metric:
+    """Base metric (metric.h). `eval(score, objective)` returns a list of
+    floats aligned with `names`; score is the raw model score, flat
+    class-major [num_class * num_data] for multiclass (reference layout)."""
+
+    def __init__(self, config):
+        self.config = config
+        self.num_data = 0
+        self.label: Optional[np.ndarray] = None
+        self.weight: Optional[np.ndarray] = None
+        self.sum_weights = 0.0
+
+    @property
+    def names(self) -> List[str]:
+        raise NotImplementedError
+
+    @property
+    def factor_to_bigger_better(self) -> float:
+        """-1 for losses (smaller is better), +1 for scores."""
+        return -1.0
+
+    def init(self, metadata, num_data: int) -> None:
+        self.num_data = num_data
+        self.label = metadata.label
+        self.weight = metadata.weight
+        if self.weight is None:
+            self.sum_weights = float(num_data)
+        else:
+            self.sum_weights = float(np.sum(self.weight))
+
+    def eval(self, score: np.ndarray, objective) -> List[float]:
+        raise NotImplementedError
+
+
+_REGISTRY: Dict[str, type] = {}
+
+
+def register(cls):
+    _REGISTRY[cls.metric_name] = cls
+    return cls
+
+
+def create_metric(name: str, config) -> Optional[Metric]:
+    """Metric::CreateMetric (src/metric/metric.cpp:16). None for 'none'."""
+    from . import multiclass, pointwise, rank  # noqa: F401
+    if name in ("none", "null", "custom", "na", ""):
+        return None
+    if name not in _REGISTRY:
+        Log.warning("Unknown metric type name: %s" % name)
+        return None
+    return _REGISTRY[name](config)

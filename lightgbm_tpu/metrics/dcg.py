@@ -1,0 +1,92 @@
+"""DCG / NDCG calculation utilities.
+
+TPU-native rebuild of the reference DCGCalculator (src/metric/dcg_calculator.cpp,
+include/LightGBM/metric.h:90-150): precomputed position discounts
+1/log2(2+i) and label gains 2^l - 1 (DefaultLabelGain), max-DCG at k over
+sorted labels, and vectorized per-query DCG evaluation used by both the
+lambdarank objective and the ndcg/map metrics.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..utils.log import Log
+
+# reference dcg_calculator.cpp: kMaxPosition = 10000 precomputed discounts;
+# we compute on demand but keep a generous cache.
+_DISCOUNT_CACHE = 1.0 / np.log2(2.0 + np.arange(65536, dtype=np.float64))
+
+
+def default_label_gain(max_label: int = 31) -> np.ndarray:
+    """2^i - 1 (DCGCalculator::DefaultLabelGain)."""
+    return (np.power(2.0, np.arange(max_label + 1, dtype=np.float64)) - 1.0)
+
+
+def get_discount(i):
+    """Position discount 1/log2(2+i)."""
+    return _DISCOUNT_CACHE[i]
+
+
+def check_label(label: np.ndarray, num_label_gain: int) -> None:
+    """DCGCalculator::CheckLabel: integer labels within label_gain range."""
+    li = label.astype(np.int64)
+    if np.any(np.abs(label - li) > 1e-6):
+        Log.fatal("label should be int type (met %f) for ranking task"
+                  % float(label[np.argmax(np.abs(label - li) > 1e-6)]))
+    if li.min() < 0:
+        Log.fatal("Label should be non-negative (met %d) for ranking task"
+                  % int(li.min()))
+    if li.max() >= num_label_gain:
+        Log.fatal("Label %d is not less than the number of label mappings (%d)"
+                  % (int(li.max()), num_label_gain))
+
+
+def cal_max_dcg_at_k(k: int, label: np.ndarray, label_gain: np.ndarray) -> float:
+    """Max DCG@k: labels sorted descending (DCGCalculator::CalMaxDCGAtK)."""
+    n = len(label)
+    k = min(k, n)
+    if k <= 0:
+        return 0.0
+    s = np.sort(label.astype(np.int64))[::-1][:k]
+    return float(np.sum(label_gain[s] * _DISCOUNT_CACHE[:k]))
+
+
+def cal_dcg_at_k(k: int, label: np.ndarray, score: np.ndarray,
+                 label_gain: np.ndarray) -> float:
+    """DCG@k of the score-induced ranking (DCGCalculator::CalDCGAtK).
+    Ties broken by stable sort on descending score (reference uses
+    std::stable_sort with operator>)."""
+    n = len(label)
+    k = min(k, n)
+    if k <= 0:
+        return 0.0
+    order = np.argsort(-score, kind="stable")[:k]
+    lab = label.astype(np.int64)[order]
+    return float(np.sum(label_gain[lab] * _DISCOUNT_CACHE[:k]))
+
+
+def cal_dcg_at_ks(ks: Sequence[int], label: np.ndarray, score: np.ndarray,
+                  label_gain: np.ndarray) -> List[float]:
+    order = np.argsort(-score, kind="stable")
+    lab = label.astype(np.int64)[order]
+    gains = label_gain[lab] * _DISCOUNT_CACHE[:len(lab)]
+    csum = np.cumsum(gains)
+    out = []
+    for k in ks:
+        kk = min(k, len(lab))
+        out.append(float(csum[kk - 1]) if kk > 0 else 0.0)
+    return out
+
+
+def cal_max_dcg_at_ks(ks: Sequence[int], label: np.ndarray,
+                      label_gain: np.ndarray) -> List[float]:
+    s = np.sort(label.astype(np.int64))[::-1]
+    gains = label_gain[s] * _DISCOUNT_CACHE[:len(s)]
+    csum = np.cumsum(gains)
+    out = []
+    for k in ks:
+        kk = min(k, len(s))
+        out.append(float(csum[kk - 1]) if kk > 0 else 0.0)
+    return out

@@ -1,0 +1,153 @@
+"""Multiclass metrics: multi_error, multi_logloss, auc_mu.
+
+TPU-native rebuild of src/metric/multiclass_metric.hpp. The per-row rec
+buffer + ConvertOutput loop (:37-109) becomes a [N, K] matrix op; auc_mu
+(:183-294) keeps the reference's pairwise-hyperplane algorithm with its
+exact tie handling.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..utils.log import Log
+from .base import K_EPSILON, Metric, register
+
+
+class _MulticlassMetric(Metric):
+    metric_name = ""
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.num_class = int(config.num_class)
+
+    @property
+    def names(self):
+        return [self.metric_name]
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        li = self.label.astype(np.int64)
+        if li.min() < 0 or li.max() >= self.num_class:
+            Log.fatal("Label must be in [0, %d) for metric %s"
+                      % (self.num_class, self.metric_name))
+        self._label_int = li
+
+    def _scores_nk(self, score, objective):
+        """flat class-major [K*N] -> per-row [N, K], converted."""
+        nk = score.reshape(self.num_class, self.num_data).T  # [N, K]
+        if objective is not None:
+            nk = objective.convert_output(nk)
+        return nk
+
+    def loss(self, label_int, probs_nk):
+        raise NotImplementedError
+
+    def eval(self, score, objective):
+        nk = self._scores_nk(score, objective)
+        pt = self.loss(self._label_int, nk)
+        if self.weight is not None:
+            s = float(np.sum(pt * self.weight))
+        else:
+            s = float(np.sum(pt))
+        return [s / self.sum_weights]
+
+
+@register
+class MultiErrorMetric(_MulticlassMetric):
+    metric_name = "multi_error"
+
+    @property
+    def names(self):
+        k = self.config.multi_error_top_k
+        return ["multi_error" if k == 1 else "multi_error@%d" % k]
+
+    def loss(self, label_int, probs_nk):
+        # multiclass_metric.hpp:123-132: error unless #(score >= score[label])
+        # stays within top_k
+        true_score = probs_nk[np.arange(len(label_int)), label_int]
+        num_larger = np.sum(probs_nk >= true_score[:, None], axis=1)
+        return (num_larger > self.config.multi_error_top_k).astype(np.float64)
+
+
+@register
+class MultiSoftmaxLoglossMetric(_MulticlassMetric):
+    metric_name = "multi_logloss"
+
+    def loss(self, label_int, probs_nk):
+        p = probs_nk[np.arange(len(label_int)), label_int]
+        return -np.log(np.maximum(p, K_EPSILON))
+
+
+@register
+class AucMuMetric(Metric):
+    """AUC-mu (multiclass_metric.hpp:183-294; Kleiman & Page, ICML'19)."""
+
+    metric_name = "auc_mu"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.num_class = int(config.num_class)
+        w = list(config.auc_mu_weights)
+        K = self.num_class
+        if w:
+            if len(w) != K * K:
+                Log.fatal("auc_mu_weights must have %d elements" % (K * K))
+            self.class_weights = np.asarray(w, dtype=np.float64).reshape(K, K)
+        else:
+            # default: 1 everywhere except 0 diagonal (config.cpp:310-325)
+            self.class_weights = 1.0 - np.eye(K)
+
+    @property
+    def names(self):
+        return ["auc_mu"]
+
+    @property
+    def factor_to_bigger_better(self):
+        return 1.0
+
+    def eval(self, score, objective):
+        K = self.num_class
+        N = self.num_data
+        lab = self.label.astype(np.int64)
+        scores_kn = score.reshape(K, N)
+        S = np.zeros((K, K))
+        class_sizes = np.bincount(lab, minlength=K)
+        for i in range(K):
+            for j in range(i + 1, K):
+                curr_v = self.class_weights[i] - self.class_weights[j]
+                t1 = curr_v[i] - curr_v[j]
+                sel = (lab == i) | (lab == j)
+                idx = np.nonzero(sel)[0]
+                v_a = curr_v @ scores_kn[:, idx]
+                dist = t1 * v_a
+                lab_sel = lab[idx]
+                # sort ascending by dist; ties put class j first
+                # (multiclass_metric.hpp:248-258)
+                order = np.lexsort((-lab_sel, dist))
+                d_sorted = dist[order]
+                l_sorted = lab_sel[order]
+                num_j = 0.0
+                last_j_dist = 0.0
+                num_current_j = 0.0
+                s_ij = 0.0
+                for k in range(len(order)):
+                    if l_sorted[k] == i:
+                        if abs(d_sorted[k] - last_j_dist) < K_EPSILON:
+                            s_ij += num_j - 0.5 * num_current_j
+                        else:
+                            s_ij += num_j
+                    else:
+                        num_j += 1
+                        if abs(d_sorted[k] - last_j_dist) < K_EPSILON:
+                            num_current_j += 1
+                        else:
+                            last_j_dist = d_sorted[k]
+                            num_current_j = 1
+                S[i, j] = s_ij
+        ans = 0.0
+        for i in range(K):
+            for j in range(i + 1, K):
+                denom = class_sizes[i] * class_sizes[j]
+                if denom > 0:
+                    ans += S[i, j] / denom
+        return [2.0 * ans / (K * (K - 1))]

@@ -1,0 +1,134 @@
+"""Ranking metrics: NDCG@k and MAP@k.
+
+TPU-native rebuild of src/metric/rank_metric.hpp:19-150 and
+map_metric.hpp:20-140 over the DCG utilities in metrics/dcg.py; per-query
+evaluation is host-side numpy (the reference's OpenMP-over-queries loop).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..utils.log import Log
+from .base import Metric, register
+from .dcg import (cal_dcg_at_ks, cal_max_dcg_at_ks, check_label,
+                  default_label_gain)
+
+
+def _default_eval_at(eval_at):
+    # DCGCalculator::DefaultEvalAt
+    return list(eval_at) if eval_at else [1, 2, 3, 4, 5]
+
+
+@register
+class NDCGMetric(Metric):
+    metric_name = "ndcg"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.eval_at = _default_eval_at(config.eval_at)
+        lg = list(config.label_gain)
+        self.label_gain = (np.asarray(lg, dtype=np.float64) if lg
+                           else default_label_gain())
+
+    @property
+    def names(self):
+        return ["ndcg@%d" % k for k in self.eval_at]
+
+    @property
+    def factor_to_bigger_better(self):
+        return 1.0
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        check_label(self.label, len(self.label_gain))
+        if metadata.query_boundaries is None:
+            Log.fatal("The NDCG metric requires query information")
+        self.query_boundaries = metadata.query_boundaries
+        self.num_queries = metadata.num_queries
+        self.query_weights = metadata.query_weights
+        if self.query_weights is None:
+            self.sum_query_weights = float(self.num_queries)
+        else:
+            self.sum_query_weights = float(np.sum(self.query_weights))
+        # cache inverse max DCG per query (rank_metric.hpp:57-75)
+        self.inverse_max_dcgs = np.zeros((self.num_queries, len(self.eval_at)))
+        qb = self.query_boundaries
+        for q in range(self.num_queries):
+            m = cal_max_dcg_at_ks(self.eval_at, self.label[qb[q]:qb[q + 1]],
+                                  self.label_gain)
+            for j, v in enumerate(m):
+                self.inverse_max_dcgs[q, j] = 1.0 / v if v > 0.0 else -1.0
+
+    def eval(self, score, objective):
+        qb = self.query_boundaries
+        result = np.zeros(len(self.eval_at))
+        for q in range(self.num_queries):
+            w = 1.0 if self.query_weights is None else self.query_weights[q]
+            if self.inverse_max_dcgs[q, 0] <= 0.0:
+                # all-negative query counts as NDCG = 1 (rank_metric.hpp:98)
+                result += 1.0 * w
+            else:
+                dcg = cal_dcg_at_ks(self.eval_at, self.label[qb[q]:qb[q + 1]],
+                                    score[qb[q]:qb[q + 1]], self.label_gain)
+                result += np.asarray(dcg) * self.inverse_max_dcgs[q] * w
+        return list(result / self.sum_query_weights)
+
+
+@register
+class MapMetric(Metric):
+    metric_name = "map"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.eval_at = _default_eval_at(config.eval_at)
+
+    @property
+    def names(self):
+        return ["map@%d" % k for k in self.eval_at]
+
+    @property
+    def factor_to_bigger_better(self):
+        return 1.0
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        if metadata.query_boundaries is None:
+            Log.fatal("For MAP metric, there should be query information")
+        self.query_boundaries = metadata.query_boundaries
+        self.num_queries = metadata.num_queries
+        self.query_weights = metadata.query_weights
+        if self.query_weights is None:
+            self.sum_query_weights = float(self.num_queries)
+        else:
+            self.sum_query_weights = float(np.sum(self.query_weights))
+        qb = self.query_boundaries
+        self.npos_per_query = np.array([
+            int(np.sum(self.label[qb[q]:qb[q + 1]] > 0.5))
+            for q in range(self.num_queries)])
+
+    def _map_at_ks(self, ks, npos, label, score):
+        # map_metric.hpp:74-105
+        order = np.argsort(-score, kind="stable")
+        hits = (label[order] > 0.5)
+        num_hit_cum = np.cumsum(hits)
+        ap_terms = np.where(hits, num_hit_cum / (np.arange(len(order)) + 1.0), 0.0)
+        sum_ap_cum = np.cumsum(ap_terms)
+        out = []
+        for k in ks:
+            kk = min(k, len(order))
+            if npos > 0:
+                out.append(sum_ap_cum[kk - 1] / min(npos, kk) if kk > 0 else 0.0)
+            else:
+                out.append(1.0)
+        return out
+
+    def eval(self, score, objective):
+        qb = self.query_boundaries
+        result = np.zeros(len(self.eval_at))
+        for q in range(self.num_queries):
+            w = 1.0 if self.query_weights is None else self.query_weights[q]
+            m = self._map_at_ks(self.eval_at, self.npos_per_query[q],
+                                self.label[qb[q]:qb[q + 1]],
+                                score[qb[q]:qb[q + 1]])
+            result += np.asarray(m) * w
+        return list(result / self.sum_query_weights)

@@ -1,0 +1,463 @@
+"""Learned decision tree: SoA arrays, prediction, LightGBM-format text IO.
+
+TPU-native rebuild of the reference Tree (include/LightGBM/tree.h:25,
+src/io/tree.cpp). Construction differs by design: the device grower
+(ops/grow.py) returns flat TreeArrays (one split record per step), and
+`Tree.from_grower` replays them through the same node-numbering scheme as
+Tree::Split (tree.h:430-468: internal node k is created by split k, left
+child keeps the split leaf's id, right child is new leaf k+1, encoded as
+~leaf). Prediction is vectorized numpy over all rows (the reference walks
+row-by-row, tree.h:470-510); model text matches Tree::ToString
+(src/io/tree.cpp) field-for-field so LightGBM tooling can read our models.
+
+decision_type byte layout (tree.h:19-23, 218-235): bit0 = categorical,
+bit1 = default_left, bits 2-3 = missing type (0 none / 1 zero / 2 nan).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+kCategoricalMask = 1
+kDefaultLeftMask = 2
+kZeroThreshold = 1e-35
+
+
+def _to_bitset(values) -> np.ndarray:
+    """Common::ConstructBitset: uint32 words, bit v set for each value v."""
+    values = np.asarray(values, dtype=np.int64)
+    if len(values) == 0:
+        return np.zeros(1, dtype=np.uint32)
+    nwords = int(values.max()) // 32 + 1
+    out = np.zeros(nwords, dtype=np.uint32)
+    np.bitwise_or.at(out, values // 32, (np.uint32(1) << (values % 32).astype(np.uint32)))
+    return out
+
+
+def _in_bitset(bits: np.ndarray, vals: np.ndarray) -> np.ndarray:
+    """Vectorized Common::FindInBitset over an int array."""
+    word = vals // 32
+    ok = (vals >= 0) & (word < len(bits))
+    word_safe = np.clip(word, 0, len(bits) - 1)
+    return ok & ((bits[word_safe] >> (vals % 32).astype(np.uint32)) & 1).astype(bool)
+
+
+def _fmt(x: float) -> str:
+    """Double -> shortest round-trip string (reference prints %.17g-ish)."""
+    return repr(float(x))
+
+
+def _fmt_arr(a, fmt=str) -> str:
+    return " ".join(fmt(x) for x in a)
+
+
+class Tree:
+    """One boosted tree in reference-compatible SoA form."""
+
+    def __init__(self, max_leaves: int):
+        L = max(int(max_leaves), 1)
+        self.max_leaves = L
+        self.num_leaves = 1
+        self.num_cat = 0
+        self.shrinkage = 1.0
+        # internal nodes [L-1]
+        self.split_feature_inner = np.zeros(max(L - 1, 1), dtype=np.int32)
+        self.split_feature = np.zeros(max(L - 1, 1), dtype=np.int32)
+        self.split_gain = np.zeros(max(L - 1, 1), dtype=np.float64)
+        self.threshold_in_bin = np.zeros(max(L - 1, 1), dtype=np.int32)
+        self.threshold = np.zeros(max(L - 1, 1), dtype=np.float64)
+        self.decision_type = np.zeros(max(L - 1, 1), dtype=np.int8)
+        self.left_child = np.zeros(max(L - 1, 1), dtype=np.int32)
+        self.right_child = np.zeros(max(L - 1, 1), dtype=np.int32)
+        self.internal_value = np.zeros(max(L - 1, 1), dtype=np.float64)
+        self.internal_weight = np.zeros(max(L - 1, 1), dtype=np.float64)
+        self.internal_count = np.zeros(max(L - 1, 1), dtype=np.int32)
+        # leaves [L]
+        self.leaf_value = np.zeros(L, dtype=np.float64)
+        self.leaf_weight = np.zeros(L, dtype=np.float64)
+        self.leaf_count = np.zeros(L, dtype=np.int32)
+        self.leaf_parent = np.full(L, -1, dtype=np.int32)
+        self.leaf_depth = np.zeros(L, dtype=np.int32)
+        # categorical storage
+        self.cat_boundaries = [0]
+        self.cat_threshold: List[int] = []          # uint32 words (real values)
+        self.cat_boundaries_inner = [0]
+        self.cat_threshold_inner: List[int] = []    # uint32 words (bins)
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_grower(cls, arrays, dataset, bag_counts: Optional[np.ndarray] = None
+                    ) -> "Tree":
+        """Build from ops/grow.py TreeArrays (host numpy pytree) + the
+        BinnedDataset that maps inner features/bins to real ones.
+
+        Replays Tree::Split semantics (tree.h:430-468): split k of recorded
+        leaf `l` creates internal node k; left child = ~l, right = ~(k+1).
+        """
+        n_leaves = int(arrays.num_leaves)
+        t = cls(max(n_leaves, 1))
+        t.num_leaves = n_leaves
+        for k in range(n_leaves - 1):
+            leaf = int(arrays.split_leaf[k])
+            parent = t.leaf_parent[leaf]
+            if parent >= 0:
+                if t.left_child[parent] == ~leaf:
+                    t.left_child[parent] = k
+                else:
+                    t.right_child[parent] = k
+            inner_f = int(arrays.split_feature[k])
+            real_f = dataset.used_features[inner_f]
+            mapper = dataset.bin_mappers[real_f]
+            t.split_feature_inner[k] = inner_f
+            t.split_feature[k] = real_f
+            t.split_gain[k] = float(arrays.gain[k])
+            t.left_child[k] = ~leaf
+            t.right_child[k] = ~(k + 1)
+            t.leaf_parent[leaf] = k
+            t.leaf_parent[k + 1] = k
+            t.internal_value[k] = float(arrays.internal_value[k])
+            t.internal_count[k] = int(arrays.internal_count[k])
+            dt = np.int8(0)
+            missing_type = int(mapper.missing_type)
+            if bool(arrays.is_cat[k]):
+                dt |= kCategoricalMask
+                bins = np.nonzero(np.asarray(arrays.cat_mask[k]))[0]
+                bins = bins[bins < mapper.num_bin]
+                cats = np.array([mapper.bin_2_categorical[b] for b in bins],
+                                dtype=np.int64)
+                cats = cats[cats >= 0]
+                inner_bits = _to_bitset(bins)
+                real_bits = _to_bitset(cats)
+                t.threshold_in_bin[k] = len(t.cat_boundaries_inner) - 1
+                t.threshold[k] = float(t.num_cat)
+                t.num_cat += 1
+                t.cat_boundaries.append(t.cat_boundaries[-1] + len(real_bits))
+                t.cat_threshold.extend(int(x) for x in real_bits)
+                t.cat_boundaries_inner.append(
+                    t.cat_boundaries_inner[-1] + len(inner_bits))
+                t.cat_threshold_inner.extend(int(x) for x in inner_bits)
+            else:
+                if bool(arrays.default_left[k]):
+                    dt |= kDefaultLeftMask
+                dt |= np.int8(missing_type << 2)
+                bin_thr = int(arrays.threshold[k])
+                t.threshold_in_bin[k] = bin_thr
+                t.threshold[k] = mapper.bin_to_value(bin_thr)
+            t.decision_type[k] = dt
+        lv = np.asarray(arrays.leaf_value, dtype=np.float64)[:max(n_leaves, 1)]
+        t.leaf_value[:len(lv)] = np.where(np.isnan(lv), 0.0, lv)
+        t.leaf_count[:n_leaves] = np.asarray(arrays.leaf_count)[:n_leaves]
+        t.leaf_weight[:n_leaves] = np.asarray(arrays.leaf_weight)[:n_leaves]
+        t._fill_internal_weight_and_depth()
+        return t
+
+    def _fill_internal_weight_and_depth(self) -> None:
+        """internal_weight = subtree sum-of-hessian (reference stores the
+        parent leaf's weight at split time, tree.h:456); leaf_depth via a
+        top-down walk. Reconstructed bottom-up: node k's children always
+        have index > k or are leaves, so a reverse scan suffices for weight."""
+        n = self.num_leaves
+        if n <= 1:
+            return
+        for k in range(n - 2, -1, -1):
+            lw = (self.leaf_weight[~self.left_child[k]]
+                  if self.left_child[k] < 0
+                  else self.internal_weight[self.left_child[k]])
+            rw = (self.leaf_weight[~self.right_child[k]]
+                  if self.right_child[k] < 0
+                  else self.internal_weight[self.right_child[k]])
+            self.internal_weight[k] = lw + rw
+        depth = np.zeros(n - 1, dtype=np.int32)
+        for k in range(n - 1):
+            for child in (self.left_child[k], self.right_child[k]):
+                if child >= 0:
+                    depth[child] = depth[k] + 1
+                else:
+                    self.leaf_depth[~child] = depth[k] + 1
+
+    # ------------------------------------------------------------------
+    def shrink(self, rate: float) -> None:
+        """Tree::Shrinkage (tree.h:158-170)."""
+        self.leaf_value[:self.num_leaves] *= rate
+        self.internal_value[:max(self.num_leaves - 1, 0)] *= rate
+        self.shrinkage *= rate
+
+    def add_bias(self, val: float) -> None:
+        """Tree::AddBias (tree.h:172-183)."""
+        self.leaf_value[:self.num_leaves] += val
+        self.internal_value[:max(self.num_leaves - 1, 0)] += val
+
+    def set_leaf_output(self, leaf: int, value: float) -> None:
+        self.leaf_value[leaf] = 0.0 if np.isnan(value) else value
+
+    # ------------------------------------------------------------------
+    def predict_leaf(self, X: np.ndarray) -> np.ndarray:
+        """Vectorized GetLeaf over raw feature rows [N, F] -> leaf idx [N]."""
+        n = X.shape[0]
+        if self.num_leaves <= 1:
+            return np.zeros(n, dtype=np.int32)
+        node = np.zeros(n, dtype=np.int32)
+        active = np.ones(n, dtype=bool)
+        # at most num_leaves-1 levels
+        for _ in range(self.num_leaves):
+            if not active.any():
+                break
+            nd = node[active]
+            fv = X[active, self.split_feature[nd]]
+            go_left = self._decision(fv, nd)
+            nxt = np.where(go_left, self.left_child[nd], self.right_child[nd])
+            node[active] = nxt
+            active = node >= 0
+        return (~node).astype(np.int32)
+
+    def _decision(self, fval: np.ndarray, node: np.ndarray) -> np.ndarray:
+        """Vectorized Tree::Decision (tree.h:244-332)."""
+        dt = self.decision_type[node]
+        is_cat = (dt & kCategoricalMask) != 0
+        missing_type = (dt >> 2) & 3
+        out = np.zeros(len(fval), dtype=bool)
+
+        num_m = ~is_cat
+        if num_m.any():
+            fv = fval[num_m].astype(np.float64)
+            mt = missing_type[num_m]
+            default_left = (dt[num_m] & kDefaultLeftMask) != 0
+            isnan = np.isnan(fv)
+            fv = np.where(isnan & (mt != 2), 0.0, fv)
+            is_zero = np.abs(fv) <= kZeroThreshold
+            go_default = ((mt == 1) & is_zero) | ((mt == 2) & isnan)
+            cmp = fv <= self.threshold[node[num_m]]
+            out[num_m] = np.where(go_default, default_left, cmp)
+
+        if is_cat.any():
+            fv = fval[is_cat].astype(np.float64)
+            isnan = np.isnan(fv)
+            int_fval = np.where(isnan, 0, fv).astype(np.int64)
+            res = np.zeros(int(is_cat.sum()), dtype=bool)
+            cat_idx = self.threshold[node[is_cat]].astype(np.int32)
+            for ci in np.unique(cat_idx):
+                m = cat_idx == ci
+                bits = np.asarray(
+                    self.cat_threshold[self.cat_boundaries[ci]:
+                                       self.cat_boundaries[ci + 1]],
+                    dtype=np.uint32)
+                res[m] = _in_bitset(bits, int_fval[m])
+            # NaN always goes right when missing_type==NaN; negative right
+            mt = missing_type[is_cat]
+            res = np.where(isnan & (mt == 2), False, res)
+            res = np.where(fv < 0, False, res)
+            out[is_cat] = res
+        return out
+
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        if self.num_leaves <= 1:
+            return np.full(X.shape[0], self.leaf_value[0])
+        return self.leaf_value[self.predict_leaf(X)]
+
+    # -- binned (inner) prediction: for cached-score updates -----------
+    def predict_leaf_binned(self, dataset) -> np.ndarray:
+        """Vectorized DecisionInner walk over a BinnedDataset aligned with
+        this tree's inner features (reference AddPredictionToScore path)."""
+        n = dataset.num_data
+        if self.num_leaves <= 1:
+            return np.zeros(n, dtype=np.int32)
+        ds = dataset
+        node = np.zeros(n, dtype=np.int32)
+        active = np.ones(n, dtype=bool)
+        binned = ds.binned
+        for _ in range(self.num_leaves):
+            if not active.any():
+                break
+            nd = node[active]
+            f = self.split_feature_inner[nd]
+            g = ds.group_of[f]
+            col = binned[active, g].astype(np.int64) + ds.group_offset[g]
+            in_range = (col >= ds.bin_start[f]) & (col < ds.bin_end[f])
+            local_bin = np.where(in_range, col - ds.bin_start[f],
+                                 ds.most_freq_bin[f])
+            go_left = self._decision_inner(local_bin, nd, ds)
+            node[active] = np.where(go_left, self.left_child[nd],
+                                    self.right_child[nd])
+            active = node >= 0
+        return (~node).astype(np.int32)
+
+    def _decision_inner(self, local_bin, node, ds):
+        dt = self.decision_type[node]
+        is_cat = (dt & kCategoricalMask) != 0
+        missing_type = (dt >> 2) & 3
+        f = self.split_feature_inner[node]
+        nb = ds.bin_end[f] - ds.bin_start[f]
+        default_bin = ds.default_bin[f]
+        out = np.zeros(len(local_bin), dtype=bool)
+        num_m = ~is_cat
+        if num_m.any():
+            b = local_bin[num_m]
+            mt = missing_type[num_m]
+            default_left = (dt[num_m] & kDefaultLeftMask) != 0
+            go_default = (((mt == 1) & (b == default_bin[num_m]))
+                          | ((mt == 2) & (b == nb[num_m] - 1)))
+            cmp = b <= self.threshold_in_bin[node[num_m]]
+            out[num_m] = np.where(go_default, default_left, cmp)
+        if is_cat.any():
+            res = np.zeros(int(is_cat.sum()), dtype=bool)
+            cat_idx = self.threshold_in_bin[node[is_cat]]
+            bv = local_bin[is_cat]
+            for ci in np.unique(cat_idx):
+                m = cat_idx == ci
+                bits = np.asarray(
+                    self.cat_threshold_inner[self.cat_boundaries_inner[ci]:
+                                             self.cat_boundaries_inner[ci + 1]],
+                    dtype=np.uint32)
+                res[m] = _in_bitset(bits, bv[m])
+            out[is_cat] = res
+        return out
+
+    def predict_binned(self, dataset) -> np.ndarray:
+        if self.num_leaves <= 1:
+            return np.full(dataset.num_data, self.leaf_value[0])
+        return self.leaf_value[self.predict_leaf_binned(dataset)]
+
+    # ------------------------------------------------------------------
+    def expected_value(self) -> float:
+        """Weighted mean output (used by SHAP base value)."""
+        n = self.num_leaves
+        total = float(np.sum(self.leaf_count[:n]))
+        if total <= 0:
+            return float(self.leaf_value[0])
+        return float(np.sum(self.leaf_value[:n] * self.leaf_count[:n]) / total)
+
+    def max_depth(self) -> int:
+        if self.num_leaves <= 1:
+            return 0
+        return int(self.leaf_depth[:self.num_leaves].max())
+
+    # ------------------------------------------------------------------
+    def to_string(self) -> str:
+        """Tree::ToString (src/io/tree.cpp) — byte-compatible field list."""
+        n = self.num_leaves
+        ni = max(n - 1, 0)
+        buf = []
+        buf.append("num_leaves=%d" % n)
+        buf.append("num_cat=%d" % self.num_cat)
+        buf.append("split_feature=" + _fmt_arr(self.split_feature[:ni]))
+        buf.append("split_gain=" + _fmt_arr(self.split_gain[:ni], _fmt_g))
+        buf.append("threshold=" + _fmt_arr(self.threshold[:ni], _fmt))
+        buf.append("decision_type=" + _fmt_arr(self.decision_type[:ni]))
+        buf.append("left_child=" + _fmt_arr(self.left_child[:ni]))
+        buf.append("right_child=" + _fmt_arr(self.right_child[:ni]))
+        buf.append("leaf_value=" + _fmt_arr(self.leaf_value[:n], _fmt))
+        buf.append("leaf_weight=" + _fmt_arr(self.leaf_weight[:n], _fmt))
+        buf.append("leaf_count=" + _fmt_arr(self.leaf_count[:n]))
+        buf.append("internal_value=" + _fmt_arr(self.internal_value[:ni], _fmt_g))
+        buf.append("internal_weight=" + _fmt_arr(self.internal_weight[:ni], _fmt_g))
+        buf.append("internal_count=" + _fmt_arr(self.internal_count[:ni]))
+        if self.num_cat > 0:
+            buf.append("cat_boundaries=" + _fmt_arr(self.cat_boundaries))
+            buf.append("cat_threshold=" + _fmt_arr(self.cat_threshold))
+        buf.append("shrinkage=%s" % _fmt_g(self.shrinkage))
+        buf.append("")
+        return "\n".join(buf) + "\n"
+
+    @classmethod
+    def from_string(cls, text: str) -> "Tree":
+        """Parse a tree block (reference Tree::Tree(const char*, size_t*))."""
+        kv: Dict[str, str] = {}
+        for line in text.splitlines():
+            line = line.strip()
+            if not line or "=" not in line:
+                continue
+            k, v = line.split("=", 1)
+            kv[k] = v
+        n = int(kv["num_leaves"])
+        t = cls(max(n, 1))
+        t.num_leaves = n
+        t.num_cat = int(kv.get("num_cat", 0))
+        t.shrinkage = float(kv.get("shrinkage", 1.0))
+
+        def parse(key, dtype, size):
+            if size <= 0 or key not in kv or not kv[key].strip():
+                return np.zeros(max(size, 1), dtype=dtype)
+            vals = np.array(kv[key].split(), dtype=np.float64)
+            return vals.astype(dtype)
+
+        ni = n - 1
+        if ni > 0:
+            t.split_feature = parse("split_feature", np.int32, ni)
+            t.split_feature_inner = t.split_feature.copy()
+            t.split_gain = parse("split_gain", np.float64, ni)
+            t.threshold = parse("threshold", np.float64, ni)
+            t.threshold_in_bin = np.zeros(ni, dtype=np.int32)
+            t.decision_type = parse("decision_type", np.int8, ni)
+            t.left_child = parse("left_child", np.int32, ni)
+            t.right_child = parse("right_child", np.int32, ni)
+            t.internal_value = parse("internal_value", np.float64, ni)
+            t.internal_weight = parse("internal_weight", np.float64, ni)
+            t.internal_count = parse("internal_count", np.int32, ni)
+        t.leaf_value = parse("leaf_value", np.float64, n)[:max(n, 1)]
+        if "leaf_weight" in kv:
+            t.leaf_weight = parse("leaf_weight", np.float64, n)[:max(n, 1)]
+        if "leaf_count" in kv:
+            t.leaf_count = parse("leaf_count", np.int32, n)[:max(n, 1)]
+        if t.num_cat > 0:
+            t.cat_boundaries = [int(x) for x in kv["cat_boundaries"].split()]
+            t.cat_threshold = [int(x) for x in kv["cat_threshold"].split()]
+        return t
+
+    # ------------------------------------------------------------------
+    def to_json(self) -> dict:
+        """Tree::ToJSON (src/io/tree.cpp): nested node dict."""
+        out = {
+            "num_leaves": self.num_leaves,
+            "num_cat": self.num_cat,
+            "shrinkage": self.shrinkage,
+        }
+        if self.num_leaves == 1:
+            out["tree_structure"] = {"leaf_value": float(self.leaf_value[0])}
+        else:
+            out["tree_structure"] = self._node_json(0)
+        return out
+
+    def _node_json(self, index: int) -> dict:
+        if index >= 0:
+            dt = int(self.decision_type[index])
+            is_cat = bool(dt & kCategoricalMask)
+            node = {
+                "split_index": index,
+                "split_feature": int(self.split_feature[index]),
+                "split_gain": float(self.split_gain[index]),
+                "missing_type": ["None", "Zero", "NaN"][(dt >> 2) & 3],
+                "internal_value": float(self.internal_value[index]),
+                "internal_weight": float(self.internal_weight[index]),
+                "internal_count": int(self.internal_count[index]),
+            }
+            if is_cat:
+                ci = int(self.threshold[index])
+                bits = np.asarray(
+                    self.cat_threshold[self.cat_boundaries[ci]:
+                                       self.cat_boundaries[ci + 1]],
+                    dtype=np.uint32)
+                cats = [int(v) for v in range(len(bits) * 32)
+                        if bits[v // 32] >> (v % 32) & 1]
+                node["decision_type"] = "=="
+                node["threshold"] = "||".join(str(c) for c in cats)
+                node["default_left"] = False
+            else:
+                node["decision_type"] = "<="
+                node["threshold"] = float(self.threshold[index])
+                node["default_left"] = bool(dt & kDefaultLeftMask)
+            node["left_child"] = self._node_json(int(self.left_child[index]))
+            node["right_child"] = self._node_json(int(self.right_child[index]))
+            return node
+        leaf = ~index
+        return {
+            "leaf_index": leaf,
+            "leaf_value": float(self.leaf_value[leaf]),
+            "leaf_weight": float(self.leaf_weight[leaf]),
+            "leaf_count": int(self.leaf_count[leaf]),
+        }
+
+
+def _fmt_g(x) -> str:
+    """%g-style float formatting used for gains/weights."""
+    return "%g" % float(x)

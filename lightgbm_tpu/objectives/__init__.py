@@ -1,1 +1,7 @@
-"""lightgbm_tpu.objectives"""
+"""Objective functions (src/objective/ rebuild, TPU-native)."""
+from .base import (ObjectiveFunction, create_objective,
+                   parse_objective_string, percentile, weighted_percentile)
+from . import binary, multiclass, rank, regression, xentropy  # noqa: F401
+
+__all__ = ["ObjectiveFunction", "create_objective", "parse_objective_string",
+           "percentile", "weighted_percentile"]

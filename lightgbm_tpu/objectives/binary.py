@@ -1,0 +1,102 @@
+"""Binary log-loss objective.
+
+TPU-native rebuild of src/objective/binary_objective.hpp:21-221: label-
+conditional ±1 encoding and per-class weights (is_unbalance /
+scale_pos_weight, :95-105), sigmoid-scaled logistic grad/hess (:109-140)
+as one vectorized jax function, BoostFromScore prior log-odds (:143-165).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..utils.log import Log
+from .base import K_EPSILON, ObjectiveFunction, register
+
+
+@register
+class BinaryLogloss(ObjectiveFunction):
+    name = "binary"
+
+    def __init__(self, config, is_pos=None):
+        super().__init__(config)
+        self.sigmoid = float(config.sigmoid)
+        if self.sigmoid <= 0.0:
+            Log.fatal("Sigmoid parameter %f should be greater than zero"
+                      % self.sigmoid)
+        self.is_unbalance = bool(config.is_unbalance)
+        self.scale_pos_weight = float(config.scale_pos_weight)
+        if self.is_unbalance and abs(self.scale_pos_weight - 1.0) > 1e-6:
+            Log.fatal("Cannot set is_unbalance and scale_pos_weight "
+                      "at the same time")
+        self.is_pos = is_pos if is_pos is not None else (lambda y: y > 0)
+        self.need_train = True
+        self.num_pos_data = 0
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        pos_mask = self.is_pos(self.label)
+        cnt_positive = int(np.count_nonzero(pos_mask))
+        cnt_negative = num_data - cnt_positive
+        self.num_pos_data = cnt_positive
+        self.need_train = not (cnt_positive == 0 or cnt_negative == 0)
+        if not self.need_train:
+            Log.warning("Contains only one class")
+        Log.info("Number of positive: %d, number of negative: %d"
+                 % (cnt_positive, cnt_negative))
+        label_weights = [1.0, 1.0]   # [negative, positive]
+        if self.is_unbalance and cnt_positive > 0 and cnt_negative > 0:
+            if cnt_positive > cnt_negative:
+                label_weights[0] = cnt_positive / cnt_negative
+            else:
+                label_weights[1] = cnt_negative / cnt_positive
+        label_weights[1] *= self.scale_pos_weight
+        self.label_weights = label_weights
+        self._pos_mask = pos_mask
+
+    def grad_fn(self):
+        sig = self.sigmoid
+        w_neg, w_pos = self.label_weights
+        need_train = self.need_train
+
+        def fn(score, pos_mask, weight):
+            if not need_train:
+                z = jnp.zeros_like(score)
+                return z, z
+            y = jnp.where(pos_mask, 1.0, -1.0)
+            lw = jnp.where(pos_mask, w_pos, w_neg)
+            response = -y * sig / (1.0 + jnp.exp(y * sig * score))
+            abs_resp = jnp.abs(response)
+            g = response * lw
+            h = abs_resp * (sig - abs_resp) * lw
+            if weight is None:
+                return g, h
+            return g * weight, h * weight
+        return fn
+
+    def _grad_args(self):
+        weight = jnp.asarray(self.weight) if self.weight is not None else None
+        return (jnp.asarray(self._pos_mask), weight)
+
+    def boost_from_score(self, class_id):
+        pos = self._pos_mask.astype(np.float64)
+        if self.weight is not None:
+            pavg = float(np.sum(pos * self.weight) / np.sum(self.weight))
+        else:
+            pavg = float(np.mean(pos))
+        pavg = min(pavg, 1.0 - K_EPSILON)
+        pavg = max(pavg, K_EPSILON)
+        initscore = float(np.log(pavg / (1.0 - pavg)) / self.sigmoid)
+        Log.info("[%s:BoostFromScore]: pavg=%f -> initscore=%f"
+                 % (self.name, pavg, initscore))
+        return initscore
+
+    def class_need_train(self, class_id):
+        return self.need_train
+
+    def convert_output(self, raw):
+        return 1.0 / (1.0 + np.exp(-self.sigmoid * raw))
+
+    def to_string(self):
+        return "%s sigmoid:%g" % (self.name, self.sigmoid)

@@ -1,0 +1,264 @@
+"""Learning-to-rank objectives: LambdaRank-NDCG and XE-NDCG.
+
+TPU-native rebuild of src/objective/rank_objective.hpp. The reference
+parallelizes over queries with OpenMP and walks O(n^2) document pairs per
+query (LambdarankNDCG::GetGradientsForOneQuery, rank_objective.hpp:139-232);
+here queries are packed into a padded [num_queries, max_len] layout and the
+pair loop becomes a vmapped [P, P] pairwise tensor computation, chunked with
+lax.map to bound memory. XE-NDCG (rank_objective.hpp:288-352) is O(n) per
+query and is expressed with segment sums over the flat row axis — no padding.
+
+Deliberate deviations from the reference (documented for the parity tests):
+  * the 1M-entry sigmoid lookup table (:237-257) is replaced by exact
+    sigmoid evaluation — on TPU computing exp is cheaper than a 1M-gather,
+    and it is strictly more accurate;
+  * XE-NDCG's per-query Random stream (:305-312) is replaced by a
+    jax.random.PRNGKey folded with (iteration, query) so gradients stay
+    deterministic under jit.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..metrics.dcg import (cal_max_dcg_at_k, check_label, default_label_gain)
+from ..utils.log import Log
+from .base import K_EPSILON, ObjectiveFunction, register
+
+
+def _pack_queries(query_boundaries: np.ndarray):
+    """[nq+1] boundaries -> (row_index [Q, P] padded with -1, valid [Q, P])."""
+    nq = len(query_boundaries) - 1
+    counts = np.diff(query_boundaries)
+    P = int(counts.max()) if nq else 1
+    idx = np.full((nq, P), -1, dtype=np.int32)
+    for q in range(nq):
+        c = counts[q]
+        idx[q, :c] = np.arange(query_boundaries[q], query_boundaries[q + 1],
+                               dtype=np.int32)
+    return idx, (idx >= 0)
+
+
+class RankingObjective(ObjectiveFunction):
+    """Base: per-query gradient computation (rank_objective.hpp:25-94)."""
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.seed = int(config.objective_seed)
+        self.query_boundaries = None
+        self.num_queries = 0
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        if metadata.query_boundaries is None:
+            Log.fatal("Ranking tasks require query information")
+        self.query_boundaries = metadata.query_boundaries
+        self.num_queries = metadata.num_queries
+
+
+@register
+class LambdarankNDCG(RankingObjective):
+    name = "lambdarank"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.sigmoid = float(config.sigmoid)
+        self.norm = bool(config.lambdarank_norm)
+        self.truncation_level = int(config.lambdarank_truncation_level)
+        lg = list(config.label_gain)
+        self.label_gain = (np.asarray(lg, dtype=np.float64) if lg
+                           else default_label_gain())
+        if self.sigmoid <= 0.0:
+            Log.fatal("Sigmoid param %f should be greater than zero"
+                      % self.sigmoid)
+        self._chunk = 256   # queries per lax.map step
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        check_label(self.label, len(self.label_gain))
+        inv = np.zeros(self.num_queries)
+        qb = self.query_boundaries
+        for q in range(self.num_queries):
+            m = cal_max_dcg_at_k(self.truncation_level,
+                                 self.label[qb[q]:qb[q + 1]], self.label_gain)
+            inv[q] = 1.0 / m if m > 0.0 else 0.0
+        self.inverse_max_dcgs = inv
+        self._qidx, self._qvalid = _pack_queries(qb)
+
+    def grad_fn(self):
+        sigmoid = self.sigmoid
+        norm = self.norm
+        num_data = self.num_data
+        chunk = self._chunk
+
+        def one_query(scores_q, labels_q, valid_q, inv_max_dcg, gains_q,
+                      disc_from_rank):
+            """Pairwise lambdas of one padded query.
+
+            scores_q/labels_q/valid_q: [P]; returns ([P] lambdas, [P] hess).
+            Mirrors rank_objective.hpp:139-232 with masks replacing the
+            `continue` conditions.
+            """
+            P = scores_q.shape[0]
+            neg_inf = jnp.asarray(-jnp.inf, scores_q.dtype)
+            s = jnp.where(valid_q, scores_q, neg_inf)
+            order = jnp.argsort(-s, stable=True)       # positions -> row
+            rank_of = jnp.argsort(order, stable=True)  # row -> position
+            n_valid = jnp.sum(valid_q.astype(jnp.int32))
+            best_score = s[order[0]]
+            worst_score = s[order[jnp.maximum(n_valid - 1, 0)]]
+
+            # pairwise [P, P]: i = high row, j = low row
+            lab = labels_q.astype(jnp.int32)
+            gain = gains_q                        # [P] label gain per row
+            disc = disc_from_rank[rank_of]        # [P] discount per row
+            d_score = s[:, None] - s[None, :]
+            pair_valid = (valid_q[:, None] & valid_q[None, :]
+                          & (lab[:, None] > lab[None, :]))
+            dcg_gap = gain[:, None] - gain[None, :]
+            paired_disc = jnp.abs(disc[:, None] - disc[None, :])
+            delta_pair_ndcg = dcg_gap * paired_disc * inv_max_dcg
+            if norm:
+                delta_pair_ndcg = jnp.where(
+                    best_score != worst_score,
+                    delta_pair_ndcg / (0.01 + jnp.abs(d_score)),
+                    delta_pair_ndcg)
+            p_lambda = 1.0 / (1.0 + jnp.exp(d_score * sigmoid))
+            p_hess = p_lambda * (1.0 - p_lambda)
+            p_lambda = -sigmoid * delta_pair_ndcg * p_lambda
+            p_hess = sigmoid * sigmoid * delta_pair_ndcg * p_hess
+            p_lambda = jnp.where(pair_valid, p_lambda, 0.0)
+            p_hess = jnp.where(pair_valid, p_hess, 0.0)
+
+            lambdas = jnp.sum(p_lambda, axis=1) - jnp.sum(p_lambda, axis=0)
+            hess = jnp.sum(p_hess, axis=1) + jnp.sum(p_hess, axis=0)
+            sum_lambdas = -2.0 * jnp.sum(p_lambda)
+            if norm:
+                norm_factor = jnp.where(
+                    sum_lambdas > 0,
+                    jnp.log2(1 + sum_lambdas) / sum_lambdas, 1.0)
+                lambdas = lambdas * norm_factor
+                hess = hess * norm_factor
+            return lambdas, hess
+
+        def fn(score, label, weight, qidx, qvalid, inv_max_dcgs, label_gain,
+               discounts):
+            Q, P = qidx.shape
+            safe_idx = jnp.maximum(qidx, 0)
+            s_q = score[safe_idx]                       # [Q, P]
+            l_q = label[safe_idx]
+            gains_q = label_gain[l_q.astype(jnp.int32)]
+
+            def chunk_fn(args):
+                sq, lq, vq, inv, gq = args
+                return jax.vmap(one_query, in_axes=(0, 0, 0, 0, 0, None))(
+                    sq, lq, vq, inv, gq, discounts)
+
+            # chunk the query axis to bound the [chunk, P, P] intermediate
+            pad_q = (-Q) % chunk
+            def padq(x):
+                return jnp.pad(x, ((0, pad_q),) + ((0, 0),) * (x.ndim - 1))
+            sq, lq, vq, gq = padq(s_q), padq(l_q), padq(qvalid), padq(gains_q)
+            inv = jnp.pad(inv_max_dcgs, (0, pad_q))
+            nchunks = (Q + pad_q) // chunk
+            resh = lambda x: x.reshape((nchunks, chunk) + x.shape[1:])
+            lam_c, hes_c = jax.lax.map(
+                chunk_fn, (resh(sq), resh(lq), resh(vq), resh(inv), resh(gq)))
+            lam = lam_c.reshape(-1, P)[:Q]
+            hes = hes_c.reshape(-1, P)[:Q]
+
+            # scatter back to the flat row axis
+            flat_idx = safe_idx.reshape(-1)
+            ok = qvalid.reshape(-1)
+            g = jnp.zeros((num_data,), lam.dtype).at[flat_idx].add(
+                jnp.where(ok, lam.reshape(-1), 0.0))
+            h = jnp.zeros((num_data,), hes.dtype).at[flat_idx].add(
+                jnp.where(ok, hes.reshape(-1), 0.0))
+            if weight is not None:
+                g = g * weight
+                h = h * weight
+            return g.astype(jnp.float32), h.astype(jnp.float32)
+        return fn
+
+    def _grad_args(self):
+        weight = jnp.asarray(self.weight) if self.weight is not None else None
+        P = self._qidx.shape[1]
+        from ..metrics.dcg import _DISCOUNT_CACHE
+        return (jnp.asarray(self.label), weight, jnp.asarray(self._qidx),
+                jnp.asarray(self._qvalid), jnp.asarray(self.inverse_max_dcgs),
+                jnp.asarray(self.label_gain),
+                jnp.asarray(_DISCOUNT_CACHE[:P]))
+
+    def to_string(self):
+        return self.name
+
+
+@register
+class RankXENDCG(RankingObjective):
+    name = "rank_xendcg"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self._iteration = 0
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        qb = self.query_boundaries
+        # flat row -> query id for segment ops
+        qid = np.zeros(self.num_data, dtype=np.int32)
+        for q in range(self.num_queries):
+            qid[qb[q]:qb[q + 1]] = q
+        self._qid = qid
+        self._counts = np.diff(qb).astype(np.int32)
+
+    def grad_fn(self):
+        num_queries = self.num_queries
+        num_data = self.num_data
+
+        def seg_sum(x, qid):
+            return jax.ops.segment_sum(x, qid, num_segments=num_queries)
+
+        def seg_max(x, qid):
+            return jax.ops.segment_max(x, qid, num_segments=num_queries)
+
+        def fn(score, label, weight, qid, counts, key):
+            # masked softmax per query (Common::Softmax over each query)
+            mx = seg_max(score, qid)
+            e = jnp.exp(score - mx[qid])
+            rho = e / seg_sum(e, qid)[qid]
+
+            g_rand = jax.random.uniform(key, (num_data,), dtype=jnp.float64)
+            phi = jnp.power(2.0, jnp.floor(label).astype(jnp.float64)) - g_rand
+            sum_labels = jnp.maximum(K_EPSILON, seg_sum(phi, qid))
+            l1 = -phi / sum_labels[qid] + rho
+            sum_l1 = seg_sum(l1, qid)
+            l2 = (sum_l1[qid] - l1) / (1.0 - rho)
+            sum_l2 = seg_sum(l2, qid)
+            l3 = (sum_l2[qid] - l2) / (1.0 - rho)
+            lambdas_multi = l1 + rho * l2 + rho * rho * l3
+            # single-document queries: l2/l3 terms are zero (cnt<=1 branch)
+            single = (counts[qid] <= 1)
+            lambdas = jnp.where(single, l1, lambdas_multi)
+            hess = rho * (1.0 - rho)
+            if weight is not None:
+                lambdas = lambdas * weight
+                hess = hess * weight
+            return lambdas.astype(jnp.float32), hess.astype(jnp.float32)
+        return fn
+
+    def get_gradients(self, score):
+        # fresh randomization each iteration (reference draws from per-query
+        # Random streams each GetGradients call, rank_objective.hpp:305-312)
+        if getattr(self, "_jit_fn", None) is None:
+            self._jit_fn = jax.jit(self.grad_fn())
+            weight = jnp.asarray(self.weight) if self.weight is not None else None
+            self._jit_args = (jnp.asarray(self.label), weight,
+                              jnp.asarray(self._qid), jnp.asarray(self._counts))
+        key = jax.random.fold_in(jax.random.PRNGKey(self.seed), self._iteration)
+        self._iteration += 1
+        return self._jit_fn(score, *self._jit_args, key)
+
+    def to_string(self):
+        return self.name

@@ -1,0 +1,96 @@
+"""Cross-entropy objectives for continuous labels in [0, 1].
+
+TPU-native rebuild of src/objective/xentropy_objective.hpp:44-262: plain
+cross-entropy (logistic link, :77-96) and the weight-lambda
+parameterization (:185-213) as vectorized jax functions.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..utils.log import Log
+from .base import K_EPSILON, ObjectiveFunction, register
+
+
+@register
+class CrossEntropy(ObjectiveFunction):
+    name = "cross_entropy"
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        if self.label.min() < 0.0 or self.label.max() > 1.0:
+            Log.fatal("[%s]: label outside [0, 1]" % self.name)
+        if self.weight is not None:
+            if self.weight.min() < 0.0:
+                Log.fatal("[%s]: at least one weight is negative" % self.name)
+            if self.weight.sum() == 0.0:
+                Log.fatal("[%s]: sum of weights is zero" % self.name)
+
+    def grad_fn(self):
+        def fn(score, label, weight):
+            z = 1.0 / (1.0 + jnp.exp(-score))
+            g = z - label
+            h = z * (1.0 - z)
+            if weight is None:
+                return g, h
+            return g * weight, h * weight
+        return fn
+
+    def boost_from_score(self, class_id):
+        if self.weight is not None:
+            pavg = float(np.sum(self.label * self.weight) / np.sum(self.weight))
+        else:
+            pavg = float(np.mean(self.label))
+        pavg = min(max(pavg, K_EPSILON), 1.0 - K_EPSILON)
+        initscore = float(np.log(pavg / (1.0 - pavg)))
+        Log.info("[%s]: pavg = %f -> initscore = %f"
+                 % (self.name, pavg, initscore))
+        return initscore
+
+    def convert_output(self, raw):
+        return 1.0 / (1.0 + np.exp(-raw))
+
+
+@register
+class CrossEntropyLambda(ObjectiveFunction):
+    name = "cross_entropy_lambda"
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        if self.label.min() < 0.0 or self.label.max() > 1.0:
+            Log.fatal("[%s]: label outside [0, 1]" % self.name)
+        if self.weight is not None and self.weight.min() <= 0.0:
+            Log.fatal("[%s]: at least one weight is non-positive" % self.name)
+
+    def grad_fn(self):
+        def fn(score, label, weight):
+            if weight is None:
+                z = 1.0 / (1.0 + jnp.exp(-score))
+                return z - label, z * (1.0 - z)
+            epf = jnp.exp(score)
+            hhat = jnp.log1p(epf)
+            z = 1.0 - jnp.exp(-weight * hhat)
+            enf = 1.0 / epf
+            g = (1.0 - label / z) * weight / (1.0 + enf)
+            c = 1.0 / (1.0 - z)
+            d = 1.0 + epf
+            a = weight * epf / (d * d)
+            d = c - 1.0
+            b = (c / (d * d)) * (1.0 + weight * epf - c)
+            return g, a * (1.0 + label * b)
+        return fn
+
+    def boost_from_score(self, class_id):
+        if self.weight is not None:
+            havg = float(np.sum(self.label * self.weight) / np.sum(self.weight))
+        else:
+            havg = float(np.mean(self.label))
+        initscore = float(np.log(np.exp(havg) - 1.0))
+        Log.info("[%s]: havg = %f -> initscore = %f"
+                 % (self.name, havg, initscore))
+        return initscore
+
+    def convert_output(self, raw):
+        return np.log1p(np.exp(raw))

@@ -1,1 +1,12 @@
-"""lightgbm_tpu.parallel"""
+"""Distributed tree learners over a jax device mesh.
+
+TPU-native rebuild of src/treelearner/{feature,data,voting}_parallel_tree_learner.cpp
+and the src/network collectives: rows sharded over a mesh axis, histogram
+reduction via psum (the ReduceScatter at data_parallel_tree_learner.cpp:163),
+best-split argmax via the same psum'd histogram (SyncUpGlobalBestSplit,
+parallel_tree_learner.h:190, collapses to a no-op because every device scans
+identical reduced histograms).
+"""
+from .learners import DataParallelTreeLearner, create_parallel_learner
+
+__all__ = ["DataParallelTreeLearner", "create_parallel_learner"]

@@ -1,0 +1,4 @@
+"""Tree learners (src/treelearner/ rebuild, TPU-native)."""
+from .serial import SerialTreeLearner, create_tree_learner
+
+__all__ = ["SerialTreeLearner", "create_tree_learner"]

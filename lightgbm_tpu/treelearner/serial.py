@@ -1,0 +1,112 @@
+"""Serial (single-device) tree learner: host wrapper around the device grower.
+
+TPU-native rebuild of SerialTreeLearner (src/treelearner/serial_tree_learner.cpp).
+The reference's per-split loop of histogram construction / best-split scan /
+partition lives entirely on device as one jitted lax.while_loop (ops/grow.py);
+this class owns the device-resident dataset layout, per-tree column sampling
+(ColSampler, src/treelearner/col_sampler.hpp), and converts the device split
+records into a host `Tree`.
+
+The parallel learners (feature/data/voting, src/treelearner/*_parallel_*) are
+the same grower under jax.sharding — see lightgbm_tpu/parallel/.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..config import Config
+from ..models.tree import Tree
+from ..ops.grow import DataLayout, FixInfo, GrowConfig, grow_tree
+from ..ops.split import FeatureMeta, SplitParams
+from ..utils.log import Log
+
+
+class ColSampler:
+    """feature_fraction by-tree sampling (col_sampler.hpp:17-160)."""
+
+    def __init__(self, config: Config, num_features: int):
+        self.fraction = float(config.feature_fraction)
+        self.num_features = num_features
+        self.rng = np.random.default_rng(config.feature_fraction_seed)
+        if config.feature_fraction_bynode < 1.0:
+            Log.warning("feature_fraction_bynode is not yet supported on "
+                        "device_type=tpu; using by-tree sampling only")
+
+    def sample(self) -> np.ndarray:
+        if self.fraction >= 1.0:
+            return np.ones(self.num_features, dtype=bool)
+        k = max(1, int(self.num_features * self.fraction))
+        mask = np.zeros(self.num_features, dtype=bool)
+        idx = self.rng.choice(self.num_features, size=k, replace=False)
+        mask[idx] = True
+        return mask
+
+
+class SerialTreeLearner:
+    """Owns device arrays for one BinnedDataset and grows trees on it."""
+
+    def __init__(self, config: Config, dataset):
+        self.config = config
+        self.dataset = dataset
+        self.layout, self.meta = dataset.to_device(config)
+        self.fix = dataset.fix_info()
+        self.params = SplitParams.from_config(config)
+        cat_bins = dataset.bin_end[dataset.is_categorical] - \
+            dataset.bin_start[dataset.is_categorical] \
+            if dataset.num_features else np.array([], dtype=np.int32)
+        cat_width = int(cat_bins.max()) if len(cat_bins) else 1
+        use_mc = bool(np.any(dataset.monotone)) if dataset.num_features else False
+        rows_per_chunk = int(config.tpu_rows_per_chunk)
+        if rows_per_chunk <= 0:
+            # bound the one-shot scatter update tensor to ~256MB
+            g = max(1, len(dataset.groups))
+            rows_per_chunk = max(1 << 14, int(2 ** 25 / g))
+            if rows_per_chunk >= dataset.num_data:
+                rows_per_chunk = 0
+        self.grow_config = GrowConfig(
+            num_leaves=int(config.num_leaves),
+            total_bins=int(dataset.total_bins),
+            num_features=int(dataset.num_features),
+            use_mc=use_mc,
+            max_depth=int(config.max_depth),
+            rows_per_chunk=rows_per_chunk,
+            cat_width=cat_width,
+        )
+        self.col_sampler = ColSampler(config, dataset.num_features)
+        self._axis_name = None   # set by parallel learners
+
+    def train(self, grad: jnp.ndarray, hess: jnp.ndarray,
+              bag_mask: jnp.ndarray) -> Tuple[Tree, jnp.ndarray]:
+        """Grow one tree; returns (host Tree, device row->leaf array).
+
+        grad/hess must be zero outside the bag (SerialTreeLearner::Train's
+        contract is that the learner only sees in-bag rows; the masked design
+        keeps shapes static instead).
+        """
+        fmask = jnp.asarray(self.col_sampler.sample())
+        arrays = grow_tree(self.layout, grad, hess, bag_mask, self.meta,
+                           self.params, fmask, self.fix, self.grow_config,
+                           axis_name=self._axis_name)
+        import jax
+        host = jax.tree.map(np.asarray, arrays)
+        tree = Tree.from_grower(host, self.dataset)
+        return tree, arrays.row_leaf
+
+
+def create_tree_learner(learner_type: str, device_type: str, config: Config,
+                        dataset):
+    """TreeLearner::CreateTreeLearner (src/treelearner/tree_learner.cpp).
+
+    The data/feature/voting learners are sharding configurations of the same
+    device grower; until the mesh wiring lands in lightgbm_tpu/parallel they
+    fall back to serial with a warning.
+    """
+    if learner_type == "serial":
+        return SerialTreeLearner(config, dataset)
+    from ..parallel import create_parallel_learner
+    return create_parallel_learner(learner_type, config, dataset)
