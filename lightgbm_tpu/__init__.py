@@ -10,10 +10,22 @@ with ICI collectives instead of socket/MPI collectives.
 Public API mirrors the reference python-package (python-package/lightgbm):
 Dataset, Booster, train, cv, sklearn wrappers, callbacks, plotting.
 """
+import os as _os
+
 import jax as _jax
 
 # f64 leaf/gain math for reference parity (hist arrays stay f32; see ops/)
 _jax.config.update("jax_enable_x64", True)
+
+# persistent XLA compile cache: tree-grower programs are re-jitted per
+# (total_bins, num_features, num_leaves) signature; cache them across runs
+_cache_dir = _os.environ.get("LIGHTGBM_TPU_CACHE",
+                             _os.path.expanduser("~/.cache/lightgbm_tpu_xla"))
+try:
+    _jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    _jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+except Exception:  # pragma: no cover - older jax
+    pass
 
 from .utils.log import LightGBMError, Log  # noqa: E402
 from .config import Config  # noqa: E402

@@ -1,0 +1,531 @@
+"""Dataset and Booster: the user-facing core API.
+
+TPU-native rebuild of python-package/lightgbm/basic.py. The reference binds
+a C library via ctypes (basic.py:24, _load_lib); here Dataset wraps the
+host-side BinnedDataset (data/dataset.py) whose binned matrix ships to TPU
+HBM at Booster construction, and Booster drives the jitted boosting engine
+(boosting/) directly — same surface, no C round-trips. Lazy construction
+(_lazy_init, reference basic.py:868), reference-aligned validation binning
+(set_reference / Dataset alignment, basic.py:730-1090), pandas and
+categorical handling (basic.py:331-418) all follow the reference semantics.
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .config import Config, params_to_config, _METRIC_ALIASES
+from .data.dataset import BinnedDataset
+from .metrics import create_metric
+from .objectives import create_objective
+from .utils.log import LightGBMError, Log
+
+try:
+    import pandas as pd
+    _PANDAS = True
+except ImportError:  # pragma: no cover
+    _PANDAS = False
+
+try:
+    from scipy import sparse as _sp
+    _SCIPY = True
+except ImportError:  # pragma: no cover
+    _SCIPY = False
+
+
+def _data_to_2d(data, feature_name="auto", categorical_feature="auto"):
+    """Coerce input data to (float64 2D array, feature_names, cat_indices).
+
+    Mirrors the pandas/categorical handling in reference basic.py:331-418
+    (_data_from_pandas): category dtypes are codified, bad object columns
+    rejected.
+    """
+    cat_idx: List[int] = []
+    names: Optional[List[str]] = None
+    if _PANDAS and isinstance(data, pd.DataFrame):
+        names = [str(c) for c in data.columns]
+        df = data.copy()
+        auto_cat = categorical_feature == "auto"
+        cat_names = ([] if auto_cat or categorical_feature is None
+                     else list(categorical_feature))
+        for i, col in enumerate(df.columns):
+            if str(df[col].dtype) == "category":
+                df[col] = df[col].cat.codes.astype(np.float64).replace(-1, np.nan) \
+                    if hasattr(df[col].cat.codes, "replace") \
+                    else df[col].cat.codes.astype(np.float64)
+                if auto_cat:
+                    cat_idx.append(i)
+            if (not auto_cat) and (col in cat_names or i in cat_names):
+                cat_idx.append(i)
+        bad = [c for c in df.columns
+               if df[c].dtype == object]
+        if bad:
+            raise LightGBMError(
+                "DataFrame.dtypes for data must be int, float or bool. Did "
+                "not expect the data types in the following fields: "
+                + ", ".join(str(b) for b in bad))
+        X = df.values.astype(np.float64)
+    elif _SCIPY and _sp.issparse(data):
+        X = np.asarray(data.todense(), dtype=np.float64)
+    elif isinstance(data, list):
+        X = np.asarray(data, dtype=np.float64)
+    else:
+        X = np.asarray(data, dtype=np.float64)
+    if X.ndim == 1:
+        X = X.reshape(-1, 1)
+    if categorical_feature not in ("auto", None) and not cat_idx:
+        for c in categorical_feature:
+            if isinstance(c, int):
+                cat_idx.append(c)
+            elif names is not None and c in names:
+                cat_idx.append(names.index(c))
+    if feature_name not in ("auto", None):
+        names = list(feature_name)
+    return X, names, sorted(set(cat_idx))
+
+
+def _label_from_pandas(label):
+    if _PANDAS and isinstance(label, (pd.Series, pd.DataFrame)):
+        return np.asarray(label).reshape(-1)
+    return label
+
+
+class Dataset:
+    """Training/validation data container (reference basic.py:730)."""
+
+    def __init__(self, data, label=None, reference: Optional["Dataset"] = None,
+                 weight=None, group=None, init_score=None,
+                 feature_name="auto", categorical_feature="auto",
+                 params: Optional[Dict[str, Any]] = None,
+                 free_raw_data: bool = True, silent=False):
+        self.data = data
+        self.label = _label_from_pandas(label)
+        self.reference = reference
+        self.weight = weight
+        self.group = group
+        self.init_score = init_score
+        self.feature_name = feature_name
+        self.categorical_feature = categorical_feature
+        self.params = dict(params or {})
+        self.free_raw_data = free_raw_data
+        self._inner: Optional[BinnedDataset] = None
+        self.used_indices = None
+        self._predictor = None
+
+    # -- laziness (reference _lazy_init, basic.py:868) -------------------
+    def construct(self) -> "Dataset":
+        if self._inner is not None:
+            return self
+        if self.data is None:
+            raise LightGBMError(
+                "Cannot construct Dataset since the raw data has been freed; "
+                "set free_raw_data=False when creating the Dataset")
+        cfg = params_to_config(self.params)
+        X, names, cat_idx = _data_to_2d(self.data, self.feature_name,
+                                        self.categorical_feature)
+        ref_inner = None
+        if self.reference is not None:
+            self.reference.construct()
+            ref_inner = self.reference._inner
+        self._inner = BinnedDataset.from_matrix(
+            X, cfg,
+            categorical_features=cat_idx,
+            label=self.label,
+            weight=self.weight,
+            group=self.group,
+            init_score=self.init_score,
+            feature_names=names,
+            reference=ref_inner,
+        )
+        self._raw_X = None if self.free_raw_data else X
+        if self.free_raw_data:
+            self.data = None
+        return self
+
+    @property
+    def constructed(self) -> bool:
+        return self._inner is not None
+
+    # -- field access (reference set_field/get_field) --------------------
+    def set_label(self, label) -> "Dataset":
+        self.label = _label_from_pandas(label)
+        if self._inner is not None:
+            self._inner.metadata.set_label(self.label)
+        return self
+
+    def set_weight(self, weight) -> "Dataset":
+        self.weight = weight
+        if self._inner is not None:
+            self._inner.metadata.set_weight(weight)
+        return self
+
+    def set_group(self, group) -> "Dataset":
+        self.group = group
+        if self._inner is not None:
+            self._inner.metadata.set_query(group)
+        return self
+
+    def set_init_score(self, init_score) -> "Dataset":
+        self.init_score = init_score
+        if self._inner is not None:
+            self._inner.metadata.set_init_score(init_score)
+        return self
+
+    def set_reference(self, reference: "Dataset") -> "Dataset":
+        if self._inner is not None and self.reference is not reference:
+            raise LightGBMError("Cannot set reference after constructed")
+        self.reference = reference
+        return self
+
+    def set_feature_name(self, feature_name) -> "Dataset":
+        if feature_name not in (None, "auto"):
+            self.feature_name = feature_name
+        return self
+
+    def set_categorical_feature(self, categorical_feature) -> "Dataset":
+        if categorical_feature not in (None, "auto"):
+            if self._inner is not None:
+                Log.warning("categorical_feature set after construction is "
+                            "ignored")
+            else:
+                self.categorical_feature = categorical_feature
+        return self
+
+    def get_label(self):
+        if self._inner is not None:
+            return self._inner.metadata.label
+        return self.label
+
+    def get_weight(self):
+        if self._inner is not None:
+            return self._inner.metadata.weight
+        return self.weight
+
+    def get_group(self):
+        if self._inner is not None and \
+                self._inner.metadata.query_boundaries is not None:
+            return np.diff(self._inner.metadata.query_boundaries)
+        return self.group
+
+    def get_init_score(self):
+        if self._inner is not None:
+            return self._inner.metadata.init_score
+        return self.init_score
+
+    def get_field(self, field_name):
+        return {"label": self.get_label, "weight": self.get_weight,
+                "group": self.get_group,
+                "init_score": self.get_init_score}[field_name]()
+
+    def set_field(self, field_name, data):
+        return {"label": self.set_label, "weight": self.set_weight,
+                "group": self.set_group,
+                "init_score": self.set_init_score}[field_name](data)
+
+    # -- info ------------------------------------------------------------
+    def num_data(self) -> int:
+        self.construct()
+        return self._inner.num_data
+
+    def num_feature(self) -> int:
+        self.construct()
+        return self._inner.num_total_features
+
+    def get_feature_name(self) -> List[str]:
+        self.construct()
+        return list(self._inner.feature_names)
+
+    def subset(self, used_indices, params=None) -> "Dataset":
+        """Row subset sharing this dataset's BinMappers (reference
+        Dataset.subset, basic.py:1330)."""
+        self.construct()
+        X = self._raw_X if getattr(self, "_raw_X", None) is not None else None
+        if X is None:
+            raise LightGBMError("subset requires free_raw_data=False")
+        idx = np.asarray(used_indices)
+        sub = Dataset(X[idx],
+                      label=None if self.label is None else
+                      np.asarray(self.label)[idx],
+                      reference=self,
+                      weight=None if self.weight is None else
+                      np.asarray(self.weight)[idx],
+                      params=params or self.params,
+                      free_raw_data=self.free_raw_data)
+        sub.used_indices = idx
+        return sub
+
+    def _update_params(self, params) -> "Dataset":
+        if params:
+            self.params.update(params)
+        return self
+
+    def _reverse_update_params(self) -> "Dataset":
+        return self
+
+    def _set_predictor(self, predictor) -> "Dataset":
+        self._predictor = predictor
+        return self
+
+
+class Booster:
+    """The trained model handle (reference basic.py:1704)."""
+
+    def __init__(self, params: Optional[Dict[str, Any]] = None,
+                 train_set: Optional[Dataset] = None,
+                 model_file: Optional[str] = None,
+                 model_str: Optional[str] = None, silent=False):
+        from .boosting import create_boosting
+        self.params = dict(params or {})
+        self.best_iteration = -1
+        self.best_score: Dict = {}
+        self.train_set = None
+        self._train_data_name = "training"
+        self._valid_sets: List[Dataset] = []
+        self.name_valid_sets: List[str] = []
+
+        if train_set is not None:
+            if not isinstance(train_set, Dataset):
+                raise TypeError("Training data should be Dataset instance, "
+                                "met %s" % type(train_set).__name__)
+            cfg = params_to_config(self.params)
+            train_set._update_params(self.params)
+            train_set.construct()
+            self.train_set = train_set
+            self._cfg = cfg
+            inner = train_set._inner
+            objective = create_objective(cfg.objective, cfg)
+            if objective is not None:
+                objective.init(inner.metadata, inner.num_data)
+            self._booster = create_boosting(cfg.boosting)
+            self._booster.init(cfg, inner, objective)
+            self._metrics = self._make_metrics(cfg, inner)
+            for m in self._metrics:
+                m.init(inner.metadata, inner.num_data)
+        elif model_file is not None:
+            with open(model_file) as f:
+                model_str = f.read()
+            self._init_from_string(model_str)
+        elif model_str is not None:
+            self._init_from_string(model_str)
+        else:
+            raise TypeError("Need at least one training dataset or model "
+                            "file or model string to create Booster instance")
+
+    def _init_from_string(self, model_str: str) -> None:
+        from .boosting import create_boosting
+        self._cfg = params_to_config(self.params)
+        self._booster = create_boosting("gbdt")
+        self._booster.config = self._cfg
+        self._booster.load_model_from_string(model_str)
+        self._metrics = []
+
+    @staticmethod
+    def _make_metrics(cfg: Config, inner: BinnedDataset):
+        """Config metric list; falls back to the objective's own metric
+        (reference config.cpp metric default resolution)."""
+        names = list(cfg.metric)
+        if not names:
+            default = _METRIC_ALIASES.get(cfg.objective)
+            if default and default != "none":
+                names = [default]
+        out = []
+        for n in names:
+            if n in ("none",):
+                continue
+            m = create_metric(n, cfg)
+            if m is not None:
+                out.append(m)
+        return out
+
+    # ------------------------------------------------------------------
+    def set_train_data_name(self, name: str) -> "Booster":
+        self._train_data_name = name
+        return self
+
+    def add_valid(self, data: Dataset, name: str) -> "Booster":
+        if not isinstance(data, Dataset):
+            raise TypeError("Validation data should be Dataset instance, "
+                            "met %s" % type(data).__name__)
+        data.set_reference(self.train_set)
+        data.construct()
+        self._valid_sets.append(data)
+        self.name_valid_sets.append(name)
+        cfg = self._cfg
+        metrics = self._make_metrics(cfg, data._inner)
+        self._booster.add_valid_dataset(data._inner, metrics, name)
+        return self
+
+    # ------------------------------------------------------------------
+    def update(self, train_set: Optional[Dataset] = None, fobj=None) -> bool:
+        """One boosting round (reference basic.py:2089). Returns True when
+        no further splits were possible (training finished)."""
+        if train_set is not None and train_set is not self.train_set:
+            raise LightGBMError("Replacing train_set is not yet supported "
+                                "on device_type=tpu")
+        if fobj is None:
+            return self._booster.train_one_iter(None, None)
+        if self._cfg.boosting == "rf":
+            raise LightGBMError("RF mode does not support custom objective")
+        preds = self._booster.train_score.score_host()
+        grad, hess = fobj(preds, self.train_set)
+        return self.__boost(grad, hess)
+
+    def __boost(self, grad, hess) -> bool:
+        grad = np.ascontiguousarray(grad, dtype=np.float32)
+        hess = np.ascontiguousarray(hess, dtype=np.float32)
+        ntpi = self._booster.num_tree_per_iteration
+        n = self._booster.num_data
+        if grad.size != n * ntpi:
+            raise ValueError(
+                "Lengths of gradients (%d) and expected (%d) don't match"
+                % (grad.size, n * ntpi))
+        return self._booster.train_one_iter(grad, hess)
+
+    def rollback_one_iter(self) -> "Booster":
+        self._booster.rollback_one_iter()
+        return self
+
+    @property
+    def current_iteration(self):
+        return self._booster.current_iteration
+
+    def num_trees(self) -> int:
+        return len(self._booster.models)
+
+    def num_model_per_iteration(self) -> int:
+        return self._booster.num_tree_per_iteration
+
+    def num_feature(self) -> int:
+        return self._booster.max_feature_idx + 1
+
+    # ------------------------------------------------------------------
+    def _eval_one(self, score: np.ndarray, metrics, data_name: str,
+                  feval=None, dataset: Optional[Dataset] = None):
+        out = []
+        obj = self._booster.objective
+        for m in metrics:
+            vals = m.eval(score, obj)
+            for name, v in zip(m.names, vals):
+                out.append((data_name, name, v,
+                            m.factor_to_bigger_better > 0))
+        if feval is not None:
+            ntpi = self._booster.num_tree_per_iteration
+            n = score.size // ntpi
+            preds = score if ntpi == 1 else score
+            res = feval(preds, dataset)
+            if isinstance(res, tuple):
+                res = [res]
+            for name, v, is_higher_better in res:
+                out.append((data_name, name, v, is_higher_better))
+        return out
+
+    def eval_train(self, feval=None):
+        score = self._booster.train_score.score_host()
+        return self._eval_one(score, self._metrics, self._train_data_name,
+                              feval, self.train_set)
+
+    def eval_valid(self, feval=None):
+        out = []
+        for i, (su, metrics) in enumerate(zip(self._booster.valid_score,
+                                              self._booster.valid_metrics)):
+            out.extend(self._eval_one(su.score_host(), metrics,
+                                      self.name_valid_sets[i], feval,
+                                      self._valid_sets[i]
+                                      if i < len(self._valid_sets) else None))
+        return out
+
+    def eval(self, data: Dataset, name: str, feval=None):
+        if data is self.train_set:
+            return self.eval_train(feval)
+        for i, vs in enumerate(self._valid_sets):
+            if data is vs:
+                su = self._booster.valid_score[i]
+                return self._eval_one(su.score_host(),
+                                      self._booster.valid_metrics[i], name,
+                                      feval, data)
+        raise LightGBMError("Data for eval must be train or valid set")
+
+    # ------------------------------------------------------------------
+    def predict(self, data, num_iteration: Optional[int] = None,
+                raw_score: bool = False, pred_leaf: bool = False,
+                pred_contrib: bool = False, data_has_header: bool = False,
+                is_reshape: bool = True, start_iteration: int = 0, **kwargs):
+        X, _, _ = _data_to_2d(data)
+        if num_iteration is None:
+            num_iteration = (self.best_iteration
+                             if self.best_iteration > 0 else -1)
+        if pred_leaf:
+            return self._booster.predict_leaf_index(
+                X, start_iteration, num_iteration)
+        if pred_contrib:
+            raise LightGBMError("pred_contrib (SHAP) is not implemented yet "
+                                "on device_type=tpu")
+        return self._booster.predict(X, raw_score=raw_score,
+                                     start_iteration=start_iteration,
+                                     num_iteration=num_iteration)
+
+    # ------------------------------------------------------------------
+    def model_to_string(self, num_iteration: Optional[int] = None,
+                        start_iteration: int = 0) -> str:
+        if num_iteration is None:
+            num_iteration = (self.best_iteration
+                             if self.best_iteration > 0 else -1)
+        return self._booster.save_model_to_string(start_iteration,
+                                                  num_iteration)
+
+    def save_model(self, filename: str,
+                   num_iteration: Optional[int] = None,
+                   start_iteration: int = 0) -> "Booster":
+        with open(filename, "w") as f:
+            f.write(self.model_to_string(num_iteration, start_iteration))
+        return self
+
+    def dump_model(self, num_iteration: Optional[int] = None,
+                   start_iteration: int = 0) -> dict:
+        if num_iteration is None:
+            num_iteration = (self.best_iteration
+                             if self.best_iteration > 0 else -1)
+        return self._booster.dump_model(start_iteration, num_iteration)
+
+    def model_from_string(self, model_str: str, verbose=True) -> "Booster":
+        self._init_from_string(model_str)
+        return self
+
+    # ------------------------------------------------------------------
+    def feature_importance(self, importance_type: str = "split",
+                           iteration: Optional[int] = None) -> np.ndarray:
+        imp = self._booster.feature_importance(
+            importance_type, iteration if iteration else 0)
+        if importance_type == "split":
+            return imp.astype(np.int32)
+        return imp
+
+    def feature_name(self) -> List[str]:
+        return list(self._booster.feature_names)
+
+    # -- pickling -------------------------------------------------------
+    def __getstate__(self):
+        state = {"params": self.params,
+                 "model_str": self.model_to_string(num_iteration=-1),
+                 "best_iteration": self.best_iteration,
+                 "best_score": self.best_score}
+        return state
+
+    def __setstate__(self, state):
+        self.params = state["params"]
+        self.best_iteration = state["best_iteration"]
+        self.best_score = state["best_score"]
+        self.train_set = None
+        self._train_data_name = "training"
+        self._valid_sets = []
+        self.name_valid_sets = []
+        self._init_from_string(state["model_str"])
+
+    def __copy__(self):
+        return self.__deepcopy__(None)
+
+    def __deepcopy__(self, _):
+        model_str = self.model_to_string(num_iteration=-1)
+        return Booster(model_str=model_str)

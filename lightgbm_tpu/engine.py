@@ -1,0 +1,405 @@
+"""Training and cross-validation entry points.
+
+TPU-native rebuild of python-package/lightgbm/engine.py: `train` (:18) with
+the same callback orchestration (:198-268) and `cv` (:375) with
+stratified/group folds (:299). The per-round work — gradients, tree growth,
+score updates — runs as jitted device programs behind Booster.update.
+"""
+from __future__ import annotations
+
+import collections
+import copy
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from . import callback
+from .basic import Booster, Dataset
+from .utils.log import LightGBMError, Log
+
+_EARLY_STOP_ALIASES = ("early_stopping_round", "early_stopping_rounds",
+                       "early_stopping", "n_iter_no_change")
+_NUM_BOOST_ROUND_ALIASES = (
+    "num_iterations", "num_iteration", "n_iter", "num_tree", "num_trees",
+    "num_round", "num_rounds", "num_boost_round", "n_estimators")
+
+
+def train(params: Dict[str, Any], train_set: Dataset,
+          num_boost_round: int = 100,
+          valid_sets: Optional[List[Dataset]] = None,
+          valid_names: Optional[List[str]] = None,
+          fobj=None, feval=None, init_model=None,
+          feature_name="auto", categorical_feature="auto",
+          early_stopping_rounds: Optional[int] = None,
+          evals_result: Optional[Dict] = None,
+          verbose_eval=True, learning_rates=None,
+          keep_training_booster: bool = False, callbacks=None) -> Booster:
+    """Train a booster (reference engine.py:18-290)."""
+    params = copy.deepcopy(params)
+    # resolve aliases the way the reference does (engine.py:119-155)
+    for alias in _NUM_BOOST_ROUND_ALIASES:
+        if alias in params:
+            num_boost_round = int(params.pop(alias))
+            Log.warning("Found `%s` in params. Will use it instead of "
+                        "argument" % alias)
+            break
+    for alias in _EARLY_STOP_ALIASES:
+        if alias in params:
+            early_stopping_rounds = int(params.pop(alias))
+            Log.warning("Found `%s` in params. Will use it instead of "
+                        "argument" % alias)
+            break
+    first_metric_only = params.get("first_metric_only", False)
+
+    if num_boost_round <= 0:
+        raise ValueError("num_boost_round should be greater than zero.")
+    if fobj is not None:
+        params["objective"] = "none"
+
+    init_booster_str = None
+    init_iteration = 0
+    if isinstance(init_model, str):
+        with open(init_model) as f:
+            init_booster_str = f.read()
+    elif isinstance(init_model, Booster):
+        init_booster_str = init_model.model_to_string(num_iteration=-1)
+    if not isinstance(train_set, Dataset):
+        raise TypeError("Training only accepts Dataset object")
+
+    train_set._update_params(params) \
+             .set_feature_name(feature_name) \
+             .set_categorical_feature(categorical_feature)
+
+    is_valid_contain_train = False
+    train_data_name = "training"
+    reduced_valid_sets = []
+    name_valid_sets = []
+    if valid_sets is not None:
+        if isinstance(valid_sets, Dataset):
+            valid_sets = [valid_sets]
+        if isinstance(valid_names, str):
+            valid_names = [valid_names]
+        for i, valid_data in enumerate(valid_sets):
+            if valid_data is train_set:
+                is_valid_contain_train = True
+                if valid_names is not None:
+                    train_data_name = valid_names[i]
+                continue
+            if not isinstance(valid_data, Dataset):
+                raise TypeError("Training only accepts Dataset object")
+            reduced_valid_sets.append(
+                valid_data._update_params(params).set_reference(train_set))
+            if valid_names is not None and len(valid_names) > i:
+                name_valid_sets.append(valid_names[i])
+            else:
+                name_valid_sets.append("valid_" + str(i))
+
+    if callbacks is None:
+        callbacks = set()
+    else:
+        for i, cb in enumerate(callbacks):
+            cb.__dict__.setdefault("order", i - len(callbacks))
+        callbacks = set(callbacks)
+
+    if verbose_eval is True:
+        callbacks.add(callback.print_evaluation())
+    elif isinstance(verbose_eval, int) and not isinstance(verbose_eval, bool):
+        callbacks.add(callback.print_evaluation(verbose_eval))
+    if early_stopping_rounds is not None and early_stopping_rounds > 0:
+        callbacks.add(callback.early_stopping(
+            early_stopping_rounds, first_metric_only,
+            verbose=bool(verbose_eval)))
+    if learning_rates is not None:
+        callbacks.add(callback.reset_parameter(learning_rate=learning_rates))
+    if evals_result is not None:
+        callbacks.add(callback.record_evaluation(evals_result))
+
+    callbacks_before_iter = {cb for cb in callbacks
+                             if getattr(cb, "before_iteration", False)}
+    callbacks_after_iter = callbacks - callbacks_before_iter
+    callbacks_before_iter = sorted(callbacks_before_iter,
+                                   key=lambda cb: getattr(cb, "order", 0))
+    callbacks_after_iter = sorted(callbacks_after_iter,
+                                  key=lambda cb: getattr(cb, "order", 0))
+
+    booster = Booster(params=params, train_set=train_set)
+    if init_booster_str is not None:
+        # continued training: seed scores with the init model's predictions
+        init_b = Booster(model_str=init_booster_str)
+        init_iteration = init_b.current_iteration
+        _seed_scores_from_model(booster, init_b, train_set,
+                                reduced_valid_sets)
+        booster._booster.models = init_b._booster.models + \
+            booster._booster.models
+        booster._booster.num_init_iteration = init_iteration
+        booster._booster.iter = 0
+    if is_valid_contain_train:
+        booster.set_train_data_name(train_data_name)
+    for valid_set, name_valid_set in zip(reduced_valid_sets, name_valid_sets):
+        booster.add_valid(valid_set, name_valid_set)
+    booster.best_iteration = 0
+
+    evaluation_result_list: List = []
+    for i in range(init_iteration, init_iteration + num_boost_round):
+        for cb in callbacks_before_iter:
+            cb(callback.CallbackEnv(model=booster, params=params, iteration=i,
+                                    begin_iteration=init_iteration,
+                                    end_iteration=init_iteration
+                                    + num_boost_round,
+                                    evaluation_result_list=None))
+        booster.update(fobj=fobj)
+
+        evaluation_result_list = []
+        if valid_sets is not None:
+            if is_valid_contain_train:
+                evaluation_result_list.extend(booster.eval_train(feval))
+            evaluation_result_list.extend(booster.eval_valid(feval))
+        try:
+            for cb in callbacks_after_iter:
+                cb(callback.CallbackEnv(model=booster, params=params,
+                                        iteration=i,
+                                        begin_iteration=init_iteration,
+                                        end_iteration=init_iteration
+                                        + num_boost_round,
+                                        evaluation_result_list=
+                                        evaluation_result_list))
+        except callback.EarlyStopException as e:
+            booster.best_iteration = e.best_iteration + 1
+            evaluation_result_list = e.best_score
+            break
+    booster.best_score = collections.defaultdict(collections.OrderedDict)
+    for item in evaluation_result_list:
+        dataset_name, eval_name, score = item[0], item[1], item[2]
+        booster.best_score[dataset_name][eval_name] = score
+    return booster
+
+
+def _seed_scores_from_model(booster: Booster, init_b: Booster,
+                            train_set: Dataset, valid_sets) -> None:
+    """Continued training: add the init model's cached predictions to the
+    fresh booster's score updaters (reference seeds via _InnerPredictor,
+    engine.py:159-165 + boosting handler init)."""
+    inner = booster._booster
+    ntpi = inner.num_tree_per_iteration
+    for i, tree in enumerate(init_b._booster.models):
+        # loaded trees carry only real-valued thresholds; bind them to the
+        # new dataset's bins before the binned walk
+        tree.bind_to_dataset(train_set._inner)
+        inner.train_score.add_score_np(
+            tree.predict_binned(train_set._inner), i % ntpi)
+
+
+# ---------------------------------------------------------------------------
+# cross-validation (engine.py:293-610)
+# ---------------------------------------------------------------------------
+
+class CVBooster:
+    """Ensemble of per-fold boosters (reference _CVBooster, engine.py:296)."""
+
+    def __init__(self):
+        self.boosters: List[Booster] = []
+        self.best_iteration = -1
+
+    def append(self, booster: Booster) -> None:
+        self.boosters.append(booster)
+
+    def __getattr__(self, name):
+        def handler_function(*args, **kwargs):
+            return [getattr(b, name)(*args, **kwargs) for b in self.boosters]
+        return handler_function
+
+
+def _make_n_folds(full_data: Dataset, folds, nfold: int, params: Dict,
+                  seed: int, fpreproc=None, stratified=False, shuffle=True,
+                  eval_train_metric=False):
+    num_data = full_data.num_data()
+    if folds is not None:
+        if not hasattr(folds, "__iter__") and not hasattr(folds, "split"):
+            raise AttributeError(
+                "folds should be a generator or iterator of (train_idx, "
+                "test_idx) tuples or scikit-learn splitter object")
+        if hasattr(folds, "split"):
+            group_info = full_data.get_group()
+            if group_info is not None:
+                group_info = np.asarray(group_info, dtype=np.int64)
+                flattened_group = np.repeat(
+                    range(len(group_info)), repeats=group_info)
+            else:
+                flattened_group = np.zeros(num_data, dtype=np.int64)
+            folds = folds.split(X=np.zeros(num_data),
+                                y=full_data.get_label(),
+                                groups=flattened_group)
+    else:
+        if any(params.get(alias, "") in ("lambdarank", "rank_xendcg")
+               for alias in ("objective", "application", "app")):
+            if not _SKLEARN_INSTALLED():
+                raise LightGBMError(
+                    "scikit-learn is required for ranking cv")
+            from sklearn.model_selection import GroupKFold
+            group_info = np.asarray(full_data.get_group(), dtype=np.int64)
+            flattened_group = np.repeat(
+                range(len(group_info)), repeats=group_info)
+            group_kfold = GroupKFold(n_splits=nfold)
+            folds = group_kfold.split(X=np.zeros(num_data),
+                                      groups=flattened_group)
+        elif stratified:
+            if not _SKLEARN_INSTALLED():
+                raise LightGBMError(
+                    "scikit-learn is required for stratified cv")
+            from sklearn.model_selection import StratifiedKFold
+            skf = StratifiedKFold(n_splits=nfold, shuffle=shuffle,
+                                  random_state=seed)
+            folds = skf.split(X=np.zeros(num_data), y=full_data.get_label())
+        else:
+            if shuffle:
+                randidx = np.random.RandomState(seed).permutation(num_data)
+            else:
+                randidx = np.arange(num_data)
+            kstep = int(num_data / nfold)
+            test_id = [randidx[i:i + kstep] for i in range(0, num_data, kstep)]
+            train_id = [np.concatenate([test_id[i] for i in range(nfold)
+                                        if k != i]) for k in range(nfold)]
+            folds = zip(train_id, test_id)
+
+    ret = CVBooster()
+    for train_idx, test_idx in folds:
+        train_subset = full_data.subset(sorted(train_idx))
+        valid_subset = full_data.subset(sorted(test_idx))
+        if fpreproc is not None:
+            train_subset, valid_subset, tparam = fpreproc(
+                train_subset, valid_subset, params.copy())
+        else:
+            tparam = params
+        cvbooster = Booster(tparam, train_subset)
+        if eval_train_metric:
+            cvbooster.add_valid(train_subset, "train")
+        cvbooster.add_valid(valid_subset, "valid")
+        ret.append(cvbooster)
+    return ret
+
+
+def _SKLEARN_INSTALLED() -> bool:
+    try:
+        import sklearn  # noqa: F401
+        return True
+    except ImportError:
+        return False
+
+
+def _agg_cv_result(raw_results, eval_train_metric=False):
+    """Aggregate per-fold eval results (engine.py:354-372)."""
+    cvmap = collections.OrderedDict()
+    metric_type = {}
+    for one_result in raw_results:
+        for one_line in one_result:
+            if eval_train_metric:
+                key = "%s %s" % (one_line[0], one_line[1])
+            else:
+                key = "valid %s" % one_line[1]
+            metric_type[key] = one_line[3]
+            cvmap.setdefault(key, [])
+            cvmap[key].append(one_line[2])
+    return [("cv_agg", k, float(np.mean(v)), metric_type[k], float(np.std(v)))
+            for k, v in cvmap.items()]
+
+
+def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
+       folds=None, nfold: int = 5, stratified: bool = True,
+       shuffle: bool = True, metrics=None, fobj=None, feval=None,
+       init_model=None, feature_name="auto", categorical_feature="auto",
+       early_stopping_rounds: Optional[int] = None, fpreproc=None,
+       verbose_eval=None, show_stdv: bool = True, seed: int = 0,
+       callbacks=None, eval_train_metric: bool = False,
+       return_cvbooster: bool = False):
+    """Cross-validation (reference engine.py:375-610)."""
+    if not isinstance(train_set, Dataset):
+        raise TypeError("Training only accepts Dataset object")
+    params = copy.deepcopy(params)
+    for alias in _NUM_BOOST_ROUND_ALIASES:
+        if alias in params:
+            Log.warning("Found `%s` in params. Will use it instead of "
+                        "argument" % alias)
+            num_boost_round = int(params.pop(alias))
+            break
+    for alias in _EARLY_STOP_ALIASES:
+        if alias in params:
+            Log.warning("Found `%s` in params. Will use it instead of "
+                        "argument" % alias)
+            early_stopping_rounds = int(params.pop(alias))
+            break
+    first_metric_only = params.get("first_metric_only", False)
+    if num_boost_round <= 0:
+        raise ValueError("num_boost_round should be greater than zero.")
+    if fobj is not None:
+        params["objective"] = "none"
+    if metrics is not None:
+        params["metric"] = metrics
+
+    train_set._update_params(params) \
+             .set_feature_name(feature_name) \
+             .set_categorical_feature(categorical_feature)
+    if train_set.free_raw_data:
+        # cv needs subsetting: keep the raw matrix
+        train_set.free_raw_data = False
+
+    results = collections.defaultdict(list)
+    cvfolds = _make_n_folds(train_set, folds=folds, nfold=nfold,
+                            params=params, seed=seed, fpreproc=fpreproc,
+                            stratified=stratified, shuffle=shuffle,
+                            eval_train_metric=eval_train_metric)
+
+    if callbacks is None:
+        callbacks = set()
+    else:
+        for i, cb in enumerate(callbacks):
+            cb.__dict__.setdefault("order", i - len(callbacks))
+        callbacks = set(callbacks)
+    if early_stopping_rounds is not None and early_stopping_rounds > 0:
+        callbacks.add(callback.early_stopping(
+            early_stopping_rounds, first_metric_only, verbose=False))
+    if verbose_eval is True:
+        callbacks.add(callback.print_evaluation(show_stdv=show_stdv))
+    elif isinstance(verbose_eval, int) and not isinstance(verbose_eval, bool):
+        callbacks.add(callback.print_evaluation(verbose_eval, show_stdv))
+
+    callbacks_before_iter = {cb for cb in callbacks
+                             if getattr(cb, "before_iteration", False)}
+    callbacks_after_iter = callbacks - callbacks_before_iter
+    callbacks_before_iter = sorted(callbacks_before_iter,
+                                   key=lambda cb: getattr(cb, "order", 0))
+    callbacks_after_iter = sorted(callbacks_after_iter,
+                                  key=lambda cb: getattr(cb, "order", 0))
+
+    for i in range(num_boost_round):
+        for cb in callbacks_before_iter:
+            cb(callback.CallbackEnv(model=cvfolds, params=params, iteration=i,
+                                    begin_iteration=0,
+                                    end_iteration=num_boost_round,
+                                    evaluation_result_list=None))
+        for b in cvfolds.boosters:
+            b.update(fobj=fobj)
+        raw = []
+        for b in cvfolds.boosters:
+            one = []
+            if eval_train_metric:
+                one.extend(b.eval_train(feval))
+            one.extend(b.eval_valid(feval))
+            raw.append(one)
+        res = _agg_cv_result(raw, eval_train_metric)
+        for _, key, mean, _, std in res:
+            results[key + "-mean"].append(mean)
+            results[key + "-stdv"].append(std)
+        try:
+            for cb in callbacks_after_iter:
+                cb(callback.CallbackEnv(model=cvfolds, params=params,
+                                        iteration=i, begin_iteration=0,
+                                        end_iteration=num_boost_round,
+                                        evaluation_result_list=res))
+        except callback.EarlyStopException as e:
+            cvfolds.best_iteration = e.best_iteration + 1
+            for k in results:
+                results[k] = results[k][:cvfolds.best_iteration]
+            break
+    if return_cvbooster:
+        results["cvbooster"] = cvfolds
+    return dict(results)

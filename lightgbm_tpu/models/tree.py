@@ -319,6 +319,55 @@ class Tree:
         return self.leaf_value[self.predict_leaf_binned(dataset)]
 
     # ------------------------------------------------------------------
+    def bind_to_dataset(self, dataset) -> "Tree":
+        """Reconstruct inner (binned) decision fields from the real-valued
+        ones using a BinnedDataset's BinMappers. Needed for trees parsed
+        from model text (threshold_in_bin is not serialized — the reference
+        re-binds via Dataset mapping too) before predict_binned works."""
+        self.cat_boundaries_inner = [0]
+        self.cat_threshold_inner = []
+        for k in range(self.num_leaves - 1):
+            real_f = int(self.split_feature[k])
+            inner = dataset.inner_of.get(real_f, -1)
+            is_cat = bool(self.decision_type[k] & kCategoricalMask)
+            if inner < 0:
+                # feature trivial in this dataset: constant value; route all
+                # rows by evaluating the decision on that constant
+                self.split_feature_inner[k] = 0
+                mapper = dataset.bin_mappers[real_f]
+                const_val = mapper.min_val
+                if is_cat:
+                    go_left = False
+                else:
+                    go_left = const_val <= self.threshold[k]
+                self.threshold_in_bin[k] = 0 if go_left else -1
+                if is_cat:
+                    # clear categorical bit: use numerical constant routing
+                    self.decision_type[k] &= ~np.int8(kCategoricalMask)
+                continue
+            self.split_feature_inner[k] = inner
+            mapper = dataset.bin_mappers[real_f]
+            if is_cat:
+                ci = int(self.threshold[k])
+                bits = np.asarray(
+                    self.cat_threshold[self.cat_boundaries[ci]:
+                                       self.cat_boundaries[ci + 1]],
+                    dtype=np.uint32)
+                cats = [v for v in range(len(bits) * 32)
+                        if bits[v // 32] >> (v % 32) & 1]
+                bins = [mapper.categorical_2_bin[c] for c in cats
+                        if c in mapper.categorical_2_bin]
+                inner_bits = _to_bitset(np.asarray(bins, dtype=np.int64))
+                self.threshold_in_bin[k] = len(self.cat_boundaries_inner) - 1
+                self.cat_boundaries_inner.append(
+                    self.cat_boundaries_inner[-1] + len(inner_bits))
+                self.cat_threshold_inner.extend(int(x) for x in inner_bits)
+            else:
+                self.threshold_in_bin[k] = int(
+                    mapper.value_to_bin(np.array([self.threshold[k]]))[0])
+        return self
+
+    # ------------------------------------------------------------------
     def expected_value(self) -> float:
         """Weighted mean output (used by SHAP base value)."""
         n = self.num_leaves

@@ -31,9 +31,18 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from .split import (F64, I32, K_MIN_SCORE, FeatureMeta, SplitCandidate,
-                    SplitParams, _leaf_output_unconstrained,
-                    find_best_split_numerical, fix_histogram)
+from .split import (CatLayout, F64, I32, K_MIN_SCORE, FeatureMeta,
+                    SplitCandidate, SplitParams, _leaf_output_unconstrained,
+                    find_best_split_categorical, find_best_split_numerical,
+                    fix_histogram, merge_candidates)
+
+
+def empty_cat_layout(cat_width: int = 1) -> CatLayout:
+    z = jnp.zeros((0,), I32)
+    return CatLayout(cat_feature=z,
+                     gather_idx=jnp.zeros((0, cat_width), I32),
+                     bin_valid=jnp.zeros((0, cat_width), bool),
+                     used_bin=z, num_bin=z)
 
 BOOL = jnp.bool_
 
@@ -172,7 +181,7 @@ def _single_leaf_tree(n, L, cat_width, grad, hess, bag_mask, params, axis_name):
 def grow_tree(layout: DataLayout, grad: jnp.ndarray, hess: jnp.ndarray,
               bag_mask: jnp.ndarray, meta: FeatureMeta, params: SplitParams,
               feature_mask: jnp.ndarray, fix: FixInfo, gc: GrowConfig,
-              axis_name=None) -> TreeArrays:
+              axis_name=None, cat: CatLayout = None) -> TreeArrays:
     """Grow one tree. grad/hess must already include bagging/GOSS weighting
     and be zero on padded/out-of-bag rows; bag_mask marks in-bag valid rows.
 
@@ -181,6 +190,8 @@ def grow_tree(layout: DataLayout, grad: jnp.ndarray, hess: jnp.ndarray,
     (reference src/treelearner/data_parallel_tree_learner.cpp) expressed as
     sharding + one collective.
     """
+    if cat is None:
+        cat = empty_cat_layout(gc.cat_width)
     n = layout.bins.shape[0]
     L = gc.num_leaves
     TB = gc.total_bins
@@ -245,6 +256,14 @@ def grow_tree(layout: DataLayout, grad: jnp.ndarray, hess: jnp.ndarray,
         cand = find_best_split_numerical(
             hist, sg, sh, cnt, meta, params, cmin, cmax, feature_mask,
             num_features=F, use_mc=gc.use_mc)
+        # widen the numerical candidate's dummy cat_mask to cat_width
+        cand = cand._replace(
+            cat_mask=jnp.zeros((gc.cat_width,), BOOL))
+        if cat.cat_feature.shape[0] > 0:
+            cat_cand = find_best_split_categorical(
+                hist, sg, sh, cnt, cat, meta, params, cmin, cmax,
+                feature_mask, use_mc=gc.use_mc)
+            cand = merge_candidates(cand, cat_cand)
         if gc.max_depth > 0:
             blocked = depth >= gc.max_depth
             cand = cand._replace(

@@ -359,3 +359,222 @@ def find_best_split_numerical(hist, sum_grad, sum_hess, num_data,
         is_cat=jnp.asarray(False),
         cat_mask=jnp.zeros((1,), dtype=bool),
     )
+
+
+class CatLayout(NamedTuple):
+    """Static gather layout for categorical features, built host-side once.
+
+    cat_feature: [C] i32 inner feature id of each categorical feature
+    gather_idx: [C, W] i32 global bin index of each local bin (clipped)
+    bin_valid: [C, W] bool local bin < num_bin
+    used_bin: [C] i32 (num_bin - 1 + is_full_categorical, hpp:281-282)
+    num_bin: [C] i32
+    """
+    cat_feature: jnp.ndarray
+    gather_idx: jnp.ndarray
+    bin_valid: jnp.ndarray
+    used_bin: jnp.ndarray
+    num_bin: jnp.ndarray
+
+
+def _cat_onehot_scan(grad_b, hess_b, cnt_b, used_mask, sum_grad, sum_hess_adj,
+                     num_data, p: SplitParams, cmin, cmax, use_mc: bool):
+    """One-hot categorical: each single bin vs rest
+    (feature_histogram.hpp:291-338). Vectorized over the W bins."""
+    hess_adj = hess_b + K_EPSILON
+    other_grad = sum_grad - grad_b
+    other_hess = sum_hess_adj - hess_b - K_EPSILON
+    other_cnt = num_data - cnt_b
+    ok = used_mask
+    ok &= (cnt_b >= p.min_data_in_leaf) & (hess_b >= p.min_sum_hessian_in_leaf)
+    ok &= (other_cnt >= p.min_data_in_leaf)
+    ok &= (other_hess >= p.min_sum_hessian_in_leaf)
+    gains = _split_gains(other_grad, other_hess, grad_b, hess_adj,
+                         p.lambda_l1, p.lambda_l2, p.max_delta_step,
+                         cmin, cmax, jnp.asarray(0.0, F64), use_mc)
+    gains = jnp.where(ok, gains, K_MIN_SCORE)
+    t = jnp.argmax(gains)
+    best_gain = gains[t]
+    W = grad_b.shape[0]
+    cat_mask = jnp.arange(W) == t
+    return (best_gain, cat_mask, grad_b[t], hess_adj[t], cnt_b[t])
+
+
+def _cat_sorted_scan(grad_b, hess_b, cnt_b, used_mask, sum_grad, sum_hess_adj,
+                     num_data, p: SplitParams, cmin, cmax, use_mc: bool):
+    """Many-vs-many categorical: bins sorted by grad/hess ratio, prefix scans
+    in both directions with the reference's stateful min_data_per_group
+    bookkeeping (feature_histogram.hpp:339-432) as a lax.scan."""
+    W = grad_b.shape[0]
+    l2 = p.lambda_l2 + p.cat_l2
+    # filter: count >= cat_smooth (hpp:340-344; count vs cat_smooth is the
+    # reference's comparison, odd but faithful)
+    part = used_mask & (cnt_b.astype(F64) >= p.cat_smooth)
+    ratio = grad_b / (hess_b + p.cat_smooth)
+    ratio = jnp.where(part, ratio, jnp.inf)    # excluded bins sort last
+    order = jnp.argsort(ratio, stable=True)    # ascending
+    used_bin_cnt = jnp.sum(part.astype(I32))
+    max_num_cat = jnp.minimum(p.max_cat_threshold, (used_bin_cnt + 1) // 2)
+
+    g_s = grad_b[order]
+    h_s = hess_b[order]
+    c_s = cnt_b[order]
+    valid_s = part[order]
+
+    def direction(reverse: bool):
+        if reverse:
+            gd = jnp.where(valid_s, g_s, 0.0)[::-1]
+            hd = jnp.where(valid_s, h_s, 0.0)[::-1]
+            cd = jnp.where(valid_s, c_s, 0)[::-1]
+            vd = valid_s[::-1]
+            # roll so position 0 is the last USED bin
+            shift = W - used_bin_cnt
+            gd = jnp.roll(gd, -shift, 0)
+            hd = jnp.roll(hd, -shift, 0)
+            cd = jnp.roll(cd, -shift, 0)
+            vd = jnp.roll(vd, -shift, 0)
+        else:
+            gd = jnp.where(valid_s, g_s, 0.0)
+            hd = jnp.where(valid_s, h_s, 0.0)
+            cd = jnp.where(valid_s, c_s, 0)
+            vd = valid_s
+
+        def step(carry, x):
+            (sum_lg, sum_lh, left_cnt, cnt_grp, stopped, i) = carry
+            g, h, c, v = x
+            sum_lg = sum_lg + g
+            sum_lh = sum_lh + h
+            left_cnt = left_cnt + c
+            cnt_grp = cnt_grp + c
+            in_range = v & (i < max_num_cat) & (~stopped)
+            right_cnt = num_data - left_cnt
+            right_hess = sum_hess_adj - sum_lh
+            brk = (right_cnt < p.min_data_in_leaf) \
+                | (right_cnt < p.min_data_per_group) \
+                | (right_hess < p.min_sum_hessian_in_leaf)
+            stopped = stopped | (in_range & brk)
+            ok = in_range & (~brk)
+            ok &= (left_cnt >= p.min_data_in_leaf)
+            ok &= (sum_lh >= p.min_sum_hessian_in_leaf)
+            ok &= (cnt_grp >= p.min_data_per_group)
+            gain = _split_gains(sum_lg, sum_lh, sum_grad - sum_lg,
+                                sum_hess_adj - sum_lh, p.lambda_l1, l2,
+                                p.max_delta_step, cmin, cmax,
+                                jnp.asarray(0.0, F64), use_mc)
+            gain = jnp.where(ok, gain, K_MIN_SCORE)
+            cnt_grp = jnp.where(ok, 0, cnt_grp)
+            return ((sum_lg, sum_lh, left_cnt, cnt_grp, stopped, i + 1),
+                    (gain, sum_lg, sum_lh, left_cnt))
+
+        init = (jnp.asarray(0.0, F64), jnp.asarray(K_EPSILON, F64),
+                jnp.asarray(0, I32), jnp.asarray(0, I32),
+                jnp.asarray(False), jnp.asarray(0, I32))
+        _, (gains, lgs, lhs, lcs) = jax.lax.scan(
+            step, init, (gd, hd.astype(F64), cd, vd))
+        i_best = jnp.argmax(gains)
+        return gains[i_best], i_best, lgs[i_best], lhs[i_best], lcs[i_best]
+
+    gain_f, i_f, lg_f, lh_f, lc_f = direction(False)
+    gain_r, i_r, lg_r, lh_r, lc_r = direction(True)
+    use_r = gain_r > gain_f
+    best_gain = jnp.where(use_r, gain_r, gain_f)
+    i_best = jnp.where(use_r, i_r, i_f)
+    lg = jnp.where(use_r, lg_r, lg_f)
+    lh = jnp.where(use_r, lh_r, lh_f)
+    lc = jnp.where(use_r, lc_r, lc_f)
+    # cat_mask over local bins: first i_best+1 sorted bins (or last, reversed)
+    pos_of = jnp.argsort(order, stable=True)   # local bin -> sorted position
+    fwd_mask = pos_of <= i_best
+    rev_mask = pos_of >= (used_bin_cnt - 1 - i_best)
+    cat_mask = jnp.where(use_r, rev_mask, fwd_mask) & part
+    return best_gain, cat_mask, lg, lh, lc
+
+
+@functools.partial(jax.jit, static_argnames=("use_mc",))
+def find_best_split_categorical(hist, sum_grad, sum_hess, num_data,
+                                cat: CatLayout, meta: FeatureMeta,
+                                p: SplitParams, cmin, cmax, feature_mask,
+                                use_mc: bool = False) -> SplitCandidate:
+    """Best categorical split over all categorical features of one leaf.
+
+    Mirrors FindBestThresholdCategoricalInner (feature_histogram.hpp:263-474):
+    one-hot when num_bin <= max_cat_to_onehot, else the sorted two-direction
+    scan; the l2 used for outputs includes cat_l2 only in sorted mode.
+    Returns a scalar SplitCandidate (feature -1 when nothing splits).
+    """
+    C, W = cat.gather_idx.shape
+    sum_hess_adj = sum_hess + 2 * K_EPSILON
+    cnt_factor = num_data.astype(F64) / sum_hess_adj
+    gain_shift = _leaf_gain(sum_grad, sum_hess_adj, p.lambda_l1, p.lambda_l2,
+                            p.max_delta_step)
+    min_gain_shift = gain_shift + p.min_gain_to_split
+
+    def per_feature(f_idx, g_idx, valid, used_bin, nb):
+        grad_b = hist[g_idx, 0].astype(F64)
+        hess_b = hist[g_idx, 1].astype(F64)
+        used_mask = valid & (jnp.arange(W) < used_bin)
+        grad_b = jnp.where(used_mask, grad_b, 0.0)
+        hess_b = jnp.where(used_mask, hess_b, 0.0)
+        cnt_b = _round_int(hess_b * cnt_factor)
+        onehot = nb <= p.max_cat_to_onehot
+        oh = _cat_onehot_scan(grad_b, hess_b, cnt_b, used_mask, sum_grad,
+                              sum_hess_adj, num_data, p, cmin, cmax, use_mc)
+        so = _cat_sorted_scan(grad_b, hess_b, cnt_b, used_mask, sum_grad,
+                              sum_hess_adj, num_data, p, cmin, cmax, use_mc)
+        gain, mask, lg, lh, lc = jax.tree.map(
+            lambda a, b: jnp.where(onehot, a, b), oh, so)
+        l2_out = jnp.where(onehot, p.lambda_l2, p.lambda_l2 + p.cat_l2)
+        ok = (gain > min_gain_shift) & feature_mask[f_idx]
+        gain_out = jnp.where(ok, (gain - min_gain_shift)
+                             * meta.penalty[f_idx], K_MIN_SCORE)
+        return gain_out, mask, lg, lh, lc, l2_out
+
+    if C == 0:
+        z64 = jnp.asarray(0.0, F64)
+        return SplitCandidate(
+            gain=jnp.asarray(K_MIN_SCORE, F64), feature=jnp.asarray(-1, I32),
+            threshold=jnp.asarray(0, I32), default_left=jnp.asarray(False),
+            left_output=z64, right_output=z64, left_sum_grad=z64,
+            left_sum_hess=z64, right_sum_grad=z64, right_sum_hess=z64,
+            left_count=jnp.asarray(0, I32), right_count=jnp.asarray(0, I32),
+            is_cat=jnp.asarray(False), cat_mask=jnp.zeros((W or 1,), bool))
+
+    gains, masks, lgs, lhs, lcs, l2s = jax.vmap(per_feature)(
+        cat.cat_feature, cat.gather_idx, cat.bin_valid, cat.used_bin,
+        cat.num_bin)
+    c = jnp.argmax(gains)
+    best_valid = gains[c] > K_MIN_SCORE
+    lg, lh, lc = lgs[c], lhs[c], lcs[c]
+    rg = sum_grad - lg
+    rh = sum_hess_adj - lh
+    rc = num_data - lc
+    l2b = l2s[c]
+    cm_b, cx_b = (cmin, cmax) if use_mc else (-jnp.inf, jnp.inf)
+    lo = _leaf_output(lg, lh, p.lambda_l1, l2b, p.max_delta_step,
+                      cm_b, cx_b, use_mc)
+    ro = _leaf_output(rg, rh, p.lambda_l1, l2b, p.max_delta_step,
+                      cm_b, cx_b, use_mc)
+    return SplitCandidate(
+        gain=jnp.where(best_valid, gains[c], K_MIN_SCORE),
+        feature=jnp.where(best_valid, cat.cat_feature[c], -1),
+        threshold=jnp.asarray(0, I32),
+        default_left=jnp.asarray(False),
+        left_output=lo, right_output=ro,
+        left_sum_grad=lg, left_sum_hess=lh - K_EPSILON,
+        right_sum_grad=rg, right_sum_hess=rh - K_EPSILON,
+        left_count=lc.astype(I32), right_count=rc.astype(I32),
+        is_cat=jnp.asarray(True),
+        cat_mask=masks[c],
+    )
+
+
+def merge_candidates(a: SplitCandidate, b: SplitCandidate) -> SplitCandidate:
+    """Pick the better of two candidates (SplitInfo::operator>,
+    split_info.hpp:126-153: higher gain wins; equal gain keeps the smaller
+    feature id — matching the reference's single-loop scan order)."""
+    b_wins = (b.gain > a.gain) | ((b.gain == a.gain)
+                                  & (b.feature >= 0)
+                                  & ((a.feature < 0)
+                                     | (b.feature < a.feature)))
+    return jax.tree.map(
+        lambda x, y: jnp.where(b_wins, y, x), a, b)

@@ -79,10 +79,12 @@ class DataParallelTreeLearner(SerialTreeLearner):
             in_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS), P()),
             out_specs=_tree_arrays_spec(gc),
             check_vma=False)
+        cat = self.cat_layout
+
         def run(bins, grad, hess, bag, fmask):
             layout = DataLayout(bins, *layout_rest)
             return grow_tree(layout, grad, hess, bag, meta, params, fmask,
-                             fix, gc, axis_name=AXIS)
+                             fix, gc, axis_name=AXIS, cat=cat)
         return run
 
     def train(self, grad: jnp.ndarray, hess: jnp.ndarray,

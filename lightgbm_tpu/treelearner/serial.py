@@ -21,9 +21,41 @@ import jax.numpy as jnp
 
 from ..config import Config
 from ..models.tree import Tree
-from ..ops.grow import DataLayout, FixInfo, GrowConfig, grow_tree
-from ..ops.split import FeatureMeta, SplitParams
+from ..ops.grow import (DataLayout, FixInfo, GrowConfig, empty_cat_layout,
+                        grow_tree)
+from ..ops.split import CatLayout, FeatureMeta, SplitParams
 from ..utils.log import Log
+
+
+def build_cat_layout(dataset, cat_width: int) -> CatLayout:
+    """Host-side gather layout for categorical features (ops.split.CatLayout).
+
+    used_bin follows feature_histogram.hpp:281-282: num_bin - 1 +
+    (missing_type == None) — the trailing other/NaN bin never splits alone.
+    """
+    import jax.numpy as jnp
+    cat_ids = np.nonzero(dataset.is_categorical)[0].astype(np.int32)
+    C = len(cat_ids)
+    if C == 0:
+        return empty_cat_layout(cat_width)
+    W = cat_width
+    gather = np.zeros((C, W), dtype=np.int32)
+    valid = np.zeros((C, W), dtype=bool)
+    used = np.zeros(C, dtype=np.int32)
+    nbins = np.zeros(C, dtype=np.int32)
+    for i, f in enumerate(cat_ids):
+        nb = int(dataset.bin_end[f] - dataset.bin_start[f])
+        idx = dataset.bin_start[f] + np.arange(W)
+        gather[i] = np.clip(idx, 0, dataset.total_bins - 1)
+        valid[i, :nb] = True
+        is_full = dataset.missing_type_arr[f] == 0
+        used[i] = nb - 1 + int(is_full)
+        nbins[i] = nb
+    return CatLayout(cat_feature=jnp.asarray(cat_ids),
+                     gather_idx=jnp.asarray(gather),
+                     bin_valid=jnp.asarray(valid),
+                     used_bin=jnp.asarray(used),
+                     num_bin=jnp.asarray(nbins))
 
 
 class ColSampler:
@@ -78,6 +110,7 @@ class SerialTreeLearner:
             cat_width=cat_width,
         )
         self.col_sampler = ColSampler(config, dataset.num_features)
+        self.cat_layout = build_cat_layout(dataset, cat_width)
         self._axis_name = None   # set by parallel learners
 
     def train(self, grad: jnp.ndarray, hess: jnp.ndarray,
@@ -91,7 +124,7 @@ class SerialTreeLearner:
         fmask = jnp.asarray(self.col_sampler.sample())
         arrays = grow_tree(self.layout, grad, hess, bag_mask, self.meta,
                            self.params, fmask, self.fix, self.grow_config,
-                           axis_name=self._axis_name)
+                           axis_name=self._axis_name, cat=self.cat_layout)
         import jax
         host = jax.tree.map(np.asarray, arrays)
         tree = Tree.from_grower(host, self.dataset)

@@ -1,0 +1,325 @@
+"""End-to-end training tests.
+
+Mirrors the reference test strategy (tests/python_package_test/test_engine.py):
+per-objective training correctness on synthetic data with known structure,
+early stopping, continued training, cv, pickling, missing values. Golden
+expectations are behavioral (loss decreases to a threshold; exact structural
+predictions on tiny crafted datasets) rather than bitwise.
+"""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _make_binary(n=1200, f=8, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    y = (X[:, 0] + X[:, 1] ** 2 + 0.1 * rng.normal(size=n) > 0.8).astype(float)
+    return X, y
+
+
+def _make_regression(n=1200, f=8, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    y = 2.0 * X[:, 0] + np.sin(X[:, 1]) + 0.05 * rng.normal(size=n)
+    return X, y
+
+
+def test_binary():
+    X, y = _make_binary()
+    ds = lgb.Dataset(X, y)
+    evals = {}
+    b = lgb.train({"objective": "binary", "metric": "binary_logloss",
+                   "num_leaves": 15, "verbosity": -1}, ds, 30,
+                  valid_sets=[ds], valid_names=["train"],
+                  evals_result=evals, verbose_eval=False)
+    ll = evals["train"]["binary_logloss"]
+    assert ll[-1] < 0.25
+    assert ll[-1] < ll[0]
+    p = b.predict(X)
+    assert ((p > 0.5) == (y > 0)).mean() > 0.93
+
+
+def test_regression():
+    X, y = _make_regression()
+    ds = lgb.Dataset(X, y)
+    evals = {}
+    lgb.train({"objective": "regression", "metric": "l2",
+               "num_leaves": 31, "verbosity": -1}, ds, 30,
+              valid_sets=[ds], evals_result=evals, verbose_eval=False)
+    l2 = evals["training"]["l2"]
+    assert l2[-1] < 0.25 * np.var(y)
+
+
+def test_missing_value_handling():
+    """Missing (NaN) rows route to the correct side (reference
+    test_engine.py:117 family)."""
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(400, 3))
+    X[:100, 0] = np.nan
+    y = np.where(np.isnan(X[:, 0]), 1.0, (X[:, 0] > 0).astype(float))
+    b = lgb.train({"objective": "binary", "num_leaves": 7, "verbosity": -1,
+                   "min_data_in_leaf": 1}, lgb.Dataset(X, y), 40,
+                  verbose_eval=False)
+    p = b.predict(X)
+    assert ((p > 0.5) == (y > 0)).mean() > 0.98
+
+
+def test_early_stopping():
+    X, y = _make_binary()
+    Xv, yv = _make_binary(seed=7)
+    ds = lgb.Dataset(X, y)
+    vs = lgb.Dataset(Xv, yv, reference=ds)
+    b = lgb.train({"objective": "binary", "metric": "binary_logloss",
+                   "num_leaves": 63, "verbosity": -1}, ds, 200,
+                  valid_sets=[vs], early_stopping_rounds=5,
+                  verbose_eval=False)
+    assert 0 < b.best_iteration < 200
+
+
+def test_continue_train():
+    X, y = _make_regression()
+    ds = lgb.Dataset(X, y)
+    b1 = lgb.train({"objective": "regression", "num_leaves": 15,
+                    "verbosity": -1}, ds, 10, verbose_eval=False)
+    b2 = lgb.train({"objective": "regression", "num_leaves": 15,
+                    "verbosity": -1}, lgb.Dataset(X, y), 10,
+                   init_model=b1, verbose_eval=False)
+    assert b2.num_trees() == 20
+    mse1 = np.mean((y - b1.predict(X)) ** 2)
+    mse2 = np.mean((y - b2.predict(X)) ** 2)
+    assert mse2 < mse1
+
+
+def test_model_roundtrip(tmp_path):
+    X, y = _make_binary()
+    b = lgb.train({"objective": "binary", "num_leaves": 15, "verbosity": -1},
+                  lgb.Dataset(X, y), 10, verbose_eval=False)
+    path = str(tmp_path / "model.txt")
+    b.save_model(path)
+    b2 = lgb.Booster(model_file=path)
+    np.testing.assert_allclose(b.predict(X, raw_score=True),
+                               b2.predict(X, raw_score=True), rtol=1e-12)
+    # converted predictions survive too (objective string parsed back)
+    np.testing.assert_allclose(b.predict(X), b2.predict(X), rtol=1e-12)
+
+
+def test_pickle():
+    import pickle
+    X, y = _make_binary()
+    b = lgb.train({"objective": "binary", "num_leaves": 7, "verbosity": -1},
+                  lgb.Dataset(X, y), 5, verbose_eval=False)
+    b2 = pickle.loads(pickle.dumps(b))
+    np.testing.assert_allclose(b.predict(X, raw_score=True),
+                               b2.predict(X, raw_score=True))
+
+
+def test_multiclass():
+    rng = np.random.default_rng(5)
+    X = rng.normal(size=(900, 6))
+    y = np.argmax(X[:, :3] + 0.3 * rng.normal(size=(900, 3)), axis=1).astype(float)
+    evals = {}
+    b = lgb.train({"objective": "multiclass", "num_class": 3,
+                   "metric": "multi_logloss", "num_leaves": 15,
+                   "verbosity": -1}, lgb.Dataset(X, y), 25,
+                  valid_sets=[lgb.Dataset(X, y, reference=None)],
+                  evals_result=evals, verbose_eval=False)
+    p = b.predict(X)
+    assert p.shape == (900, 3)
+    assert (np.argmax(p, 1) == y).mean() > 0.85
+
+
+def test_multiclassova():
+    rng = np.random.default_rng(5)
+    X = rng.normal(size=(600, 6))
+    y = np.argmax(X[:, :3], axis=1).astype(float)
+    b = lgb.train({"objective": "multiclassova", "num_class": 3,
+                   "num_leaves": 7, "verbosity": -1},
+                  lgb.Dataset(X, y), 20, verbose_eval=False)
+    p = b.predict(X)
+    assert (np.argmax(p, 1) == y).mean() > 0.85
+
+
+@pytest.mark.parametrize("objective,tol", [
+    ("regression_l1", 0.5), ("huber", 0.3), ("fair", 0.3),
+    ("quantile", 0.6), ("mape", 0.6)])
+def test_regression_objectives(objective, tol):
+    X, y = _make_regression()
+    y = y - y.min() + 1.0   # keep positive for mape stability
+    b = lgb.train({"objective": objective, "num_leaves": 15,
+                   "verbosity": -1}, lgb.Dataset(X, y), 40,
+                  verbose_eval=False)
+    mse = np.mean((y - b.predict(X)) ** 2)
+    assert mse < tol * np.var(y), mse
+
+
+@pytest.mark.parametrize("objective", ["poisson", "gamma", "tweedie"])
+def test_positive_objectives(objective):
+    rng = np.random.default_rng(9)
+    X = rng.normal(size=(800, 5))
+    y = np.exp(0.5 * X[:, 0] + 0.1 * rng.normal(size=800))
+    b = lgb.train({"objective": objective, "num_leaves": 15,
+                   "verbosity": -1}, lgb.Dataset(X, y), 40,
+                  verbose_eval=False)
+    p = b.predict(X)
+    assert np.all(p > 0)
+    # correlation with target is strong
+    assert np.corrcoef(p, y)[0, 1] > 0.8
+
+
+def test_xentropy():
+    rng = np.random.default_rng(11)
+    X = rng.normal(size=(600, 5))
+    y = 1.0 / (1.0 + np.exp(-X[:, 0]))        # soft labels in [0,1]
+    b = lgb.train({"objective": "cross_entropy", "num_leaves": 15,
+                   "verbosity": -1}, lgb.Dataset(X, y), 30,
+                  verbose_eval=False)
+    p = b.predict(X)
+    assert np.mean((p - y) ** 2) < 0.01
+
+
+def test_goss_dart_rf():
+    X, y = _make_binary(n=2000)
+    common = {"objective": "binary", "num_leaves": 15, "verbosity": -1}
+    accs = {}
+    for boosting, extra in [
+            ("goss", {}),
+            ("dart", {"drop_rate": 0.2}),
+            ("rf", {"bagging_freq": 1, "bagging_fraction": 0.7})]:
+        params = dict(common, boosting=boosting, **extra)
+        b = lgb.train(params, lgb.Dataset(X, y), 30, verbose_eval=False)
+        p = b.predict(X)
+        accs[boosting] = ((p > 0.5) == (y > 0)).mean()
+    for k, acc in accs.items():
+        assert acc > 0.9, (k, acc)
+
+
+def test_bagging_and_feature_fraction():
+    X, y = _make_binary(n=2000)
+    b = lgb.train({"objective": "binary", "num_leaves": 15, "verbosity": -1,
+                   "bagging_fraction": 0.6, "bagging_freq": 2,
+                   "feature_fraction": 0.7}, lgb.Dataset(X, y), 30,
+                  verbose_eval=False)
+    p = b.predict(X)
+    assert ((p > 0.5) == (y > 0)).mean() > 0.92
+
+
+def test_lambdarank():
+    rng = np.random.default_rng(13)
+    n_queries, per_q = 60, 20
+    n = n_queries * per_q
+    X = rng.normal(size=(n, 5))
+    rel = np.clip((X[:, 0] * 1.5 + 0.3 * rng.normal(size=n)), 0, None)
+    y = np.minimum(rel.astype(int), 4).astype(float)
+    group = np.full(n_queries, per_q)
+    ds = lgb.Dataset(X, y, group=group)
+    evals = {}
+    b = lgb.train({"objective": "lambdarank", "metric": "ndcg",
+                   "eval_at": [5], "num_leaves": 15, "verbosity": -1,
+                   "min_data_in_leaf": 5},
+                  ds, 30, valid_sets=[ds], evals_result=evals,
+                  verbose_eval=False)
+    ndcg = evals["training"]["ndcg@5"]
+    assert ndcg[-1] > 0.80
+    assert ndcg[-1] > ndcg[0]
+
+
+def test_xendcg():
+    rng = np.random.default_rng(13)
+    n_queries, per_q = 60, 20
+    n = n_queries * per_q
+    X = rng.normal(size=(n, 5))
+    y = np.minimum(np.clip(X[:, 0] * 1.5, 0, None).astype(int), 4).astype(float)
+    group = np.full(n_queries, per_q)
+    ds = lgb.Dataset(X, y, group=group)
+    evals = {}
+    lgb.train({"objective": "rank_xendcg", "metric": "ndcg", "eval_at": [5],
+               "num_leaves": 15, "verbosity": -1, "min_data_in_leaf": 5},
+              ds, 30, valid_sets=[ds], evals_result=evals, verbose_eval=False)
+    assert evals["training"]["ndcg@5"][-1] > 0.80
+
+
+def test_cv():
+    X, y = _make_binary()
+    r = lgb.cv({"objective": "binary", "metric": "auc", "verbosity": -1,
+                "num_leaves": 7}, lgb.Dataset(X, y), 10, nfold=3,
+               stratified=False)
+    assert len(r["valid auc-mean"]) == 10
+    assert r["valid auc-mean"][-1] > 0.9
+
+
+def test_custom_objective_and_metric():
+    X, y = _make_binary()
+
+    def fobj(preds, dtrain):
+        labels = dtrain.get_label()
+        p = 1.0 / (1.0 + np.exp(-preds))
+        return p - labels, p * (1 - p)
+
+    def feval(preds, dtrain):
+        labels = dtrain.get_label()
+        return "my_err", float(np.mean((preds > 0) != (labels > 0))), False
+
+    evals = {}
+    lgb.train({"objective": "none", "verbosity": -1, "num_leaves": 15},
+              lgb.Dataset(X, y), 30, valid_sets=[lgb.Dataset(X, y)],
+              fobj=fobj, feval=feval, evals_result=evals, verbose_eval=False)
+    errs = evals["valid_0"]["my_err"]
+    assert errs[-1] < 0.1
+
+
+def test_monotone_constraints():
+    """Compliance checker like reference test_engine.py:998."""
+    rng = np.random.default_rng(17)
+    n = 1500
+    X = rng.uniform(size=(n, 3))
+    y = (3 * X[:, 0] - 2 * X[:, 1] + 0.5 * rng.normal(size=n))
+    b = lgb.train({"objective": "regression", "num_leaves": 31,
+                   "verbosity": -1, "monotone_constraints": [1, -1, 0]},
+                  lgb.Dataset(X, y), 30, verbose_eval=False)
+
+    def is_monotone(b, feature, sign):
+        grid = np.tile(np.array([0.5, 0.5, 0.5]), (50, 1))
+        grid[:, feature] = np.linspace(0, 1, 50)
+        p = b.predict(grid)
+        d = np.diff(p)
+        return np.all(sign * d >= -1e-10)
+    assert is_monotone(b, 0, +1)
+    assert is_monotone(b, 1, -1)
+
+
+def test_weights():
+    X, y = _make_regression(n=800)
+    w = np.ones(800)
+    w[:400] = 10.0
+    b = lgb.train({"objective": "regression", "num_leaves": 15,
+                   "verbosity": -1}, lgb.Dataset(X, y, weight=w), 20,
+                  verbose_eval=False)
+    pred = b.predict(X)
+    mse_heavy = np.mean((y[:400] - pred[:400]) ** 2)
+    assert mse_heavy < 0.3 * np.var(y)
+
+
+def test_feature_importance():
+    X, y = _make_regression()
+    b = lgb.train({"objective": "regression", "num_leaves": 15,
+                   "verbosity": -1}, lgb.Dataset(X, y), 10,
+                  verbose_eval=False)
+    imp_split = b.feature_importance("split")
+    imp_gain = b.feature_importance("gain")
+    assert imp_split.sum() > 0
+    # features 0 and 1 carry all the signal
+    assert imp_gain[0] + imp_gain[1] > 0.9 * imp_gain.sum()
+
+
+def test_dump_model_json():
+    X, y = _make_binary(n=300)
+    b = lgb.train({"objective": "binary", "num_leaves": 7, "verbosity": -1},
+                  lgb.Dataset(X, y), 3, verbose_eval=False)
+    import json
+    d = b.dump_model()
+    s = json.dumps(d)
+    assert d["num_class"] == 1
+    assert len(d["tree_info"]) == 3
+    assert "tree_structure" in d["tree_info"][0]
