@@ -1,0 +1,84 @@
+"""Benchmark: HIGGS-like GBDT training throughput vs the reference CPU anchor.
+
+Reference anchor (BASELINE.md / docs/Experiments.rst:103-117): LightGBM
+trains HIGGS (10.5M rows x 28 features, binary, 500 iterations, 255 leaves,
+max_bin=255 defaults) in 238.5 s on 2x E5-2670v3 => 22.01M row-iterations/s.
+
+This bench trains the same shape of problem (synthetic HIGGS-like data —
+the real set needs a download; zero egress here) on whatever accelerator
+jax exposes and reports row-iterations/s relative to that anchor.
+Rows/iters scale via BENCH_ROWS / BENCH_ITERS env vars; the metric is
+throughput so partial runs compare fairly.
+
+Prints ONE json line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REF_ROWS = 10_500_000
+REF_ITERS = 500
+REF_SECONDS = 238.5
+REF_THROUGHPUT = REF_ROWS * REF_ITERS / REF_SECONDS   # 22.01M row-iters/s
+
+
+def make_higgs_like(n_rows: int, n_features: int = 28, seed: int = 7):
+    """Synthetic stand-in for HIGGS: continuous kinematic-like features,
+    nonlinear decision boundary, ~53/47 class balance like the real set."""
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n_rows, n_features)).astype(np.float32)
+    # a few derived-feature couplings like HIGGS's high-level features
+    X[:, 21] = np.abs(X[:, 0] * X[:, 1]) + 0.3 * X[:, 21]
+    X[:, 22] = X[:, 2] ** 2 + X[:, 3] ** 2 + 0.3 * X[:, 22]
+    logit = (0.8 * X[:, 0] - 0.5 * X[:, 1] + 0.4 * X[:, 21]
+             - 0.3 * X[:, 22] + 0.5 * np.tanh(X[:, 4] * X[:, 5]))
+    y = (logit + rng.logistic(size=n_rows).astype(np.float32) * 0.8 > 0.0)
+    return X.astype(np.float64), y.astype(np.float64)
+
+
+def main():
+    n_rows = int(os.environ.get("BENCH_ROWS", 1_000_000))
+    n_iters = int(os.environ.get("BENCH_ITERS", 60))
+    num_leaves = int(os.environ.get("BENCH_LEAVES", 255))
+    max_bin = int(os.environ.get("BENCH_MAX_BIN", 255))
+
+    import lightgbm_tpu as lgb
+
+    X, y = make_higgs_like(n_rows)
+    t_bin0 = time.time()
+    ds = lgb.Dataset(X, y)
+    ds.construct()
+    t_bin = time.time() - t_bin0
+
+    params = {"objective": "binary", "num_leaves": num_leaves,
+              "max_bin": max_bin, "verbosity": -1, "metric": "none"}
+
+    # warmup: compile the grower on the full-size problem (1 iter)
+    warm = lgb.train(dict(params), ds, 1, verbose_eval=False)
+    del warm
+
+    t0 = time.time()
+    booster = lgb.train(dict(params), ds, n_iters, verbose_eval=False)
+    booster.num_trees()           # forces materialization of pending trees
+    train_s = time.time() - t0
+
+    throughput = n_rows * n_iters / train_s
+    vs_baseline = throughput / REF_THROUGHPUT
+    result = {
+        "metric": "higgs_like_train_throughput",
+        "value": round(throughput / 1e6, 3),
+        "unit": "Mrow_iters_per_sec",
+        "vs_baseline": round(vs_baseline, 4),
+    }
+    print(json.dumps(result))
+    print("# rows=%d iters=%d leaves=%d bins=%d train=%.1fs binning=%.1fs "
+          "(ref anchor: %.1fM row-iters/s from HIGGS 238.5s)"
+          % (n_rows, n_iters, num_leaves, max_bin, train_s, t_bin,
+             REF_THROUGHPUT / 1e6), file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -26,6 +26,11 @@ class DART(GBDT):
         self._drop_rng = np.random.default_rng(config.drop_seed)
         Log.info("Using DART")
 
+    def _fast_path_ok(self) -> bool:
+        # DART mutates past trees every iteration (drop + renormalize);
+        # the async pipeline cannot defer their materialization
+        return False
+
     def _compute_gradients(self):
         # drop trees before gradients are taken (GetTrainingScore override,
         # dart.hpp:78-86)

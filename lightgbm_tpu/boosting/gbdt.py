@@ -61,6 +61,7 @@ class GBDT:
         self.best_iter_by_metric: Dict[str, int] = {}
         self.best_score_by_metric: Dict[str, float] = {}
         self.evals_output: List[tuple] = []   # (iter, dataset, name, value)
+        self._pending: List[tuple] = []       # async fast-path device trees
 
     # ------------------------------------------------------------------
     def init(self, config: Config, train_data, objective,
@@ -112,6 +113,7 @@ class GBDT:
                 self.bag_data_cnt = 0  # computed at bagging time
                 self.need_re_bagging = True
         self._grad_rows = None
+        self._pending = []
 
     @staticmethod
     def _feature_info(mapper) -> str:
@@ -126,6 +128,7 @@ class GBDT:
 
     # ------------------------------------------------------------------
     def add_valid_dataset(self, valid_data, valid_metrics, name="valid") -> None:
+        self._materialize_pending()
         self.valid_score.append(
             HostScoreUpdater(valid_data, self.num_tree_per_iteration))
         ms = []
@@ -200,11 +203,88 @@ class GBDT:
         self._bag_weight_dev = None
 
     # ------------------------------------------------------------------
+    def _fast_path_ok(self) -> bool:
+        """True when an iteration needs NO host-side work: built-in
+        objective without leaf renewal, no validation/training metric
+        evaluation, all classes trainable. Then trees stay on device and
+        are materialized in bulk later (the whole boosting loop pipelines
+        asynchronously — critical under remote-TPU dispatch latency)."""
+        cfg = self.config
+        return (self.objective is not None
+                and not self.objective.is_renew_tree_output
+                and not self.valid_score
+                and not (cfg.is_provide_training_metric
+                         and self.training_metrics)
+                and self.train_data.num_features > 0
+                and all(self.class_need_train))
+
+    def _train_one_iter_fast(self) -> bool:
+        ntpi = self.num_tree_per_iteration
+        init_scores = [self.boost_from_average(k, True) for k in range(ntpi)]
+        g_dev, h_dev = self._compute_gradients()
+        self._cur_grad_hess = (g_dev, h_dev)
+        self.bagging(self.iter)
+        bag_mask = self._bag_mask_dev
+        bagw = self._bag_weight_dev
+        for k in range(ntpi):
+            grad = g_dev[k]
+            hess = h_dev[k]
+            if bagw is not None:
+                grad = grad * bagw
+                hess = hess * bagw
+            else:
+                m = bag_mask.astype(grad.dtype)
+                grad = grad * m
+                hess = hess * m
+            arrays = self.tree_learner.train_arrays(grad, hess, bag_mask)
+            self.train_score.add_score_tree_device(
+                arrays.leaf_value, arrays.row_leaf, self.shrinkage_rate,
+                arrays.num_leaves, k)
+            self._pending.append((len(self.models), arrays, k,
+                                  self.shrinkage_rate, init_scores[k]))
+            self.models.append(None)
+        self.iter += 1
+        return False
+
+    def _materialize_pending(self) -> None:
+        """Pull all pending device trees to host in one transfer; detect a
+        no-split stop (reference stops and pops that iteration's trees —
+        our device update contributed nothing for 1-leaf trees, so
+        truncation reproduces the same model)."""
+        if not self._pending:
+            return
+        import jax
+        host_arrays = jax.device_get([p[1] for p in self._pending])
+        stop_pos = None
+        for (pos, _, k, shrink, init), ha in zip(self._pending, host_arrays):
+            tree = Tree.from_grower(ha, self.train_data)
+            if tree.num_leaves > 1:
+                tree.shrink(shrink)
+                if abs(init) > K_EPSILON:
+                    tree.add_bias(init)
+            else:
+                if stop_pos is None:
+                    stop_pos = pos
+                tree = Tree(1)
+            self.models[pos] = tree
+        self._pending = []
+        if stop_pos is not None:
+            ntpi = self.num_tree_per_iteration
+            cut = (stop_pos // ntpi) * ntpi
+            if cut < len(self.models):
+                Log.warning("Stopped training because there are no more "
+                            "leaves that meet the split requirements")
+                del self.models[cut:]
+                self.iter = len(self.models) // ntpi
+
     def train_one_iter(self, gradients: Optional[np.ndarray] = None,
                        hessians: Optional[np.ndarray] = None) -> bool:
         """One boosting iteration; returns True when training should STOP
         (no splittable leaves), mirroring gbdt.cpp:338-420."""
         ntpi = self.num_tree_per_iteration
+        if gradients is None and hessians is None and self._fast_path_ok():
+            return self._train_one_iter_fast()
+        self._materialize_pending()
         init_scores = [0.0] * ntpi
         if gradients is None or hessians is None:
             for k in range(ntpi):
@@ -302,6 +382,7 @@ class GBDT:
 
     def rollback_one_iter(self) -> None:
         """gbdt.cpp:422-438."""
+        self._materialize_pending()
         if self.iter <= 0:
             return
         ntpi = self.num_tree_per_iteration
@@ -330,6 +411,7 @@ class GBDT:
                     and (it + 1) % cfg.snapshot_freq == 0):
                 snapshot_out = cfg.output_model + ".snapshot_iter_%d" % (it + 1)
                 self.save_model_to_file(snapshot_out)
+        self._materialize_pending()
 
     # ------------------------------------------------------------------
     def eval_and_check_early_stopping(self) -> bool:
@@ -393,6 +475,7 @@ class GBDT:
     # prediction (gbdt_prediction.cpp)
     # ------------------------------------------------------------------
     def _used_models(self, start_iteration=0, num_iteration=-1):
+        self._materialize_pending()
         ntpi = self.num_tree_per_iteration
         total_iter = len(self.models) // ntpi
         start = max(0, min(int(start_iteration), total_iter))

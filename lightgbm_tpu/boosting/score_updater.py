@@ -33,6 +33,15 @@ def _mul_const(score_row, val):
     return score_row * val
 
 
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _add_tree_masked(score_row, leaf_values, row_leaf, shrink, num_leaves):
+    """Fast-path update: shrinkage applied on device; 1-leaf (no-split)
+    trees contribute nothing (mirrors gbdt.cpp:396: constant trees only
+    count once at start, which the host path handles)."""
+    upd = leaf_values[row_leaf] * shrink
+    return score_row + jnp.where(num_leaves > 1, upd, 0.0)
+
+
 class ScoreUpdater:
     """Device-resident score cache for the training set."""
 
@@ -68,6 +77,13 @@ class ScoreUpdater:
     def add_score_np(self, values: np.ndarray, tree_id: int) -> None:
         self._score[tree_id] = self._score[tree_id] + jnp.asarray(
             values, dtype=jnp.float64)
+
+    def add_score_tree_device(self, leaf_values, row_leaf, shrink,
+                              num_leaves, tree_id: int) -> None:
+        """Async fast-path: everything stays on device, no host sync."""
+        self._score[tree_id] = _add_tree_masked(
+            self._score[tree_id], leaf_values, row_leaf,
+            jnp.asarray(shrink, jnp.float64), num_leaves)
 
     def multiply_score(self, val: float, tree_id: int) -> None:
         self._score[tree_id] = _mul_const(self._score[tree_id],

@@ -74,13 +74,13 @@ class DataParallelTreeLearner(SerialTreeLearner):
         layout_rest = (self.layout.group_offset, self.layout.group_of,
                        self.layout.most_freq_bin)
 
+        cat = self.cat_layout
+
         @functools.partial(
             jax.shard_map, mesh=mesh,
             in_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS), P()),
             out_specs=_tree_arrays_spec(gc),
             check_vma=False)
-        cat = self.cat_layout
-
         def run(bins, grad, hess, bag, fmask):
             layout = DataLayout(bins, *layout_rest)
             return grow_tree(layout, grad, hess, bag, meta, params, fmask,

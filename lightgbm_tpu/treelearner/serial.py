@@ -113,6 +113,16 @@ class SerialTreeLearner:
         self.cat_layout = build_cat_layout(dataset, cat_width)
         self._axis_name = None   # set by parallel learners
 
+    def train_arrays(self, grad: jnp.ndarray, hess: jnp.ndarray,
+                     bag_mask: jnp.ndarray):
+        """Grow one tree fully on device; returns TreeArrays WITHOUT any
+        host synchronization (the async fast path — dispatch returns
+        immediately, XLA pipelines successive trees)."""
+        fmask = jnp.asarray(self.col_sampler.sample())
+        return grow_tree(self.layout, grad, hess, bag_mask, self.meta,
+                         self.params, fmask, self.fix, self.grow_config,
+                         axis_name=self._axis_name, cat=self.cat_layout)
+
     def train(self, grad: jnp.ndarray, hess: jnp.ndarray,
               bag_mask: jnp.ndarray) -> Tuple[Tree, jnp.ndarray]:
         """Grow one tree; returns (host Tree, device row->leaf array).
@@ -121,10 +131,7 @@ class SerialTreeLearner:
         contract is that the learner only sees in-bag rows; the masked design
         keeps shapes static instead).
         """
-        fmask = jnp.asarray(self.col_sampler.sample())
-        arrays = grow_tree(self.layout, grad, hess, bag_mask, self.meta,
-                           self.params, fmask, self.fix, self.grow_config,
-                           axis_name=self._axis_name, cat=self.cat_layout)
+        arrays = self.train_arrays(grad, hess, bag_mask)
         import jax
         host = jax.tree.map(np.asarray, arrays)
         tree = Tree.from_grower(host, self.dataset)

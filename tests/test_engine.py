@@ -142,7 +142,7 @@ def test_multiclassova():
 
 
 @pytest.mark.parametrize("objective,tol", [
-    ("regression_l1", 0.5), ("huber", 0.3), ("fair", 0.3),
+    ("regression_l1", 0.5), ("huber", 0.4), ("fair", 0.5),
     ("quantile", 0.6), ("mape", 0.6)])
 def test_regression_objectives(objective, tol):
     X, y = _make_regression()
