@@ -62,7 +62,11 @@ def main():
 
     t0 = time.time()
     booster = lgb.train(dict(params), ds, n_iters, verbose_eval=False)
-    booster.num_trees()           # forces materialization of pending trees
+    # force the async pipeline to finish: materialize every pending device
+    # tree and block on the score buffer
+    booster._booster._materialize_pending()
+    import jax
+    jax.block_until_ready(booster._booster.train_score.score_device(0))
     train_s = time.time() - t0
 
     throughput = n_rows * n_iters / train_s

@@ -1,0 +1,6 @@
+"""`python -m lightgbm_tpu` — CLI entry (reference src/main.cpp)."""
+import sys
+
+from .main import main
+
+sys.exit(main())

@@ -1,0 +1,178 @@
+"""Text-file dataset loading: CSV / TSV / LibSVM with column resolution.
+
+TPU-native rebuild of the reference parser + loader front-end
+(src/io/parser.{hpp,cpp}: CSVParser :18, TSVParser :55, LibSVMParser :91,
+format auto-detection :200-216; DatasetLoader::SetHeader column resolution,
+src/io/dataset_loader.cpp:31-160). Parsing is vectorized numpy (np.loadtxt-
+style) on host; a C fast path can slot in behind the same interface.
+
+Column spec syntax follows the reference: an integer index, or `name:<col>`
+when the file has a header (label_column/weight_column/group_column/
+ignore_column, config.h).
+"""
+from __future__ import annotations
+
+import io
+import os
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..utils.log import Log
+
+NAME_PREFIX = "name:"
+
+
+def _detect_format(sample_lines: List[str]) -> str:
+    """LibSVM when tokens contain ':', else TSV on tabs, else CSV
+    (reference parser.cpp:120-216 heuristic, simplified)."""
+    for line in sample_lines:
+        line = line.strip()
+        if not line:
+            continue
+        toks = line.replace("\t", " ").replace(",", " ").split()
+        has_colon = any(":" in t for t in toks[1:])
+        if has_colon:
+            return "libsvm"
+        if "\t" in line:
+            return "tsv"
+        if "," in line:
+            return "csv"
+        return "tsv" if len(toks) > 1 else "csv"
+    Log.fatal("Unknown format of training data")
+
+
+def _resolve_column(spec: str, header: Optional[List[str]], what: str) -> int:
+    """Column spec -> index; -1 when unset."""
+    if not spec:
+        return -1
+    if spec.startswith(NAME_PREFIX):
+        name = spec[len(NAME_PREFIX):]
+        if header is None:
+            Log.fatal("Cannot use column name %s without header" % name)
+        if name not in header:
+            Log.fatal("Could not find %s column %s in data file"
+                      % (what, name))
+        return header.index(name)
+    try:
+        return int(spec)
+    except ValueError:
+        Log.fatal("Cannot parse %s column '%s'" % (what, spec))
+
+
+class LoadedData:
+    def __init__(self, X, label, weight, group, feature_names):
+        self.X = X
+        self.label = label
+        self.weight = weight
+        self.group = group
+        self.feature_names = feature_names
+
+
+def load_text_file(filename: str, config) -> LoadedData:
+    """File -> dense matrix + metadata columns."""
+    if not os.path.exists(filename):
+        Log.fatal("Data file %s does not exist" % filename)
+    with open(filename, "r") as f:
+        text = f.read()
+    lines = text.splitlines()
+    if not lines:
+        Log.fatal("Data file %s is empty" % filename)
+
+    header: Optional[List[str]] = None
+    has_header = bool(config.header)
+    first_data_line = 0
+    sep = None
+    fmt = _detect_format(lines[1 if has_header else 0:][:10])
+    sep = {"csv": ",", "tsv": "\t", "libsvm": None}[fmt]
+    if has_header:
+        header = [t.strip() for t in
+                  (lines[0].split(sep) if sep else lines[0].split())]
+        first_data_line = 1
+
+    label_idx = 0
+    if config.label_column:
+        label_idx = _resolve_column(config.label_column, header, "label")
+    weight_idx = _resolve_column(config.weight_column, header, "weight")
+    group_idx = _resolve_column(config.group_column, header, "group")
+    ignore_idx: List[int] = []
+    if config.ignore_column:
+        if config.ignore_column.startswith(NAME_PREFIX):
+            for nm in config.ignore_column[len(NAME_PREFIX):].split(","):
+                ignore_idx.append(_resolve_column(NAME_PREFIX + nm, header,
+                                                  "ignore"))
+        else:
+            ignore_idx = [int(x) for x in config.ignore_column.split(",")]
+
+    data_lines = lines[first_data_line:]
+    data_lines = [ln for ln in data_lines if ln.strip()]
+
+    if fmt == "libsvm":
+        return _parse_libsvm(data_lines, label_idx, header)
+
+    mat = np.genfromtxt(io.StringIO("\n".join(data_lines)), delimiter=sep,
+                        dtype=np.float64)
+    if mat.ndim == 1:
+        mat = mat.reshape(-1, 1)
+    ncol = mat.shape[1]
+    special = {label_idx} | {weight_idx, group_idx} | set(ignore_idx)
+    special.discard(-1)
+    feat_cols = [c for c in range(ncol) if c not in special]
+    label = mat[:, label_idx] if label_idx >= 0 else np.zeros(len(mat))
+    weight = mat[:, weight_idx] if weight_idx >= 0 else None
+    group_col = mat[:, group_idx] if group_idx >= 0 else None
+    group = None
+    if group_col is not None:
+        # per-row query ids -> query sizes (metadata.cpp SetQueryId path)
+        _, counts = np.unique(group_col, return_counts=True)
+        # preserve order of appearance
+        change = np.nonzero(np.diff(group_col) != 0)[0]
+        bounds = np.concatenate([[0], change + 1, [len(group_col)]])
+        group = np.diff(bounds)
+    X = mat[:, feat_cols]
+    names = ([header[c] for c in feat_cols] if header is not None
+             else ["Column_%d" % c for c in feat_cols])
+    # query file alongside (reference Metadata::LoadQueryBoundaries from
+    # <data>.query); weight file <data>.weight
+    group = _sidecar(filename, ".query", group)
+    weight_sc = _sidecar(filename, ".weight", None)
+    if weight_sc is not None:
+        weight = weight_sc
+    return LoadedData(X, label.astype(np.float32), weight, group, names)
+
+
+def _sidecar(filename: str, suffix: str, default):
+    path = filename + suffix
+    if os.path.exists(path):
+        return np.loadtxt(path)
+    return default
+
+
+def _parse_libsvm(data_lines: List[str], label_idx: int,
+                  header) -> LoadedData:
+    """index:value rows -> dense matrix (reference LibSVMParser,
+    parser.hpp:91; indices are 0-based like the reference's default)."""
+    labels = np.empty(len(data_lines))
+    rows: List[Tuple[np.ndarray, np.ndarray]] = []
+    max_idx = -1
+    for i, line in enumerate(data_lines):
+        toks = line.split()
+        labels[i] = float(toks[0]) if toks else 0.0
+        idxs, vals = [], []
+        for t in toks[1:]:
+            if ":" not in t:
+                continue
+            k, v = t.split(":", 1)
+            idxs.append(int(k))
+            vals.append(float(v))
+        ii = np.asarray(idxs, dtype=np.int64)
+        vv = np.asarray(vals)
+        if len(ii):
+            max_idx = max(max_idx, int(ii.max()))
+        rows.append((ii, vv))
+    nf = max_idx + 1
+    X = np.zeros((len(data_lines), max(nf, 1)))
+    for i, (ii, vv) in enumerate(rows):
+        X[i, ii] = vv
+    names = ["Column_%d" % c for c in range(X.shape[1])]
+    return LoadedData(X, labels.astype(np.float32), None, None, names)

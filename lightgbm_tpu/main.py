@@ -1,0 +1,113 @@
+"""Command-line application: train / predict with .conf files.
+
+TPU-native rebuild of src/main.cpp + src/application/application.cpp: parse
+`key=value` args and an optional `config=<file>` (CLI wins over file,
+application.cpp:49-82), dispatch on `task` (train :164-210, predict
+:212-240; convert_model and refit report unimplemented for now). Usage is
+CLI-compatible with the reference:
+
+    python -m lightgbm_tpu config=train.conf [key=value ...]
+"""
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+from .basic import Booster, Dataset
+from .config import Config
+from .data.loader import load_text_file
+from .engine import train as engine_train
+from .utils.log import LightGBMError, Log
+
+
+class Application:
+    def __init__(self, argv):
+        self.config = Config.from_cli_args(argv)
+        if self.config.data == "" and self.config.task in ("train", "refit"):
+            Log.fatal("No training/prediction data, application quit")
+
+    def run(self):
+        task = self.config.task
+        if task == "train":
+            self.train()
+        elif task in ("predict", "prediction", "test"):
+            self.predict()
+        elif task == "convert_model":
+            Log.fatal("convert_model is not supported on device_type=tpu yet")
+        elif task == "refit":
+            Log.fatal("refit task is not supported on device_type=tpu yet")
+        else:
+            Log.fatal("Unknown task type %s" % task)
+
+    # ------------------------------------------------------------------
+    def train(self):
+        cfg = self.config
+        params = cfg.to_dict()
+        loaded = load_text_file(cfg.data, cfg)
+        train_set = Dataset(loaded.X, label=loaded.label,
+                            weight=loaded.weight, group=loaded.group,
+                            feature_name=loaded.feature_names,
+                            params=params)
+        valid_sets = []
+        valid_names = []
+        for i, vfile in enumerate(cfg.valid):
+            v = load_text_file(vfile, cfg)
+            valid_sets.append(Dataset(v.X, label=v.label, weight=v.weight,
+                                      group=v.group, reference=train_set,
+                                      params=params))
+            valid_names.append("valid_%d" % i if len(cfg.valid) > 1
+                               else "valid_1")
+        booster = engine_train(
+            params, train_set,
+            num_boost_round=cfg.num_iterations,
+            valid_sets=valid_sets or None,
+            valid_names=valid_names or None,
+            early_stopping_rounds=(cfg.early_stopping_round or None),
+            verbose_eval=True)
+        booster.save_model(cfg.output_model)
+        Log.info("Finished training; model saved to %s" % cfg.output_model)
+
+    # ------------------------------------------------------------------
+    def predict(self):
+        cfg = self.config
+        if not cfg.input_model:
+            Log.fatal("Need input_model for predict task")
+        booster = Booster(model_file=cfg.input_model,
+                          params=cfg.to_dict())
+        loaded = load_text_file(cfg.data, cfg)
+        num_iteration = (cfg.num_iteration_predict
+                         if cfg.num_iteration_predict > 0 else None)
+        if cfg.predict_leaf_index:
+            result = booster.predict(loaded.X, pred_leaf=True,
+                                     num_iteration=num_iteration)
+        elif cfg.predict_contrib:
+            result = booster.predict(loaded.X, pred_contrib=True,
+                                     num_iteration=num_iteration)
+        else:
+            result = booster.predict(loaded.X,
+                                     raw_score=cfg.predict_raw_score,
+                                     num_iteration=num_iteration)
+        result = np.asarray(result)
+        if result.ndim == 1:
+            result = result.reshape(-1, 1)
+        np.savetxt(cfg.output_result, result, fmt="%.18g", delimiter="\t")
+        Log.info("Finished prediction; results saved to %s"
+                 % cfg.output_result)
+
+
+def main(argv=None):
+    argv = argv if argv is not None else sys.argv[1:]
+    if not argv:
+        print("Usage: python -m lightgbm_tpu config=<conf> [key=value ...]")
+        return 1
+    try:
+        Application(argv).run()
+    except LightGBMError as e:
+        Log.warning("Met Exceptions: %s" % e)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -56,6 +56,7 @@ class GrowConfig(NamedTuple):
     max_depth: int          # <=0: unlimited
     rows_per_chunk: int     # histogram chunking; 0 = one shot
     cat_width: int          # width of categorical bitmask (1 if no cat feats)
+    hist_impl: str = "scatter"   # "scatter" (CPU) | "onehot" (MXU einsum)
 
 
 class FixInfo(NamedTuple):
@@ -388,6 +389,326 @@ def grow_tree(layout: DataLayout, grad: jnp.ndarray, hess: jnp.ndarray,
         sum_grad, sum_hess, params.lambda_l1, params.lambda_l2,
         params.max_delta_step)
     state = state._replace(leaf_value=state.leaf_value.at[0].set(root_out))
+
+    final = jax.lax.while_loop(cond, body, state)
+    return final.tree._replace(
+        leaf_value=final.leaf_value,
+        leaf_count=final.leaf_count,
+        leaf_weight=final.leaf_sum_hess,
+        row_leaf=final.row_leaf,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Partitioned grower: O(rows-in-child) per split via a leaf-sorted row
+# permutation (the DataPartition analog) + power-of-two budget classes.
+# ---------------------------------------------------------------------------
+
+class _PartState(NamedTuple):
+    s: jnp.ndarray
+    done: jnp.ndarray
+    row_leaf: jnp.ndarray       # [N] i32
+    perm: jnp.ndarray           # [N + B_max] i32 rows grouped by leaf
+    leaf_start: jnp.ndarray     # [L] i32 segment starts (local rows)
+    leaf_nrows: jnp.ndarray     # [L] i32 segment lengths (local rows)
+    leaf_hist: jnp.ndarray
+    leaf_sum_grad: jnp.ndarray
+    leaf_sum_hess: jnp.ndarray
+    leaf_count: jnp.ndarray     # [L] i32 in-bag (global when sharded)
+    leaf_value: jnp.ndarray
+    leaf_depth: jnp.ndarray
+    leaf_cmin: jnp.ndarray
+    leaf_cmax: jnp.ndarray
+    best: SplitCandidate
+    tree: TreeArrays
+
+
+def _hist_window_rows(rows, valid, layout: DataLayout, grad, hess,
+                      gc: GrowConfig, gw_global):
+    """Histogram over an index window: gather rows' bins, then either
+    scatter-add (CPU-friendly) or one-hot einsum (MXU-friendly) per
+    gc.hist_impl. Returns [TB, 2] f32."""
+    B = rows.shape[0]
+    TB = gc.total_bins
+    bvals = layout.bins[rows].astype(I32)          # [B, G] group-local bins
+    gw = grad[rows] * valid
+    hw = hess[rows] * valid
+    if gc.hist_impl == "onehot":
+        G, W = gw_global.shape
+        chunk = min(B, 8192)
+        nch = (B + chunk - 1) // chunk
+        pad = nch * chunk - B
+        if pad:
+            bvals = jnp.pad(bvals, ((0, pad), (0, 0)))
+            gw = jnp.pad(gw, (0, pad))
+            hw = jnp.pad(hw, (0, pad))
+        bc = bvals.reshape(nch, chunk, G)
+        vc = jnp.stack([gw, hw], -1).reshape(nch, chunk, 2)
+
+        def body(i, acc):
+            oh = (bc[i][:, :, None]
+                  == jnp.arange(W, dtype=I32)[None, None, :]).astype(jnp.float32)
+            return acc + jnp.einsum("rgw,rc->gwc", oh, vc[i],
+                                    preferred_element_type=jnp.float32)
+        hgw = jax.lax.fori_loop(0, nch, body,
+                                jnp.zeros((G, W, 2), jnp.float32))
+        return jnp.zeros((TB, 2), jnp.float32).at[gw_global.reshape(-1)].add(
+            hgw.reshape(-1, 2), mode="drop")
+    idx = bvals + layout.group_offset[None, :]
+    vals = jnp.stack([gw, hw], -1)
+    G = idx.shape[1]
+    flat_vals = jnp.broadcast_to(vals[:, None, :], (B, G, 2)).reshape(-1, 2)
+    return jnp.zeros((TB, 2), jnp.float32).at[idx.reshape(-1)].add(flat_vals)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("gc", "axis_name", "budgets"))
+def grow_tree_partitioned(layout: DataLayout, grad: jnp.ndarray,
+                          hess: jnp.ndarray, bag_mask: jnp.ndarray,
+                          meta: FeatureMeta, params: SplitParams,
+                          feature_mask: jnp.ndarray, fix: FixInfo,
+                          gc: GrowConfig, budgets: tuple,
+                          gw_global=None, axis_name=None,
+                          cat: CatLayout = None) -> TreeArrays:
+    """Leaf-wise growth with O(rows-in-child) per-split work.
+
+    Same semantics as grow_tree (bit-equal trees up to f32 summation order);
+    the difference is HOW child histograms are built: a leaf-sorted
+    permutation (DataPartition, data_partition.hpp:21) is maintained with
+    stable in-window partitions, and the smaller child's histogram gathers
+    only that child's rows under the smallest static budget that fits
+    (lax.switch over `budgets`). The subtraction trick is unchanged.
+    """
+    from .partition import budget_index, stable_partition_window
+    if cat is None:
+        cat = empty_cat_layout(gc.cat_width)
+    n = layout.bins.shape[0]
+    L = gc.num_leaves
+    TB = gc.total_bins
+    F = gc.num_features
+    if F == 0 or TB == 0:
+        return _single_leaf_tree(n, L, gc.cat_width, grad, hess, bag_mask,
+                                 params, axis_name)
+    grad = grad.astype(jnp.float32)
+    hess = hess.astype(jnp.float32)
+    bagf = bag_mask.astype(jnp.float32)
+    budgets_arr = jnp.asarray(budgets, dtype=I32)
+    B_max = budgets[-1]
+
+    def psum(x):
+        return jax.lax.psum(x, axis_name) if axis_name is not None else x
+
+    # ---- root ----------------------------------------------------------
+    all_rows = jnp.arange(n, dtype=I32)
+    root_hist = _hist_window_rows(all_rows, bagf, layout, grad, hess, gc,
+                                  gw_global)
+    root_hist = psum(root_hist)
+    sum_grad = psum(jnp.sum(grad * bagf, dtype=F64))
+    sum_hess = psum(jnp.sum(hess * bagf, dtype=F64))
+    root_count = psum(jnp.sum(bag_mask, dtype=I32))
+    root_hist = fix_histogram(root_hist, sum_grad, sum_hess,
+                              fix.mf_global, fix.start, fix.end)
+
+    feat_nb = meta.bin_end - meta.bin_start
+
+    def eval_leaf(hist, sg, sh, cnt, depth, cmin, cmax):
+        cand = find_best_split_numerical(
+            hist, sg, sh, cnt, meta, params, cmin, cmax, feature_mask,
+            num_features=F, use_mc=gc.use_mc)
+        cand = cand._replace(cat_mask=jnp.zeros((gc.cat_width,), BOOL))
+        if cat.cat_feature.shape[0] > 0:
+            cat_cand = find_best_split_categorical(
+                hist, sg, sh, cnt, cat, meta, params, cmin, cmax,
+                feature_mask, use_mc=gc.use_mc)
+            cand = merge_candidates(cand, cat_cand)
+        if gc.max_depth > 0:
+            blocked = depth >= gc.max_depth
+            cand = cand._replace(
+                gain=jnp.where(blocked, K_MIN_SCORE, cand.gain))
+        return cand
+
+    root_cand = eval_leaf(root_hist, sum_grad, sum_hess, root_count,
+                          jnp.asarray(0, I32), jnp.asarray(-jnp.inf, F64),
+                          jnp.asarray(jnp.inf, F64))
+    root_out = _leaf_output_unconstrained(
+        sum_grad, sum_hess, params.lambda_l1, params.lambda_l2,
+        params.max_delta_step)
+
+    state = _PartState(
+        s=jnp.asarray(1, I32),
+        done=jnp.asarray(False),
+        row_leaf=jnp.zeros((n,), I32),
+        perm=jnp.concatenate([all_rows, jnp.zeros((B_max,), I32)]),
+        leaf_start=jnp.zeros((L,), I32),
+        leaf_nrows=jnp.zeros((L,), I32).at[0].set(n),
+        leaf_hist=jnp.zeros((L, TB, 2), jnp.float32).at[0].set(root_hist),
+        leaf_sum_grad=jnp.zeros((L,), F64).at[0].set(sum_grad),
+        leaf_sum_hess=jnp.zeros((L,), F64).at[0].set(sum_hess),
+        leaf_count=jnp.zeros((L,), I32).at[0].set(root_count),
+        leaf_value=jnp.zeros((L,), F64).at[0].set(root_out),
+        leaf_depth=jnp.zeros((L,), I32),
+        leaf_cmin=jnp.full((L,), -jnp.inf, F64),
+        leaf_cmax=jnp.full((L,), jnp.inf, F64),
+        best=jax.tree.map(
+            lambda a: jnp.broadcast_to(a, (L,) + a.shape),
+            _root_candidate_dummy(gc.cat_width)),
+        tree=TreeArrays(
+            num_leaves=jnp.asarray(1, I32),
+            split_leaf=jnp.zeros((L - 1,), I32),
+            split_feature=jnp.full((L - 1,), -1, I32),
+            threshold=jnp.zeros((L - 1,), I32),
+            default_left=jnp.zeros((L - 1,), BOOL),
+            gain=jnp.zeros((L - 1,), F64),
+            is_cat=jnp.zeros((L - 1,), BOOL),
+            cat_mask=jnp.zeros((L - 1, gc.cat_width), BOOL),
+            internal_value=jnp.zeros((L - 1,), F64),
+            internal_count=jnp.zeros((L - 1,), I32),
+            leaf_value=jnp.zeros((L,), F64),
+            leaf_count=jnp.zeros((L,), I32),
+            leaf_weight=jnp.zeros((L,), F64),
+            row_leaf=jnp.zeros((n,), I32),
+        ),
+    )
+    state = state._replace(
+        best=jax.tree.map(lambda a, v: a.at[0].set(v), state.best, root_cand))
+
+    def _partition_branch(Bj):
+        def fn(perm, row_leaf, s0, n_l, cand, s):
+            f = cand.feature
+            g = layout.group_of[f]
+            win = jax.lax.dynamic_slice(perm, (s0,), (Bj,))
+            valid = jnp.arange(Bj, dtype=I32) < n_l
+            rows = jnp.where(valid, win, 0)
+            col = layout.bins[rows, g].astype(I32) + layout.group_offset[g]
+            in_range = (col >= meta.bin_start[f]) & (col < meta.bin_end[f])
+            local_bin = col - meta.bin_start[f]
+            go_left = _go_left_decision(
+                local_bin, in_range,
+                (feat_nb[f], meta.missing_type[f], meta.default_bin[f],
+                 layout.most_freq_bin[f]),
+                cand, gc.cat_width)
+            new_win, n_left = stable_partition_window(win, go_left, valid)
+            perm = jax.lax.dynamic_update_slice(perm, new_win, (s0,))
+            right_rows = jnp.where(valid & ~go_left, rows, n)
+            row_leaf = row_leaf.at[right_rows].set(s, mode="drop")
+            bag_left = jnp.sum(
+                jnp.where(go_left & valid, bag_mask[rows], False),
+                dtype=I32)
+            return perm, row_leaf, n_left, bag_left
+        return fn
+
+    def _hist_branch(Bj):
+        def fn(perm, start, seg_len):
+            win = jax.lax.dynamic_slice(perm, (start,), (Bj,))
+            valid = (jnp.arange(Bj, dtype=I32) < seg_len)
+            rows = jnp.where(valid, win, 0)
+            return _hist_window_rows(rows, valid.astype(jnp.float32),
+                                     layout, grad, hess, gc, gw_global)
+        return fn
+
+    part_branches = [_partition_branch(b) for b in budgets]
+    hist_branches = [_hist_branch(b) for b in budgets]
+
+    def cond(st: _PartState):
+        return (~st.done) & (st.s < L)
+
+    def body(st: _PartState) -> _PartState:
+        l = jnp.argmax(st.best.gain).astype(I32)
+        gain = st.best.gain[l]
+        no_split = gain <= 0.0
+
+        def do_split(st: _PartState) -> _PartState:
+            s = st.s
+            cand = jax.tree.map(lambda a: a[l], st.best)
+            s0 = st.leaf_start[l]
+            n_l = st.leaf_nrows[l]
+            j = budget_index(budgets_arr, n_l)
+            perm, row_leaf, n_left, bag_left = jax.lax.switch(
+                j, part_branches, st.perm, st.row_leaf, s0, n_l, cand, s)
+            left_cnt = psum(bag_left)
+            right_cnt = st.leaf_count[l] - left_cnt
+            n_right = n_l - n_left
+
+            smaller_is_left = left_cnt <= right_cnt
+            start_sm = jnp.where(smaller_is_left, s0, s0 + n_left)
+            len_sm = jnp.where(smaller_is_left, n_left, n_right)
+            j2 = budget_index(budgets_arr, len_sm)
+            hist_smaller = jax.lax.switch(j2, hist_branches, perm, start_sm,
+                                          len_sm)
+            hist_smaller = psum(hist_smaller)
+            sm_sum_grad = jnp.where(smaller_is_left, cand.left_sum_grad,
+                                    cand.right_sum_grad)
+            sm_sum_hess = jnp.where(smaller_is_left, cand.left_sum_hess,
+                                    cand.right_sum_hess)
+            hist_smaller = fix_histogram(hist_smaller, sm_sum_grad,
+                                         sm_sum_hess, fix.mf_global,
+                                         fix.start, fix.end)
+            parent_hist = st.leaf_hist[l]
+            hist_larger = parent_hist - hist_smaller
+            hist_left = jnp.where(smaller_is_left, hist_smaller, hist_larger)
+            hist_right = jnp.where(smaller_is_left, hist_larger, hist_smaller)
+
+            depth_child = st.leaf_depth[l] + 1
+            cmin_p, cmax_p = st.leaf_cmin[l], st.leaf_cmax[l]
+            mono = meta.monotone[cand.feature]
+            mid = (cand.left_output + cand.right_output) / 2.0
+            l_cmax = jnp.where(mono > 0, jnp.minimum(cmax_p, mid), cmax_p)
+            r_cmin = jnp.where(mono > 0, jnp.maximum(cmin_p, mid), cmin_p)
+            l_cmin = jnp.where(mono < 0, jnp.maximum(cmin_p, mid), cmin_p)
+            r_cmax = jnp.where(mono < 0, jnp.minimum(cmax_p, mid), cmax_p)
+
+            leaf_hist = st.leaf_hist.at[l].set(hist_left).at[s].set(hist_right)
+            leaf_sum_grad = st.leaf_sum_grad.at[l].set(cand.left_sum_grad) \
+                                            .at[s].set(cand.right_sum_grad)
+            leaf_sum_hess = st.leaf_sum_hess.at[l].set(cand.left_sum_hess) \
+                                            .at[s].set(cand.right_sum_hess)
+            leaf_count = st.leaf_count.at[l].set(left_cnt).at[s].set(right_cnt)
+            leaf_value = st.leaf_value.at[l].set(cand.left_output) \
+                                      .at[s].set(cand.right_output)
+            leaf_depth = st.leaf_depth.at[l].set(depth_child) \
+                                      .at[s].set(depth_child)
+            leaf_cmin = st.leaf_cmin.at[l].set(l_cmin).at[s].set(r_cmin)
+            leaf_cmax = st.leaf_cmax.at[l].set(l_cmax).at[s].set(r_cmax)
+            leaf_start = st.leaf_start.at[s].set(s0 + n_left)
+            leaf_nrows = st.leaf_nrows.at[l].set(n_left).at[s].set(n_right)
+
+            cand_l = eval_leaf(hist_left, cand.left_sum_grad,
+                               cand.left_sum_hess, left_cnt, depth_child,
+                               l_cmin, l_cmax)
+            cand_r = eval_leaf(hist_right, cand.right_sum_grad,
+                               cand.right_sum_hess, right_cnt, depth_child,
+                               r_cmin, r_cmax)
+            best = jax.tree.map(
+                lambda a, vl, vr: a.at[l].set(vl).at[s].set(vr),
+                st.best, cand_l, cand_r)
+
+            k = s - 1
+            tree = st.tree._replace(
+                num_leaves=s + 1,
+                split_leaf=st.tree.split_leaf.at[k].set(l),
+                split_feature=st.tree.split_feature.at[k].set(cand.feature),
+                threshold=st.tree.threshold.at[k].set(cand.threshold),
+                default_left=st.tree.default_left.at[k].set(cand.default_left),
+                gain=st.tree.gain.at[k].set(cand.gain),
+                is_cat=st.tree.is_cat.at[k].set(cand.is_cat),
+                cat_mask=st.tree.cat_mask.at[k].set(cand.cat_mask),
+                internal_value=st.tree.internal_value.at[k].set(
+                    st.leaf_value[l]),
+                internal_count=st.tree.internal_count.at[k].set(
+                    st.leaf_count[l]),
+            )
+            return st._replace(
+                s=s + 1, row_leaf=row_leaf, perm=perm,
+                leaf_start=leaf_start, leaf_nrows=leaf_nrows,
+                leaf_hist=leaf_hist, leaf_sum_grad=leaf_sum_grad,
+                leaf_sum_hess=leaf_sum_hess, leaf_count=leaf_count,
+                leaf_value=leaf_value, leaf_depth=leaf_depth,
+                leaf_cmin=leaf_cmin, leaf_cmax=leaf_cmax, best=best,
+                tree=tree)
+
+        return jax.lax.cond(no_split,
+                            lambda st: st._replace(done=jnp.asarray(True)),
+                            do_split, st)
 
     final = jax.lax.while_loop(cond, body, state)
     return final.tree._replace(

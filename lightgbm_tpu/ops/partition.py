@@ -1,0 +1,63 @@
+"""Leaf-sorted row partition maintenance on device.
+
+TPU-native rebuild of DataPartition (src/treelearner/data_partition.hpp:21):
+a permutation array groups row indices by leaf so per-leaf work (child
+histograms) touches only that leaf's rows. Dynamic leaf sizes are handled
+with power-of-two BUDGET CLASSES: each partition/histogram step runs under
+`lax.switch` in the smallest compiled budget >= the segment length, keeping
+shapes static while bounding overwork to <2x (the reference's
+ParallelPartitionRunner gets exact sizes; XLA needs static shapes).
+
+The permutation is padded by the largest budget so dynamic_slice windows
+never clamp (reads beyond num_rows land in the pad region and are masked).
+"""
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import jax
+import jax.numpy as jnp
+
+I32 = jnp.int32
+
+
+def budget_classes(n: int, min_budget: int = 8192) -> List[int]:
+    """Ascending power-of-two budgets (last = exactly n) covering segment
+    sizes up to n."""
+    if n <= min_budget:
+        return [n]
+    out = []
+    b = min_budget
+    while b < n:
+        out.append(b)
+        b *= 2
+    out.append(n)
+    return out
+
+
+def budget_index(budgets_arr: jnp.ndarray, seg_len: jnp.ndarray) -> jnp.ndarray:
+    """Index of the smallest budget >= seg_len (budgets ascending)."""
+    return jnp.sum(budgets_arr < seg_len).astype(I32)
+
+
+def stable_partition_window(win: jnp.ndarray, go_left: jnp.ndarray,
+                            valid: jnp.ndarray):
+    """Stable in-window partition: valid left rows first, then valid right
+    rows; tail keeps the original window (rows of other leaves / padding).
+
+    Returns (new_win, n_left). Scatter uses unique positions (a permutation)
+    so XLA needn't serialize updates.
+    """
+    B = win.shape[0]
+    gl = go_left & valid
+    gr = (~go_left) & valid
+    n_left = jnp.sum(gl, dtype=I32)
+    left_pos = jnp.cumsum(gl, dtype=I32) - 1
+    right_pos = n_left + jnp.cumsum(gr, dtype=I32) - 1
+    pos = jnp.where(gl, left_pos, right_pos)
+    pos = jnp.where(valid, pos, B)              # dropped
+    packed = jnp.zeros_like(win).at[pos].set(
+        win, mode="drop", unique_indices=True)
+    n_valid = jnp.sum(valid, dtype=I32)
+    keep = jnp.arange(B, dtype=I32) < n_valid
+    return jnp.where(keep, packed, win), n_left

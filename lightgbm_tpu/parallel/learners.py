@@ -30,8 +30,9 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..models.tree import Tree
-from ..ops.grow import DataLayout, GrowConfig, grow_tree
-from ..treelearner.serial import SerialTreeLearner
+from ..ops.grow import DataLayout, GrowConfig, grow_tree, grow_tree_partitioned
+from ..ops.partition import budget_classes
+from ..treelearner.serial import PARTITION_MIN_ROWS, SerialTreeLearner
 from ..utils.log import Log
 
 AXIS = "data"
@@ -75,6 +76,10 @@ class DataParallelTreeLearner(SerialTreeLearner):
                        self.layout.most_freq_bin)
 
         cat = self.cat_layout
+        n_shard = (self.dataset.num_data + self._pad) // self.num_shards
+        use_part = n_shard >= PARTITION_MIN_ROWS
+        budgets = tuple(budget_classes(n_shard))
+        gw_global = self.gw_global
 
         @functools.partial(
             jax.shard_map, mesh=mesh,
@@ -83,6 +88,11 @@ class DataParallelTreeLearner(SerialTreeLearner):
             check_vma=False)
         def run(bins, grad, hess, bag, fmask):
             layout = DataLayout(bins, *layout_rest)
+            if use_part:
+                return grow_tree_partitioned(
+                    layout, grad, hess, bag, meta, params, fmask, fix, gc,
+                    budgets=budgets, gw_global=gw_global, axis_name=AXIS,
+                    cat=cat)
             return grow_tree(layout, grad, hess, bag, meta, params, fmask,
                              fix, gc, axis_name=AXIS, cat=cat)
         return run

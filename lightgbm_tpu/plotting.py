@@ -1,0 +1,234 @@
+"""Plotting utilities.
+
+TPU-native rebuild of python-package/lightgbm/plotting.py:
+plot_importance (:29), plot_split_value_histogram (:145), plot_metric
+(:251), plot_tree / create_tree_digraph (:365-650). matplotlib/graphviz are
+imported lazily and gated like the reference compat layer.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .basic import Booster
+from .utils.log import LightGBMError
+
+
+def _check_not_tuple_of_2_elements(obj, obj_name="obj"):
+    if not isinstance(obj, (list, tuple)) or len(obj) != 2:
+        raise TypeError("%s must be a list/tuple of 2 elements" % obj_name)
+
+
+def _to_booster(booster):
+    from .sklearn import LGBMModel
+    if isinstance(booster, LGBMModel):
+        return booster.booster_
+    if isinstance(booster, Booster):
+        return booster
+    raise TypeError("booster must be Booster or LGBMModel")
+
+
+def plot_importance(booster, ax=None, height=0.2, xlim=None, ylim=None,
+                    title="Feature importance", xlabel="Feature importance",
+                    ylabel="Features", importance_type="split",
+                    max_num_features=None, ignore_zero=True, figsize=None,
+                    dpi=None, grid=True, precision=3, **kwargs):
+    """Plot model feature importances (reference plotting.py:29-142)."""
+    try:
+        import matplotlib.pyplot as plt
+    except ImportError:
+        raise ImportError("You must install matplotlib to plot importance")
+    booster = _to_booster(booster)
+    importance = booster.feature_importance(importance_type=importance_type)
+    feature_name = booster.feature_name()
+    if not len(importance):
+        raise ValueError("Booster's feature_importance is empty")
+    tuples = sorted(zip(feature_name, importance), key=lambda x: x[1])
+    if ignore_zero:
+        tuples = [x for x in tuples if x[1] > 0]
+    if max_num_features is not None and max_num_features > 0:
+        tuples = tuples[-max_num_features:]
+    labels, values = zip(*tuples) if tuples else ((), ())
+    if ax is None:
+        if figsize is not None:
+            _check_not_tuple_of_2_elements(figsize, "figsize")
+        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+    ylocs = np.arange(len(values))
+    ax.barh(ylocs, values, align="center", height=height, **kwargs)
+    for x, y in zip(values, ylocs):
+        ax.text(x + 1, y,
+                ("%." + str(precision) + "f") % x if importance_type == "gain"
+                else str(int(x)), va="center")
+    ax.set_yticks(ylocs)
+    ax.set_yticklabels(labels)
+    if xlim is not None:
+        _check_not_tuple_of_2_elements(xlim, "xlim")
+        ax.set_xlim(xlim)
+    if ylim is not None:
+        _check_not_tuple_of_2_elements(ylim, "ylim")
+        ax.set_ylim(ylim)
+    if title is not None:
+        ax.set_title(title)
+    if xlabel is not None:
+        ax.set_xlabel(xlabel)
+    if ylabel is not None:
+        ax.set_ylabel(ylabel)
+    ax.grid(grid)
+    return ax
+
+
+def plot_split_value_histogram(booster, feature, bins=None, ax=None,
+                               width_coef=0.8, xlim=None, ylim=None,
+                               title="Split value histogram for feature with "
+                                     "@index/name@ @feature@",
+                               xlabel="Feature split value", ylabel="Count",
+                               figsize=None, dpi=None, grid=True, **kwargs):
+    """Histogram of split thresholds of one feature (plotting.py:145-248)."""
+    try:
+        import matplotlib.pyplot as plt
+    except ImportError:
+        raise ImportError("You must install matplotlib to plot")
+    booster = _to_booster(booster)
+    gbdt = booster._booster
+    if isinstance(feature, str):
+        feature = booster.feature_name().index(feature)
+    values = []
+    for tree in gbdt._used_models():
+        ni = tree.num_leaves - 1
+        for k in range(ni):
+            if tree.split_feature[k] == feature and \
+                    not (tree.decision_type[k] & 1):
+                values.append(tree.threshold[k])
+    if not values:
+        raise ValueError("Cannot plot split value histogram, "
+                         "as feature %d was not used in splitting" % feature)
+    hist, bin_edges = np.histogram(values, bins=bins or min(len(values), 20))
+    if ax is None:
+        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+    width = width_coef * (bin_edges[1] - bin_edges[0])
+    centred = (bin_edges[:-1] + bin_edges[1:]) / 2
+    ax.bar(centred, hist, width=width, align="center", **kwargs)
+    if title is not None:
+        title = title.replace("@feature@", str(feature)) \
+                     .replace("@index/name@",
+                              "name" if isinstance(feature, str) else "index")
+        ax.set_title(title)
+    if xlabel is not None:
+        ax.set_xlabel(xlabel)
+    if ylabel is not None:
+        ax.set_ylabel(ylabel)
+    ax.grid(grid)
+    return ax
+
+
+def plot_metric(booster, metric=None, dataset_names=None, ax=None,
+                xlim=None, ylim=None, title="Metric during training",
+                xlabel="Iterations", ylabel="auto", figsize=None, dpi=None,
+                grid=True):
+    """Plot metric curves from evals_result (plotting.py:251-362)."""
+    try:
+        import matplotlib.pyplot as plt
+    except ImportError:
+        raise ImportError("You must install matplotlib to plot metric")
+    if isinstance(booster, dict):
+        eval_results = booster
+    else:
+        from .sklearn import LGBMModel
+        if isinstance(booster, LGBMModel):
+            eval_results = booster.evals_result_
+        else:
+            raise TypeError("booster must be dict or LGBMModel")
+    if not eval_results:
+        raise ValueError("eval results cannot be empty")
+    if ax is None:
+        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+    if dataset_names is None:
+        dataset_names = iter(eval_results.keys())
+    name = None
+    for name_ in dataset_names:
+        metrics = eval_results[name_]
+        if metric is None:
+            metric = next(iter(metrics.keys()))
+        results = metrics[metric]
+        ax.plot(range(len(results)), results, label=name_)
+        name = name_
+    ax.legend(loc="best")
+    if ylabel == "auto":
+        ylabel = metric
+    if title is not None:
+        ax.set_title(title)
+    if xlabel is not None:
+        ax.set_xlabel(xlabel)
+    if ylabel is not None:
+        ax.set_ylabel(ylabel)
+    ax.grid(grid)
+    return ax
+
+
+def _tree_to_digraph(tree, feature_names, precision=3, **kwargs):
+    try:
+        from graphviz import Digraph
+    except ImportError:
+        raise ImportError("You must install graphviz to plot tree")
+    graph = Digraph(**kwargs)
+
+    def fmt(x):
+        return ("%." + str(precision) + "g") % x
+
+    def add(node_idx):
+        if node_idx >= 0:
+            f = tree.split_feature[node_idx]
+            fname = (feature_names[f] if feature_names is not None
+                     else "Column_%d" % f)
+            is_cat = bool(tree.decision_type[node_idx] & 1)
+            op = "==" if is_cat else "<="
+            name = "split%d" % node_idx
+            graph.node(name, "%s %s %s\ngain: %s" % (
+                fname, op, fmt(tree.threshold[node_idx]),
+                fmt(tree.split_gain[node_idx])))
+            for child, tag in ((tree.left_child[node_idx], "yes"),
+                               (tree.right_child[node_idx], "no")):
+                cname = add(int(child))
+                graph.edge(name, cname, label=tag)
+            return name
+        leaf = ~node_idx
+        name = "leaf%d" % leaf
+        graph.node(name, "leaf %d: %s" % (leaf, fmt(tree.leaf_value[leaf])))
+        return name
+
+    if tree.num_leaves <= 1:
+        graph.node("leaf0", "leaf 0: %g" % tree.leaf_value[0])
+    else:
+        add(0)
+    return graph
+
+
+def create_tree_digraph(booster, tree_index=0, show_info=None, precision=3,
+                        **kwargs):
+    """Digraph of one tree (plotting.py:365-460)."""
+    booster = _to_booster(booster)
+    gbdt = booster._booster
+    models = gbdt._used_models()
+    if tree_index >= len(models):
+        raise IndexError("tree_index is out of range.")
+    return _tree_to_digraph(models[tree_index], gbdt.feature_names,
+                            precision, **kwargs)
+
+
+def plot_tree(booster, ax=None, tree_index=0, figsize=None, dpi=None,
+              show_info=None, precision=3, **kwargs):
+    """Render one tree with matplotlib (plotting.py:555-650)."""
+    try:
+        import matplotlib.image as mpimg
+        import matplotlib.pyplot as plt
+    except ImportError:
+        raise ImportError("You must install matplotlib to plot tree")
+    import io
+    graph = create_tree_digraph(booster, tree_index=tree_index,
+                                precision=precision, **kwargs)
+    s = io.BytesIO(graph.pipe(format="png"))
+    img = mpimg.imread(s)
+    if ax is None:
+        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+    ax.imshow(img)
+    ax.axis("off")
+    return ax

@@ -1,0 +1,385 @@
+"""scikit-learn estimator wrappers.
+
+TPU-native rebuild of python-package/lightgbm/sklearn.py: LGBMModel (:169)
+with LGBMRegressor (:744), LGBMClassifier (:771), LGBMRanker (:913); custom
+objective/eval adapters (:21-160) translating sklearn-style fobj(y_true,
+y_pred) into the engine's fobj(preds, dataset) convention.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from .basic import Booster, Dataset, _data_to_2d
+from .engine import train
+from .utils.log import LightGBMError, Log
+
+try:
+    from sklearn.base import BaseEstimator as _SKBase
+    from sklearn.base import ClassifierMixin as _SKClassifier
+    from sklearn.base import RegressorMixin as _SKRegressor
+    from sklearn.preprocessing import LabelEncoder as _LabelEncoder
+    _SKLEARN = True
+except ImportError:  # pragma: no cover
+    _SKBase = object
+
+    class _SKClassifier:  # noqa: N801
+        pass
+
+    class _SKRegressor:  # noqa: N801
+        pass
+    _LabelEncoder = None
+    _SKLEARN = False
+
+
+class _ObjectiveFunctionWrapper:
+    """sklearn fobj(y_true, y_pred[, weight|group]) -> engine fobj
+    (reference sklearn.py:21-97)."""
+
+    def __init__(self, func: Callable):
+        self.func = func
+
+    def __call__(self, preds, dataset):
+        labels = dataset.get_label()
+        argc = self.func.__code__.co_argcount
+        if argc == 2:
+            grad, hess = self.func(labels, preds)
+        elif argc == 3:
+            grad, hess = self.func(labels, preds, dataset.get_weight())
+        elif argc == 4:
+            grad, hess = self.func(labels, preds, dataset.get_weight(),
+                                   dataset.get_group())
+        else:
+            raise TypeError("Self-defined objective function should have "
+                            "2, 3 or 4 arguments, got %d" % argc)
+        return grad, hess
+
+
+class _EvalFunctionWrapper:
+    """sklearn feval(y_true, y_pred[, weight|group]) -> engine feval
+    (reference sklearn.py:100-160)."""
+
+    def __init__(self, func: Callable):
+        self.func = func
+
+    def __call__(self, preds, dataset):
+        labels = dataset.get_label()
+        argc = self.func.__code__.co_argcount
+        if argc == 2:
+            return self.func(labels, preds)
+        if argc == 3:
+            return self.func(labels, preds, dataset.get_weight())
+        if argc == 4:
+            return self.func(labels, preds, dataset.get_weight(),
+                             dataset.get_group())
+        raise TypeError("Self-defined eval function should have 2, 3 or 4 "
+                        "arguments, got %d" % argc)
+
+
+class LGBMModel(_SKBase):
+    """Base sklearn estimator (reference sklearn.py:169)."""
+
+    def __init__(self, boosting_type="gbdt", num_leaves=31, max_depth=-1,
+                 learning_rate=0.1, n_estimators=100,
+                 subsample_for_bin=200000, objective=None, class_weight=None,
+                 min_split_gain=0., min_child_weight=1e-3,
+                 min_child_samples=20, subsample=1., subsample_freq=0,
+                 colsample_bytree=1., reg_alpha=0., reg_lambda=0.,
+                 random_state=None, n_jobs=-1, silent=True,
+                 importance_type="split", **kwargs):
+        if not _SKLEARN:
+            raise LightGBMError("scikit-learn is required for lightgbm_tpu."
+                                "sklearn")
+        self.boosting_type = boosting_type
+        self.objective = objective
+        self.num_leaves = num_leaves
+        self.max_depth = max_depth
+        self.learning_rate = learning_rate
+        self.n_estimators = n_estimators
+        self.subsample_for_bin = subsample_for_bin
+        self.min_split_gain = min_split_gain
+        self.min_child_weight = min_child_weight
+        self.min_child_samples = min_child_samples
+        self.subsample = subsample
+        self.subsample_freq = subsample_freq
+        self.colsample_bytree = colsample_bytree
+        self.reg_alpha = reg_alpha
+        self.reg_lambda = reg_lambda
+        self.random_state = random_state
+        self.n_jobs = n_jobs
+        self.silent = silent
+        self.importance_type = importance_type
+        self.class_weight = class_weight
+        self._Booster: Optional[Booster] = None
+        self._evals_result = None
+        self._best_score = None
+        self._best_iteration = None
+        self._other_params: Dict[str, Any] = {}
+        self._objective = objective
+        self._n_features = None
+        self._classes = None
+        self._n_classes = None
+        self.set_params(**kwargs)
+
+    # -- sklearn plumbing ----------------------------------------------
+    def get_params(self, deep=True):
+        params = super().get_params(deep=deep) if _SKLEARN else {}
+        params.update(self._other_params)
+        return params
+
+    def set_params(self, **params):
+        for key, value in params.items():
+            setattr(self, key, value)
+            if hasattr(self, "_other_params") and \
+                    key not in self.__init__.__code__.co_varnames:
+                self._other_params[key] = value
+        return self
+
+    def _default_objective(self) -> str:
+        return "regression"
+
+    def _process_params(self) -> Dict[str, Any]:
+        params = self.get_params()
+        params.pop("silent", None)
+        params.pop("importance_type", None)
+        params.pop("n_estimators", None)
+        params.pop("class_weight", None)
+        alias = {"boosting_type": "boosting",
+                 "min_split_gain": "min_gain_to_split",
+                 "min_child_weight": "min_sum_hessian_in_leaf",
+                 "min_child_samples": "min_data_in_leaf",
+                 "subsample": "bagging_fraction",
+                 "subsample_freq": "bagging_freq",
+                 "colsample_bytree": "feature_fraction",
+                 "subsample_for_bin": "bin_construct_sample_cnt",
+                 "reg_alpha": "lambda_l1",
+                 "reg_lambda": "lambda_l2",
+                 "random_state": "seed",
+                 "n_jobs": "num_threads"}
+        out = {}
+        for k, v in params.items():
+            k = alias.get(k, k)
+            if v is None and k in ("objective", "seed"):
+                continue
+            out[k] = v
+        if callable(self._objective):
+            out.pop("objective", None)
+        out.setdefault("objective", self._default_objective())
+        out["verbosity"] = -1 if self.silent else 1
+        if out.get("num_threads") in (-1, None):
+            out.pop("num_threads", None)
+        return out
+
+    # -- training -------------------------------------------------------
+    def fit(self, X, y, sample_weight=None, init_score=None, group=None,
+            eval_set=None, eval_names=None, eval_sample_weight=None,
+            eval_class_weight=None, eval_init_score=None, eval_group=None,
+            eval_metric=None, early_stopping_rounds=None, verbose=True,
+            feature_name="auto", categorical_feature="auto",
+            callbacks=None):
+        params = self._process_params()
+        fobj = None
+        if callable(self._objective):
+            fobj = _ObjectiveFunctionWrapper(self._objective)
+            params["objective"] = "none"
+        feval = None
+        if callable(eval_metric):
+            feval = _EvalFunctionWrapper(eval_metric)
+        elif isinstance(eval_metric, str):
+            params["metric"] = eval_metric
+        elif isinstance(eval_metric, (list, tuple)):
+            params["metric"] = list(eval_metric)
+
+        y = np.asarray(y).reshape(-1)
+        if self.class_weight is not None and sample_weight is None:
+            sample_weight = self._compute_class_weights(y)
+        train_set = Dataset(X, label=y, weight=sample_weight,
+                            group=group, init_score=init_score,
+                            params=params)
+        valid_sets = []
+        if eval_set is not None:
+            if isinstance(eval_set, tuple):
+                eval_set = [eval_set]
+            for i, (vx, vy) in enumerate(eval_set):
+                vy = np.asarray(vy).reshape(-1)
+                if self._classes is not None:
+                    vy = self._le.transform(vy)
+                vw = (eval_sample_weight[i]
+                      if eval_sample_weight is not None else None)
+                vg = eval_group[i] if eval_group is not None else None
+                vi = (eval_init_score[i]
+                      if eval_init_score is not None else None)
+                valid_sets.append(Dataset(vx, label=vy, weight=vw, group=vg,
+                                          init_score=vi, reference=train_set,
+                                          params=params))
+        evals_result: Dict = {}
+        self._Booster = train(
+            params, train_set, num_boost_round=self.n_estimators,
+            valid_sets=valid_sets or None, valid_names=eval_names,
+            fobj=fobj, feval=feval,
+            early_stopping_rounds=early_stopping_rounds,
+            evals_result=evals_result, verbose_eval=verbose,
+            feature_name=feature_name,
+            categorical_feature=categorical_feature, callbacks=callbacks)
+        self._evals_result = evals_result
+        self._best_iteration = self._Booster.best_iteration
+        self._best_score = self._Booster.best_score
+        X2, _, _ = _data_to_2d(X)
+        self._n_features = X2.shape[1]
+        self.fitted_ = True
+        return self
+
+    def _compute_class_weights(self, y):
+        from sklearn.utils.class_weight import compute_sample_weight
+        return compute_sample_weight(self.class_weight, y)
+
+    def predict(self, X, raw_score=False, num_iteration=None,
+                pred_leaf=False, pred_contrib=False, **kwargs):
+        if self._Booster is None:
+            raise LightGBMError("Estimator not fitted, call fit before "
+                                "exploiting the model.")
+        return self._Booster.predict(X, raw_score=raw_score,
+                                     num_iteration=num_iteration,
+                                     pred_leaf=pred_leaf,
+                                     pred_contrib=pred_contrib, **kwargs)
+
+    # -- accessors ------------------------------------------------------
+    @property
+    def booster_(self) -> Booster:
+        if self._Booster is None:
+            raise LightGBMError("No booster found. Need to call fit first.")
+        return self._Booster
+
+    @property
+    def best_iteration_(self):
+        return self._best_iteration
+
+    @property
+    def best_score_(self):
+        return self._best_score
+
+    @property
+    def evals_result_(self):
+        return self._evals_result
+
+    @property
+    def n_features_(self):
+        return self._n_features
+
+    @property
+    def feature_importances_(self):
+        return self.booster_.feature_importance(
+            importance_type=self.importance_type)
+
+    @property
+    def feature_name_(self):
+        return self.booster_.feature_name()
+
+    @property
+    def objective_(self):
+        return self._objective or self._default_objective()
+
+
+class LGBMRegressor(LGBMModel, _SKRegressor):
+    """LightGBM regressor (reference sklearn.py:744)."""
+
+    def _default_objective(self):
+        return "regression"
+
+
+class LGBMClassifier(LGBMModel, _SKClassifier):
+    """LightGBM classifier (reference sklearn.py:771)."""
+
+    def _default_objective(self):
+        return "binary" if (self._n_classes or 2) <= 2 else "multiclass"
+
+    def fit(self, X, y, sample_weight=None, init_score=None,
+            eval_set=None, eval_names=None, eval_sample_weight=None,
+            eval_class_weight=None, eval_init_score=None, eval_metric=None,
+            early_stopping_rounds=None, verbose=True, feature_name="auto",
+            categorical_feature="auto", callbacks=None):
+        y = np.asarray(y).reshape(-1)
+        self._le = _LabelEncoder().fit(y)
+        y_enc = self._le.transform(y)
+        self._classes = self._le.classes_
+        self._n_classes = len(self._classes)
+        params_extra = {}
+        if self._n_classes > 2:
+            params_extra["num_class"] = self._n_classes
+        for k, v in params_extra.items():
+            self._other_params[k] = v
+            setattr(self, k, v)
+        super().fit(X, y_enc, sample_weight=sample_weight,
+                    init_score=init_score, eval_set=eval_set,
+                    eval_names=eval_names,
+                    eval_sample_weight=eval_sample_weight,
+                    eval_class_weight=eval_class_weight,
+                    eval_init_score=eval_init_score, eval_metric=eval_metric,
+                    early_stopping_rounds=early_stopping_rounds,
+                    verbose=verbose, feature_name=feature_name,
+                    categorical_feature=categorical_feature,
+                    callbacks=callbacks)
+        return self
+
+    def predict(self, X, raw_score=False, num_iteration=None,
+                pred_leaf=False, pred_contrib=False, **kwargs):
+        result = self.predict_proba(X, raw_score, num_iteration, pred_leaf,
+                                    pred_contrib, **kwargs)
+        if raw_score or pred_leaf or pred_contrib:
+            return result
+        if result.ndim == 1:
+            idx = (result > 0.5).astype(np.int32)
+        else:
+            idx = np.argmax(result, axis=1)
+        return self._le.inverse_transform(idx)
+
+    def predict_proba(self, X, raw_score=False, num_iteration=None,
+                      pred_leaf=False, pred_contrib=False, **kwargs):
+        result = super().predict(X, raw_score, num_iteration, pred_leaf,
+                                 pred_contrib, **kwargs)
+        if raw_score or pred_leaf or pred_contrib:
+            return result
+        if result.ndim == 1:
+            return np.column_stack([1.0 - result, result])
+        return result
+
+    @property
+    def classes_(self):
+        return self._classes
+
+    @property
+    def n_classes_(self):
+        return self._n_classes
+
+
+class LGBMRanker(LGBMModel):
+    """LightGBM ranker (reference sklearn.py:913)."""
+
+    def _default_objective(self):
+        return "lambdarank"
+
+    def fit(self, X, y, sample_weight=None, init_score=None, group=None,
+            eval_set=None, eval_names=None, eval_sample_weight=None,
+            eval_init_score=None, eval_group=None, eval_metric=None,
+            eval_at=(1, 2, 3, 4, 5), early_stopping_rounds=None,
+            verbose=True, feature_name="auto", categorical_feature="auto",
+            callbacks=None):
+        if group is None:
+            raise ValueError("Should set group for ranking task")
+        if eval_set is not None and eval_group is None:
+            raise ValueError("Eval_group cannot be None when eval_set is "
+                             "not None")
+        self._other_params["eval_at"] = list(eval_at)
+        self.eval_at = list(eval_at)
+        super().fit(X, y, sample_weight=sample_weight,
+                    init_score=init_score, group=group, eval_set=eval_set,
+                    eval_names=eval_names,
+                    eval_sample_weight=eval_sample_weight,
+                    eval_init_score=eval_init_score, eval_group=eval_group,
+                    eval_metric=eval_metric,
+                    early_stopping_rounds=early_stopping_rounds,
+                    verbose=verbose, feature_name=feature_name,
+                    categorical_feature=categorical_feature,
+                    callbacks=callbacks)
+        return self

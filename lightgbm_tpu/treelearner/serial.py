@@ -22,9 +22,38 @@ import jax.numpy as jnp
 from ..config import Config
 from ..models.tree import Tree
 from ..ops.grow import (DataLayout, FixInfo, GrowConfig, empty_cat_layout,
-                        grow_tree)
+                        grow_tree, grow_tree_partitioned)
+from ..ops.partition import budget_classes
 from ..ops.split import CatLayout, FeatureMeta, SplitParams
 from ..utils.log import Log
+
+# below this many rows the masked full-N grower compiles faster and the
+# O(N)-per-split cost is irrelevant
+PARTITION_MIN_ROWS = 65536
+
+
+def resolve_hist_impl(config: Config) -> str:
+    """'auto' -> one-hot einsum on accelerators (MXU), scatter-add on CPU."""
+    impl = str(config.tpu_histogram_impl).lower()
+    if impl in ("xla", "scatter"):
+        return "scatter"
+    if impl in ("onehot", "pallas"):
+        return "onehot"
+    import jax
+    return "scatter" if jax.default_backend() == "cpu" else "onehot"
+
+
+def build_gw_global(dataset) -> "jnp.ndarray":
+    """[G, W] map from (group, group-local bin) to global bin; entries past
+    a group's width point at total_bins and are dropped by the scatter."""
+    offs = np.asarray(dataset.group_offset, dtype=np.int64)
+    widths = np.diff(np.append(offs, dataset.total_bins))
+    W = int(widths.max()) if len(widths) else 1
+    G = len(offs)
+    gw = np.full((G, W), dataset.total_bins, dtype=np.int32)
+    for g in range(G):
+        gw[g, :widths[g]] = offs[g] + np.arange(widths[g])
+    return jnp.asarray(gw)
 
 
 def build_cat_layout(dataset, cat_width: int) -> CatLayout:
@@ -108,9 +137,13 @@ class SerialTreeLearner:
             max_depth=int(config.max_depth),
             rows_per_chunk=rows_per_chunk,
             cat_width=cat_width,
+            hist_impl=resolve_hist_impl(config),
         )
         self.col_sampler = ColSampler(config, dataset.num_features)
         self.cat_layout = build_cat_layout(dataset, cat_width)
+        self.use_partitioned = dataset.num_data >= PARTITION_MIN_ROWS
+        self.budgets = tuple(budget_classes(dataset.num_data))
+        self.gw_global = build_gw_global(dataset)
         self._axis_name = None   # set by parallel learners
 
     def train_arrays(self, grad: jnp.ndarray, hess: jnp.ndarray,
@@ -119,6 +152,12 @@ class SerialTreeLearner:
         host synchronization (the async fast path — dispatch returns
         immediately, XLA pipelines successive trees)."""
         fmask = jnp.asarray(self.col_sampler.sample())
+        if self.use_partitioned:
+            return grow_tree_partitioned(
+                self.layout, grad, hess, bag_mask, self.meta, self.params,
+                fmask, self.fix, self.grow_config, budgets=self.budgets,
+                gw_global=self.gw_global, axis_name=self._axis_name,
+                cat=self.cat_layout)
         return grow_tree(self.layout, grad, hess, bag_mask, self.meta,
                          self.params, fmask, self.fix, self.grow_config,
                          axis_name=self._axis_name, cat=self.cat_layout)
