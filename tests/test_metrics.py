@@ -1,0 +1,146 @@
+"""Metric unit tests with hand-computed golden values
+(reference strategy: tests/python_package_test pins metric outputs)."""
+import numpy as np
+import pytest
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.data.dataset import Metadata
+from lightgbm_tpu.metrics import create_metric
+
+
+def _eval(name, label, score, weight=None, group=None, params=None,
+          objective=None):
+    cfg = Config(params or {})
+    m = create_metric(name, cfg)
+    md = Metadata(len(label))
+    md.set_label(np.asarray(label))
+    if weight is not None:
+        md.set_weight(np.asarray(weight))
+    if group is not None:
+        md.set_query(np.asarray(group))
+    m.init(md, len(label))
+    return m.eval(np.asarray(score, dtype=np.float64), objective)
+
+
+def test_l2_and_rmse():
+    y = [1.0, 2.0, 3.0]
+    p = [1.5, 2.0, 2.0]
+    assert _eval("l2", y, p)[0] == pytest.approx((0.25 + 0 + 1) / 3)
+    assert _eval("rmse", y, p)[0] == pytest.approx(np.sqrt((0.25 + 0 + 1) / 3))
+
+
+def test_l1_weighted():
+    y = [1.0, 2.0]
+    p = [2.0, 0.0]
+    w = [3.0, 1.0]
+    assert _eval("l1", y, p, weight=w)[0] == pytest.approx((3 * 1 + 1 * 2) / 4)
+
+
+def test_binary_logloss():
+    y = [0, 1]
+    prob = [0.25, 0.75]
+    expected = (-np.log(0.75) - np.log(0.75)) / 2
+    assert _eval("binary_logloss", y, prob)[0] == pytest.approx(expected)
+
+
+def test_binary_error():
+    y = [0, 1, 1, 0]
+    prob = [0.4, 0.6, 0.4, 0.6]
+    assert _eval("binary_error", y, prob)[0] == pytest.approx(0.5)
+
+
+def test_auc_perfect_and_random():
+    y = [0, 0, 1, 1]
+    assert _eval("auc", y, [0.1, 0.2, 0.8, 0.9])[0] == pytest.approx(1.0)
+    assert _eval("auc", y, [0.9, 0.8, 0.2, 0.1])[0] == pytest.approx(0.0)
+    # ties: all equal scores -> 0.5
+    assert _eval("auc", y, [0.5] * 4)[0] == pytest.approx(0.5)
+
+
+def test_auc_weighted():
+    y = [0, 1]
+    w = [2.0, 3.0]
+    assert _eval("auc", y, [0.1, 0.9], weight=w)[0] == pytest.approx(1.0)
+
+
+def test_ndcg():
+    # one query, 3 docs, labels 2,1,0 ranked perfectly
+    y = [2, 1, 0]
+    score = [3.0, 2.0, 1.0]
+    vals = _eval("ndcg", y, score, group=[3], params={"eval_at": [3]})
+    assert vals[0] == pytest.approx(1.0)
+    # worst order
+    vals = _eval("ndcg", y, [1.0, 2.0, 3.0], group=[3],
+                 params={"eval_at": [3]})
+    gain = [3.0, 1.0, 0.0]   # 2^l - 1
+    disc = 1.0 / np.log2(np.arange(3) + 2)
+    dcg = gain[2] * disc[0] + gain[1] * disc[1] + gain[0] * disc[2]
+    max_dcg = gain[0] * disc[0] + gain[1] * disc[1] + gain[2] * disc[2]
+    assert vals[0] == pytest.approx(dcg / max_dcg)
+
+
+def test_map():
+    # one query: relevant docs at ranks 1 and 3
+    y = [1, 0, 1, 0]
+    score = [4.0, 3.0, 2.0, 1.0]
+    vals = _eval("map", y, score, group=[4], params={"eval_at": [4]})
+    expected = (1.0 / 1 + 2.0 / 3) / 2
+    assert vals[0] == pytest.approx(expected)
+
+
+def test_multi_logloss():
+    y = [0, 1]
+    # class-major scores [K*N]: probabilities passed directly (no objective)
+    probs = np.array([[0.7, 0.2], [0.3, 0.8]])   # [K=2, N=2]
+    vals = _eval("multi_logloss", y, probs.reshape(-1),
+                 params={"num_class": 2})
+    expected = (-np.log(0.7) - np.log(0.8)) / 2
+    assert vals[0] == pytest.approx(expected)
+
+
+def test_multi_error_topk():
+    y = [0, 1]
+    probs = np.array([[0.4, 0.2], [0.6, 0.8]])
+    vals = _eval("multi_error", y, probs.reshape(-1),
+                 params={"num_class": 2})
+    assert vals[0] == pytest.approx(0.5)
+    vals = _eval("multi_error", y, probs.reshape(-1),
+                 params={"num_class": 2, "multi_error_top_k": 2})
+    assert vals[0] == pytest.approx(0.0)
+
+
+def test_auc_mu_binaryish():
+    # 2-class auc_mu equals plain AUC on separable data
+    y = [0, 0, 1, 1]
+    probs = np.array([[0.9, 0.8, 0.2, 0.1], [0.1, 0.2, 0.8, 0.9]])
+    vals = _eval("auc_mu", y, probs.reshape(-1), params={"num_class": 2})
+    assert vals[0] == pytest.approx(1.0)
+
+
+def test_poisson_metric():
+    y = [1.0, 2.0]
+    mu = [1.0, 2.0]
+    expected = np.mean([1 - 1 * np.log(1), 2 - 2 * np.log(2)])
+    assert _eval("poisson", y, mu)[0] == pytest.approx(expected)
+
+
+def test_quantile_metric():
+    y = [1.0, 1.0]
+    p = [0.0, 2.0]
+    # alpha=0.9: under-prediction penalized 0.9, over penalized 0.1
+    vals = _eval("quantile", y, p, params={"alpha": 0.9})
+    assert vals[0] == pytest.approx((0.9 * 1 + 0.1 * 1) / 2)
+
+
+def test_xentropy_soft_labels():
+    y = [0.3]
+    p = [0.3]
+    expected = -(0.3 * np.log(0.3) + 0.7 * np.log(0.7))
+    assert _eval("cross_entropy", y, p)[0] == pytest.approx(expected)
+
+
+def test_kldiv():
+    y = [0.3]
+    p = [0.3]
+    # KL(y||p) = 0 when p == y
+    assert _eval("kldiv", y, p)[0] == pytest.approx(0.0, abs=1e-12)
