@@ -245,12 +245,42 @@ class Dataset:
         if X is None:
             raise LightGBMError("subset requires free_raw_data=False")
         idx = np.asarray(used_indices)
+        n = self.num_data()
+        # recompute per-fold query sizes from the parent group vector so
+        # ranking cv folds keep their query structure
+        group_sub = None
+        parent_group = self.get_group()
+        if parent_group is not None and len(parent_group):
+            qid = np.repeat(np.arange(len(parent_group)),
+                            np.asarray(parent_group, dtype=np.int64))
+            qid_sub = qid[idx]
+            if len(qid_sub):
+                change = np.flatnonzero(np.diff(qid_sub) != 0)
+                bounds = np.concatenate([[0], change + 1, [len(qid_sub)]])
+                group_sub = np.diff(bounds)
+        # slice init_score rows ([n], [n*k] class-major, or [n, k])
+        init_sub = None
+        isc = self.get_init_score()
+        if isc is not None:
+            isc = np.asarray(isc)
+            if isc.ndim == 2:
+                init_sub = isc[idx]
+            elif isc.size == n:
+                init_sub = isc[idx]
+            elif isc.size % n == 0:
+                init_sub = isc.reshape(-1, n)[:, idx].reshape(-1)
+            else:
+                raise LightGBMError(
+                    "init_score size %d is not compatible with num_data %d"
+                    % (isc.size, n))
         sub = Dataset(X[idx],
                       label=None if self.label is None else
                       np.asarray(self.label)[idx],
                       reference=self,
                       weight=None if self.weight is None else
                       np.asarray(self.weight)[idx],
+                      group=group_sub,
+                      init_score=init_sub,
                       params=params or self.params,
                       free_raw_data=self.free_raw_data)
         sub.used_indices = idx

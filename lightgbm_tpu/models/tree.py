@@ -332,7 +332,10 @@ class Tree:
             is_cat = bool(self.decision_type[k] & kCategoricalMask)
             if inner < 0:
                 # feature trivial in this dataset: constant value; route all
-                # rows by evaluating the decision on that constant
+                # rows by evaluating the decision on that constant.  The
+                # all-left threshold must exceed any bin (b <= thr for every
+                # b) and the missing-type bits must be cleared so go_default
+                # cannot override the constant routing.
                 self.split_feature_inner[k] = 0
                 mapper = dataset.bin_mappers[real_f]
                 const_val = mapper.min_val
@@ -340,7 +343,8 @@ class Tree:
                     go_left = False
                 else:
                     go_left = const_val <= self.threshold[k]
-                self.threshold_in_bin[k] = 0 if go_left else -1
+                self.threshold_in_bin[k] = (1 << 30) if go_left else -1
+                self.decision_type[k] &= ~np.int8(3 << 2)   # missing: None
                 if is_cat:
                     # clear categorical bit: use numerical constant routing
                     self.decision_type[k] &= ~np.int8(kCategoricalMask)

@@ -97,8 +97,10 @@ class DataParallelTreeLearner(SerialTreeLearner):
                              fix, gc, axis_name=AXIS, cat=cat)
         return run
 
-    def train(self, grad: jnp.ndarray, hess: jnp.ndarray,
-              bag_mask: jnp.ndarray) -> Tuple[Tree, jnp.ndarray]:
+    def train_arrays(self, grad: jnp.ndarray, hess: jnp.ndarray,
+                     bag_mask: jnp.ndarray):
+        """Sharded grow; returns TreeArrays with row_leaf sliced back to
+        num_data (the async fast path used by GBDT.train_one_iter)."""
         if self._sharded_grow is None:
             self._sharded_grow = self._build()
         pad = self._pad
@@ -109,11 +111,17 @@ class DataParallelTreeLearner(SerialTreeLearner):
             bag_mask = jnp.pad(bag_mask, (0, pad))
         fmask = jnp.asarray(self.col_sampler.sample())
         arrays = self._sharded_grow(bins, grad, hess, bag_mask, fmask)
+        if pad:
+            arrays = arrays._replace(
+                row_leaf=arrays.row_leaf[:self.dataset.num_data])
+        return arrays
+
+    def train(self, grad: jnp.ndarray, hess: jnp.ndarray,
+              bag_mask: jnp.ndarray) -> Tuple[Tree, jnp.ndarray]:
+        arrays = self.train_arrays(grad, hess, bag_mask)
         host = jax.tree.map(np.asarray, arrays)
         tree = Tree.from_grower(host, self.dataset)
-        row_leaf = arrays.row_leaf[:self.dataset.num_data] if pad else \
-            arrays.row_leaf
-        return tree, row_leaf
+        return tree, arrays.row_leaf
 
 
 def _tree_arrays_spec(gc: GrowConfig):

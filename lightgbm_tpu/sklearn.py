@@ -192,8 +192,12 @@ class LGBMModel(_SKBase):
             params["metric"] = list(eval_metric)
 
         y = np.asarray(y).reshape(-1)
-        if self.class_weight is not None and sample_weight is None:
-            sample_weight = self._compute_class_weights(y)
+        if self.class_weight is not None:
+            cw = self._compute_class_weights(y)
+            # class weights multiply into any user-provided sample weights
+            # (reference sklearn.py fit: _LGBMComputeSampleWeight product)
+            sample_weight = cw if sample_weight is None else \
+                np.asarray(sample_weight, dtype=np.float64) * cw
         train_set = Dataset(X, label=y, weight=sample_weight,
                             group=group, init_score=init_score,
                             params=params)
@@ -207,6 +211,16 @@ class LGBMModel(_SKBase):
                     vy = self._le.transform(vy)
                 vw = (eval_sample_weight[i]
                       if eval_sample_weight is not None else None)
+                if eval_class_weight is not None and \
+                        i < len(eval_class_weight) and \
+                        eval_class_weight[i] is not None:
+                    # computed on encoded labels — same key space as the
+                    # training class_weight (y reaches this method encoded)
+                    from sklearn.utils.class_weight import \
+                        compute_sample_weight
+                    vcw = compute_sample_weight(eval_class_weight[i], vy)
+                    vw = vcw if vw is None else \
+                        np.asarray(vw, dtype=np.float64) * vcw
                 vg = eval_group[i] if eval_group is not None else None
                 vi = (eval_init_score[i]
                       if eval_init_score is not None else None)

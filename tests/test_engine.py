@@ -249,6 +249,43 @@ def test_cv():
     assert r["valid auc-mean"][-1] > 0.9
 
 
+def test_cv_lambdarank():
+    """cv() with ranking objectives must propagate per-fold query groups
+    (GroupKFold path) and not drop init_score in folds."""
+    rng = np.random.default_rng(9)
+    n_q, q_len = 40, 12
+    n = n_q * q_len
+    X = rng.normal(size=(n, 6))
+    rel = np.clip((X[:, 0] + 0.5 * X[:, 1]
+                   + 0.3 * rng.normal(size=n)) * 1.5 + 1.5, 0, 4)
+    y = np.round(rel).astype(int)
+    group = np.full(n_q, q_len)
+    ds = lgb.Dataset(X, y, group=group, free_raw_data=False)
+    r = lgb.cv({"objective": "lambdarank", "metric": "ndcg",
+                "ndcg_eval_at": [3], "num_leaves": 7, "verbosity": -1,
+                "min_data_in_leaf": 3}, ds, 8, nfold=4)
+    key = [k for k in r if k.endswith("-mean")][0]
+    assert len(r[key]) == 8
+    assert r[key][-1] > 0.5
+
+
+def test_subset_propagates_fields():
+    X, y = _make_binary(n=600)
+    w = np.linspace(0.5, 1.5, 600)
+    isc = np.linspace(-0.1, 0.1, 600)
+    group = np.full(60, 10)
+    ds = lgb.Dataset(X, y, weight=w, group=group, init_score=isc,
+                     free_raw_data=False)
+    ds.construct()
+    idx = np.arange(100, 300)
+    sub = ds.subset(idx)
+    sub.construct()
+    np.testing.assert_allclose(sub.get_weight(), w[idx])
+    np.testing.assert_allclose(sub.get_init_score(), isc[idx])
+    assert np.sum(sub.get_group()) == 200
+    np.testing.assert_array_equal(sub.get_group(), np.full(20, 10))
+
+
 def test_custom_objective_and_metric():
     X, y = _make_binary()
 
