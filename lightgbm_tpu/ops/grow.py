@@ -14,6 +14,11 @@ Key TPU design decisions (vs the reference's pointer-chasing structures):
   * per-leaf histograms live in one [num_leaves, total_bins, 2] HBM tensor
     (replacing HistogramPool, feature_histogram.hpp:960) updated with
     dynamic_update_slice inside a lax.while_loop;
+  * the loop body is BRANCH-FREE: instead of lax.cond around the split, every
+    state update is masked by a `do` predicate. A cond keeps both the old and
+    new leaf-histogram tensors alive, forcing XLA to copy the full [L, TB, 2]
+    buffer every iteration (~2x14MB per split at 255 leaves); masked
+    dynamic-update-slices keep the updates in place;
   * the partition decision reproduces DenseBin::Split semantics
     (src/io/dense_bin.hpp:112-207): missing NaN bin / zero bin travel in the
     default_left direction, everything else compares local_bin <= threshold;
@@ -21,7 +26,9 @@ Key TPU design decisions (vs the reference's pointer-chasing structures):
     to this feature's most_freq_bin;
   * monotone constraint propagation follows
     src/treelearner/monotone_constraints.hpp:15-64 (children inherit the
-    parent's range; the split midpoint tightens one side).
+    parent's range; the split midpoint tightens one side);
+  * gc.use_dp selects f64 vs f32 leaf/gain state (f32 is the TPU default,
+    mirroring the reference GPU learner's gpu_use_dp=false).
 """
 from __future__ import annotations
 
@@ -33,8 +40,9 @@ import jax.numpy as jnp
 
 from .split import (CatLayout, F64, I32, K_MIN_SCORE, FeatureMeta,
                     SplitCandidate, SplitParams, _leaf_output_unconstrained,
-                    find_best_split_categorical, find_best_split_numerical,
-                    fix_histogram, merge_candidates)
+                    acc_dtype, find_best_split_categorical,
+                    find_best_split_numerical, fix_histogram,
+                    merge_candidates)
 
 
 def empty_cat_layout(cat_width: int = 1) -> CatLayout:
@@ -57,6 +65,12 @@ class GrowConfig(NamedTuple):
     rows_per_chunk: int     # histogram chunking; 0 = one shot
     cat_width: int          # width of categorical bitmask (1 if no cat feats)
     hist_impl: str = "scatter"   # "scatter" (CPU) | "onehot" (MXU einsum)
+    scan_width: int = 0     # dense scan width (0 = min(total_bins, 256))
+    use_dp: bool = True     # f64 (CPU default) vs f32 (TPU default) math
+    window_chunk: int = 2048  # streaming chunk of the partitioned grower
+    use_l1: bool = True     # lambda_l1 > 0 (USE_L1 template analog)
+    use_mds: bool = True    # max_delta_step > 0 (USE_MAX_OUTPUT analog)
+    hist_dtype: str = "f32"  # "f32" | "bf16x2" (hi/lo split bf16 MXU)
 
 
 class FixInfo(NamedTuple):
@@ -81,14 +95,14 @@ class TreeArrays(NamedTuple):
     split_feature: jnp.ndarray  # [L-1] i32 inner feature index
     threshold: jnp.ndarray      # [L-1] i32 local bin threshold
     default_left: jnp.ndarray   # [L-1] bool
-    gain: jnp.ndarray           # [L-1] f64
+    gain: jnp.ndarray           # [L-1] ft
     is_cat: jnp.ndarray         # [L-1] bool
     cat_mask: jnp.ndarray       # [L-1, CAT_W] bool
-    internal_value: jnp.ndarray  # [L-1] f64 (parent leaf output at split time)
+    internal_value: jnp.ndarray  # [L-1] ft (parent leaf output at split time)
     internal_count: jnp.ndarray  # [L-1] i32
-    leaf_value: jnp.ndarray     # [L] f64
+    leaf_value: jnp.ndarray     # [L] ft
     leaf_count: jnp.ndarray     # [L] i32
-    leaf_weight: jnp.ndarray    # [L] f64 (sum_hessian)
+    leaf_weight: jnp.ndarray    # [L] ft (sum_hessian)
     row_leaf: jnp.ndarray       # [N] i32 final leaf id per row
 
 
@@ -97,13 +111,13 @@ class _LoopState(NamedTuple):
     done: jnp.ndarray           # bool
     row_leaf: jnp.ndarray       # [N] i32
     leaf_hist: jnp.ndarray      # [L, TB, 2] f32
-    leaf_sum_grad: jnp.ndarray  # [L] f64
-    leaf_sum_hess: jnp.ndarray  # [L] f64
+    leaf_sum_grad: jnp.ndarray  # [L] ft
+    leaf_sum_hess: jnp.ndarray  # [L] ft
     leaf_count: jnp.ndarray     # [L] i32 (in-bag rows)
-    leaf_value: jnp.ndarray     # [L] f64
+    leaf_value: jnp.ndarray     # [L] ft
     leaf_depth: jnp.ndarray     # [L] i32
-    leaf_cmin: jnp.ndarray      # [L] f64 monotone lower bound
-    leaf_cmax: jnp.ndarray      # [L] f64 monotone upper bound
+    leaf_cmin: jnp.ndarray      # [L] ft monotone lower bound
+    leaf_cmax: jnp.ndarray      # [L] ft monotone upper bound
     best: SplitCandidate        # [L] pytree of per-leaf best splits
     tree: TreeArrays
 
@@ -120,13 +134,13 @@ def _hist_masked(bins, group_offset, grad, hess, mask, total_bins, rows_per_chun
     return h
 
 
-def _root_candidate_dummy(cat_width: int) -> SplitCandidate:
-    z64 = jnp.asarray(0.0, F64)
+def _root_candidate_dummy(cat_width: int, ft) -> SplitCandidate:
+    z = jnp.asarray(0.0, ft)
     return SplitCandidate(
-        gain=jnp.asarray(K_MIN_SCORE, F64), feature=jnp.asarray(-1, I32),
+        gain=jnp.asarray(K_MIN_SCORE, ft), feature=jnp.asarray(-1, I32),
         threshold=jnp.asarray(0, I32), default_left=jnp.asarray(True),
-        left_output=z64, right_output=z64, left_sum_grad=z64,
-        left_sum_hess=z64, right_sum_grad=z64, right_sum_hess=z64,
+        left_output=z, right_output=z, left_sum_grad=z,
+        left_sum_hess=z, right_sum_grad=z, right_sum_hess=z,
         left_count=jnp.asarray(0, I32), right_count=jnp.asarray(0, I32),
         is_cat=jnp.asarray(False), cat_mask=jnp.zeros((cat_width,), BOOL))
 
@@ -147,30 +161,144 @@ def _go_left_decision(local_bin, in_range, feat_meta_row, cand, cat_width):
     return num_left
 
 
-def _single_leaf_tree(n, L, cat_width, grad, hess, bag_mask, params, axis_name):
+def _single_leaf_tree(n, L, cat_width, grad, hess, bag_mask, params, axis_name,
+                      ft):
     def psum(x):
         return jax.lax.psum(x, axis_name) if axis_name is not None else x
-    sum_grad = psum(jnp.sum(grad.astype(jnp.float32), dtype=F64))
-    sum_hess = psum(jnp.sum(hess.astype(jnp.float32), dtype=F64))
+    sum_grad = psum(jnp.sum(grad.astype(jnp.float32), dtype=ft))
+    sum_hess = psum(jnp.sum(hess.astype(jnp.float32), dtype=ft))
     count = psum(jnp.sum(bag_mask, dtype=I32))
+    params = params.cast(ft)
     root_out = _leaf_output_unconstrained(
         sum_grad, sum_hess, params.lambda_l1, params.lambda_l2,
-        params.max_delta_step)
+        params.max_delta_step)   # generic flags: one-off, not hot
     return TreeArrays(
         num_leaves=jnp.asarray(1, I32),
         split_leaf=jnp.zeros((L - 1,), I32),
         split_feature=jnp.full((L - 1,), -1, I32),
         threshold=jnp.zeros((L - 1,), I32),
         default_left=jnp.zeros((L - 1,), BOOL),
-        gain=jnp.zeros((L - 1,), F64),
+        gain=jnp.zeros((L - 1,), ft),
         is_cat=jnp.zeros((L - 1,), BOOL),
         cat_mask=jnp.zeros((L - 1, cat_width), BOOL),
-        internal_value=jnp.zeros((L - 1,), F64),
+        internal_value=jnp.zeros((L - 1,), ft),
         internal_count=jnp.zeros((L - 1,), I32),
-        leaf_value=jnp.zeros((L,), F64).at[0].set(root_out),
+        leaf_value=jnp.zeros((L,), ft).at[0].set(root_out),
         leaf_count=jnp.zeros((L,), I32).at[0].set(count),
-        leaf_weight=jnp.zeros((L,), F64).at[0].set(sum_hess),
+        leaf_weight=jnp.zeros((L,), ft).at[0].set(sum_hess),
         row_leaf=jnp.zeros((n,), I32),
+    )
+
+
+def _empty_tree_arrays(n, L, cat_width, ft) -> TreeArrays:
+    return TreeArrays(
+        num_leaves=jnp.asarray(1, I32),
+        split_leaf=jnp.zeros((L - 1,), I32),
+        split_feature=jnp.full((L - 1,), -1, I32),
+        threshold=jnp.zeros((L - 1,), I32),
+        default_left=jnp.zeros((L - 1,), BOOL),
+        gain=jnp.zeros((L - 1,), ft),
+        is_cat=jnp.zeros((L - 1,), BOOL),
+        cat_mask=jnp.zeros((L - 1, cat_width), BOOL),
+        internal_value=jnp.zeros((L - 1,), ft),
+        internal_count=jnp.zeros((L - 1,), I32),
+        leaf_value=jnp.zeros((L,), ft),
+        leaf_count=jnp.zeros((L,), I32),
+        leaf_weight=jnp.zeros((L,), ft),
+        row_leaf=jnp.zeros((n,), I32),
+    )
+
+
+def _make_eval_leaf(meta, params, feature_mask, cat, gc: GrowConfig):
+    """Per-leaf best-split evaluator over a [TB, 2] histogram."""
+    F = gc.num_features
+
+    def eval_leaf(hist, sg, sh, cnt, depth, cmin, cmax):
+        cand = find_best_split_numerical(
+            hist, sg, sh, cnt, meta, params, cmin, cmax, feature_mask,
+            num_features=F, use_mc=gc.use_mc, max_w=gc.scan_width,
+            use_dp=gc.use_dp, use_l1=gc.use_l1, use_mds=gc.use_mds)
+        cand = cand._replace(cat_mask=jnp.zeros((gc.cat_width,), BOOL))
+        if cat.cat_feature.shape[0] > 0:
+            cat_cand = find_best_split_categorical(
+                hist, sg, sh, cnt, cat, meta, params, cmin, cmax,
+                feature_mask, use_mc=gc.use_mc, use_dp=gc.use_dp)
+            cand = merge_candidates(cand, cat_cand)
+        if gc.max_depth > 0:
+            blocked = depth >= gc.max_depth
+            cand = cand._replace(
+                gain=jnp.where(blocked, K_MIN_SCORE, cand.gain))
+        return cand
+    return eval_leaf
+
+
+def _eval_children(eval_leaf, leaf_hist, l, s, cand, left_cnt, right_cnt,
+                   depth_child, l_cmin, l_cmax, r_cmin, r_cmax):
+    """Evaluate both children in ONE vectorized scan pass (vmap over a
+    [2, TB, 2] stack) — halves the per-split fixed cost of the dense scan."""
+    pair_hist = jnp.stack([leaf_hist[l], leaf_hist[s]])
+    sgs = jnp.stack([cand.left_sum_grad, cand.right_sum_grad])
+    shs = jnp.stack([cand.left_sum_hess, cand.right_sum_hess])
+    cnts = jnp.stack([left_cnt, right_cnt])
+    cmins = jnp.stack([l_cmin, r_cmin])
+    cmaxs = jnp.stack([l_cmax, r_cmax])
+    pair = jax.vmap(eval_leaf, in_axes=(0, 0, 0, 0, None, 0, 0))(
+        pair_hist, sgs, shs, cnts, depth_child, cmins, cmaxs)
+    cand_l = jax.tree.map(lambda a: a[0], pair)
+    cand_r = jax.tree.map(lambda a: a[1], pair)
+    return cand_l, cand_r
+
+
+def _hist_chunk_contract(bv, vc, W, hist_dtype):
+    """One chunk's one-hot MXU contraction -> [G, W, 2] f32.
+
+    hist_dtype "bf16x2" splits (grad, hess) into bf16 hi + lo halves and
+    contracts one [C, 4]-wide bf16 matmul (the one-hot is exact in bf16, so
+    accuracy is f32-grade while the MXU runs at its bf16 rate — the padded-N
+    cost of 4 vs 2 columns is zero).
+    """
+    if hist_dtype == "bf16x2":
+        oh = (bv[:, :, None] == jnp.arange(W, dtype=I32)[None, None, :]
+              ).astype(jnp.bfloat16)
+        v_hi = vc.astype(jnp.bfloat16)
+        v_lo = (vc - v_hi.astype(jnp.float32)).astype(jnp.bfloat16)
+        vq = jnp.concatenate([v_hi, v_lo], -1)                  # [C, 4]
+        out = jnp.einsum("rgw,rc->gwc", oh, vq,
+                         preferred_element_type=jnp.float32)    # [G, W, 4]
+        return out[..., :2] + out[..., 2:]
+    oh = (bv[:, :, None] == jnp.arange(W, dtype=I32)[None, None, :]
+          ).astype(jnp.float32)
+    return jnp.einsum("rgw,rc->gwc", oh, vc,
+                      preferred_element_type=jnp.float32)
+
+
+def _mono_bounds(st_cmin, st_cmax, mono, left_out, right_out, ft):
+    """Monotone bound propagation (monotone_constraints.hpp:15-64)."""
+    mid = ((left_out + right_out) / 2.0).astype(ft)
+    l_cmax = jnp.where(mono > 0, jnp.minimum(st_cmax, mid), st_cmax)
+    r_cmin = jnp.where(mono > 0, jnp.maximum(st_cmin, mid), st_cmin)
+    l_cmin = jnp.where(mono < 0, jnp.maximum(st_cmin, mid), st_cmin)
+    r_cmax = jnp.where(mono < 0, jnp.minimum(st_cmax, mid), st_cmax)
+    return l_cmin, l_cmax, r_cmin, r_cmax
+
+
+def _record_split(tree: TreeArrays, k, do, l, cand, parent_value,
+                  parent_count, s):
+    """Masked write of split record k (identity when ~do)."""
+    def m(a, new, idx):
+        return a.at[idx].set(jnp.where(do, new, a[idx]))
+    return tree._replace(
+        num_leaves=jnp.where(do, s + 1, tree.num_leaves),
+        split_leaf=m(tree.split_leaf, l, k),
+        split_feature=m(tree.split_feature, cand.feature, k),
+        threshold=m(tree.threshold, cand.threshold, k),
+        default_left=m(tree.default_left, cand.default_left, k),
+        gain=m(tree.gain, cand.gain, k),
+        is_cat=m(tree.is_cat, cand.is_cat, k),
+        cat_mask=tree.cat_mask.at[k].set(
+            jnp.where(do, cand.cat_mask, tree.cat_mask[k])),
+        internal_value=m(tree.internal_value, parent_value, k),
+        internal_count=m(tree.internal_count, parent_count, k),
     )
 
 
@@ -193,6 +321,7 @@ def grow_tree(layout: DataLayout, grad: jnp.ndarray, hess: jnp.ndarray,
     """
     if cat is None:
         cat = empty_cat_layout(gc.cat_width)
+    ft = acc_dtype(gc.use_dp)
     n = layout.bins.shape[0]
     L = gc.num_leaves
     TB = gc.total_bins
@@ -201,7 +330,7 @@ def grow_tree(layout: DataLayout, grad: jnp.ndarray, hess: jnp.ndarray,
         # no usable features: a single-leaf tree (reference warns and trains
         # constant trees when all features are trivial)
         return _single_leaf_tree(n, L, gc.cat_width, grad, hess, bag_mask,
-                                 params, axis_name)
+                                 params, axis_name, ft)
 
     grad = grad.astype(jnp.float32)
     hess = hess.astype(jnp.float32)
@@ -212,64 +341,36 @@ def grow_tree(layout: DataLayout, grad: jnp.ndarray, hess: jnp.ndarray,
     # ---- root ----------------------------------------------------------
     root_hist = _hist_masked(layout.bins, layout.group_offset, grad, hess,
                              bag_mask, TB, gc.rows_per_chunk, axis_name)
-    sum_grad = psum(jnp.sum(grad, dtype=F64))
-    sum_hess = psum(jnp.sum(hess, dtype=F64))
+    sum_grad = psum(jnp.sum(grad, dtype=ft))
+    sum_hess = psum(jnp.sum(hess, dtype=ft))
     root_count = psum(jnp.sum(bag_mask, dtype=I32))
     root_hist = fix_histogram(root_hist, sum_grad, sum_hess,
-                              fix.mf_global, fix.start, fix.end)
+                              fix.mf_global, fix.start, fix.end,
+                              max_w=gc.scan_width, use_dp=gc.use_dp)
 
-    ninf = jnp.full((L,), K_MIN_SCORE, F64)
+    pcast = params.cast(ft)
+    eval_leaf = _make_eval_leaf(meta, params, feature_mask, cat, gc)
+    root_out = _leaf_output_unconstrained(
+        sum_grad, sum_hess, pcast.lambda_l1, pcast.lambda_l2,
+        pcast.max_delta_step)
+
     state = _LoopState(
         s=jnp.asarray(1, I32),
         done=jnp.asarray(False),
         row_leaf=jnp.zeros((n,), I32),
         leaf_hist=jnp.zeros((L, TB, 2), jnp.float32).at[0].set(root_hist),
-        leaf_sum_grad=jnp.zeros((L,), F64).at[0].set(sum_grad),
-        leaf_sum_hess=jnp.zeros((L,), F64).at[0].set(sum_hess),
+        leaf_sum_grad=jnp.zeros((L,), ft).at[0].set(sum_grad),
+        leaf_sum_hess=jnp.zeros((L,), ft).at[0].set(sum_hess),
         leaf_count=jnp.zeros((L,), I32).at[0].set(root_count),
-        leaf_value=jnp.zeros((L,), F64),
+        leaf_value=jnp.zeros((L,), ft).at[0].set(root_out),
         leaf_depth=jnp.zeros((L,), I32),
-        leaf_cmin=jnp.full((L,), -jnp.inf, F64),
-        leaf_cmax=jnp.full((L,), jnp.inf, F64),
+        leaf_cmin=jnp.full((L,), -jnp.inf, ft),
+        leaf_cmax=jnp.full((L,), jnp.inf, ft),
         best=jax.tree.map(
             lambda x: jnp.broadcast_to(x, (L,) + x.shape),
-            _root_candidate_dummy(gc.cat_width)),
-        tree=TreeArrays(
-            num_leaves=jnp.asarray(1, I32),
-            split_leaf=jnp.zeros((L - 1,), I32),
-            split_feature=jnp.full((L - 1,), -1, I32),
-            threshold=jnp.zeros((L - 1,), I32),
-            default_left=jnp.zeros((L - 1,), BOOL),
-            gain=jnp.zeros((L - 1,), F64),
-            is_cat=jnp.zeros((L - 1,), BOOL),
-            cat_mask=jnp.zeros((L - 1, gc.cat_width), BOOL),
-            internal_value=jnp.zeros((L - 1,), F64),
-            internal_count=jnp.zeros((L - 1,), I32),
-            leaf_value=jnp.zeros((L,), F64),
-            leaf_count=jnp.zeros((L,), I32),
-            leaf_weight=jnp.zeros((L,), F64),
-            row_leaf=jnp.zeros((n,), I32),
-        ),
+            _root_candidate_dummy(gc.cat_width, ft)),
+        tree=_empty_tree_arrays(n, L, gc.cat_width, ft),
     )
-
-    def eval_leaf(hist, sg, sh, cnt, depth, cmin, cmax):
-        """Best split of a (new) leaf; -inf gain when depth-limited."""
-        cand = find_best_split_numerical(
-            hist, sg, sh, cnt, meta, params, cmin, cmax, feature_mask,
-            num_features=F, use_mc=gc.use_mc)
-        # widen the numerical candidate's dummy cat_mask to cat_width
-        cand = cand._replace(
-            cat_mask=jnp.zeros((gc.cat_width,), BOOL))
-        if cat.cat_feature.shape[0] > 0:
-            cat_cand = find_best_split_categorical(
-                hist, sg, sh, cnt, cat, meta, params, cmin, cmax,
-                feature_mask, use_mc=gc.use_mc)
-            cand = merge_candidates(cand, cat_cand)
-        if gc.max_depth > 0:
-            blocked = depth >= gc.max_depth
-            cand = cand._replace(
-                gain=jnp.where(blocked, K_MIN_SCORE, cand.gain))
-        return cand
 
     # root best split
     root_cand = eval_leaf(root_hist, sum_grad, sum_hess, root_count,
@@ -286,112 +387,100 @@ def grow_tree(layout: DataLayout, grad: jnp.ndarray, hess: jnp.ndarray,
     def body(st: _LoopState) -> _LoopState:
         l = jnp.argmax(st.best.gain).astype(I32)   # first max = smallest leaf
         gain = st.best.gain[l]
-        no_split = gain <= 0.0
+        do = gain > 0.0
+        s = st.s
+        cand = jax.tree.map(lambda a: a[l], st.best)
+        f = jnp.maximum(cand.feature, 0)
+        g = layout.group_of[f]
+        # per-row local bin of feature f (EFB fallback to most_freq)
+        col = layout.bins[:, g].astype(I32) + layout.group_offset[g]
+        in_range = (col >= meta.bin_start[f]) & (col < meta.bin_end[f])
+        local_bin = col - meta.bin_start[f]
+        go_left = _go_left_decision(
+            local_bin, in_range,
+            (feat_nb[f], meta.missing_type[f], meta.default_bin[f],
+             layout.most_freq_bin[f]),
+            cand, gc.cat_width)
+        in_leaf = (st.row_leaf == l) & do
+        row_leaf = jnp.where(in_leaf & ~go_left, s, st.row_leaf)
 
-        def do_split(st: _LoopState) -> _LoopState:
-            s = st.s
-            cand = jax.tree.map(lambda a: a[l], st.best)
-            f = cand.feature
-            g = layout.group_of[f]
-            # per-row local bin of feature f (EFB fallback to most_freq)
-            col = layout.bins[:, g].astype(I32) + layout.group_offset[g]
-            in_range = (col >= meta.bin_start[f]) & (col < meta.bin_end[f])
-            local_bin = col - meta.bin_start[f]
-            go_left = _go_left_decision(
-                local_bin, in_range,
-                (feat_nb[f], meta.missing_type[f], meta.default_bin[f],
-                 layout.most_freq_bin[f]),
-                cand, gc.cat_width)
-            in_leaf = st.row_leaf == l
-            row_leaf = jnp.where(in_leaf & ~go_left, s, st.row_leaf)
+        in_bag = in_leaf & bag_mask
+        left_cnt = psum(jnp.sum(in_bag & go_left, dtype=I32))
+        right_cnt = psum(jnp.sum(in_bag, dtype=I32)) - left_cnt
 
-            in_bag = in_leaf & bag_mask
-            left_cnt = psum(jnp.sum(in_bag & go_left, dtype=I32))
-            right_cnt = psum(jnp.sum(in_bag, dtype=I32)) - left_cnt
+        smaller_is_left = left_cnt <= right_cnt
+        smaller_mask = in_leaf & (go_left == smaller_is_left)
+        hist_smaller = _hist_masked(
+            layout.bins, layout.group_offset, grad, hess, smaller_mask,
+            TB, gc.rows_per_chunk, axis_name)
+        sm_sum_grad = jnp.where(smaller_is_left, cand.left_sum_grad,
+                                cand.right_sum_grad)
+        sm_sum_hess = jnp.where(smaller_is_left, cand.left_sum_hess,
+                                cand.right_sum_hess)
+        hist_smaller = fix_histogram(hist_smaller, sm_sum_grad, sm_sum_hess,
+                                     fix.mf_global, fix.start, fix.end,
+                                     max_w=gc.scan_width, use_dp=gc.use_dp)
+        parent_hist = st.leaf_hist[l]
+        hist_larger = parent_hist - hist_smaller
+        hist_left = jnp.where(smaller_is_left, hist_smaller, hist_larger)
+        hist_right = jnp.where(smaller_is_left, hist_larger, hist_smaller)
 
-            smaller_is_left = left_cnt <= right_cnt
-            smaller_mask = in_leaf & (go_left == smaller_is_left)
-            hist_smaller = _hist_masked(
-                layout.bins, layout.group_offset, grad, hess, smaller_mask,
-                TB, gc.rows_per_chunk, axis_name)
-            sm_sum_grad = jnp.where(smaller_is_left, cand.left_sum_grad,
-                                    cand.right_sum_grad)
-            sm_sum_hess = jnp.where(smaller_is_left, cand.left_sum_hess,
-                                    cand.right_sum_hess)
-            hist_smaller = fix_histogram(hist_smaller, sm_sum_grad, sm_sum_hess,
-                                         fix.mf_global, fix.start, fix.end)
-            parent_hist = st.leaf_hist[l]
-            hist_larger = parent_hist - hist_smaller
-            hist_left = jnp.where(smaller_is_left, hist_smaller, hist_larger)
-            hist_right = jnp.where(smaller_is_left, hist_larger, hist_smaller)
+        depth_child = st.leaf_depth[l] + 1
+        mono = meta.monotone[f]
+        l_cmin, l_cmax, r_cmin, r_cmax = _mono_bounds(
+            st.leaf_cmin[l], st.leaf_cmax[l], mono, cand.left_output,
+            cand.right_output, ft)
 
-            depth_child = st.leaf_depth[l] + 1
-            # monotone bound propagation (monotone_constraints.hpp:15-64)
-            cmin_p, cmax_p = st.leaf_cmin[l], st.leaf_cmax[l]
-            mono = meta.monotone[f]
-            mid = (cand.left_output + cand.right_output) / 2.0
-            l_cmax = jnp.where(mono > 0, jnp.minimum(cmax_p, mid), cmax_p)
-            r_cmin = jnp.where(mono > 0, jnp.maximum(cmin_p, mid), cmin_p)
-            l_cmin = jnp.where(mono < 0, jnp.maximum(cmin_p, mid), cmin_p)
-            r_cmax = jnp.where(mono < 0, jnp.minimum(cmax_p, mid), cmax_p)
+        # masked in-place updates: left keeps id l, right gets id s.
+        # Fallback values avoid re-reading the big buffer: slot l's old value
+        # is parent_hist (already sliced), slot s is untouched initial zeros
+        # by construction — so the original buffer's liveness ends at the
+        # first update and XLA keeps the DUS chain in place.
+        def upd(a, new_l, new_s):
+            a = a.at[l].set(jnp.where(do, new_l, a[l]))
+            return a.at[s].set(jnp.where(do, new_s, a[s]))
 
-            # update leaf state: left keeps id l, right gets id s
-            leaf_hist = st.leaf_hist.at[l].set(hist_left).at[s].set(hist_right)
-            leaf_sum_grad = st.leaf_sum_grad.at[l].set(cand.left_sum_grad) \
-                                            .at[s].set(cand.right_sum_grad)
-            leaf_sum_hess = st.leaf_sum_hess.at[l].set(cand.left_sum_hess) \
-                                            .at[s].set(cand.right_sum_hess)
-            leaf_count = st.leaf_count.at[l].set(left_cnt).at[s].set(right_cnt)
-            leaf_value = st.leaf_value.at[l].set(cand.left_output) \
-                                      .at[s].set(cand.right_output)
-            leaf_depth = st.leaf_depth.at[l].set(depth_child) \
-                                      .at[s].set(depth_child)
-            leaf_cmin = st.leaf_cmin.at[l].set(l_cmin).at[s].set(r_cmin)
-            leaf_cmax = st.leaf_cmax.at[l].set(l_cmax).at[s].set(r_cmax)
+        # materialize both write values behind an optimization barrier so
+        # XLA cannot re-fuse the parent_hist slice into the DUS fusions
+        # (that would keep the carried buffer alive and force a full copy)
+        val_l, val_r = jax.lax.optimization_barrier(
+            (jnp.where(do, hist_left, parent_hist),
+             jnp.where(do, hist_right, jnp.zeros_like(hist_right))))
+        leaf_hist = st.leaf_hist.at[l].set(val_l).at[s].set(val_r)
+        leaf_sum_grad = upd(st.leaf_sum_grad, cand.left_sum_grad,
+                            cand.right_sum_grad)
+        leaf_sum_hess = upd(st.leaf_sum_hess, cand.left_sum_hess,
+                            cand.right_sum_hess)
+        leaf_count = upd(st.leaf_count, left_cnt, right_cnt)
+        leaf_value = upd(st.leaf_value, cand.left_output, cand.right_output)
+        leaf_depth = upd(st.leaf_depth, depth_child, depth_child)
+        leaf_cmin = upd(st.leaf_cmin, l_cmin, r_cmin)
+        leaf_cmax = upd(st.leaf_cmax, l_cmax, r_cmax)
 
-            # evaluate children
-            cand_l = eval_leaf(hist_left, cand.left_sum_grad,
-                               cand.left_sum_hess, left_cnt, depth_child,
-                               l_cmin, l_cmax)
-            cand_r = eval_leaf(hist_right, cand.right_sum_grad,
-                               cand.right_sum_hess, right_cnt, depth_child,
-                               r_cmin, r_cmax)
-            best = jax.tree.map(
-                lambda a, vl, vr: a.at[l].set(vl).at[s].set(vr),
-                st.best, cand_l, cand_r)
+        # evaluate children FROM THE UPDATED BUFFER: slicing leaf_hist (not
+        # the hist_left/right expressions) ends the old buffer's liveness at
+        # the update, letting XLA do the dynamic-update-slice in place
+        # instead of copying the whole [L, TB, 2] tensor twice per split
+        cand_l, cand_r = _eval_children(
+            eval_leaf, leaf_hist, l, s, cand, left_cnt, right_cnt,
+            depth_child, l_cmin, l_cmax, r_cmin, r_cmax)
+        best = jax.tree.map(
+            lambda a, vl, vr: a.at[l].set(jnp.where(do, vl, a[l]))
+                               .at[s].set(jnp.where(do, vr, a[s])),
+            st.best, cand_l, cand_r)
 
-            k = s - 1
-            tree = st.tree._replace(
-                num_leaves=s + 1,
-                split_leaf=st.tree.split_leaf.at[k].set(l),
-                split_feature=st.tree.split_feature.at[k].set(f),
-                threshold=st.tree.threshold.at[k].set(cand.threshold),
-                default_left=st.tree.default_left.at[k].set(cand.default_left),
-                gain=st.tree.gain.at[k].set(cand.gain),
-                is_cat=st.tree.is_cat.at[k].set(cand.is_cat),
-                cat_mask=st.tree.cat_mask.at[k].set(cand.cat_mask),
-                internal_value=st.tree.internal_value.at[k].set(st.leaf_value[l]),
-                internal_count=st.tree.internal_count.at[k].set(st.leaf_count[l]),
-            )
-            return st._replace(
-                s=s + 1, row_leaf=row_leaf, leaf_hist=leaf_hist,
-                leaf_sum_grad=leaf_sum_grad, leaf_sum_hess=leaf_sum_hess,
-                leaf_count=leaf_count, leaf_value=leaf_value,
-                leaf_depth=leaf_depth, leaf_cmin=leaf_cmin,
-                leaf_cmax=leaf_cmax, best=best, tree=tree)
-
-        return jax.lax.cond(no_split,
-                            lambda st: st._replace(done=jnp.asarray(True)),
-                            do_split, st)
-
-    # root leaf output (used when the tree ends up with a single leaf)
-    root_out = _leaf_output_unconstrained(
-        sum_grad, sum_hess, params.lambda_l1, params.lambda_l2,
-        params.max_delta_step)
-    state = state._replace(leaf_value=state.leaf_value.at[0].set(root_out))
+        tree = _record_split(st.tree, s - 1, do, l, cand, st.leaf_value[l],
+                             st.leaf_count[l], s)
+        return st._replace(
+            s=s + do.astype(I32), done=~do, row_leaf=row_leaf,
+            leaf_hist=leaf_hist, leaf_sum_grad=leaf_sum_grad,
+            leaf_sum_hess=leaf_sum_hess, leaf_count=leaf_count,
+            leaf_value=leaf_value, leaf_depth=leaf_depth,
+            leaf_cmin=leaf_cmin, leaf_cmax=leaf_cmax, best=best, tree=tree)
 
     final = jax.lax.while_loop(cond, body, state)
     return final.tree._replace(
+        num_leaves=final.s,
         leaf_value=final.leaf_value,
         leaf_count=final.leaf_count,
         leaf_weight=final.leaf_sum_hess,
@@ -401,14 +490,17 @@ def grow_tree(layout: DataLayout, grad: jnp.ndarray, hess: jnp.ndarray,
 
 # ---------------------------------------------------------------------------
 # Partitioned grower: O(rows-in-child) per split via a leaf-sorted row
-# permutation (the DataPartition analog) + power-of-two budget classes.
+# permutation (the DataPartition analog) processed in fixed-size chunks by
+# dynamic-trip-count fori loops (no lax.switch: conditionals force XLA to
+# copy the carried permutation in and out of every branch).
 # ---------------------------------------------------------------------------
 
 class _PartState(NamedTuple):
     s: jnp.ndarray
     done: jnp.ndarray
     row_leaf: jnp.ndarray       # [N] i32
-    perm: jnp.ndarray           # [N + B_max] i32 rows grouped by leaf
+    perm: jnp.ndarray           # [N + C] i32 rows grouped by leaf
+    scratch: jnp.ndarray        # [N + C] i32 two-ended packing buffer
     leaf_start: jnp.ndarray     # [L] i32 segment starts (local rows)
     leaf_nrows: jnp.ndarray     # [L] i32 segment lengths (local rows)
     leaf_hist: jnp.ndarray
@@ -446,10 +538,7 @@ def _hist_window_rows(rows, valid, layout: DataLayout, grad, hess,
         vc = jnp.stack([gw, hw], -1).reshape(nch, chunk, 2)
 
         def body(i, acc):
-            oh = (bc[i][:, :, None]
-                  == jnp.arange(W, dtype=I32)[None, None, :]).astype(jnp.float32)
-            return acc + jnp.einsum("rgw,rc->gwc", oh, vc[i],
-                                    preferred_element_type=jnp.float32)
+            return acc + _hist_chunk_contract(bc[i], vc[i], W, gc.hist_dtype)
         hgw = jax.lax.fori_loop(0, nch, body,
                                 jnp.zeros((G, W, 2), jnp.float32))
         return jnp.zeros((TB, 2), jnp.float32).at[gw_global.reshape(-1)].add(
@@ -462,38 +551,48 @@ def _hist_window_rows(rows, valid, layout: DataLayout, grad, hess,
 
 
 @functools.partial(
-    jax.jit, static_argnames=("gc", "axis_name", "budgets"))
+    jax.jit, static_argnames=("gc", "axis_name"))
 def grow_tree_partitioned(layout: DataLayout, grad: jnp.ndarray,
                           hess: jnp.ndarray, bag_mask: jnp.ndarray,
                           meta: FeatureMeta, params: SplitParams,
                           feature_mask: jnp.ndarray, fix: FixInfo,
-                          gc: GrowConfig, budgets: tuple,
-                          gw_global=None, axis_name=None,
+                          gc: GrowConfig, gw_global=None, axis_name=None,
                           cat: CatLayout = None) -> TreeArrays:
     """Leaf-wise growth with O(rows-in-child) per-split work.
 
-    Same semantics as grow_tree (bit-equal trees up to f32 summation order);
-    the difference is HOW child histograms are built: a leaf-sorted
-    permutation (DataPartition, data_partition.hpp:21) is maintained with
-    stable in-window partitions, and the smaller child's histogram gathers
-    only that child's rows under the smallest static budget that fits
-    (lax.switch over `budgets`). The subtraction trick is unchanged.
+    Same semantics as grow_tree (same trees up to f32 summation order); the
+    difference is HOW child histograms are built: a leaf-sorted permutation
+    (DataPartition, data_partition.hpp:21) is maintained, and each split
+    streams only that leaf's window in fixed gc.window_chunk-row chunks:
+      1. partition pass: chunks are packed two-ended into a scratch buffer
+         (left children ascending from 0, right children descending from the
+         top) — row order inside a leaf is irrelevant to every later
+         computation, so stability is not required;
+      2. copy-back pass: the packed segment is gathered back into the
+         permutation (left block then reversed right block) with a masked
+         tail so neighbouring leaves' rows are untouched;
+      3. histogram pass: the smaller child's chunks accumulate the one-hot
+         MXU contraction (or scatter-add on CPU); larger = parent - smaller
+         (the subtraction trick) as in the reference.
+    All three are lax.fori_loop with data-dependent trip counts: overwork is
+    bounded by ONE chunk per split (the lax.switch budget-class design this
+    replaces wasted up to 2x and, worse, copied the [N] permutation into and
+    out of every conditional branch).
     """
-    from .partition import budget_index, stable_partition_window
     if cat is None:
         cat = empty_cat_layout(gc.cat_width)
+    ft = acc_dtype(gc.use_dp)
     n = layout.bins.shape[0]
     L = gc.num_leaves
     TB = gc.total_bins
     F = gc.num_features
+    C = max(256, int(gc.window_chunk))
     if F == 0 or TB == 0:
         return _single_leaf_tree(n, L, gc.cat_width, grad, hess, bag_mask,
-                                 params, axis_name)
+                                 params, axis_name, ft)
     grad = grad.astype(jnp.float32)
     hess = hess.astype(jnp.float32)
     bagf = bag_mask.astype(jnp.float32)
-    budgets_arr = jnp.asarray(budgets, dtype=I32)
-    B_max = budgets[-1]
 
     def psum(x):
         return jax.lax.psum(x, axis_name) if axis_name is not None else x
@@ -503,111 +602,51 @@ def grow_tree_partitioned(layout: DataLayout, grad: jnp.ndarray,
     root_hist = _hist_window_rows(all_rows, bagf, layout, grad, hess, gc,
                                   gw_global)
     root_hist = psum(root_hist)
-    sum_grad = psum(jnp.sum(grad * bagf, dtype=F64))
-    sum_hess = psum(jnp.sum(hess * bagf, dtype=F64))
+    sum_grad = psum(jnp.sum(grad * bagf, dtype=ft))
+    sum_hess = psum(jnp.sum(hess * bagf, dtype=ft))
     root_count = psum(jnp.sum(bag_mask, dtype=I32))
     root_hist = fix_histogram(root_hist, sum_grad, sum_hess,
-                              fix.mf_global, fix.start, fix.end)
+                              fix.mf_global, fix.start, fix.end,
+                              max_w=gc.scan_width, use_dp=gc.use_dp)
 
     feat_nb = meta.bin_end - meta.bin_start
-
-    def eval_leaf(hist, sg, sh, cnt, depth, cmin, cmax):
-        cand = find_best_split_numerical(
-            hist, sg, sh, cnt, meta, params, cmin, cmax, feature_mask,
-            num_features=F, use_mc=gc.use_mc)
-        cand = cand._replace(cat_mask=jnp.zeros((gc.cat_width,), BOOL))
-        if cat.cat_feature.shape[0] > 0:
-            cat_cand = find_best_split_categorical(
-                hist, sg, sh, cnt, cat, meta, params, cmin, cmax,
-                feature_mask, use_mc=gc.use_mc)
-            cand = merge_candidates(cand, cat_cand)
-        if gc.max_depth > 0:
-            blocked = depth >= gc.max_depth
-            cand = cand._replace(
-                gain=jnp.where(blocked, K_MIN_SCORE, cand.gain))
-        return cand
+    pcast = params.cast(ft)
+    eval_leaf = _make_eval_leaf(meta, params, feature_mask, cat, gc)
 
     root_cand = eval_leaf(root_hist, sum_grad, sum_hess, root_count,
-                          jnp.asarray(0, I32), jnp.asarray(-jnp.inf, F64),
-                          jnp.asarray(jnp.inf, F64))
+                          jnp.asarray(0, I32), jnp.asarray(-jnp.inf, ft),
+                          jnp.asarray(jnp.inf, ft))
     root_out = _leaf_output_unconstrained(
-        sum_grad, sum_hess, params.lambda_l1, params.lambda_l2,
-        params.max_delta_step)
+        sum_grad, sum_hess, pcast.lambda_l1, pcast.lambda_l2,
+        pcast.max_delta_step)
 
     state = _PartState(
         s=jnp.asarray(1, I32),
         done=jnp.asarray(False),
         row_leaf=jnp.zeros((n,), I32),
-        perm=jnp.concatenate([all_rows, jnp.zeros((B_max,), I32)]),
+        perm=jnp.concatenate([all_rows, jnp.zeros((C,), I32)]),
+        scratch=jnp.zeros((n + C,), I32),
         leaf_start=jnp.zeros((L,), I32),
         leaf_nrows=jnp.zeros((L,), I32).at[0].set(n),
         leaf_hist=jnp.zeros((L, TB, 2), jnp.float32).at[0].set(root_hist),
-        leaf_sum_grad=jnp.zeros((L,), F64).at[0].set(sum_grad),
-        leaf_sum_hess=jnp.zeros((L,), F64).at[0].set(sum_hess),
+        leaf_sum_grad=jnp.zeros((L,), ft).at[0].set(sum_grad),
+        leaf_sum_hess=jnp.zeros((L,), ft).at[0].set(sum_hess),
         leaf_count=jnp.zeros((L,), I32).at[0].set(root_count),
-        leaf_value=jnp.zeros((L,), F64).at[0].set(root_out),
+        leaf_value=jnp.zeros((L,), ft).at[0].set(root_out),
         leaf_depth=jnp.zeros((L,), I32),
-        leaf_cmin=jnp.full((L,), -jnp.inf, F64),
-        leaf_cmax=jnp.full((L,), jnp.inf, F64),
+        leaf_cmin=jnp.full((L,), -jnp.inf, ft),
+        leaf_cmax=jnp.full((L,), jnp.inf, ft),
         best=jax.tree.map(
             lambda a: jnp.broadcast_to(a, (L,) + a.shape),
-            _root_candidate_dummy(gc.cat_width)),
-        tree=TreeArrays(
-            num_leaves=jnp.asarray(1, I32),
-            split_leaf=jnp.zeros((L - 1,), I32),
-            split_feature=jnp.full((L - 1,), -1, I32),
-            threshold=jnp.zeros((L - 1,), I32),
-            default_left=jnp.zeros((L - 1,), BOOL),
-            gain=jnp.zeros((L - 1,), F64),
-            is_cat=jnp.zeros((L - 1,), BOOL),
-            cat_mask=jnp.zeros((L - 1, gc.cat_width), BOOL),
-            internal_value=jnp.zeros((L - 1,), F64),
-            internal_count=jnp.zeros((L - 1,), I32),
-            leaf_value=jnp.zeros((L,), F64),
-            leaf_count=jnp.zeros((L,), I32),
-            leaf_weight=jnp.zeros((L,), F64),
-            row_leaf=jnp.zeros((n,), I32),
-        ),
+            _root_candidate_dummy(gc.cat_width, ft)),
+        tree=_empty_tree_arrays(n, L, gc.cat_width, ft),
     )
     state = state._replace(
         best=jax.tree.map(lambda a, v: a.at[0].set(v), state.best, root_cand))
 
-    def _partition_branch(Bj):
-        def fn(perm, row_leaf, s0, n_l, cand, s):
-            f = cand.feature
-            g = layout.group_of[f]
-            win = jax.lax.dynamic_slice(perm, (s0,), (Bj,))
-            valid = jnp.arange(Bj, dtype=I32) < n_l
-            rows = jnp.where(valid, win, 0)
-            col = layout.bins[rows, g].astype(I32) + layout.group_offset[g]
-            in_range = (col >= meta.bin_start[f]) & (col < meta.bin_end[f])
-            local_bin = col - meta.bin_start[f]
-            go_left = _go_left_decision(
-                local_bin, in_range,
-                (feat_nb[f], meta.missing_type[f], meta.default_bin[f],
-                 layout.most_freq_bin[f]),
-                cand, gc.cat_width)
-            new_win, n_left = stable_partition_window(win, go_left, valid)
-            perm = jax.lax.dynamic_update_slice(perm, new_win, (s0,))
-            right_rows = jnp.where(valid & ~go_left, rows, n)
-            row_leaf = row_leaf.at[right_rows].set(s, mode="drop")
-            bag_left = jnp.sum(
-                jnp.where(go_left & valid, bag_mask[rows], False),
-                dtype=I32)
-            return perm, row_leaf, n_left, bag_left
-        return fn
-
-    def _hist_branch(Bj):
-        def fn(perm, start, seg_len):
-            win = jax.lax.dynamic_slice(perm, (start,), (Bj,))
-            valid = (jnp.arange(Bj, dtype=I32) < seg_len)
-            rows = jnp.where(valid, win, 0)
-            return _hist_window_rows(rows, valid.astype(jnp.float32),
-                                     layout, grad, hess, gc, gw_global)
-        return fn
-
-    part_branches = [_partition_branch(b) for b in budgets]
-    hist_branches = [_hist_branch(b) for b in budgets]
+    G = layout.bins.shape[1]
+    W = gw_global.shape[1] if gw_global is not None else 0
+    arangeC = jnp.arange(C, dtype=I32)
 
     def cond(st: _PartState):
         return (~st.done) & (st.s < L)
@@ -615,103 +654,175 @@ def grow_tree_partitioned(layout: DataLayout, grad: jnp.ndarray,
     def body(st: _PartState) -> _PartState:
         l = jnp.argmax(st.best.gain).astype(I32)
         gain = st.best.gain[l]
-        no_split = gain <= 0.0
+        do = gain > 0.0
+        s = st.s
+        cand = jax.tree.map(lambda a: a[l], st.best)
+        s0 = st.leaf_start[l]
+        n_l = jnp.where(do, st.leaf_nrows[l], 0)
+        f = jnp.maximum(cand.feature, 0)
+        g = layout.group_of[f]
+        fmeta = (feat_nb[f], meta.missing_type[f], meta.default_bin[f],
+                 layout.most_freq_bin[f])
 
-        def do_split(st: _PartState) -> _PartState:
-            s = st.s
-            cand = jax.tree.map(lambda a: a[l], st.best)
-            s0 = st.leaf_start[l]
-            n_l = st.leaf_nrows[l]
-            j = budget_index(budgets_arr, n_l)
-            perm, row_leaf, n_left, bag_left = jax.lax.switch(
-                j, part_branches, st.perm, st.row_leaf, s0, n_l, cand, s)
-            left_cnt = psum(bag_left)
-            right_cnt = st.leaf_count[l] - left_cnt
-            n_right = n_l - n_left
+        # ---- pass 1: partition chunks two-ended into scratch -------------
+        nch = (n_l + C - 1) // C
+        perm_in = st.perm
 
-            smaller_is_left = left_cnt <= right_cnt
-            start_sm = jnp.where(smaller_is_left, s0, s0 + n_left)
-            len_sm = jnp.where(smaller_is_left, n_left, n_right)
-            j2 = budget_index(budgets_arr, len_sm)
-            hist_smaller = jax.lax.switch(j2, hist_branches, perm, start_sm,
-                                          len_sm)
-            hist_smaller = psum(hist_smaller)
-            sm_sum_grad = jnp.where(smaller_is_left, cand.left_sum_grad,
-                                    cand.right_sum_grad)
-            sm_sum_hess = jnp.where(smaller_is_left, cand.left_sum_hess,
-                                    cand.right_sum_hess)
-            hist_smaller = fix_histogram(hist_smaller, sm_sum_grad,
-                                         sm_sum_hess, fix.mf_global,
-                                         fix.start, fix.end)
-            parent_hist = st.leaf_hist[l]
-            hist_larger = parent_hist - hist_smaller
-            hist_left = jnp.where(smaller_is_left, hist_smaller, hist_larger)
-            hist_right = jnp.where(smaller_is_left, hist_larger, hist_smaller)
+        def pbody(i, carry):
+            scratch, row_leaf, lf, rf, bagl = carry
+            off = s0 + i * C
+            win = jax.lax.dynamic_slice(perm_in, (off,), (C,))
+            valid = arangeC < (n_l - i * C)
+            rows = jnp.where(valid, win, 0)
+            col = layout.bins[rows, g].astype(I32) + layout.group_offset[g]
+            in_range = (col >= meta.bin_start[f]) & (col < meta.bin_end[f])
+            local_bin = col - meta.bin_start[f]
+            go_left = _go_left_decision(local_bin, in_range, fmeta, cand,
+                                        gc.cat_width)
+            gl = valid & go_left
+            gr = valid & ~go_left
+            nL = jnp.sum(gl, dtype=I32)
+            nR = jnp.sum(gr, dtype=I32)
+            posL = jnp.cumsum(gl, dtype=I32) - 1
+            posR = (C - nR) + jnp.cumsum(gr, dtype=I32) - 1
+            packedL = jnp.zeros((C,), I32).at[
+                jnp.where(gl, posL, C)].set(win, mode="drop",
+                                            unique_indices=True)
+            packedR = jnp.zeros((C,), I32).at[
+                jnp.where(gr, posR, C)].set(win, mode="drop",
+                                            unique_indices=True)
+            scratch = jax.lax.dynamic_update_slice(scratch, packedL, (lf,))
+            scratch = jax.lax.dynamic_update_slice(scratch, packedR,
+                                                   (rf - C,))
+            right_rows = jnp.where(gr, rows, n)
+            row_leaf = row_leaf.at[right_rows].set(s, mode="drop")
+            bagl = bagl + jnp.sum(jnp.where(gl, bag_mask[rows], False),
+                                  dtype=I32)
+            return scratch, row_leaf, lf + nL, rf - nR, bagl
 
-            depth_child = st.leaf_depth[l] + 1
-            cmin_p, cmax_p = st.leaf_cmin[l], st.leaf_cmax[l]
-            mono = meta.monotone[cand.feature]
-            mid = (cand.left_output + cand.right_output) / 2.0
-            l_cmax = jnp.where(mono > 0, jnp.minimum(cmax_p, mid), cmax_p)
-            r_cmin = jnp.where(mono > 0, jnp.maximum(cmin_p, mid), cmin_p)
-            l_cmin = jnp.where(mono < 0, jnp.maximum(cmin_p, mid), cmin_p)
-            r_cmax = jnp.where(mono < 0, jnp.minimum(cmax_p, mid), cmax_p)
+        scratch, row_leaf, n_left, rf_end, bag_left = jax.lax.fori_loop(
+            0, nch, pbody,
+            (st.scratch, st.row_leaf, jnp.asarray(0, I32),
+             jnp.asarray(n + C, I32), jnp.asarray(0, I32)))
+        n_right = n_l - n_left
 
-            leaf_hist = st.leaf_hist.at[l].set(hist_left).at[s].set(hist_right)
-            leaf_sum_grad = st.leaf_sum_grad.at[l].set(cand.left_sum_grad) \
-                                            .at[s].set(cand.right_sum_grad)
-            leaf_sum_hess = st.leaf_sum_hess.at[l].set(cand.left_sum_hess) \
-                                            .at[s].set(cand.right_sum_hess)
-            leaf_count = st.leaf_count.at[l].set(left_cnt).at[s].set(right_cnt)
-            leaf_value = st.leaf_value.at[l].set(cand.left_output) \
-                                      .at[s].set(cand.right_output)
-            leaf_depth = st.leaf_depth.at[l].set(depth_child) \
-                                      .at[s].set(depth_child)
-            leaf_cmin = st.leaf_cmin.at[l].set(l_cmin).at[s].set(r_cmin)
-            leaf_cmax = st.leaf_cmax.at[l].set(l_cmax).at[s].set(r_cmax)
-            leaf_start = st.leaf_start.at[s].set(s0 + n_left)
-            leaf_nrows = st.leaf_nrows.at[l].set(n_left).at[s].set(n_right)
+        # ---- pass 2: gather the packed segment back into the permutation -
+        def cbody(i, perm):
+            p = i * C + arangeC
+            src = jnp.where(p < n_left, p, (n + C) - n_l + p)
+            blk = scratch[jnp.clip(src, 0, n + C - 1)]
+            dst = s0 + i * C
+            old = jax.lax.dynamic_slice(perm, (dst,), (C,))
+            blk = jnp.where(p < n_l, blk, old)
+            return jax.lax.dynamic_update_slice(perm, blk, (dst,))
 
-            cand_l = eval_leaf(hist_left, cand.left_sum_grad,
-                               cand.left_sum_hess, left_cnt, depth_child,
-                               l_cmin, l_cmax)
-            cand_r = eval_leaf(hist_right, cand.right_sum_grad,
-                               cand.right_sum_hess, right_cnt, depth_child,
-                               r_cmin, r_cmax)
-            best = jax.tree.map(
-                lambda a, vl, vr: a.at[l].set(vl).at[s].set(vr),
-                st.best, cand_l, cand_r)
+        perm = jax.lax.fori_loop(0, nch, cbody, perm_in)
 
-            k = s - 1
-            tree = st.tree._replace(
-                num_leaves=s + 1,
-                split_leaf=st.tree.split_leaf.at[k].set(l),
-                split_feature=st.tree.split_feature.at[k].set(cand.feature),
-                threshold=st.tree.threshold.at[k].set(cand.threshold),
-                default_left=st.tree.default_left.at[k].set(cand.default_left),
-                gain=st.tree.gain.at[k].set(cand.gain),
-                is_cat=st.tree.is_cat.at[k].set(cand.is_cat),
-                cat_mask=st.tree.cat_mask.at[k].set(cand.cat_mask),
-                internal_value=st.tree.internal_value.at[k].set(
-                    st.leaf_value[l]),
-                internal_count=st.tree.internal_count.at[k].set(
-                    st.leaf_count[l]),
-            )
-            return st._replace(
-                s=s + 1, row_leaf=row_leaf, perm=perm,
-                leaf_start=leaf_start, leaf_nrows=leaf_nrows,
-                leaf_hist=leaf_hist, leaf_sum_grad=leaf_sum_grad,
-                leaf_sum_hess=leaf_sum_hess, leaf_count=leaf_count,
-                leaf_value=leaf_value, leaf_depth=leaf_depth,
-                leaf_cmin=leaf_cmin, leaf_cmax=leaf_cmax, best=best,
-                tree=tree)
+        left_cnt = psum(bag_left)
+        right_cnt = st.leaf_count[l] - left_cnt
 
-        return jax.lax.cond(no_split,
-                            lambda st: st._replace(done=jnp.asarray(True)),
-                            do_split, st)
+        # ---- pass 3: smaller child's histogram ---------------------------
+        smaller_is_left = left_cnt <= right_cnt
+        start_sm = jnp.where(smaller_is_left, s0, s0 + n_left)
+        len_sm = jnp.where(smaller_is_left, n_left, n_right)
+        nch_h = (len_sm + C - 1) // C
+
+        if gc.hist_impl == "onehot":
+            def hbody(i, acc):
+                off = start_sm + i * C
+                win = jax.lax.dynamic_slice(perm, (off,), (C,))
+                valid = (arangeC < (len_sm - i * C)).astype(jnp.float32)
+                rows = jnp.where(valid > 0, win, 0)
+                bv = layout.bins[rows].astype(I32)          # [C, G]
+                vc = jnp.stack([grad[rows] * valid, hess[rows] * valid], -1)
+                return acc + _hist_chunk_contract(bv, vc, W, gc.hist_dtype)
+            hgw = jax.lax.fori_loop(0, nch_h, hbody,
+                                    jnp.zeros((G, W, 2), jnp.float32))
+            hist_smaller = jnp.zeros((TB, 2), jnp.float32).at[
+                gw_global.reshape(-1)].add(hgw.reshape(-1, 2), mode="drop")
+        else:
+            def hbody(i, acc):
+                off = start_sm + i * C
+                win = jax.lax.dynamic_slice(perm, (off,), (C,))
+                valid = (arangeC < (len_sm - i * C)).astype(jnp.float32)
+                rows = jnp.where(valid > 0, win, 0)
+                idx = layout.bins[rows].astype(I32) \
+                    + layout.group_offset[None, :]
+                vals = jnp.stack([grad[rows] * valid, hess[rows] * valid], -1)
+                fv = jnp.broadcast_to(vals[:, None, :], (C, G, 2))
+                return acc.at[idx.reshape(-1)].add(fv.reshape(-1, 2))
+            hist_smaller = jax.lax.fori_loop(
+                0, nch_h, hbody, jnp.zeros((TB, 2), jnp.float32))
+
+        hist_smaller = psum(hist_smaller)
+        sm_sum_grad = jnp.where(smaller_is_left, cand.left_sum_grad,
+                                cand.right_sum_grad)
+        sm_sum_hess = jnp.where(smaller_is_left, cand.left_sum_hess,
+                                cand.right_sum_hess)
+        hist_smaller = fix_histogram(hist_smaller, sm_sum_grad,
+                                     sm_sum_hess, fix.mf_global,
+                                     fix.start, fix.end,
+                                     max_w=gc.scan_width, use_dp=gc.use_dp)
+        parent_hist = st.leaf_hist[l]
+        hist_larger = parent_hist - hist_smaller
+        hist_left = jnp.where(smaller_is_left, hist_smaller, hist_larger)
+        hist_right = jnp.where(smaller_is_left, hist_larger, hist_smaller)
+
+        depth_child = st.leaf_depth[l] + 1
+        mono = meta.monotone[f]
+        l_cmin, l_cmax, r_cmin, r_cmax = _mono_bounds(
+            st.leaf_cmin[l], st.leaf_cmax[l], mono, cand.left_output,
+            cand.right_output, ft)
+
+        def upd(a, new_l, new_s):
+            a = a.at[l].set(jnp.where(do, new_l, a[l]))
+            return a.at[s].set(jnp.where(do, new_s, a[s]))
+
+        # big-buffer update with liveness-safe fallbacks: materialize both
+        # write values behind an optimization barrier so XLA cannot re-fuse
+        # the parent_hist slice into the DUS fusions (that would keep the
+        # carried buffer alive and force a full copy)
+        val_l, val_r = jax.lax.optimization_barrier(
+            (jnp.where(do, hist_left, parent_hist),
+             jnp.where(do, hist_right, jnp.zeros_like(hist_right))))
+        leaf_hist = st.leaf_hist.at[l].set(val_l).at[s].set(val_r)
+        leaf_sum_grad = upd(st.leaf_sum_grad, cand.left_sum_grad,
+                            cand.right_sum_grad)
+        leaf_sum_hess = upd(st.leaf_sum_hess, cand.left_sum_hess,
+                            cand.right_sum_hess)
+        leaf_count = upd(st.leaf_count, left_cnt, right_cnt)
+        leaf_value = upd(st.leaf_value, cand.left_output, cand.right_output)
+        leaf_depth = upd(st.leaf_depth, depth_child, depth_child)
+        leaf_cmin = upd(st.leaf_cmin, l_cmin, r_cmin)
+        leaf_cmax = upd(st.leaf_cmax, l_cmax, r_cmax)
+        leaf_start = st.leaf_start.at[s].set(
+            jnp.where(do, s0 + n_left, st.leaf_start[s]))
+        leaf_nrows = upd(st.leaf_nrows, n_left, n_right)
+
+        # children evaluated from the updated buffer (in-place DUS; see
+        # grow_tree body comment)
+        cand_l, cand_r = _eval_children(
+            eval_leaf, leaf_hist, l, s, cand, left_cnt, right_cnt,
+            depth_child, l_cmin, l_cmax, r_cmin, r_cmax)
+        best = jax.tree.map(
+            lambda a, vl, vr: a.at[l].set(jnp.where(do, vl, a[l]))
+                               .at[s].set(jnp.where(do, vr, a[s])),
+            st.best, cand_l, cand_r)
+
+        tree = _record_split(st.tree, s - 1, do, l, cand, st.leaf_value[l],
+                             st.leaf_count[l], s)
+        return st._replace(
+            s=s + do.astype(I32), done=~do, row_leaf=row_leaf, perm=perm,
+            scratch=scratch, leaf_start=leaf_start, leaf_nrows=leaf_nrows,
+            leaf_hist=leaf_hist, leaf_sum_grad=leaf_sum_grad,
+            leaf_sum_hess=leaf_sum_hess, leaf_count=leaf_count,
+            leaf_value=leaf_value, leaf_depth=leaf_depth,
+            leaf_cmin=leaf_cmin, leaf_cmax=leaf_cmax, best=best,
+            tree=tree)
 
     final = jax.lax.while_loop(cond, body, state)
     return final.tree._replace(
+        num_leaves=final.s,
         leaf_value=final.leaf_value,
         leaf_count=final.leaf_count,
         leaf_weight=final.leaf_sum_hess,

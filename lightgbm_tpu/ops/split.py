@@ -1,15 +1,18 @@
-"""Best-split search over histograms, vectorized over the global bin axis.
+"""Best-split search over histograms, vectorized over a dense [F, W] grid.
 
 TPU-native equivalent of the reference per-feature sequential scan
 (FeatureHistogram::FindBestThresholdSequentially,
 src/treelearner/feature_histogram.hpp:770-948, and
 FindBestThresholdCategoricalInner, :263-474). The reference walks each
 feature's bins twice (REVERSE and forward) accumulating running sums; here
-both directions become segmented prefix/suffix sums over one flat
-[total_bins] axis, the validity `continue`/`break` conditions become masks
-(all break conditions are monotone along the scan so masking is exactly
-equivalent), and the argmax tie-breaking reproduces the reference's
-first-maximum semantics:
+the flat [total_bins] histogram is gathered once into a dense
+[num_features, max_w] grid (max_w = widest feature, <= max_bin+1) and both
+directions become cumulative sums along the W axis — plain vectorized ops
+with no segment scatters, which matters on TPU where scatter serializes.
+The validity `continue`/`break` conditions become masks (all break
+conditions are monotone along the scan so masking is exactly equivalent),
+and the argmax tie-breaking reproduces the reference's first-maximum
+semantics:
   * REVERSE scans thresholds high->low, ties keep the highest threshold;
   * forward beats REVERSE only on strictly greater gain
     (feature_histogram.hpp:924);
@@ -30,6 +33,11 @@ CalculateSplittedLeafOutput (feature_histogram.hpp:656-768) including L1
 thresholding, max_delta_step clamping, monotone-constraint clipping, the
 kEpsilon hessian adjustments (:87, :786, :848) and the count-from-hessian
 recovery Common::RoundInt(hess * cnt_factor) (:783).
+
+Precision: `use_dp` selects f64 (bit-faithful to the reference CPU learner;
+the CPU-backend default) or f32 accumulation/gain math (the TPU default —
+the same trade the reference GPU learner makes with gpu_use_dp=false,
+docs/GPU-Performance.rst:43-47; f64 is software-emulated on TPU).
 """
 from __future__ import annotations
 
@@ -50,6 +58,10 @@ K_MIN_SCORE = -jnp.inf
 MISSING_NONE = 0
 MISSING_ZERO = 1
 MISSING_NAN = 2
+
+
+def acc_dtype(use_dp: bool):
+    return F64 if use_dp else F32
 
 
 class FeatureMeta(NamedTuple):
@@ -96,17 +108,29 @@ class SplitParams(NamedTuple):
             min_data_per_group=jnp.asarray(cfg.min_data_per_group, I32),
         )
 
+    def cast(self, ft):
+        """Float fields in the accumulation dtype (ints untouched)."""
+        return self._replace(
+            lambda_l1=self.lambda_l1.astype(ft),
+            lambda_l2=self.lambda_l2.astype(ft),
+            max_delta_step=self.max_delta_step.astype(ft),
+            min_gain_to_split=self.min_gain_to_split.astype(ft),
+            min_sum_hessian_in_leaf=self.min_sum_hessian_in_leaf.astype(ft),
+            cat_smooth=self.cat_smooth.astype(ft),
+            cat_l2=self.cat_l2.astype(ft),
+        )
+
 
 class SplitCandidate(NamedTuple):
     """Best split of one leaf (analog of SplitInfo, split_info.hpp)."""
-    gain: jnp.ndarray           # f64; -inf when unsplittable
+    gain: jnp.ndarray           # ft; -inf when unsplittable
     feature: jnp.ndarray        # i32 inner feature id; -1 when none
     threshold: jnp.ndarray      # i32 local bin threshold (numerical)
     default_left: jnp.ndarray   # bool
-    left_output: jnp.ndarray    # f64
-    right_output: jnp.ndarray   # f64
-    left_sum_grad: jnp.ndarray  # f64
-    left_sum_hess: jnp.ndarray  # f64
+    left_output: jnp.ndarray    # ft
+    right_output: jnp.ndarray   # ft
+    left_sum_grad: jnp.ndarray  # ft
+    left_sum_hess: jnp.ndarray  # ft
     right_sum_grad: jnp.ndarray
     right_sum_hess: jnp.ndarray
     left_count: jnp.ndarray     # i32 (hessian-recovered, reference semantics)
@@ -120,53 +144,74 @@ def _round_int(x):
     return jnp.floor(x + 0.5).astype(I32)
 
 
-def _threshold_l1(s, l1):
-    # feature_histogram.hpp:659
+def _threshold_l1(s, l1, use_l1: bool = True):
+    # feature_histogram.hpp:659; the use_l1=False specialization mirrors the
+    # reference's USE_L1 template parameter (identity when lambda_l1 == 0)
+    if not use_l1:
+        return s
     return jnp.sign(s) * jnp.maximum(0.0, jnp.abs(s) - l1)
 
 
-def _leaf_output_unconstrained(g, h, l1, l2, mds):
+def _leaf_output_unconstrained(g, h, l1, l2, mds, use_l1: bool = True,
+                               use_mds: bool = True):
     # CalculateSplittedLeafOutput, feature_histogram.hpp:664-685
-    ret = -_threshold_l1(g, l1) / (h + l2)
+    ret = -_threshold_l1(g, l1, use_l1) / (h + l2)
+    if not use_mds:
+        return ret
     clipped = jnp.sign(ret) * jnp.minimum(jnp.abs(ret), mds)
     return jnp.where(mds > 0, clipped, ret)
 
 
-def _leaf_output(g, h, l1, l2, mds, cmin, cmax, use_mc: bool):
-    ret = _leaf_output_unconstrained(g, h, l1, l2, mds)
+def _leaf_output(g, h, l1, l2, mds, cmin, cmax, use_mc: bool,
+                 use_l1: bool = True, use_mds: bool = True):
+    ret = _leaf_output_unconstrained(g, h, l1, l2, mds, use_l1, use_mds)
     if use_mc:
         ret = jnp.clip(ret, cmin, cmax)
     return ret
 
 
-def _leaf_gain_given_output(g, h, l1, l2, out):
+def _leaf_gain_given_output(g, h, l1, l2, out, use_l1: bool = True):
     # feature_histogram.hpp:757-768
-    sg = _threshold_l1(g, l1)
+    sg = _threshold_l1(g, l1, use_l1)
     return -(2.0 * sg * out + (h + l2) * out * out)
 
 
-def _leaf_gain(g, h, l1, l2, mds):
-    # feature_histogram.hpp:739-755
-    sg = _threshold_l1(g, l1)
+def _leaf_gain(g, h, l1, l2, mds, use_l1: bool = True, use_mds: bool = True):
+    # feature_histogram.hpp:739-755 (USE_MAX_OUTPUT specialization)
+    sg = _threshold_l1(g, l1, use_l1)
     plain = sg * sg / (h + l2)
-    out = _leaf_output_unconstrained(g, h, l1, l2, mds)
-    with_mds = _leaf_gain_given_output(g, h, l1, l2, out)
+    if not use_mds:
+        return plain
+    out = _leaf_output_unconstrained(g, h, l1, l2, mds, use_l1, True)
+    with_mds = _leaf_gain_given_output(g, h, l1, l2, out, use_l1)
     return jnp.where(mds > 0, with_mds, plain)
 
 
-def _split_gains(gl, hl, gr, hr, l1, l2, mds, cmin, cmax, mono, use_mc: bool):
+def _split_gains(gl, hl, gr, hr, l1, l2, mds, cmin, cmax, mono, use_mc: bool,
+                 use_l1: bool = True, use_mds: bool = True):
     # GetSplitGains, feature_histogram.hpp:704-737
     if not use_mc:
-        return _leaf_gain(gl, hl, l1, l2, mds) + _leaf_gain(gr, hr, l1, l2, mds)
-    lo = _leaf_output(gl, hl, l1, l2, mds, cmin, cmax, True)
-    ro = _leaf_output(gr, hr, l1, l2, mds, cmin, cmax, True)
+        return (_leaf_gain(gl, hl, l1, l2, mds, use_l1, use_mds)
+                + _leaf_gain(gr, hr, l1, l2, mds, use_l1, use_mds))
+    lo = _leaf_output(gl, hl, l1, l2, mds, cmin, cmax, True, use_l1, use_mds)
+    ro = _leaf_output(gr, hr, l1, l2, mds, cmin, cmax, True, use_l1, use_mds)
     bad = ((mono > 0) & (lo > ro)) | ((mono < 0) & (lo < ro))
-    gain = (_leaf_gain_given_output(gl, hl, l1, l2, lo)
-            + _leaf_gain_given_output(gr, hr, l1, l2, ro))
+    gain = (_leaf_gain_given_output(gl, hl, l1, l2, lo, use_l1)
+            + _leaf_gain_given_output(gr, hr, l1, l2, ro, use_l1))
     return jnp.where(bad, 0.0, gain)
 
 
-def fix_histogram(hist, sum_grad, sum_hess, fix_mf_global, fix_start, fix_end):
+def _resolve_w(tb: int, max_w: int) -> int:
+    """Static dense scan width: widest feature (caller-supplied) or a safe
+    upper bound for small problems."""
+    if max_w and max_w > 0:
+        return int(max_w)
+    return int(min(tb, 256))
+
+
+@functools.partial(jax.jit, static_argnames=("max_w", "use_dp"))
+def fix_histogram(hist, sum_grad, sum_hess, fix_mf_global, fix_start, fix_end,
+                  max_w: int = 0, use_dp: bool = True):
     """Reconstruct bundled features' most_freq bins from leaf totals.
 
     TPU equivalent of Dataset::FixHistogram (src/io/dataset.cpp:1410): rows at
@@ -176,79 +221,85 @@ def fix_histogram(hist, sum_grad, sum_hess, fix_mf_global, fix_start, fix_end):
     """
     if fix_mf_global.shape[0] == 0:
         return hist
-    c = jnp.cumsum(hist.astype(F64), axis=0)
-    zero = jnp.zeros((1, 2), F64)
-    c = jnp.concatenate([zero, c], axis=0)          # c[i] = sum hist[:i]
-    tot = c[fix_end] - c[fix_start]                 # [K, 2] per-feature totals
-    leaf_tot = jnp.stack([sum_grad, sum_hess])      # [2]
-    corrected = leaf_tot[None, :] - (tot - hist[fix_mf_global].astype(F64))
+    ft = acc_dtype(use_dp)
+    tb = hist.shape[0]
+    W = _resolve_w(tb, max_w)
+    w = jnp.arange(W, dtype=I32)[None, :]
+    gidx = jnp.clip(fix_start[:, None] + w, 0, tb - 1)          # [K, W]
+    valid = w < (fix_end - fix_start)[:, None]
+    vals = hist[gidx].astype(ft) * valid[..., None]             # [K, W, 2]
+    tot = vals.sum(axis=1)                                      # [K, 2]
+    leaf_tot = jnp.stack([sum_grad, sum_hess]).astype(ft)       # [2]
+    corrected = leaf_tot[None, :] - (tot - hist[fix_mf_global].astype(ft))
     return hist.at[fix_mf_global].set(corrected.astype(hist.dtype))
 
 
-def _segment_cumsum(vals, feat_id, bin_start):
-    """Inclusive prefix sum within feature segments over the flat bin axis."""
-    c = jnp.cumsum(vals, axis=0)
-    # subtract the global cumsum just before each feature's first bin
-    start_idx = bin_start[feat_id]                    # [TB]
-    before = jnp.where(start_idx > 0, c[jnp.maximum(start_idx - 1, 0)], 0)
-    return c - before
-
-
-@functools.partial(jax.jit, static_argnames=("use_mc", "num_features"))
+@functools.partial(jax.jit,
+                   static_argnames=("use_mc", "num_features", "max_w",
+                                    "use_dp", "use_l1", "use_mds"))
 def find_best_split_numerical(hist, sum_grad, sum_hess, num_data,
                               meta: FeatureMeta, p: SplitParams,
                               cmin, cmax, feature_mask,
-                              num_features: int, use_mc: bool = False):
+                              num_features: int, use_mc: bool = False,
+                              max_w: int = 0, use_dp: bool = True,
+                              use_l1: bool = True, use_mds: bool = True):
     """Best numerical split for one leaf over all features at once.
 
-    hist: [TB, 2] f32; sums are leaf totals (f64); num_data i32 (reference
+    hist: [TB, 2] f32; sums are leaf totals; num_data i32 (reference
     semantics: in-bag count). Returns a SplitCandidate of scalars (cat fields
     dummy). Mirrors the dispatch in FuncForNumricalL2
     (feature_histogram.hpp:141-208) and both scan directions.
     """
+    ft = acc_dtype(use_dp)
     tb = hist.shape[0]
-    fid = meta.feat_id
-    start = meta.bin_start[fid]
-    end = meta.bin_end[fid]
-    nb = end - start
-    t_local = jnp.arange(tb, dtype=I32) - start
-    mt = meta.missing_type[fid]
-    d_local = meta.default_bin[fid]
-    mono = meta.monotone[fid].astype(F64)
+    F = num_features
+    W = _resolve_w(tb, max_w)
+    p = p.cast(ft)
+    sum_grad = sum_grad.astype(ft)
+    sum_hess = sum_hess.astype(ft)
+    cmin = jnp.asarray(cmin).astype(ft)
+    cmax = jnp.asarray(cmax).astype(ft)
+
+    start = meta.bin_start[:, None]                       # [F, 1]
+    nb = (meta.bin_end - meta.bin_start)[:, None]         # [F, 1]
+    w = jnp.arange(W, dtype=I32)[None, :]                 # [1, W]
+    in_feat = w < nb                                      # [F, W]
+    gidx = jnp.clip(start + w, 0, tb - 1)
+    mt = meta.missing_type[:, None]
+    d_local = meta.default_bin[:, None]
+    mono = meta.monotone.astype(ft)                       # [F]
 
     sum_hess_adj = sum_hess + 2 * K_EPSILON
-    cnt_factor = num_data.astype(F64) / sum_hess_adj
+    cnt_factor = num_data.astype(ft) / sum_hess_adj
     min_data = p.min_data_in_leaf
     min_hess = p.min_sum_hessian_in_leaf
 
     gain_shift = _leaf_gain(sum_grad, sum_hess_adj, p.lambda_l1, p.lambda_l2,
-                            p.max_delta_step)
+                            p.max_delta_step, use_l1, use_mds)
     min_gain_shift = gain_shift + p.min_gain_to_split
 
-    grad_b = hist[:, 0].astype(F64)
-    hess_b = hist[:, 1].astype(F64)
-    cnt_b = _round_int(hess_b * cnt_factor)
+    grad_b = jnp.where(in_feat, hist[gidx, 0].astype(ft), 0)
+    hess_b = jnp.where(in_feat, hist[gidx, 1].astype(ft), 0)
+    cnt_b = jnp.where(in_feat, _round_int(hess_b * cnt_factor), 0)
 
     two_scan = (nb > 2) & (mt != MISSING_NONE)
     skip_default = two_scan & (mt == MISSING_ZERO)
     na_as_missing = two_scan & (mt == MISSING_NAN)
-    is_na_bin = t_local == (nb - 1)
-    is_default_bin = t_local == d_local
+    is_na_bin = w == (nb - 1)
+    is_default_bin = w == d_local
 
-    not_cat = ~meta.is_categorical[fid]
-    fmask_b = feature_mask[fid] & not_cat
+    not_cat = ~meta.is_categorical
+    fmask_f = (feature_mask & not_cat)[:, None]           # [F, 1]
 
     # ---------------- REVERSE scan (right accumulates from high bins) ------
     excl_r = (na_as_missing & is_na_bin) | (skip_default & is_default_bin)
-    keep_r = (~excl_r).astype(F64)
-    gr_c = _segment_cumsum(grad_b * keep_r, fid, meta.bin_start)
-    hr_c = _segment_cumsum(hess_b * keep_r, fid, meta.bin_start)
-    cr_c = _segment_cumsum(cnt_b * (~excl_r), fid, meta.bin_start)
-    # totals per feature broadcast to bins
-    last = jnp.maximum(end - 1, 0)
-    gr_tot = gr_c[last]
-    hr_tot = hr_c[last]
-    cr_tot = cr_c[last]
+    keep_r = (~excl_r).astype(ft)
+    gr_c = jnp.cumsum(grad_b * keep_r, axis=1)
+    hr_c = jnp.cumsum(hess_b * keep_r, axis=1)
+    cr_c = jnp.cumsum(cnt_b * (~excl_r), axis=1)
+    gr_tot = gr_c[:, -1:]
+    hr_tot = hr_c[:, -1:]
+    cr_tot = cr_c[:, -1:]
     sum_right_grad = gr_tot - gr_c
     sum_right_hess = hr_tot - hr_c + K_EPSILON
     right_cnt = cr_tot - cr_c
@@ -256,30 +307,30 @@ def find_best_split_numerical(hist, sum_grad, sum_hess, num_data,
     sum_left_grad = sum_grad - sum_right_grad
     sum_left_hess = sum_hess_adj - sum_right_hess
 
-    valid_r = (t_local >= 0) & (t_local <= nb - 2 - na_as_missing.astype(I32))
-    valid_r &= ~(skip_default & (t_local == d_local - 1))
+    valid_r = in_feat & (w <= nb - 2 - na_as_missing.astype(I32))
+    valid_r &= ~(skip_default & (w == d_local - 1))
     valid_r &= (right_cnt >= min_data) & (sum_right_hess >= min_hess)
     valid_r &= (left_cnt >= min_data) & (sum_left_hess >= min_hess)
-    valid_r &= fmask_b
+    valid_r &= fmask_f
 
     gains_r = _split_gains(sum_left_grad, sum_left_hess, sum_right_grad,
                            sum_right_hess, p.lambda_l1, p.lambda_l2,
-                           p.max_delta_step, cmin, cmax, mono, use_mc)
+                           p.max_delta_step, cmin, cmax, mono[:, None],
+                           use_mc, use_l1, use_mds)
     valid_r &= gains_r > min_gain_shift
     gains_r = jnp.where(valid_r, gains_r, K_MIN_SCORE)
 
     # per-feature best, ties -> HIGHEST threshold (reverse scans high->low)
-    best_gain_r = jax.ops.segment_max(gains_r, fid, num_segments=num_features)
-    at_max_r = valid_r & (gains_r == best_gain_r[fid])
-    best_t_r = jax.ops.segment_max(jnp.where(at_max_r, t_local, -1), fid,
-                                   num_segments=num_features)
+    best_gain_r = jnp.max(gains_r, axis=1)                # [F]
+    at_max_r = valid_r & (gains_r == best_gain_r[:, None])
+    best_t_r = jnp.max(jnp.where(at_max_r, w, -1), axis=1)
 
     # ---------------- forward scan (left accumulates from low bins) --------
     excl_f = skip_default & is_default_bin
-    keep_f = (~excl_f).astype(F64)
-    gl_c = _segment_cumsum(grad_b * keep_f, fid, meta.bin_start)
-    hl_c = _segment_cumsum(hess_b * keep_f, fid, meta.bin_start)
-    cl_c = _segment_cumsum(cnt_b * (~excl_f), fid, meta.bin_start)
+    keep_f = (~excl_f).astype(ft)
+    gl_c = jnp.cumsum(grad_b * keep_f, axis=1)
+    hl_c = jnp.cumsum(hess_b * keep_f, axis=1)
+    cl_c = jnp.cumsum(cnt_b * (~excl_f), axis=1)
     f_left_grad = gl_c
     f_left_hess = hl_c + K_EPSILON
     f_left_cnt = cl_c
@@ -287,23 +338,23 @@ def find_best_split_numerical(hist, sum_grad, sum_hess, num_data,
     f_right_grad = sum_grad - f_left_grad
     f_right_hess = sum_hess_adj - f_left_hess
 
-    valid_f = two_scan & (t_local >= 0) & (t_local <= nb - 2)
+    valid_f = two_scan & in_feat & (w <= nb - 2)
     valid_f &= ~(skip_default & is_default_bin)
     valid_f &= (f_left_cnt >= min_data) & (f_left_hess >= min_hess)
     valid_f &= (f_right_cnt >= min_data) & (f_right_hess >= min_hess)
-    valid_f &= fmask_b
+    valid_f &= fmask_f
 
     gains_f = _split_gains(f_left_grad, f_left_hess, f_right_grad,
                            f_right_hess, p.lambda_l1, p.lambda_l2,
-                           p.max_delta_step, cmin, cmax, mono, use_mc)
+                           p.max_delta_step, cmin, cmax, mono[:, None],
+                           use_mc, use_l1, use_mds)
     valid_f &= gains_f > min_gain_shift
     gains_f = jnp.where(valid_f, gains_f, K_MIN_SCORE)
 
-    best_gain_f = jax.ops.segment_max(gains_f, fid, num_segments=num_features)
-    at_max_f = valid_f & (gains_f == best_gain_f[fid])
+    best_gain_f = jnp.max(gains_f, axis=1)
+    at_max_f = valid_f & (gains_f == best_gain_f[:, None])
     big = jnp.iinfo(jnp.int32).max
-    best_t_f = jax.ops.segment_min(jnp.where(at_max_f, t_local, big), fid,
-                                   num_segments=num_features)
+    best_t_f = jnp.min(jnp.where(at_max_f, w, big), axis=1)
 
     # ---------------- combine directions per feature -----------------------
     has_r = best_t_r >= 0
@@ -321,32 +372,36 @@ def find_best_split_numerical(hist, sum_grad, sum_hess, num_data,
 
     # gain reported = best - shift, then * penalty (:89, :945)
     feat_gain_out = jnp.where(feat_valid,
-                              (feat_gain - min_gain_shift) * meta.penalty,
+                              (feat_gain - min_gain_shift)
+                              * meta.penalty.astype(ft),
                               K_MIN_SCORE)
 
     # ---------------- best feature (ties -> smaller index) -----------------
     best_f = jnp.argmax(feat_gain_out)      # first max = smallest feature id
     best_valid = feat_valid[best_f] & (feat_gain_out[best_f] > K_MIN_SCORE)
     bt = feat_t[best_f]
-    bt_global = meta.bin_start[best_f] + bt
+
     b_use_f = use_f[best_f]
 
     # recover left sums at the chosen threshold
-    lg = jnp.where(b_use_f, gl_c[bt_global], sum_grad - (gr_tot[bt_global] - gr_c[bt_global]))
-    lh = jnp.where(b_use_f, hl_c[bt_global] + K_EPSILON,
-                   sum_hess_adj - (hr_tot[bt_global] - hr_c[bt_global] + K_EPSILON))
-    lc = jnp.where(b_use_f, cl_c[bt_global], num_data - (cr_tot[bt_global] - cr_c[bt_global]))
+    lg = jnp.where(b_use_f, gl_c[best_f, bt],
+                   sum_grad - (gr_tot[best_f, 0] - gr_c[best_f, bt]))
+    lh = jnp.where(b_use_f, hl_c[best_f, bt] + K_EPSILON,
+                   sum_hess_adj - (hr_tot[best_f, 0] - hr_c[best_f, bt]
+                                   + K_EPSILON))
+    lc = jnp.where(b_use_f, cl_c[best_f, bt],
+                   num_data - (cr_tot[best_f, 0] - cr_c[best_f, bt]))
     rg = sum_grad - lg
     rh = sum_hess_adj - lh
     rc = num_data - lc
 
     cm_b, cx_b = (cmin, cmax) if use_mc else (-jnp.inf, jnp.inf)
     lo = _leaf_output(lg, lh, p.lambda_l1, p.lambda_l2, p.max_delta_step,
-                      cm_b, cx_b, use_mc)
+                      cm_b, cx_b, use_mc, use_l1, use_mds)
     ro = _leaf_output(rg, rh, p.lambda_l1, p.lambda_l2, p.max_delta_step,
-                      cm_b, cx_b, use_mc)
+                      cm_b, cx_b, use_mc, use_l1, use_mds)
 
-    neg = jnp.asarray(K_MIN_SCORE, F64)
+    neg = jnp.asarray(K_MIN_SCORE, ft)
     return SplitCandidate(
         gain=jnp.where(best_valid, feat_gain_out[best_f], neg),
         feature=jnp.where(best_valid, best_f.astype(I32), -1),
@@ -389,9 +444,10 @@ def _cat_onehot_scan(grad_b, hess_b, cnt_b, used_mask, sum_grad, sum_hess_adj,
     ok &= (cnt_b >= p.min_data_in_leaf) & (hess_b >= p.min_sum_hessian_in_leaf)
     ok &= (other_cnt >= p.min_data_in_leaf)
     ok &= (other_hess >= p.min_sum_hessian_in_leaf)
+    zero = jnp.zeros((), grad_b.dtype)
     gains = _split_gains(other_grad, other_hess, grad_b, hess_adj,
                          p.lambda_l1, p.lambda_l2, p.max_delta_step,
-                         cmin, cmax, jnp.asarray(0.0, F64), use_mc)
+                         cmin, cmax, zero, use_mc)
     gains = jnp.where(ok, gains, K_MIN_SCORE)
     t = jnp.argmax(gains)
     best_gain = gains[t]
@@ -405,11 +461,12 @@ def _cat_sorted_scan(grad_b, hess_b, cnt_b, used_mask, sum_grad, sum_hess_adj,
     """Many-vs-many categorical: bins sorted by grad/hess ratio, prefix scans
     in both directions with the reference's stateful min_data_per_group
     bookkeeping (feature_histogram.hpp:339-432) as a lax.scan."""
+    ft = grad_b.dtype
     W = grad_b.shape[0]
     l2 = p.lambda_l2 + p.cat_l2
     # filter: count >= cat_smooth (hpp:340-344; count vs cat_smooth is the
     # reference's comparison, odd but faithful)
-    part = used_mask & (cnt_b.astype(F64) >= p.cat_smooth)
+    part = used_mask & (cnt_b.astype(ft) >= p.cat_smooth)
     ratio = grad_b / (hess_b + p.cat_smooth)
     ratio = jnp.where(part, ratio, jnp.inf)    # excluded bins sort last
     order = jnp.argsort(ratio, stable=True)    # ascending
@@ -460,17 +517,17 @@ def _cat_sorted_scan(grad_b, hess_b, cnt_b, used_mask, sum_grad, sum_hess_adj,
             gain = _split_gains(sum_lg, sum_lh, sum_grad - sum_lg,
                                 sum_hess_adj - sum_lh, p.lambda_l1, l2,
                                 p.max_delta_step, cmin, cmax,
-                                jnp.asarray(0.0, F64), use_mc)
+                                jnp.zeros((), ft), use_mc)
             gain = jnp.where(ok, gain, K_MIN_SCORE)
             cnt_grp = jnp.where(ok, 0, cnt_grp)
             return ((sum_lg, sum_lh, left_cnt, cnt_grp, stopped, i + 1),
                     (gain, sum_lg, sum_lh, left_cnt))
 
-        init = (jnp.asarray(0.0, F64), jnp.asarray(K_EPSILON, F64),
+        init = (jnp.asarray(0.0, ft), jnp.asarray(K_EPSILON, ft),
                 jnp.asarray(0, I32), jnp.asarray(0, I32),
                 jnp.asarray(False), jnp.asarray(0, I32))
         _, (gains, lgs, lhs, lcs) = jax.lax.scan(
-            step, init, (gd, hd.astype(F64), cd, vd))
+            step, init, (gd, hd.astype(ft), cd, vd))
         i_best = jnp.argmax(gains)
         return gains[i_best], i_best, lgs[i_best], lhs[i_best], lcs[i_best]
 
@@ -490,11 +547,12 @@ def _cat_sorted_scan(grad_b, hess_b, cnt_b, used_mask, sum_grad, sum_hess_adj,
     return best_gain, cat_mask, lg, lh, lc
 
 
-@functools.partial(jax.jit, static_argnames=("use_mc",))
+@functools.partial(jax.jit, static_argnames=("use_mc", "use_dp"))
 def find_best_split_categorical(hist, sum_grad, sum_hess, num_data,
                                 cat: CatLayout, meta: FeatureMeta,
                                 p: SplitParams, cmin, cmax, feature_mask,
-                                use_mc: bool = False) -> SplitCandidate:
+                                use_mc: bool = False,
+                                use_dp: bool = True) -> SplitCandidate:
     """Best categorical split over all categorical features of one leaf.
 
     Mirrors FindBestThresholdCategoricalInner (feature_histogram.hpp:263-474):
@@ -502,16 +560,22 @@ def find_best_split_categorical(hist, sum_grad, sum_hess, num_data,
     scan; the l2 used for outputs includes cat_l2 only in sorted mode.
     Returns a scalar SplitCandidate (feature -1 when nothing splits).
     """
+    ft = acc_dtype(use_dp)
     C, W = cat.gather_idx.shape
+    p = p.cast(ft)
+    sum_grad = sum_grad.astype(ft)
+    sum_hess = sum_hess.astype(ft)
+    cmin = jnp.asarray(cmin).astype(ft)
+    cmax = jnp.asarray(cmax).astype(ft)
     sum_hess_adj = sum_hess + 2 * K_EPSILON
-    cnt_factor = num_data.astype(F64) / sum_hess_adj
+    cnt_factor = num_data.astype(ft) / sum_hess_adj
     gain_shift = _leaf_gain(sum_grad, sum_hess_adj, p.lambda_l1, p.lambda_l2,
                             p.max_delta_step)
     min_gain_shift = gain_shift + p.min_gain_to_split
 
     def per_feature(f_idx, g_idx, valid, used_bin, nb):
-        grad_b = hist[g_idx, 0].astype(F64)
-        hess_b = hist[g_idx, 1].astype(F64)
+        grad_b = hist[g_idx, 0].astype(ft)
+        hess_b = hist[g_idx, 1].astype(ft)
         used_mask = valid & (jnp.arange(W) < used_bin)
         grad_b = jnp.where(used_mask, grad_b, 0.0)
         hess_b = jnp.where(used_mask, hess_b, 0.0)
@@ -526,16 +590,16 @@ def find_best_split_categorical(hist, sum_grad, sum_hess, num_data,
         l2_out = jnp.where(onehot, p.lambda_l2, p.lambda_l2 + p.cat_l2)
         ok = (gain > min_gain_shift) & feature_mask[f_idx]
         gain_out = jnp.where(ok, (gain - min_gain_shift)
-                             * meta.penalty[f_idx], K_MIN_SCORE)
+                             * meta.penalty[f_idx].astype(ft), K_MIN_SCORE)
         return gain_out, mask, lg, lh, lc, l2_out
 
     if C == 0:
-        z64 = jnp.asarray(0.0, F64)
+        z = jnp.asarray(0.0, ft)
         return SplitCandidate(
-            gain=jnp.asarray(K_MIN_SCORE, F64), feature=jnp.asarray(-1, I32),
+            gain=jnp.asarray(K_MIN_SCORE, ft), feature=jnp.asarray(-1, I32),
             threshold=jnp.asarray(0, I32), default_left=jnp.asarray(False),
-            left_output=z64, right_output=z64, left_sum_grad=z64,
-            left_sum_hess=z64, right_sum_grad=z64, right_sum_hess=z64,
+            left_output=z, right_output=z, left_sum_grad=z,
+            left_sum_hess=z, right_sum_grad=z, right_sum_hess=z,
             left_count=jnp.asarray(0, I32), right_count=jnp.asarray(0, I32),
             is_cat=jnp.asarray(False), cat_mask=jnp.zeros((W or 1,), bool))
 

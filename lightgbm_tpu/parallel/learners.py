@@ -31,7 +31,6 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..models.tree import Tree
 from ..ops.grow import DataLayout, GrowConfig, grow_tree, grow_tree_partitioned
-from ..ops.partition import budget_classes
 from ..treelearner.serial import PARTITION_MIN_ROWS, SerialTreeLearner
 from ..utils.log import Log
 
@@ -78,7 +77,6 @@ class DataParallelTreeLearner(SerialTreeLearner):
         cat = self.cat_layout
         n_shard = (self.dataset.num_data + self._pad) // self.num_shards
         use_part = n_shard >= PARTITION_MIN_ROWS
-        budgets = tuple(budget_classes(n_shard))
         gw_global = self.gw_global
 
         @functools.partial(
@@ -91,7 +89,7 @@ class DataParallelTreeLearner(SerialTreeLearner):
             if use_part:
                 return grow_tree_partitioned(
                     layout, grad, hess, bag, meta, params, fmask, fix, gc,
-                    budgets=budgets, gw_global=gw_global, axis_name=AXIS,
+                    gw_global=gw_global, axis_name=AXIS,
                     cat=cat)
             return grow_tree(layout, grad, hess, bag, meta, params, fmask,
                              fix, gc, axis_name=AXIS, cat=cat)

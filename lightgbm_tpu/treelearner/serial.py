@@ -23,7 +23,6 @@ from ..config import Config
 from ..models.tree import Tree
 from ..ops.grow import (DataLayout, FixInfo, GrowConfig, empty_cat_layout,
                         grow_tree, grow_tree_partitioned)
-from ..ops.partition import budget_classes
 from ..ops.split import CatLayout, FeatureMeta, SplitParams
 from ..utils.log import Log
 
@@ -41,6 +40,18 @@ def resolve_hist_impl(config: Config) -> str:
         return "onehot"
     import jax
     return "scatter" if jax.default_backend() == "cpu" else "onehot"
+
+
+def resolve_use_dp(config: Config) -> bool:
+    """Precision of leaf sums / gain math. The CPU backend always uses f64
+    (it stands in for the reference CPU learner, which is double-only); on
+    accelerators the default is f32 — the same trade the reference GPU
+    learner makes (gpu_use_dp, docs/GPU-Performance.rst:43-47) — unless
+    tpu_use_dp=true requests emulated f64."""
+    import jax
+    if jax.default_backend() == "cpu":
+        return True
+    return bool(config.tpu_use_dp)
 
 
 def build_gw_global(dataset) -> "jnp.ndarray":
@@ -129,6 +140,8 @@ class SerialTreeLearner:
             rows_per_chunk = max(1 << 14, int(2 ** 25 / g))
             if rows_per_chunk >= dataset.num_data:
                 rows_per_chunk = 0
+        widths = dataset.bin_end - dataset.bin_start \
+            if dataset.num_features else np.array([1])
         self.grow_config = GrowConfig(
             num_leaves=int(config.num_leaves),
             total_bins=int(dataset.total_bins),
@@ -138,11 +151,12 @@ class SerialTreeLearner:
             rows_per_chunk=rows_per_chunk,
             cat_width=cat_width,
             hist_impl=resolve_hist_impl(config),
+            scan_width=max(1, int(widths.max())),
+            use_dp=resolve_use_dp(config),
         )
         self.col_sampler = ColSampler(config, dataset.num_features)
         self.cat_layout = build_cat_layout(dataset, cat_width)
         self.use_partitioned = dataset.num_data >= PARTITION_MIN_ROWS
-        self.budgets = tuple(budget_classes(dataset.num_data))
         self.gw_global = build_gw_global(dataset)
         self._axis_name = None   # set by parallel learners
 
@@ -155,7 +169,7 @@ class SerialTreeLearner:
         if self.use_partitioned:
             return grow_tree_partitioned(
                 self.layout, grad, hess, bag_mask, self.meta, self.params,
-                fmask, self.fix, self.grow_config, budgets=self.budgets,
+                fmask, self.fix, self.grow_config,
                 gw_global=self.gw_global, axis_name=self._axis_name,
                 cat=self.cat_layout)
         return grow_tree(self.layout, grad, hess, bag_mask, self.meta,
