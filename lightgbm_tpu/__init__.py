@@ -52,6 +52,29 @@ def _register_api():
         __all__ += ["LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker"]
     except ImportError:
         pass
+    try:
+        from .callback import (early_stopping, print_evaluation,  # noqa
+                               record_evaluation, reset_parameter)
+        globals().update(early_stopping=early_stopping,
+                         print_evaluation=print_evaluation,
+                         record_evaluation=record_evaluation,
+                         reset_parameter=reset_parameter)
+        __all__ += ["early_stopping", "print_evaluation",
+                    "record_evaluation", "reset_parameter"]
+    except ImportError:
+        pass
+    try:
+        from .plotting import (create_tree_digraph, plot_importance,  # noqa
+                               plot_metric, plot_split_value_histogram,
+                               plot_tree)
+        globals().update(plot_importance=plot_importance,
+                         plot_split_value_histogram=plot_split_value_histogram,
+                         plot_metric=plot_metric, plot_tree=plot_tree,
+                         create_tree_digraph=create_tree_digraph)
+        __all__ += ["plot_importance", "plot_split_value_histogram",
+                    "plot_metric", "plot_tree", "create_tree_digraph"]
+    except ImportError:
+        pass
 
 
 _register_api()

@@ -1,14 +1,15 @@
 """Training callbacks.
 
-TPU-native rebuild of python-package/lightgbm/callback.py: the same
-CallbackEnv protocol (print_evaluation :55, record_evaluation :78,
-reset_parameter :109, early_stopping :150) so user callbacks written for
-LightGBM run unchanged.
+Implements the CallbackEnv protocol of the reference python package
+(python-package/lightgbm/callback.py) — same factory names, env fields,
+`order`/`before_iteration` attributes and EarlyStopException contract, so
+user callbacks written for LightGBM run unchanged — but the machinery here
+is class-based: each factory returns a small stateful object whose
+`__call__(env)` does the work.
 """
 from __future__ import annotations
 
-import collections
-from typing import Callable, Dict, List
+from typing import Callable, Dict, List, Optional
 
 from .utils.log import Log
 
@@ -22,158 +23,213 @@ class EarlyStopException(Exception):
         self.best_score = best_score
 
 
-CallbackEnv = collections.namedtuple(
-    "CallbackEnv",
-    ["model", "params", "iteration", "begin_iteration", "end_iteration",
-     "evaluation_result_list"])
+class CallbackEnv:
+    """State handed to every callback once per iteration."""
+
+    __slots__ = ("model", "params", "iteration", "begin_iteration",
+                 "end_iteration", "evaluation_result_list")
+
+    def __init__(self, model, params, iteration, begin_iteration,
+                 end_iteration, evaluation_result_list):
+        self.model = model
+        self.params = params
+        self.iteration = iteration
+        self.begin_iteration = begin_iteration
+        self.end_iteration = end_iteration
+        self.evaluation_result_list = evaluation_result_list
 
 
 def _format_eval_result(value, show_stdv: bool = True) -> str:
+    """One eval tuple -> 'data's metric: 0.123 [+ 0.01]'.
+
+    Tuples are (data, metric, value, is_higher_better) from train() or the
+    5-field (data, metric, mean, is_higher_better, stdv) from cv().
+    """
     if len(value) == 4:
-        return "%s's %s: %g" % (value[0], value[1], value[2])
+        return f"{value[0]}'s {value[1]}: {value[2]:g}"
     if len(value) == 5:
-        if show_stdv:
-            return "%s's %s: %g + %g" % (value[0], value[1], value[2], value[4])
-        return "%s's %s: %g" % (value[0], value[1], value[2])
+        base = f"{value[0]}'s {value[1]}: {value[2]:g}"
+        return base + (f" + {value[4]:g}" if show_stdv else "")
     raise ValueError("Wrong metric value")
 
 
+class _EvalLogger:
+    """Prints the eval tuples every `period` iterations."""
+
+    def __init__(self, period: int, show_stdv: bool):
+        self.order = 10
+        self.before_iteration = False
+        self.period = period
+        self.show_stdv = show_stdv
+
+    def __call__(self, env: CallbackEnv) -> None:
+        if self.period <= 0 or not env.evaluation_result_list:
+            return
+        if (env.iteration + 1) % self.period:
+            return
+        line = "\t".join(_format_eval_result(v, self.show_stdv)
+                         for v in env.evaluation_result_list)
+        Log.info("[%d]\t%s" % (env.iteration + 1, line))
+
+
 def print_evaluation(period: int = 1, show_stdv: bool = True) -> Callable:
-    """Print evaluation results every `period` iterations (callback.py:55)."""
-    def _callback(env: CallbackEnv) -> None:
-        if period > 0 and env.evaluation_result_list \
-                and (env.iteration + 1) % period == 0:
-            result = "\t".join(_format_eval_result(x, show_stdv)
-                               for x in env.evaluation_result_list)
-            Log.info("[%d]\t%s" % (env.iteration + 1, result))
-    _callback.order = 10
-    return _callback
+    """Log evaluation results every `period` iterations."""
+    return _EvalLogger(period, show_stdv)
+
+
+class _HistoryRecorder:
+    """Appends each iteration's eval values into a user-supplied dict of
+    {data_name: {eval_name: [values...]}}."""
+
+    def __init__(self, store: Dict):
+        self.order = 20
+        self.before_iteration = False
+        if not isinstance(store, dict):
+            raise TypeError("eval_result should be a dictionary")
+        store.clear()
+        self.store = store
+
+    def __call__(self, env: CallbackEnv) -> None:
+        for item in env.evaluation_result_list:
+            data_name, eval_name, value = item[0], item[1], item[2]
+            self.store.setdefault(data_name, {}) \
+                      .setdefault(eval_name, []).append(value)
 
 
 def record_evaluation(eval_result: Dict) -> Callable:
-    """Record evaluation history into eval_result (callback.py:78)."""
-    if not isinstance(eval_result, dict):
-        raise TypeError("eval_result should be a dictionary")
-    eval_result.clear()
+    """Record evaluation history into `eval_result`."""
+    return _HistoryRecorder(eval_result)
 
-    def _init(env: CallbackEnv) -> None:
-        for data_name, eval_name, _, _ in env.evaluation_result_list:
-            eval_result.setdefault(data_name, collections.OrderedDict())
-            eval_result[data_name].setdefault(eval_name, [])
 
-    def _callback(env: CallbackEnv) -> None:
-        if not eval_result:
-            _init(env)
-        for data_name, eval_name, result, _ in env.evaluation_result_list:
-            eval_result.setdefault(data_name, collections.OrderedDict())
-            eval_result[data_name].setdefault(eval_name, [])
-            eval_result[data_name][eval_name].append(result)
-    _callback.order = 20
-    return _callback
+class _ParamScheduler:
+    """Re-applies parameters on a schedule before each iteration.
+
+    Values may be lists (indexed by iteration) or callables(iteration).
+    On this backend only learning_rate can change mid-training without a
+    relearn; anything else warns.
+    """
+
+    def __init__(self, schedule: Dict):
+        self.order = 10
+        self.before_iteration = True
+        self.schedule = schedule
+
+    def _value_at(self, key, spec, env: CallbackEnv):
+        step = env.iteration - env.begin_iteration
+        if isinstance(spec, list):
+            if len(spec) != env.end_iteration - env.begin_iteration:
+                raise ValueError("Length of list %r has to equal to "
+                                 "'num_boost_round'" % key)
+            return spec[step]
+        return spec(step)
+
+    def __call__(self, env: CallbackEnv) -> None:
+        updates = {k: self._value_at(k, v, env)
+                   for k, v in self.schedule.items()}
+        if not updates:
+            return
+        if "learning_rate" in updates:
+            inner = getattr(env.model, "_booster", None)
+            if inner is not None:
+                lr = float(updates["learning_rate"])
+                inner.shrinkage_rate = lr
+                inner.config.learning_rate = lr
+        rest = [k for k in updates if k != "learning_rate"]
+        if rest:
+            Log.warning("reset_parameter: only learning_rate is resettable "
+                        "on device_type=tpu (got %s)" % ", ".join(sorted(rest)))
+        env.params.update(updates)
 
 
 def reset_parameter(**kwargs) -> Callable:
-    """Reset parameters on schedule: value list or callable(iter)
-    (callback.py:109). Supported: learning_rate (per-iteration shrinkage)."""
-    def _callback(env: CallbackEnv) -> None:
-        new_parameters = {}
-        for key, value in kwargs.items():
-            if isinstance(value, list):
-                if len(value) != env.end_iteration - env.begin_iteration:
-                    raise ValueError(
-                        "Length of list %r has to equal to 'num_boost_round'"
-                        % key)
-                new_param = value[env.iteration - env.begin_iteration]
-            else:
-                new_param = value(env.iteration - env.begin_iteration)
-            new_parameters[key] = new_param
-        if new_parameters:
-            inner = getattr(env.model, "_booster", None)
-            if inner is not None and "learning_rate" in new_parameters:
-                inner.shrinkage_rate = float(new_parameters["learning_rate"])
-                inner.config.learning_rate = float(
-                    new_parameters["learning_rate"])
-            unhandled = set(new_parameters) - {"learning_rate"}
-            if unhandled:
-                Log.warning("reset_parameter: only learning_rate is "
-                            "resettable on device_type=tpu (got %s)"
-                            % ", ".join(sorted(unhandled)))
-            env.params.update(new_parameters)
-    _callback.before_iteration = True
-    _callback.order = 10
-    return _callback
+    """Change parameters on a per-iteration schedule."""
+    return _ParamScheduler(kwargs)
 
 
-def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
-                   verbose: bool = True) -> Callable:
-    """Early stopping on validation metrics (callback.py:150)."""
-    best_score: List[float] = []
-    best_iter: List[int] = []
-    best_score_list: List = []
-    cmp_op: List[Callable] = []
-    enabled = [True]
-    first_metric = [""]
+class _MetricState:
+    """Best-so-far tracker for one (dataset, metric) eval stream."""
 
-    def _init(env: CallbackEnv) -> None:
-        enabled[0] = not any(
-            env.params.get(alias, "") == "dart"
-            for alias in ("boosting", "boosting_type", "boost"))
-        if not enabled[0]:
+    __slots__ = ("best_value", "best_iteration", "best_snapshot", "bigger")
+
+    def __init__(self, bigger_is_better: bool):
+        self.bigger = bigger_is_better
+        self.best_value = float("-inf") if bigger_is_better else float("inf")
+        self.best_iteration = 0
+        self.best_snapshot = None
+
+    def update(self, value, iteration, snapshot) -> None:
+        improved = (value > self.best_value if self.bigger
+                    else value < self.best_value)
+        if self.best_snapshot is None or improved:
+            self.best_value = value
+            self.best_iteration = iteration
+            self.best_snapshot = snapshot
+
+
+class _EarlyStopper:
+    """Stops training when no tracked metric improves for N rounds."""
+
+    def __init__(self, stopping_rounds: int, first_metric_only: bool,
+                 verbose: bool):
+        self.order = 30
+        self.before_iteration = False
+        self.rounds = stopping_rounds
+        self.first_metric_only = first_metric_only
+        self.verbose = verbose
+        self.states: Optional[List[_MetricState]] = None
+        self.enabled = True
+        self.first_metric = ""
+
+    def _setup(self, env: CallbackEnv) -> None:
+        boosting = next((env.params[k] for k in
+                         ("boosting", "boosting_type", "boost")
+                         if k in env.params), None)
+        if boosting == "dart":
+            self.enabled = False
             Log.warning("Early stopping is not available in dart mode")
             return
         if not env.evaluation_result_list:
             raise ValueError(
                 "For early stopping, at least one dataset and eval metric "
                 "is required for evaluation")
-        if verbose:
+        if self.verbose:
             Log.info("Training until validation scores don't improve for "
-                     "%d rounds" % stopping_rounds)
-        first_metric[0] = env.evaluation_result_list[0][1].split(" ")[-1]
-        for ret in env.evaluation_result_list:
-            best_iter.append(0)
-            best_score_list.append(None)
-            if ret[3]:  # is_higher_better
-                best_score.append(float("-inf"))
-                cmp_op.append(lambda x, y: x > y)
-            else:
-                best_score.append(float("inf"))
-                cmp_op.append(lambda x, y: x < y)
+                     "%d rounds" % self.rounds)
+        # metric name may carry a 'top-k' prefix: compare the last token
+        self.first_metric = env.evaluation_result_list[0][1].split(" ")[-1]
+        self.states = [_MetricState(bool(item[3]))
+                       for item in env.evaluation_result_list]
 
-    def _final_iteration_check(env, eval_name_splitted, i) -> None:
-        if env.iteration == env.end_iteration - 1:
-            if verbose:
-                Log.info("Did not meet early stopping. Best iteration is:"
-                         "\n[%d]\t%s" % (
-                             best_iter[i] + 1,
-                             "\t".join(_format_eval_result(x)
-                                       for x in best_score_list[i])))
-            raise EarlyStopException(best_iter[i], best_score_list[i])
+    def _stop(self, state: _MetricState, reason: str) -> None:
+        if self.verbose:
+            Log.info("%s, best iteration is:\n[%d]\t%s" % (
+                reason, state.best_iteration + 1,
+                "\t".join(_format_eval_result(v)
+                          for v in state.best_snapshot)))
+        raise EarlyStopException(state.best_iteration, state.best_snapshot)
 
-    def _callback(env: CallbackEnv) -> None:
-        if not cmp_op:
-            _init(env)
-        if not enabled[0]:
+    def __call__(self, env: CallbackEnv) -> None:
+        if self.states is None and self.enabled:
+            self._setup(env)
+        if not self.enabled:
             return
-        for i in range(len(env.evaluation_result_list)):
-            score = env.evaluation_result_list[i][2]
-            if best_score_list[i] is None or cmp_op[i](score, best_score[i]):
-                best_score[i] = score
-                best_iter[i] = env.iteration
-                best_score_list[i] = env.evaluation_result_list
-            eval_name_splitted = env.evaluation_result_list[i][1].split(" ")
-            if first_metric_only and first_metric[0] != eval_name_splitted[-1]:
+        results = env.evaluation_result_list
+        data_names = {item[0] for item in results}
+        is_last = env.iteration == env.end_iteration - 1
+        for state, item in zip(self.states, results):
+            state.update(item[2], env.iteration, results)
+            if self.first_metric_only and \
+                    item[1].split(" ")[-1] != self.first_metric:
                 continue
-            if (env.evaluation_result_list[i][0] == "training"
-                    and len({er[0] for er in env.evaluation_result_list}) > 1):
-                _final_iteration_check(env, eval_name_splitted, i)
-                continue
-            if env.iteration - best_iter[i] >= stopping_rounds:
-                if verbose:
-                    Log.info("Early stopping, best iteration is:\n[%d]\t%s"
-                             % (best_iter[i] + 1,
-                                "\t".join(_format_eval_result(x)
-                                          for x in best_score_list[i])))
-                raise EarlyStopException(best_iter[i], best_score_list[i])
-            _final_iteration_check(env, eval_name_splitted, i)
-    _callback.order = 30
-    return _callback
+            train_only_stream = item[0] == "training" and len(data_names) > 1
+            if not train_only_stream and \
+                    env.iteration - state.best_iteration >= self.rounds:
+                self._stop(state, "Early stopping")
+            if is_last:
+                self._stop(state, "Did not meet early stopping")
+
+
+def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
+                   verbose: bool = True) -> Callable:
+    """Stop training when validation metrics stall for `stopping_rounds`."""
+    return _EarlyStopper(stopping_rounds, first_metric_only, verbose)

@@ -195,6 +195,8 @@ PARAMS: List[_P] = [
     _P("tpu_rows_per_chunk", int, 0),        # 0 = auto; histogram kernel chunking
     _P("tpu_histogram_impl", str, "auto"),   # auto | xla | pallas
     _P("tpu_donate_buffers", bool, True),
+    _P("tpu_window_chunk", int, 0),          # 0 = auto; partitioned-grower chunk rows
+    _P("tpu_hist_dtype", str, "auto"),       # auto | f32 | bf16x2
 ]
 
 _BY_NAME: Dict[str, _P] = {p.name: p for p in PARAMS}

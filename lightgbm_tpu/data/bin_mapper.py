@@ -77,33 +77,54 @@ def greedy_find_bin(distinct_values: np.ndarray, counts: np.ndarray,
             max_bin = min(max_bin, total_cnt // min_data_in_bin)
             max_bin = max(max_bin, 1)
         mean_bin_size = total_cnt / max_bin
-        rest_bin_cnt = max_bin
-        rest_sample_cnt = int(total_cnt)
-        is_big = counts[:num_distinct_values] >= mean_bin_size
-        n_big = int(np.count_nonzero(is_big))
-        rest_bin_cnt -= n_big
-        rest_sample_cnt -= int(counts[:num_distinct_values][is_big].sum())
-        mean_bin_size = rest_sample_cnt / rest_bin_cnt if rest_bin_cnt else math.inf
+        n = num_distinct_values
+        cnts = np.asarray(counts[:n], dtype=np.int64)
+        is_big = cnts >= mean_bin_size
+        rest_bin_cnt = max_bin - int(np.count_nonzero(is_big))
+        init_rest = int(total_cnt) - int(cnts[is_big].sum())
+        mean_bin_size = init_rest / rest_bin_cnt if rest_bin_cnt else math.inf
+
+        # The boundary walk is sequential, but between boundaries nothing
+        # changes: the next stop is the earliest of (first big value),
+        # (prefix count reaching mean_bin_size), (value preceding a big one
+        # once half a bin has accumulated). Each is a sorted-array lookup, so
+        # the walk costs O(max_bin log n) instead of a Python loop over every
+        # distinct value.
+        prefix = np.cumsum(cnts)                       # [n]
+        # float copy for the threshold lookups: comparing an int array
+        # against a float target would silently convert the whole array
+        # per searchsorted call (sample counts are < 2^53, so exact)
+        prefix_f = prefix.astype(np.float64)
+        small_prefix = np.cumsum(np.where(is_big, 0, cnts))
+        big_idx = np.nonzero(is_big)[0]
 
         upper_bounds = []
         lower_bounds = [distinct_values[0]]
         bin_cnt = 0
-        cur_cnt_inbin = 0
-        for i in range(num_distinct_values - 1):
-            if not is_big[i]:
-                rest_sample_cnt -= counts[i]
-            cur_cnt_inbin += counts[i]
-            if is_big[i] or cur_cnt_inbin >= mean_bin_size or \
-                    (is_big[i + 1] and cur_cnt_inbin >= max(1.0, mean_bin_size * np.float32(0.5))):
-                upper_bounds.append(distinct_values[i])
-                bin_cnt += 1
-                lower_bounds.append(distinct_values[i + 1])
-                if bin_cnt >= max_bin - 1:
-                    break
-                cur_cnt_inbin = 0
-                if not is_big[i]:
-                    rest_bin_cnt -= 1
-                    mean_bin_size = rest_sample_cnt / rest_bin_cnt if rest_bin_cnt else math.inf
+        seg = 0                                        # first index of segment
+        while seg <= n - 2:
+            base = int(prefix[seg - 1]) if seg > 0 else 0
+            j = np.searchsorted(big_idx, seg, side="left")
+            i_a = int(big_idx[j]) if j < len(big_idx) else n
+            i_b = int(np.searchsorted(prefix_f, base + mean_bin_size,
+                                      side="left"))
+            t_half = max(1.0, mean_bin_size * np.float32(0.5))
+            pos_h = int(np.searchsorted(prefix_f, base + t_half, side="left"))
+            jc = np.searchsorted(big_idx, max(seg, pos_h) + 1, side="left")
+            i_c = int(big_idx[jc]) - 1 if jc < len(big_idx) else n
+            stop = min(i_a, i_b, i_c)
+            if stop > n - 2:
+                break
+            upper_bounds.append(distinct_values[stop])
+            bin_cnt += 1
+            lower_bounds.append(distinct_values[stop + 1])
+            if bin_cnt >= max_bin - 1:
+                break
+            if not is_big[stop]:
+                rest_bin_cnt -= 1
+                rest = init_rest - int(small_prefix[stop])
+                mean_bin_size = rest / rest_bin_cnt if rest_bin_cnt else math.inf
+            seg = stop + 1
         bin_cnt += 1
         for i in range(bin_cnt - 1):
             val = _double_upper_bound((upper_bounds[i] + lower_bounds[i + 1]) / 2.0)
@@ -308,22 +329,28 @@ class BinMapper:
             dvals = np.empty(0)
             dcnts = np.empty(0, dtype=np.int64)
 
-        distinct_values: List[float] = []
-        counts: List[int] = []
-        if n_values == 0 or (len(dvals) and dvals[0] > 0.0 and zero_cnt > 0):
-            distinct_values.append(0.0)
-            counts.append(zero_cnt)
-        for i in range(len(dvals)):
-            if i > 0 and dvals[i - 1] < 0.0 and dvals[i] > 0.0:
-                distinct_values.append(0.0)
-                counts.append(zero_cnt)
-            distinct_values.append(float(dvals[i]))
-            counts.append(int(dcnts[i]))
-        if len(dvals) and dvals[-1] < 0.0 and zero_cnt > 0:
-            distinct_values.append(0.0)
-            counts.append(zero_cnt)
-        if not distinct_values:
-            distinct_values, counts = [0.0], [max(zero_cnt, 0)]
+        # insert the implicit zero (stripped by sampling) into the sorted
+        # distinct list: before positives / between sign change / after
+        # negatives — the sign-change insert happens even at zero_cnt == 0
+        if n_values == 0:
+            dv_arr = np.array([0.0])
+            ct_arr = np.array([max(zero_cnt, 0)], dtype=np.int64)
+        else:
+            pos0 = int(np.searchsorted(dvals, 0.0, side="left"))
+            if pos0 == 0:
+                insert = zero_cnt > 0 and dvals[0] > 0.0
+            elif pos0 == len(dvals):
+                insert = zero_cnt > 0 and dvals[-1] < 0.0
+            else:
+                insert = dvals[pos0 - 1] < 0.0 and dvals[pos0] > 0.0
+            if insert:
+                dv_arr = np.insert(dvals, pos0, 0.0)
+                ct_arr = np.insert(dcnts.astype(np.int64), pos0, zero_cnt)
+            else:
+                dv_arr = dvals
+                ct_arr = dcnts.astype(np.int64)
+        distinct_values = dv_arr
+        counts = ct_arr
         # NOTE: when sampled values contain exact 0.0 runs the reference counted
         # them in-place; our caller strips zeros, so implicit-zero insertion above
         # is the only zero source (matches dataset_loader's non-zero sampling).

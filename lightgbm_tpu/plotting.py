@@ -1,9 +1,11 @@
 """Plotting utilities.
 
-TPU-native rebuild of python-package/lightgbm/plotting.py:
-plot_importance (:29), plot_split_value_histogram (:145), plot_metric
-(:251), plot_tree / create_tree_digraph (:365-650). matplotlib/graphviz are
-imported lazily and gated like the reference compat layer.
+Same public surface as the reference python package's plotting module
+(plot_importance / plot_split_value_histogram / plot_metric / plot_tree /
+create_tree_digraph) so downstream code ports unchanged; implemented here
+on top of this package's TreeArrays-backed model objects, with a shared
+axis-decoration helper instead of per-function boilerplate. matplotlib and
+graphviz are imported lazily.
 """
 from __future__ import annotations
 
@@ -13,9 +15,18 @@ from .basic import Booster
 from .utils.log import LightGBMError
 
 
-def _check_not_tuple_of_2_elements(obj, obj_name="obj"):
-    if not isinstance(obj, (list, tuple)) or len(obj) != 2:
-        raise TypeError("%s must be a list/tuple of 2 elements" % obj_name)
+def _require_mpl(what="plot"):
+    try:
+        import matplotlib.pyplot as plt
+    except ImportError as e:
+        raise ImportError("matplotlib is required to %s" % what) from e
+    return plt
+
+
+def _pair(v, name):
+    if not isinstance(v, (list, tuple)) or len(v) != 2:
+        raise TypeError("%s must be a list/tuple of 2 elements" % name)
+    return v
 
 
 def _to_booster(booster):
@@ -27,45 +38,12 @@ def _to_booster(booster):
     raise TypeError("booster must be Booster or LGBMModel")
 
 
-def plot_importance(booster, ax=None, height=0.2, xlim=None, ylim=None,
-                    title="Feature importance", xlabel="Feature importance",
-                    ylabel="Features", importance_type="split",
-                    max_num_features=None, ignore_zero=True, figsize=None,
-                    dpi=None, grid=True, precision=3, **kwargs):
-    """Plot model feature importances (reference plotting.py:29-142)."""
-    try:
-        import matplotlib.pyplot as plt
-    except ImportError:
-        raise ImportError("You must install matplotlib to plot importance")
-    booster = _to_booster(booster)
-    importance = booster.feature_importance(importance_type=importance_type)
-    feature_name = booster.feature_name()
-    if not len(importance):
-        raise ValueError("Booster's feature_importance is empty")
-    tuples = sorted(zip(feature_name, importance), key=lambda x: x[1])
-    if ignore_zero:
-        tuples = [x for x in tuples if x[1] > 0]
-    if max_num_features is not None and max_num_features > 0:
-        tuples = tuples[-max_num_features:]
-    labels, values = zip(*tuples) if tuples else ((), ())
-    if ax is None:
-        if figsize is not None:
-            _check_not_tuple_of_2_elements(figsize, "figsize")
-        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
-    ylocs = np.arange(len(values))
-    ax.barh(ylocs, values, align="center", height=height, **kwargs)
-    for x, y in zip(values, ylocs):
-        ax.text(x + 1, y,
-                ("%." + str(precision) + "f") % x if importance_type == "gain"
-                else str(int(x)), va="center")
-    ax.set_yticks(ylocs)
-    ax.set_yticklabels(labels)
+def _decorate(ax, *, title, xlabel, ylabel, xlim=None, ylim=None, grid=True):
+    """Apply the common title/label/limit/grid block to an axis."""
     if xlim is not None:
-        _check_not_tuple_of_2_elements(xlim, "xlim")
-        ax.set_xlim(xlim)
+        ax.set_xlim(_pair(xlim, "xlim"))
     if ylim is not None:
-        _check_not_tuple_of_2_elements(ylim, "ylim")
-        ax.set_ylim(ylim)
+        ax.set_ylim(_pair(ylim, "ylim"))
     if title is not None:
         ax.set_title(title)
     if xlabel is not None:
@@ -74,6 +52,50 @@ def plot_importance(booster, ax=None, height=0.2, xlim=None, ylim=None,
         ax.set_ylabel(ylabel)
     ax.grid(grid)
     return ax
+
+
+def _new_axis(plt, figsize, dpi):
+    if figsize is not None:
+        _pair(figsize, "figsize")
+    _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+    return ax
+
+
+def plot_importance(booster, ax=None, height=0.2, xlim=None, ylim=None,
+                    title="Feature importance", xlabel="Feature importance",
+                    ylabel="Features", importance_type="split",
+                    max_num_features=None, ignore_zero=True, figsize=None,
+                    dpi=None, grid=True, precision=3, **kwargs):
+    """Horizontal bar chart of feature importances."""
+    plt = _require_mpl("plot importance")
+    booster = _to_booster(booster)
+    values = np.asarray(
+        booster.feature_importance(importance_type=importance_type))
+    if values.size == 0:
+        raise ValueError("Booster's feature_importance is empty")
+    names = np.asarray(booster.feature_name(), dtype=object)
+
+    # ascending by importance so the largest bar lands on top of the chart
+    order = np.argsort(values, kind="stable")
+    if ignore_zero:
+        order = order[values[order] > 0]
+    if max_num_features is not None and max_num_features > 0:
+        order = order[max(0, len(order) - max_num_features):]
+    values = values[order]
+    names = names[order]
+
+    if ax is None:
+        ax = _new_axis(plt, figsize, dpi)
+    ypos = np.arange(values.size)
+    ax.barh(ypos, values, align="center", height=height, **kwargs)
+    annotate = (lambda v: "%.*f" % (precision, v)) \
+        if importance_type == "gain" else (lambda v: str(int(v)))
+    for y, v in enumerate(values):
+        ax.text(v + 1, y, annotate(v), va="center")
+    ax.set_yticks(ypos)
+    ax.set_yticklabels(names)
+    return _decorate(ax, title=title, xlabel=xlabel, ylabel=ylabel,
+                     xlim=xlim, ylim=ylim, grid=grid)
 
 
 def plot_split_value_histogram(booster, feature, bins=None, ax=None,
@@ -82,93 +104,71 @@ def plot_split_value_histogram(booster, feature, bins=None, ax=None,
                                      "@index/name@ @feature@",
                                xlabel="Feature split value", ylabel="Count",
                                figsize=None, dpi=None, grid=True, **kwargs):
-    """Histogram of split thresholds of one feature (plotting.py:145-248)."""
-    try:
-        import matplotlib.pyplot as plt
-    except ImportError:
-        raise ImportError("You must install matplotlib to plot")
+    """Histogram of the split thresholds used for one feature."""
+    plt = _require_mpl("plot split value histogram")
     booster = _to_booster(booster)
     gbdt = booster._booster
-    if isinstance(feature, str):
+    by_name = isinstance(feature, str)
+    if by_name:
         feature = booster.feature_name().index(feature)
-    values = []
-    for tree in gbdt._used_models():
-        ni = tree.num_leaves - 1
-        for k in range(ni):
-            if tree.split_feature[k] == feature and \
-                    not (tree.decision_type[k] & 1):
-                values.append(tree.threshold[k])
-    if not values:
-        raise ValueError("Cannot plot split value histogram, "
-                         "as feature %d was not used in splitting" % feature)
-    hist, bin_edges = np.histogram(values, bins=bins or min(len(values), 20))
+
+    thresholds = [
+        tree.threshold[k]
+        for tree in gbdt._used_models()
+        for k in range(tree.num_leaves - 1)
+        if tree.split_feature[k] == feature
+        and not (tree.decision_type[k] & 1)   # numerical splits only
+    ]
+    if not thresholds:
+        raise ValueError("Cannot plot split value histogram, as feature %d "
+                         "was not used in splitting" % feature)
+    counts, edges = np.histogram(thresholds,
+                                 bins=bins or min(len(thresholds), 20))
     if ax is None:
-        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
-    width = width_coef * (bin_edges[1] - bin_edges[0])
-    centred = (bin_edges[:-1] + bin_edges[1:]) / 2
-    ax.bar(centred, hist, width=width, align="center", **kwargs)
+        ax = _new_axis(plt, figsize, dpi)
+    ax.bar((edges[:-1] + edges[1:]) / 2, counts,
+           width=width_coef * (edges[1] - edges[0]), align="center", **kwargs)
     if title is not None:
         title = title.replace("@feature@", str(feature)) \
-                     .replace("@index/name@",
-                              "name" if isinstance(feature, str) else "index")
-        ax.set_title(title)
-    if xlabel is not None:
-        ax.set_xlabel(xlabel)
-    if ylabel is not None:
-        ax.set_ylabel(ylabel)
-    ax.grid(grid)
-    return ax
+                     .replace("@index/name@", "name" if by_name else "index")
+    return _decorate(ax, title=title, xlabel=xlabel, ylabel=ylabel,
+                     xlim=xlim, ylim=ylim, grid=grid)
 
 
 def plot_metric(booster, metric=None, dataset_names=None, ax=None,
                 xlim=None, ylim=None, title="Metric during training",
                 xlabel="Iterations", ylabel="auto", figsize=None, dpi=None,
                 grid=True):
-    """Plot metric curves from evals_result (plotting.py:251-362)."""
-    try:
-        import matplotlib.pyplot as plt
-    except ImportError:
-        raise ImportError("You must install matplotlib to plot metric")
+    """Plot one metric's training curve(s) from recorded eval results."""
+    plt = _require_mpl("plot metric")
     if isinstance(booster, dict):
         eval_results = booster
     else:
         from .sklearn import LGBMModel
-        if isinstance(booster, LGBMModel):
-            eval_results = booster.evals_result_
-        else:
+        if not isinstance(booster, LGBMModel):
             raise TypeError("booster must be dict or LGBMModel")
+        eval_results = booster.evals_result_
     if not eval_results:
         raise ValueError("eval results cannot be empty")
     if ax is None:
-        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
-    if dataset_names is None:
-        dataset_names = iter(eval_results.keys())
-    name = None
-    for name_ in dataset_names:
-        metrics = eval_results[name_]
+        ax = _new_axis(plt, figsize, dpi)
+    for name in (dataset_names or list(eval_results.keys())):
+        curves = eval_results[name]
         if metric is None:
-            metric = next(iter(metrics.keys()))
-        results = metrics[metric]
-        ax.plot(range(len(results)), results, label=name_)
-        name = name_
+            metric = next(iter(curves))
+        series = curves[metric]
+        ax.plot(np.arange(len(series)), series, label=name)
     ax.legend(loc="best")
-    if ylabel == "auto":
-        ylabel = metric
-    if title is not None:
-        ax.set_title(title)
-    if xlabel is not None:
-        ax.set_xlabel(xlabel)
-    if ylabel is not None:
-        ax.set_ylabel(ylabel)
-    ax.grid(grid)
-    return ax
+    return _decorate(ax, title=title, xlabel=xlabel,
+                     ylabel=metric if ylabel == "auto" else ylabel,
+                     xlim=xlim, ylim=ylim, grid=grid)
 
 
 def _tree_to_digraph(tree, feature_names, precision=3, **kwargs):
     try:
         from graphviz import Digraph
-    except ImportError:
-        raise ImportError("You must install graphviz to plot tree")
+    except ImportError as e:
+        raise ImportError("graphviz is required to plot trees") from e
     graph = Digraph(**kwargs)
 
     def fmt(x):
@@ -204,7 +204,7 @@ def _tree_to_digraph(tree, feature_names, precision=3, **kwargs):
 
 def create_tree_digraph(booster, tree_index=0, show_info=None, precision=3,
                         **kwargs):
-    """Digraph of one tree (plotting.py:365-460)."""
+    """Build a graphviz Digraph of one tree."""
     booster = _to_booster(booster)
     gbdt = booster._booster
     models = gbdt._used_models()
@@ -216,19 +216,16 @@ def create_tree_digraph(booster, tree_index=0, show_info=None, precision=3,
 
 def plot_tree(booster, ax=None, tree_index=0, figsize=None, dpi=None,
               show_info=None, precision=3, **kwargs):
-    """Render one tree with matplotlib (plotting.py:555-650)."""
-    try:
-        import matplotlib.image as mpimg
-        import matplotlib.pyplot as plt
-    except ImportError:
-        raise ImportError("You must install matplotlib to plot tree")
+    """Render one tree to a matplotlib axis via graphviz."""
+    plt = _require_mpl("plot tree")
     import io
+
+    import matplotlib.image as mpimg
     graph = create_tree_digraph(booster, tree_index=tree_index,
                                 precision=precision, **kwargs)
-    s = io.BytesIO(graph.pipe(format="png"))
-    img = mpimg.imread(s)
+    img = mpimg.imread(io.BytesIO(graph.pipe(format="png")))
     if ax is None:
-        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+        ax = _new_axis(plt, figsize, dpi)
     ax.imshow(img)
     ax.axis("off")
     return ax

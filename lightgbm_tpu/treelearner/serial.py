@@ -142,6 +142,16 @@ class SerialTreeLearner:
                 rows_per_chunk = 0
         widths = dataset.bin_end - dataset.bin_start \
             if dataset.num_features else np.array([1])
+        window_chunk = int(config.tpu_window_chunk)
+        if window_chunk <= 0:
+            # measured sweet spot on v5e: overwork per split is bounded by
+            # one chunk, so large chunks lose on deep trees' small leaves
+            window_chunk = 2048
+        hist_dtype = str(config.tpu_hist_dtype).lower()
+        if hist_dtype == "auto":
+            import jax
+            hist_dtype = ("f32" if jax.default_backend() == "cpu"
+                          else "bf16x2")
         self.grow_config = GrowConfig(
             num_leaves=int(config.num_leaves),
             total_bins=int(dataset.total_bins),
@@ -153,6 +163,10 @@ class SerialTreeLearner:
             hist_impl=resolve_hist_impl(config),
             scan_width=max(1, int(widths.max())),
             use_dp=resolve_use_dp(config),
+            window_chunk=window_chunk,
+            hist_dtype=hist_dtype,
+            use_l1=float(config.lambda_l1) > 0.0,
+            use_mds=float(config.max_delta_step) > 0.0,
         )
         self.col_sampler = ColSampler(config, dataset.num_features)
         self.cat_layout = build_cat_layout(dataset, cat_width)

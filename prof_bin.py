@@ -1,0 +1,16 @@
+import cProfile
+import pstats
+import sys
+
+from bench import make_higgs_like
+
+import lightgbm_tpu as lgb
+
+X, y = make_higgs_like(500_000)
+pr = cProfile.Profile()
+pr.enable()
+ds = lgb.Dataset(X, y)
+ds.construct()
+pr.disable()
+st = pstats.Stats(pr)
+st.sort_stats("cumulative").print_stats(25)
