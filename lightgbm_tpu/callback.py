@@ -9,7 +9,7 @@ is class-based: each factory returns a small stateful object whose
 """
 from __future__ import annotations
 
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, NamedTuple, Optional
 
 from .utils.log import Log
 
@@ -23,20 +23,18 @@ class EarlyStopException(Exception):
         self.best_score = best_score
 
 
-class CallbackEnv:
-    """State handed to every callback once per iteration."""
+class CallbackEnv(NamedTuple):
+    """State handed to every callback once per iteration.
 
-    __slots__ = ("model", "params", "iteration", "begin_iteration",
-                 "end_iteration", "evaluation_result_list")
-
-    def __init__(self, model, params, iteration, begin_iteration,
-                 end_iteration, evaluation_result_list):
-        self.model = model
-        self.params = params
-        self.iteration = iteration
-        self.begin_iteration = begin_iteration
-        self.end_iteration = end_iteration
-        self.evaluation_result_list = evaluation_result_list
+    A NamedTuple like the reference's, so third-party callbacks that
+    tuple-unpack or index it positionally keep working.
+    """
+    model: object
+    params: dict
+    iteration: int
+    begin_iteration: int
+    end_iteration: int
+    evaluation_result_list: list
 
 
 def _format_eval_result(value, show_stdv: bool = True) -> str:

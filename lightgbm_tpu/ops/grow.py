@@ -8,9 +8,12 @@ scan both children for their best splits} until num_leaves-1 splits or no
 positive gain.
 
 Key TPU design decisions (vs the reference's pointer-chasing structures):
-  * rows are never physically re-ordered: a flat [N] leaf-id vector replaces
-    DataPartition (src/treelearner/data_partition.hpp:21); the split update
-    is a masked `where`, score update is a gather of leaf values;
+  * two row-management strategies: grow_tree (small data) keeps a flat [N]
+    leaf-id vector and masks — no reordering, O(N) per split; grow_tree_
+    partitioned (large data) keeps the row PAYLOADS physically leaf-sorted
+    (the OrderedBin/DataPartition analog, src/io/bin.h:229 +
+    src/treelearner/data_partition.hpp:21) so every pass is a contiguous
+    slice — TPU gathers run on the scalar path and would dominate;
   * per-leaf histograms live in one [num_leaves, total_bins, 2] HBM tensor
     (replacing HistogramPool, feature_histogram.hpp:960) updated with
     dynamic_update_slice inside a lax.while_loop;
@@ -489,18 +492,46 @@ def grow_tree(layout: DataLayout, grad: jnp.ndarray, hess: jnp.ndarray,
 
 
 # ---------------------------------------------------------------------------
-# Partitioned grower: O(rows-in-child) per split via a leaf-sorted row
-# permutation (the DataPartition analog) processed in fixed-size chunks by
-# dynamic-trip-count fori loops (no lax.switch: conditionals force XLA to
-# copy the carried permutation in and out of every branch).
+# Partitioned grower: O(rows-in-child) per split with ZERO row gathers.
+#
+# The reference keeps rows leaf-sorted so histogram loops stream memory
+# (OrderedBin, include/LightGBM/bin.h:229; DataPartition::Split,
+# src/treelearner/data_partition.hpp:101). A TPU cannot afford the index
+# indirection — random row gathers run on the scalar path — so instead of a
+# leaf-sorted *index permutation* this grower maintains the row PAYLOADS
+# (bins, grad, hess, bag flag, original row id) physically leaf-sorted in
+# HBM. Every pass is then a contiguous dynamic_slice, and the reordering
+# itself is done with a one-hot [C, C] pack matmul on the MXU (a permutation
+# expressed as matrix multiply is exact in f32 and runs at systolic-array
+# speed).
+#
+# Per split, two chunked passes over the leaf's segment:
+#   pass A: decide go_left per row, pack rows two-ended into scratch
+#           ([left block ... right block]) via the pack matmul, count in-bag
+#           left rows, and accumulate the SMALLER child's histogram on the
+#           fly (which side is smaller is known beforehand from the split
+#           candidate's counts) — larger child = parent - smaller;
+#   pass B: copy the packed blocks back into the payload buffers
+#           (contiguous, masked tails so neighbouring leaves are untouched)
+#           and stamp the new leaf id on the right block's positions.
+# The final per-row leaf ids are recovered once per tree by scattering the
+# position->leaf map through the carried row ids.
 # ---------------------------------------------------------------------------
 
 class _PartState(NamedTuple):
     s: jnp.ndarray
     done: jnp.ndarray
-    row_leaf: jnp.ndarray       # [N] i32
-    perm: jnp.ndarray           # [N + C] i32 rows grouped by leaf
-    scratch: jnp.ndarray        # [N + C] i32 two-ended packing buffer
+    binsP: jnp.ndarray          # [N + C, G]  leaf-sorted bins
+    gradP: jnp.ndarray          # [N + C] f32
+    hessP: jnp.ndarray          # [N + C] f32
+    bagP: jnp.ndarray           # [N + C] bool
+    ridP: jnp.ndarray           # [N + C] i32 original row id per position
+    posL: jnp.ndarray           # [N + C] i32 leaf id per position
+    binsS: jnp.ndarray          # [N + 3C, G] scratch (writes top out at
+    gradS: jnp.ndarray          # [N + 3C]    N + 2C; the extra C rows are
+    hessS: jnp.ndarray          # [N + 3C]    read slack so the final right
+    bagS: jnp.ndarray           # [N + 3C]    copy-back chunk's slice stays
+    ridS: jnp.ndarray           # [N + 3C]    in range instead of clamping)
     leaf_start: jnp.ndarray     # [L] i32 segment starts (local rows)
     leaf_nrows: jnp.ndarray     # [L] i32 segment lengths (local rows)
     leaf_hist: jnp.ndarray
@@ -515,39 +546,69 @@ class _PartState(NamedTuple):
     tree: TreeArrays
 
 
-def _hist_window_rows(rows, valid, layout: DataLayout, grad, hess,
-                      gc: GrowConfig, gw_global):
-    """Histogram over an index window: gather rows' bins, then either
-    scatter-add (CPU-friendly) or one-hot einsum (MXU-friendly) per
-    gc.hist_impl. Returns [TB, 2] f32."""
-    B = rows.shape[0]
-    TB = gc.total_bins
-    bvals = layout.bins[rows].astype(I32)          # [B, G] group-local bins
-    gw = grad[rows] * valid
-    hw = hess[rows] * valid
-    if gc.hist_impl == "onehot":
-        G, W = gw_global.shape
-        chunk = min(B, 8192)
-        nch = (B + chunk - 1) // chunk
-        pad = nch * chunk - B
-        if pad:
-            bvals = jnp.pad(bvals, ((0, pad), (0, 0)))
-            gw = jnp.pad(gw, (0, pad))
-            hw = jnp.pad(hw, (0, pad))
-        bc = bvals.reshape(nch, chunk, G)
-        vc = jnp.stack([gw, hw], -1).reshape(nch, chunk, 2)
+def _pack_matmul(slot, payload, C):
+    """Permute `payload` rows into their target `slot` via a one-hot matmul.
 
-        def body(i, acc):
-            return acc + _hist_chunk_contract(bc[i], vc[i], W, gc.hist_dtype)
-        hgw = jax.lax.fori_loop(0, nch, body,
-                                jnp.zeros((G, W, 2), jnp.float32))
-        return jnp.zeros((TB, 2), jnp.float32).at[gw_global.reshape(-1)].add(
-            hgw.reshape(-1, 2), mode="drop")
-    idx = bvals + layout.group_offset[None, :]
-    vals = jnp.stack([gw, hw], -1)
-    G = idx.shape[1]
-    flat_vals = jnp.broadcast_to(vals[:, None, :], (B, G, 2)).reshape(-1, 2)
-    return jnp.zeros((TB, 2), jnp.float32).at[idx.reshape(-1)].add(flat_vals)
+    slot: [C] i32 target position (== C drops the row); payload [C, P] f32.
+    Exact: each output row is a sum with exactly one nonzero term — but ONLY
+    at Precision.HIGHEST: the TPU default truncates f32 operands to bf16,
+    which would corrupt row ids/grads in the permuted payload.
+    """
+    slots = jnp.arange(C, dtype=I32)
+    onehot = (slot[None, :] == slots[:, None]).astype(jnp.float32)  # [C, C]
+    return jax.lax.dot(onehot, payload,
+                       precision=jax.lax.Precision.HIGHEST,
+                       preferred_element_type=jnp.float32)
+
+
+def _hist_chunk_accum(acc, bw, gw, hw, gc: GrowConfig, group_offset, W):
+    """Accumulate one chunk's (masked) grad/hess into the running histogram.
+
+    The single shared chunk kernel: "onehot" accumulates the MXU contraction
+    into a [G, W, 2] accumulator (caller scatters to global bins once at the
+    end); "scatter" adds straight into a [TB, 2] accumulator.
+    """
+    vc = jnp.stack([gw, hw], -1)
+    if gc.hist_impl == "onehot":
+        return acc + _hist_chunk_contract(bw, vc, W, gc.hist_dtype)
+    idx = bw + group_offset[None, :]
+    C, G = bw.shape
+    fv = jnp.broadcast_to(vc[:, None, :], (C, G, 2))
+    return acc.at[idx.reshape(-1)].add(fv.reshape(-1, 2))
+
+
+def _hist_acc_init(gc: GrowConfig, G, W):
+    if gc.hist_impl == "onehot":
+        return jnp.zeros((G, W, 2), jnp.float32)
+    return jnp.zeros((gc.total_bins, 2), jnp.float32)
+
+
+def _hist_acc_finish(acc, gc: GrowConfig, gw_global):
+    if gc.hist_impl == "onehot":
+        return jnp.zeros((gc.total_bins, 2), jnp.float32).at[
+            gw_global.reshape(-1)].add(acc.reshape(-1, 2), mode="drop")
+    return acc
+
+
+def _hist_contiguous(binsP, grad, hess, group_offset, start, length, C,
+                     gc: GrowConfig, gw_global):
+    """[TB, 2] histogram over a contiguous payload segment, chunked by C."""
+    G = binsP.shape[1]
+    W = gw_global.shape[1] if gw_global is not None else 0
+    arangeC = jnp.arange(C, dtype=I32)
+    nch = (length + C - 1) // C
+
+    def body(i, acc):
+        off = (start + i * C).astype(I32)
+        bw = jax.lax.dynamic_slice(
+            binsP, (off, jnp.asarray(0, I32)), (C, G)).astype(I32)
+        m = (arangeC < (length - i * C)).astype(jnp.float32)
+        gw = jax.lax.dynamic_slice(grad, (off,), (C,)) * m
+        hw = jax.lax.dynamic_slice(hess, (off,), (C,)) * m
+        return _hist_chunk_accum(acc, bw, gw, hw, gc, group_offset, W)
+
+    acc = jax.lax.fori_loop(0, nch, body, _hist_acc_init(gc, G, W))
+    return _hist_acc_finish(acc, gc, gw_global)
 
 
 @functools.partial(
@@ -558,26 +619,12 @@ def grow_tree_partitioned(layout: DataLayout, grad: jnp.ndarray,
                           feature_mask: jnp.ndarray, fix: FixInfo,
                           gc: GrowConfig, gw_global=None, axis_name=None,
                           cat: CatLayout = None) -> TreeArrays:
-    """Leaf-wise growth with O(rows-in-child) per-split work.
+    """Leaf-wise growth with O(rows-in-child) per-split work and no gathers.
 
-    Same semantics as grow_tree (same trees up to f32 summation order); the
-    difference is HOW child histograms are built: a leaf-sorted permutation
-    (DataPartition, data_partition.hpp:21) is maintained, and each split
-    streams only that leaf's window in fixed gc.window_chunk-row chunks:
-      1. partition pass: chunks are packed two-ended into a scratch buffer
-         (left children ascending from 0, right children descending from the
-         top) — row order inside a leaf is irrelevant to every later
-         computation, so stability is not required;
-      2. copy-back pass: the packed segment is gathered back into the
-         permutation (left block then reversed right block) with a masked
-         tail so neighbouring leaves' rows are untouched;
-      3. histogram pass: the smaller child's chunks accumulate the one-hot
-         MXU contraction (or scatter-add on CPU); larger = parent - smaller
-         (the subtraction trick) as in the reference.
-    All three are lax.fori_loop with data-dependent trip counts: overwork is
-    bounded by ONE chunk per split (the lax.switch budget-class design this
-    replaces wasted up to 2x and, worse, copied the [N] permutation into and
-    out of every conditional branch).
+    Same trees as grow_tree (up to f32 summation order); see the section
+    comment above for the payload-sorting design. Row ids ride along as two
+    f32 columns (4096*hi + lo, both < 2^23) so the pack matmul stays exact
+    for any realistic per-shard row count.
     """
     if cat is None:
         cat = empty_cat_layout(gc.cat_width)
@@ -586,6 +633,7 @@ def grow_tree_partitioned(layout: DataLayout, grad: jnp.ndarray,
     L = gc.num_leaves
     TB = gc.total_bins
     F = gc.num_features
+    G = layout.bins.shape[1]
     C = max(256, int(gc.window_chunk))
     if F == 0 or TB == 0:
         return _single_leaf_tree(n, L, gc.cat_width, grad, hess, bag_mask,
@@ -593,14 +641,28 @@ def grow_tree_partitioned(layout: DataLayout, grad: jnp.ndarray,
     grad = grad.astype(jnp.float32)
     hess = hess.astype(jnp.float32)
     bagf = bag_mask.astype(jnp.float32)
+    bdt = layout.bins.dtype
+    goff = layout.group_offset
 
     def psum(x):
         return jax.lax.psum(x, axis_name) if axis_name is not None else x
 
+    # ---- padded payload buffers ----------------------------------------
+    # PAD covers both the per-split C-windows and the root's bigger chunks
+    # (dynamic_slice clamps out-of-range starts, which would silently shift
+    # a window onto the wrong rows — padding keeps every slice in range)
+    CR = min(max(C, 65536), max(C, n))
+    PAD = max(2 * C, CR)
+    binsP0 = jnp.concatenate([layout.bins, jnp.zeros((PAD, G), bdt)])
+    gradP0 = jnp.concatenate([grad, jnp.zeros((PAD,), jnp.float32)])
+    hessP0 = jnp.concatenate([hess, jnp.zeros((PAD,), jnp.float32)])
+    bagP0 = jnp.concatenate([bag_mask, jnp.zeros((PAD,), BOOL)])
+
     # ---- root ----------------------------------------------------------
-    all_rows = jnp.arange(n, dtype=I32)
-    root_hist = _hist_window_rows(all_rows, bagf, layout, grad, hess, gc,
-                                  gw_global)
+    # root histogram streams the (identity-ordered) payload in big chunks
+    root_hist = _hist_contiguous(binsP0, gradP0 * bagP0, hessP0 * bagP0,
+                                 goff, jnp.asarray(0, I32),
+                                 jnp.asarray(n, I32), CR, gc, gw_global)
     root_hist = psum(root_hist)
     sum_grad = psum(jnp.sum(grad * bagf, dtype=ft))
     sum_hess = psum(jnp.sum(hess * bagf, dtype=ft))
@@ -623,9 +685,17 @@ def grow_tree_partitioned(layout: DataLayout, grad: jnp.ndarray,
     state = _PartState(
         s=jnp.asarray(1, I32),
         done=jnp.asarray(False),
-        row_leaf=jnp.zeros((n,), I32),
-        perm=jnp.concatenate([all_rows, jnp.zeros((C,), I32)]),
-        scratch=jnp.zeros((n + C,), I32),
+        binsP=binsP0,
+        gradP=gradP0,
+        hessP=hessP0,
+        bagP=bagP0,
+        ridP=jnp.arange(n + PAD, dtype=I32),
+        posL=jnp.zeros((n + PAD,), I32),
+        binsS=jnp.zeros((n + 3 * C, G), bdt),
+        gradS=jnp.zeros((n + 3 * C,), jnp.float32),
+        hessS=jnp.zeros((n + 3 * C,), jnp.float32),
+        bagS=jnp.zeros((n + 3 * C,), BOOL),
+        ridS=jnp.zeros((n + 3 * C,), I32),
         leaf_start=jnp.zeros((L,), I32),
         leaf_nrows=jnp.zeros((L,), I32).at[0].set(n),
         leaf_hist=jnp.zeros((L, TB, 2), jnp.float32).at[0].set(root_hist),
@@ -644,7 +714,6 @@ def grow_tree_partitioned(layout: DataLayout, grad: jnp.ndarray,
     state = state._replace(
         best=jax.tree.map(lambda a, v: a.at[0].set(v), state.best, root_cand))
 
-    G = layout.bins.shape[1]
     W = gw_global.shape[1] if gw_global is not None else 0
     arangeC = jnp.arange(C, dtype=I32)
 
@@ -663,18 +732,26 @@ def grow_tree_partitioned(layout: DataLayout, grad: jnp.ndarray,
         g = layout.group_of[f]
         fmeta = (feat_nb[f], meta.missing_type[f], meta.default_bin[f],
                  layout.most_freq_bin[f])
+        # which child is smaller is known BEFORE partitioning from the
+        # candidate's (hessian-recovered) counts; a rare mismatch with the
+        # exact row counts only swaps which side takes the subtraction
+        smaller_is_left = cand.left_count <= cand.right_count
 
-        # ---- pass 1: partition chunks two-ended into scratch -------------
+        # ---- pass A: partition + pack + fused smaller-child histogram ----
         nch = (n_l + C - 1) // C
-        perm_in = st.perm
 
-        def pbody(i, carry):
-            scratch, row_leaf, lf, rf, bagl = carry
-            off = s0 + i * C
-            win = jax.lax.dynamic_slice(perm_in, (off,), (C,))
+        def pa_body(i, carry):
+            (binsS, gradS, hessS, bagS, ridS, lf, rf, bag_left, hacc) = carry
+            off = (s0 + i * C).astype(I32)
+            bw = jax.lax.dynamic_slice(st.binsP,
+                                       (off, jnp.asarray(0, I32)), (C, G))
+            gw = jax.lax.dynamic_slice(st.gradP, (off,), (C,))
+            hw = jax.lax.dynamic_slice(st.hessP, (off,), (C,))
+            bgw = jax.lax.dynamic_slice(st.bagP, (off,), (C,))
+            rw = jax.lax.dynamic_slice(st.ridP, (off,), (C,))
             valid = arangeC < (n_l - i * C)
-            rows = jnp.where(valid, win, 0)
-            col = layout.bins[rows, g].astype(I32) + layout.group_offset[g]
+
+            col = bw[:, g].astype(I32) + goff[g]
             in_range = (col >= meta.bin_start[f]) & (col < meta.bin_end[f])
             local_bin = col - meta.bin_start[f]
             go_left = _go_left_decision(local_bin, in_range, fmeta, cand,
@@ -683,78 +760,110 @@ def grow_tree_partitioned(layout: DataLayout, grad: jnp.ndarray,
             gr = valid & ~go_left
             nL = jnp.sum(gl, dtype=I32)
             nR = jnp.sum(gr, dtype=I32)
-            posL = jnp.cumsum(gl, dtype=I32) - 1
-            posR = (C - nR) + jnp.cumsum(gr, dtype=I32) - 1
-            packedL = jnp.zeros((C,), I32).at[
-                jnp.where(gl, posL, C)].set(win, mode="drop",
-                                            unique_indices=True)
-            packedR = jnp.zeros((C,), I32).at[
-                jnp.where(gr, posR, C)].set(win, mode="drop",
-                                            unique_indices=True)
-            scratch = jax.lax.dynamic_update_slice(scratch, packedL, (lf,))
-            scratch = jax.lax.dynamic_update_slice(scratch, packedR,
-                                                   (rf - C,))
-            right_rows = jnp.where(gr, rows, n)
-            row_leaf = row_leaf.at[right_rows].set(s, mode="drop")
-            bagl = bagl + jnp.sum(jnp.where(gl, bag_mask[rows], False),
-                                  dtype=I32)
-            return scratch, row_leaf, lf + nL, rf - nR, bagl
+            # local slots: left block ascending from 0, right block at the
+            # chunk's end (order within a block is irrelevant)
+            posl = jnp.cumsum(gl, dtype=I32) - 1
+            posr = (C - nR) + jnp.cumsum(gr, dtype=I32) - 1
+            slot = jnp.where(gl, posl, jnp.where(gr, posr, C))
 
-        scratch, row_leaf, n_left, rf_end, bag_left = jax.lax.fori_loop(
-            0, nch, pbody,
-            (st.scratch, st.row_leaf, jnp.asarray(0, I32),
-             jnp.asarray(n + C, I32), jnp.asarray(0, I32)))
+            # split row ids hi/lo IN INTEGER SPACE (each half < 2^23, so the
+            # f32 pack matmul is exact for any per-shard row count < 2^35)
+            rid_hi = (rw // jnp.asarray(4096, I32)).astype(jnp.float32)
+            rid_lo = (rw % jnp.asarray(4096, I32)).astype(jnp.float32)
+            payload = jnp.concatenate([
+                bw.astype(jnp.float32),
+                gw[:, None], hw[:, None], bgw.astype(jnp.float32)[:, None],
+                rid_hi[:, None], rid_lo[:, None],
+            ], axis=1)                                   # [C, G+5]
+            packed = _pack_matmul(slot, payload, C)
+            pb = packed[:, :G].astype(bdt)
+            pg = packed[:, G]
+            ph = packed[:, G + 1]
+            pbag = packed[:, G + 2] > 0.5
+            prid = (packed[:, G + 3].astype(I32) * 4096
+                    + packed[:, G + 4].astype(I32))
+
+            # scratch layout: left blocks stack up from 0, right blocks
+            # stack down from n+2C; the 2C padding keeps the two whole-[C]
+            # writes inside the gap, so they never clobber packed blocks
+            binsS = jax.lax.dynamic_update_slice(binsS, pb, (lf, jnp.asarray(0, I32)))
+            gradS = jax.lax.dynamic_update_slice(gradS, pg, (lf,))
+            hessS = jax.lax.dynamic_update_slice(hessS, ph, (lf,))
+            bagS = jax.lax.dynamic_update_slice(bagS, pbag, (lf,))
+            ridS = jax.lax.dynamic_update_slice(ridS, prid, (lf,))
+            binsS = jax.lax.dynamic_update_slice(binsS, pb, (rf - C, jnp.asarray(0, I32)))
+            gradS = jax.lax.dynamic_update_slice(gradS, pg, (rf - C,))
+            hessS = jax.lax.dynamic_update_slice(hessS, ph, (rf - C,))
+            bagS = jax.lax.dynamic_update_slice(bagS, pbag, (rf - C,))
+            ridS = jax.lax.dynamic_update_slice(ridS, prid, (rf - C,))
+
+            bag_left = bag_left + jnp.sum(gl & bgw, dtype=I32)
+            m = (valid & (go_left == smaller_is_left)).astype(jnp.float32)
+            hacc = _hist_chunk_accum(hacc, bw.astype(I32), gw * m, hw * m,
+                                     gc, goff, W)
+            return (binsS, gradS, hessS, bagS, ridS,
+                    lf + nL, rf - nR, bag_left, hacc)
+
+        (binsS, gradS, hessS, bagS, ridS, n_left, rf_end, bag_left,
+         hacc) = jax.lax.fori_loop(
+            0, nch, pa_body,
+            (st.binsS, st.gradS, st.hessS, st.bagS, st.ridS,
+             jnp.asarray(0, I32), jnp.asarray(n + 2 * C, I32),
+             jnp.asarray(0, I32), _hist_acc_init(gc, G, W)))
         n_right = n_l - n_left
 
-        # ---- pass 2: gather the packed segment back into the permutation -
-        def cbody(i, perm):
-            p = i * C + arangeC
-            src = jnp.where(p < n_left, p, (n + C) - n_l + p)
-            blk = scratch[jnp.clip(src, 0, n + C - 1)]
-            dst = s0 + i * C
-            old = jax.lax.dynamic_slice(perm, (dst,), (C,))
-            blk = jnp.where(p < n_l, blk, old)
-            return jax.lax.dynamic_update_slice(perm, blk, (dst,))
-
-        perm = jax.lax.fori_loop(0, nch, cbody, perm_in)
+        hist_smaller = psum(_hist_acc_finish(hacc, gc, gw_global))
 
         left_cnt = psum(bag_left)
         right_cnt = st.leaf_count[l] - left_cnt
 
-        # ---- pass 3: smaller child's histogram ---------------------------
-        smaller_is_left = left_cnt <= right_cnt
-        start_sm = jnp.where(smaller_is_left, s0, s0 + n_left)
-        len_sm = jnp.where(smaller_is_left, n_left, n_right)
-        nch_h = (len_sm + C - 1) // C
+        # ---- pass B: copy packed blocks back (contiguous, masked tails) --
+        nchL = (n_left + C - 1) // C
+        nchR = (n_right + C - 1) // C
+        right_src0 = jnp.asarray(n + 2 * C, I32) - n_right
 
-        if gc.hist_impl == "onehot":
-            def hbody(i, acc):
-                off = start_sm + i * C
-                win = jax.lax.dynamic_slice(perm, (off,), (C,))
-                valid = (arangeC < (len_sm - i * C)).astype(jnp.float32)
-                rows = jnp.where(valid > 0, win, 0)
-                bv = layout.bins[rows].astype(I32)          # [C, G]
-                vc = jnp.stack([grad[rows] * valid, hess[rows] * valid], -1)
-                return acc + _hist_chunk_contract(bv, vc, W, gc.hist_dtype)
-            hgw = jax.lax.fori_loop(0, nch_h, hbody,
-                                    jnp.zeros((G, W, 2), jnp.float32))
-            hist_smaller = jnp.zeros((TB, 2), jnp.float32).at[
-                gw_global.reshape(-1)].add(hgw.reshape(-1, 2), mode="drop")
-        else:
-            def hbody(i, acc):
-                off = start_sm + i * C
-                win = jax.lax.dynamic_slice(perm, (off,), (C,))
-                valid = (arangeC < (len_sm - i * C)).astype(jnp.float32)
-                rows = jnp.where(valid > 0, win, 0)
-                idx = layout.bins[rows].astype(I32) \
-                    + layout.group_offset[None, :]
-                vals = jnp.stack([grad[rows] * valid, hess[rows] * valid], -1)
-                fv = jnp.broadcast_to(vals[:, None, :], (C, G, 2))
-                return acc.at[idx.reshape(-1)].add(fv.reshape(-1, 2))
-            hist_smaller = jax.lax.fori_loop(
-                0, nch_h, hbody, jnp.zeros((TB, 2), jnp.float32))
+        def copy_back(j, carry, src0, dst0, count, stamp):
+            binsP, gradP, hessP, bagP, ridP, posL = carry
+            src = (src0 + j * C).astype(I32)
+            dst = (dst0 + j * C).astype(I32)
+            keep = arangeC < (count - j * C)
 
-        hist_smaller = psum(hist_smaller)
+            def blend(P, S, is2d):
+                if is2d:
+                    z = jnp.asarray(0, I32)
+                    new = jax.lax.dynamic_slice(S, (src, z), (C, G))
+                    old = jax.lax.dynamic_slice(P, (dst, z), (C, G))
+                    out = jnp.where(keep[:, None], new, old)
+                    return jax.lax.dynamic_update_slice(P, out, (dst, z))
+                new = jax.lax.dynamic_slice(S, (src,), (C,))
+                old = jax.lax.dynamic_slice(P, (dst,), (C,))
+                return jax.lax.dynamic_update_slice(
+                    P, jnp.where(keep, new, old), (dst,))
+
+            binsP = blend(binsP, binsS, True)
+            gradP = blend(gradP, gradS, False)
+            hessP = blend(hessP, hessS, False)
+            bagP = blend(bagP, bagS, False)
+            ridP = blend(ridP, ridS, False)
+            if stamp is not None:
+                oldp = jax.lax.dynamic_slice(posL, (dst,), (C,))
+                posL = jax.lax.dynamic_update_slice(
+                    posL, jnp.where(keep, stamp, oldp), (dst,))
+            return binsP, gradP, hessP, bagP, ridP, posL
+
+        carry0 = (st.binsP, st.gradP, st.hessP, st.bagP, st.ridP, st.posL)
+        carry1 = jax.lax.fori_loop(
+            0, nchL,
+            lambda j, c: copy_back(j, c, jnp.asarray(0, I32), s0,
+                                   n_left, None),
+            carry0)
+        binsP, gradP, hessP, bagP, ridP, posL = jax.lax.fori_loop(
+            0, nchR,
+            lambda j, c: copy_back(j, c, right_src0, s0 + n_left,
+                                   n_right, s),
+            carry1)
+
+        # ---- histograms for both children --------------------------------
         sm_sum_grad = jnp.where(smaller_is_left, cand.left_sum_grad,
                                 cand.right_sum_grad)
         sm_sum_hess = jnp.where(smaller_is_left, cand.left_sum_hess,
@@ -812,8 +921,10 @@ def grow_tree_partitioned(layout: DataLayout, grad: jnp.ndarray,
         tree = _record_split(st.tree, s - 1, do, l, cand, st.leaf_value[l],
                              st.leaf_count[l], s)
         return st._replace(
-            s=s + do.astype(I32), done=~do, row_leaf=row_leaf, perm=perm,
-            scratch=scratch, leaf_start=leaf_start, leaf_nrows=leaf_nrows,
+            s=s + do.astype(I32), done=~do,
+            binsP=binsP, gradP=gradP, hessP=hessP, bagP=bagP, ridP=ridP,
+            posL=posL, binsS=binsS, gradS=gradS, hessS=hessS, bagS=bagS,
+            ridS=ridS, leaf_start=leaf_start, leaf_nrows=leaf_nrows,
             leaf_hist=leaf_hist, leaf_sum_grad=leaf_sum_grad,
             leaf_sum_hess=leaf_sum_hess, leaf_count=leaf_count,
             leaf_value=leaf_value, leaf_depth=leaf_depth,
@@ -821,10 +932,14 @@ def grow_tree_partitioned(layout: DataLayout, grad: jnp.ndarray,
             tree=tree)
 
     final = jax.lax.while_loop(cond, body, state)
+    # per-row leaf ids in original row order: one scatter through the carried
+    # row ids (ridP[:n] is a permutation of 0..n-1)
+    row_leaf = jnp.zeros((n,), I32).at[final.ridP[:n]].set(
+        final.posL[:n], mode="drop", unique_indices=True)
     return final.tree._replace(
         num_leaves=final.s,
         leaf_value=final.leaf_value,
         leaf_count=final.leaf_count,
         leaf_weight=final.leaf_sum_hess,
-        row_leaf=final.row_leaf,
+        row_leaf=row_leaf,
     )

@@ -14,7 +14,8 @@ takes those rows) and are repaired afterwards by ops.split.fix_histogram —
 the analog of the reference's FixHistogram (src/io/dataset.cpp:1410).
 
 The XLA path chunks rows through `lax.fori_loop` to bound the materialized
-update tensor; a Pallas kernel drop-in lives in pallas_histogram.py.
+update tensor. On accelerators the growers use the one-hot MXU contraction
+in ops/grow.py (_hist_chunk_contract) instead of this scatter-add.
 """
 from __future__ import annotations
 

@@ -152,7 +152,9 @@ def plot_metric(booster, metric=None, dataset_names=None, ax=None,
         raise ValueError("eval results cannot be empty")
     if ax is None:
         ax = _new_axis(plt, figsize, dpi)
-    for name in (dataset_names or list(eval_results.keys())):
+    if dataset_names is None:
+        dataset_names = list(eval_results.keys())
+    for name in dataset_names:
         curves = eval_results[name]
         if metric is None:
             metric = next(iter(curves))

@@ -1,0 +1,102 @@
+"""Partitioned (payload-sorting) grower vs masked grower equivalence.
+
+The two growers must produce identical trees on numerical data (identical
+histograms up to f32 summation order; with a fixed seed the argmaxes are
+stable). With categorical features a near-tie in the sorted categorical scan
+can legitimately pick an equal-gain split from the other scan direction, so
+that case asserts prediction-level closeness instead of bit equality.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.data.dataset import BinnedDataset
+from lightgbm_tpu.ops.grow import grow_tree, grow_tree_partitioned
+from lightgbm_tpu.treelearner.serial import SerialTreeLearner
+
+
+def _make(n, seed=3, cats=False):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 8))
+    X[rng.random((n, 8)) < 0.08] = np.nan          # NaN missing
+    X[:, 5] = np.where(rng.random(n) < 0.8, 0.0, X[:, 5])  # sparse zeros
+    if cats:
+        X[:, 2] = rng.integers(0, 12, size=n)
+    y = np.nan_to_num(X[:, 0]) + 0.3 * np.nan_to_num(X[:, 1]) \
+        + rng.normal(size=n) * 0.1
+    return X, y
+
+
+def _grow_both(X, y, leaves, wc, cat_cols=()):
+    n = len(y)
+    cfg = lgb.Config({"num_leaves": leaves, "max_bin": 63,
+                      "min_data_in_leaf": 5, "tpu_window_chunk": wc})
+    ds = BinnedDataset.from_matrix(X, cfg, categorical_features=cat_cols,
+                                   label=y)
+    le = SerialTreeLearner(cfg, ds)
+    g = jnp.asarray((y - y.mean()).astype(np.float32))
+    h = jnp.ones(n, jnp.float32)
+    args = (le.layout, g, h, jnp.ones(n, bool), le.meta, le.params,
+            jnp.ones(ds.num_features, bool), le.fix, le.grow_config)
+    a1 = grow_tree(*args, cat=le.cat_layout)
+    a2 = grow_tree_partitioned(*args, gw_global=le.gw_global,
+                               cat=le.cat_layout)
+    return ds, le, a1, a2
+
+
+@pytest.mark.parametrize("wc,leaves", [(256, 31), (1024, 63), (256, 4)])
+def test_partitioned_matches_masked_numerical(wc, leaves):
+    X, y = _make(4000)
+    _, _, a1, a2 = _grow_both(X, y, leaves, wc)
+    for fld in a1._fields:
+        if fld == "default_left":
+            # when a leaf holds no missing rows the forward/reverse scans tie
+            # exactly and ulp-level histogram differences pick either winner;
+            # routing is identical either way (leaf_count/row_leaf prove it)
+            continue
+        v1, v2 = np.asarray(getattr(a1, fld)), np.asarray(getattr(a2, fld))
+        if v1.dtype.kind == "f":
+            np.testing.assert_allclose(v1, v2, rtol=1e-4, atol=1e-6,
+                                       err_msg=fld)
+        else:
+            np.testing.assert_array_equal(v1, v2, err_msg=fld)
+
+
+def test_partitioned_row_leaf_is_consistent_partition():
+    """row_leaf must agree with the recorded split decisions row by row."""
+    X, y = _make(3000, seed=11)
+    ds, le, _, a2 = _grow_both(X, y, 31, 512)
+    rl = np.asarray(a2.row_leaf)
+    counts = np.bincount(rl, minlength=31)
+    np.testing.assert_array_equal(
+        counts[:int(a2.num_leaves)],
+        np.asarray(a2.leaf_count)[:int(a2.num_leaves)])
+
+
+def test_partitioned_categorical_close():
+    X, y = _make(4000, cats=True)
+    _, _, a1, a2 = _grow_both(X, y, 63, 1024, cat_cols=[2])
+    # same number of leaves and near-identical gains even if a near-tie picks
+    # a different equal-gain categorical mask
+    assert int(a1.num_leaves) == int(a2.num_leaves)
+    np.testing.assert_allclose(np.asarray(a1.gain), np.asarray(a2.gain),
+                               rtol=1e-3, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(a1.leaf_value).sum(),
+                               np.asarray(a2.leaf_value).sum(),
+                               rtol=1e-2, atol=1e-4)
+
+
+def test_train_partitioned_end_to_end(monkeypatch):
+    """Full train loop through the partitioned path (patched threshold)."""
+    import lightgbm_tpu.treelearner.serial as serial_mod
+    monkeypatch.setattr(serial_mod, "PARTITION_MIN_ROWS", 100)
+    X, y = _make(3000, seed=7)
+    labels = (y > np.median(y)).astype(float)
+    bst = lgb.train({"objective": "binary", "num_leaves": 31,
+                     "verbosity": -1}, lgb.Dataset(X, labels), 10,
+                    verbose_eval=False)
+    p = bst.predict(X)
+    acc = ((p > 0.5) == labels).mean()
+    assert acc > 0.85, acc
