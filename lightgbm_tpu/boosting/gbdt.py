@@ -254,7 +254,15 @@ class GBDT:
         if not self._pending:
             return
         import jax
-        host_arrays = jax.device_get([p[1] for p in self._pending])
+        # one stacked transfer per FIELD, not per (tree, field): the host
+        # Tree never reads row_leaf (it exists for device score updates),
+        # and under remote-TPU dispatch every D2H round trip costs ~100ms+
+        empty_rl = jnp.zeros((0,), jnp.int32)
+        stripped = [p[1]._replace(row_leaf=empty_rl) for p in self._pending]
+        batched = jax.tree.map(lambda *xs: jnp.stack(xs), *stripped)
+        host_batched = jax.device_get(batched)
+        host_arrays = [jax.tree.map(lambda a, i=i: a[i], host_batched)
+                       for i in range(len(stripped))]
         stop_pos = None
         for (pos, _, k, shrink, init), ha in zip(self._pending, host_arrays):
             tree = Tree.from_grower(ha, self.train_data)

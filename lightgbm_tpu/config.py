@@ -197,6 +197,7 @@ PARAMS: List[_P] = [
     _P("tpu_donate_buffers", bool, True),
     _P("tpu_window_chunk", int, 0),          # 0 = auto; partitioned-grower chunk rows
     _P("tpu_hist_dtype", str, "auto"),       # auto | f32 | bf16x2
+    _P("tpu_pack_impl", str, "sort"),        # sort | matmul (partition pack)
 ]
 
 _BY_NAME: Dict[str, _P] = {p.name: p for p in PARAMS}

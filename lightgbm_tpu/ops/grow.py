@@ -67,13 +67,15 @@ class GrowConfig(NamedTuple):
     max_depth: int          # <=0: unlimited
     rows_per_chunk: int     # histogram chunking; 0 = one shot
     cat_width: int          # width of categorical bitmask (1 if no cat feats)
-    hist_impl: str = "scatter"   # "scatter" (CPU) | "onehot" (MXU einsum)
+    hist_impl: str = "scatter"   # "scatter" (CPU) | "onehot" (XLA einsum)
+    #                            # | "pallas" (VMEM one-hot MXU kernel)
     scan_width: int = 0     # dense scan width (0 = min(total_bins, 256))
     use_dp: bool = True     # f64 (CPU default) vs f32 (TPU default) math
     window_chunk: int = 2048  # streaming chunk of the partitioned grower
     use_l1: bool = True     # lambda_l1 > 0 (USE_L1 template analog)
     use_mds: bool = True    # max_delta_step > 0 (USE_MAX_OUTPUT analog)
     hist_dtype: str = "f32"  # "f32" | "bf16x2" (hi/lo split bf16 MXU)
+    pack_impl: str = "sort"  # "sort" (lax.sort, exact) | "matmul" (one-hot)
 
 
 class FixInfo(NamedTuple):
@@ -546,14 +548,13 @@ class _PartState(NamedTuple):
     tree: TreeArrays
 
 
-def _pack_matmul(slot, payload, C):
-    """Permute `payload` rows into their target `slot` via a one-hot matmul.
+U32 = jnp.uint32
 
-    slot: [C] i32 target position (== C drops the row); payload [C, P] f32.
-    Exact: each output row is a sum with exactly one nonzero term — but ONLY
-    at Precision.HIGHEST: the TPU default truncates f32 operands to bf16,
-    which would corrupt row ids/grads in the permuted payload.
-    """
+
+def _pack_matmul(slot, payload, C):
+    """Permute `payload` rows into their target `slot` via a one-hot matmul
+    at Precision.HIGHEST (the TPU default truncates f32 operands to bf16,
+    which would corrupt row ids/grads in the permuted payload)."""
     slots = jnp.arange(C, dtype=I32)
     onehot = (slot[None, :] == slots[:, None]).astype(jnp.float32)  # [C, C]
     return jax.lax.dot(onehot, payload,
@@ -561,13 +562,72 @@ def _pack_matmul(slot, payload, C):
                        preferred_element_type=jnp.float32)
 
 
+def _bits_of(bdt) -> int:
+    return jnp.dtype(bdt).itemsize * 8
+
+
+def _bitpack_cols(bw, bits: int):
+    """[C, G] narrow ints -> [C, ncol] u32, `32 // bits` values per column."""
+    per = 32 // bits
+    C, G = bw.shape
+    ncol = (G + per - 1) // per
+    pad = ncol * per - G
+    w = bw.astype(U32)
+    if pad:
+        w = jnp.pad(w, ((0, 0), (0, pad)))
+    shifts = (jnp.arange(per, dtype=U32) * U32(bits))[None, None, :]
+    return jnp.sum(w.reshape(C, ncol, per) << shifts, axis=-1, dtype=U32)
+
+
+def _bitunpack_cols(packed, bits: int, G: int, bdt):
+    per = 32 // bits
+    C, ncol = packed.shape
+    shifts = (jnp.arange(per, dtype=U32) * U32(bits))[None, None, :]
+    mask = U32((1 << bits) - 1)
+    vals = (packed[:, :, None] >> shifts) & mask
+    return vals.reshape(C, ncol * per)[:, :G].astype(bdt)
+
+
+def _pack_sort(key, bw, gw, hw, bgw, rw, bits: int):
+    """Two-way partition of a chunk's payload via one vectorized sort.
+
+    key: [C] u32 with 0 = left, 1 = invalid, 2 = right, so the sorted chunk
+    is [left block | dropped rows | right block] — the same two-ended layout
+    the scratch writes expect. Payload rides as u32 columns (bins bit-packed,
+    grad/hess bit-cast, row id and bag flag packed together), so the pack is
+    EXACT by construction: lax.sort moves words, it never does arithmetic.
+    Returns (bins [C, G_as_input], grad, hess, bag, rid).
+    """
+    C, G = bw.shape
+    bin_cols = _bitpack_cols(bw, bits)
+    g_u = jax.lax.bitcast_convert_type(gw, U32)
+    h_u = jax.lax.bitcast_convert_type(hw, U32)
+    ridbag = rw.astype(U32) | (bgw.astype(U32) << U32(30))
+    ops = [key] + [bin_cols[:, i] for i in range(bin_cols.shape[1])] \
+        + [g_u, h_u, ridbag]
+    out = jax.lax.sort(ops, num_keys=1, is_stable=False)
+    nbc = bin_cols.shape[1]
+    pb = _bitunpack_cols(jnp.stack(out[1:1 + nbc], axis=-1), bits, G,
+                         bw.dtype)
+    pg = jax.lax.bitcast_convert_type(out[1 + nbc], jnp.float32)
+    ph = jax.lax.bitcast_convert_type(out[2 + nbc], jnp.float32)
+    prb = out[3 + nbc]
+    pbag = ((prb >> U32(30)) & U32(1)).astype(BOOL)
+    prid = (prb & U32((1 << 30) - 1)).astype(I32)
+    return pb, pg, ph, pbag, prid
+
+
 def _hist_chunk_accum(acc, bw, gw, hw, gc: GrowConfig, group_offset, W):
     """Accumulate one chunk's (masked) grad/hess into the running histogram.
 
-    The single shared chunk kernel: "onehot" accumulates the MXU contraction
-    into a [G, W, 2] accumulator (caller scatters to global bins once at the
-    end); "scatter" adds straight into a [TB, 2] accumulator.
+    The single shared chunk kernel: "pallas" (TPU default) runs the VMEM
+    one-hot MXU kernel; "onehot" is the XLA einsum equivalent; both use a
+    [G, W, 2] accumulator the caller scatters to global bins once at the
+    end. "scatter" (CPU) adds straight into a [TB, 2] accumulator.
     """
+    if gc.hist_impl == "pallas":
+        from .pallas_histogram import hist_window
+        return acc + hist_window(bw.T, gw, hw, W)
     vc = jnp.stack([gw, hw], -1)
     if gc.hist_impl == "onehot":
         return acc + _hist_chunk_contract(bw, vc, W, gc.hist_dtype)
@@ -578,13 +638,13 @@ def _hist_chunk_accum(acc, bw, gw, hw, gc: GrowConfig, group_offset, W):
 
 
 def _hist_acc_init(gc: GrowConfig, G, W):
-    if gc.hist_impl == "onehot":
+    if gc.hist_impl in ("onehot", "pallas"):
         return jnp.zeros((G, W, 2), jnp.float32)
     return jnp.zeros((gc.total_bins, 2), jnp.float32)
 
 
 def _hist_acc_finish(acc, gc: GrowConfig, gw_global):
-    if gc.hist_impl == "onehot":
+    if gc.hist_impl in ("onehot", "pallas"):
         return jnp.zeros((gc.total_bins, 2), jnp.float32).at[
             gw_global.reshape(-1)].add(acc.reshape(-1, 2), mode="drop")
     return acc
@@ -653,16 +713,22 @@ def grow_tree_partitioned(layout: DataLayout, grad: jnp.ndarray,
     # a window onto the wrong rows — padding keeps every slice in range)
     CR = min(max(C, 65536), max(C, n))
     PAD = max(2 * C, CR)
+    # row ids share a u32 with the bag bit in the pack sort
+    assert n + PAD < (1 << 30), "per-shard row count must be < 2^30"
     binsP0 = jnp.concatenate([layout.bins, jnp.zeros((PAD, G), bdt)])
     gradP0 = jnp.concatenate([grad, jnp.zeros((PAD,), jnp.float32)])
     hessP0 = jnp.concatenate([hess, jnp.zeros((PAD,), jnp.float32)])
     bagP0 = jnp.concatenate([bag_mask, jnp.zeros((PAD,), BOOL)])
 
     # ---- root ----------------------------------------------------------
-    # root histogram streams the (identity-ordered) payload in big chunks
+    # root histogram streams the (identity-ordered) payload in big chunks;
+    # the XLA einsum path materializes a [chunk, G, W] one-hot, so cap its
+    # chunk (the Pallas kernel re-tiles internally and takes the full CR)
+    root_chunk = CR if gc.hist_impl != "onehot" else min(CR, 8192)
     root_hist = _hist_contiguous(binsP0, gradP0 * bagP0, hessP0 * bagP0,
                                  goff, jnp.asarray(0, I32),
-                                 jnp.asarray(n, I32), CR, gc, gw_global)
+                                 jnp.asarray(n, I32), root_chunk, gc,
+                                 gw_global)
     root_hist = psum(root_hist)
     sum_grad = psum(jnp.sum(grad * bagf, dtype=ft))
     sum_hess = psum(jnp.sum(hess * bagf, dtype=ft))
@@ -760,28 +826,30 @@ def grow_tree_partitioned(layout: DataLayout, grad: jnp.ndarray,
             gr = valid & ~go_left
             nL = jnp.sum(gl, dtype=I32)
             nR = jnp.sum(gr, dtype=I32)
-            # local slots: left block ascending from 0, right block at the
-            # chunk's end (order within a block is irrelevant)
-            posl = jnp.cumsum(gl, dtype=I32) - 1
-            posr = (C - nR) + jnp.cumsum(gr, dtype=I32) - 1
-            slot = jnp.where(gl, posl, jnp.where(gr, posr, C))
-
-            # split row ids hi/lo IN INTEGER SPACE (each half < 2^23, so the
-            # f32 pack matmul is exact for any per-shard row count < 2^35)
-            rid_hi = (rw // jnp.asarray(4096, I32)).astype(jnp.float32)
-            rid_lo = (rw % jnp.asarray(4096, I32)).astype(jnp.float32)
-            payload = jnp.concatenate([
-                bw.astype(jnp.float32),
-                gw[:, None], hw[:, None], bgw.astype(jnp.float32)[:, None],
-                rid_hi[:, None], rid_lo[:, None],
-            ], axis=1)                                   # [C, G+5]
-            packed = _pack_matmul(slot, payload, C)
-            pb = packed[:, :G].astype(bdt)
-            pg = packed[:, G]
-            ph = packed[:, G + 1]
-            pbag = packed[:, G + 2] > 0.5
-            prid = (packed[:, G + 3].astype(I32) * 4096
-                    + packed[:, G + 4].astype(I32))
+            # pack orders the chunk [left | dropped | right]; writing the
+            # whole packed block at lf puts the left block in place, writing
+            # it again at rf - C puts the right block's end exactly at rf
+            if gc.pack_impl == "sort":
+                key = jnp.where(gl, U32(0), jnp.where(gr, U32(2), U32(1)))
+                pb, pg, ph, pbag, prid = _pack_sort(key, bw, gw, hw, bgw, rw,
+                                                    _bits_of(bdt))
+            else:
+                posl = jnp.cumsum(gl, dtype=I32) - 1
+                posr = (C - nR) + jnp.cumsum(gr, dtype=I32) - 1
+                slot = jnp.where(gl, posl, jnp.where(gr, posr, C))
+                rid_hi = (rw // jnp.asarray(4096, I32)).astype(jnp.float32)
+                rid_lo = (rw % jnp.asarray(4096, I32)).astype(jnp.float32)
+                payload = jnp.concatenate([
+                    bw.astype(jnp.float32), gw[:, None], hw[:, None],
+                    bgw.astype(jnp.float32)[:, None],
+                    rid_hi[:, None], rid_lo[:, None]], axis=1)
+                packed = _pack_matmul(slot, payload, C)
+                pb = packed[:, :G].astype(bdt)
+                pg = packed[:, G]
+                ph = packed[:, G + 1]
+                pbag = packed[:, G + 2] > 0.5
+                prid = (packed[:, G + 3].astype(I32) * 4096
+                        + packed[:, G + 4].astype(I32))
 
             # scratch layout: left blocks stack up from 0, right blocks
             # stack down from n+2C; the 2C padding keeps the two whole-[C]

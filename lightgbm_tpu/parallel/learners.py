@@ -117,7 +117,8 @@ class DataParallelTreeLearner(SerialTreeLearner):
     def train(self, grad: jnp.ndarray, hess: jnp.ndarray,
               bag_mask: jnp.ndarray) -> Tuple[Tree, jnp.ndarray]:
         arrays = self.train_arrays(grad, hess, bag_mask)
-        host = jax.tree.map(np.asarray, arrays)
+        host = jax.device_get(
+            arrays._replace(row_leaf=jnp.zeros((0,), jnp.int32)))
         tree = Tree.from_grower(host, self.dataset)
         return tree, arrays.row_leaf
 

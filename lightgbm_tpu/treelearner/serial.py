@@ -32,14 +32,26 @@ PARTITION_MIN_ROWS = 65536
 
 
 def resolve_hist_impl(config: Config) -> str:
-    """'auto' -> one-hot einsum on accelerators (MXU), scatter-add on CPU."""
+    """'auto' -> Pallas VMEM one-hot kernel on TPU, XLA einsum on other
+    accelerators, scatter-add on CPU."""
     impl = str(config.tpu_histogram_impl).lower()
     if impl in ("xla", "scatter"):
         return "scatter"
-    if impl in ("onehot", "pallas"):
-        return "onehot"
     import jax
-    return "scatter" if jax.default_backend() == "cpu" else "onehot"
+    backend = jax.default_backend()
+    from ..ops.pallas_histogram import HAS_PALLAS
+    pallas_ok = HAS_PALLAS and backend in ("tpu", "axon")
+    if impl == "onehot":
+        return impl
+    if impl == "pallas":
+        if not pallas_ok:
+            Log.warning("tpu_histogram_impl=pallas unavailable on backend "
+                        "%s; falling back to onehot" % backend)
+            return "onehot"
+        return impl
+    if backend == "cpu":
+        return "scatter"
+    return "pallas" if pallas_ok else "onehot"
 
 
 def resolve_use_dp(config: Config) -> bool:
@@ -144,9 +156,9 @@ class SerialTreeLearner:
             if dataset.num_features else np.array([1])
         window_chunk = int(config.tpu_window_chunk)
         if window_chunk <= 0:
-            # measured sweet spot on v5e: overwork per split is bounded by
-            # one chunk, so large chunks lose on deep trees' small leaves
-            window_chunk = 2048
+            # measured sweet spot on v5e with the sort pack + Pallas
+            # histogram kernel; overwork per split is bounded by one chunk
+            window_chunk = 8192
         hist_dtype = str(config.tpu_hist_dtype).lower()
         if hist_dtype == "auto":
             import jax
@@ -167,6 +179,7 @@ class SerialTreeLearner:
             hist_dtype=hist_dtype,
             use_l1=float(config.lambda_l1) > 0.0,
             use_mds=float(config.max_delta_step) > 0.0,
+            pack_impl=str(config.tpu_pack_impl).lower(),
         )
         self.col_sampler = ColSampler(config, dataset.num_features)
         self.cat_layout = build_cat_layout(dataset, cat_width)
@@ -200,7 +213,10 @@ class SerialTreeLearner:
         """
         arrays = self.train_arrays(grad, hess, bag_mask)
         import jax
-        host = jax.tree.map(np.asarray, arrays)
+        # row_leaf stays on device: the host Tree never reads it and the
+        # [N] transfer would dominate under remote-TPU dispatch
+        host = jax.device_get(
+            arrays._replace(row_leaf=jnp.zeros((0,), jnp.int32)))
         tree = Tree.from_grower(host, self.dataset)
         return tree, arrays.row_leaf
 
