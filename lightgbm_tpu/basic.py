@@ -370,6 +370,28 @@ class Booster:
         return out
 
     # ------------------------------------------------------------------
+    def refit(self, data, label, decay_rate: float = 0.9,
+              **kwargs) -> "Booster":
+        """Refit the existing model's leaf values to new data
+        (reference Booster.refit, basic.py:2614 / GBDT::RefitTree): tree
+        structures are kept; each leaf output is re-estimated from the new
+        data's gradients and blended by decay_rate."""
+        import copy
+        self._booster._materialize_pending()
+        if not self._booster.models:
+            raise LightGBMError("Cannot refit an empty model")
+        X, _, _ = _data_to_2d(data)
+        params = dict(self.params)
+        params.pop("input_model", None)
+        new_set = Dataset(X, label, params=params)
+        new_booster = Booster(params=params, train_set=new_set)
+        self._booster._materialize_pending()
+        new_booster._booster.models = [copy.deepcopy(t)
+                                       for t in self._booster.models]
+        new_booster._booster.refit(np.ascontiguousarray(X, np.float64),
+                                   decay_rate=float(decay_rate))
+        return new_booster
+
     def set_train_data_name(self, name: str) -> "Booster":
         self._train_data_name = name
         return self
@@ -490,11 +512,27 @@ class Booster:
             return self._booster.predict_leaf_index(
                 X, start_iteration, num_iteration)
         if pred_contrib:
-            raise LightGBMError("pred_contrib (SHAP) is not implemented yet "
-                                "on device_type=tpu")
+            return self._booster.predict_contrib(
+                X, start_iteration, num_iteration)
+        early_stop = None
+        # the reference only honors pred_early_stop where accuracy is not
+        # required (binary/multiclass objectives, NeedAccuratePrediction)
+        obj = getattr(self._booster, "objective", None)
+        es_ok = obj is not None and getattr(obj, "name", "") in (
+            "binary", "multiclass", "multiclassova")
+        if es_ok and kwargs.get(
+                "pred_early_stop", self.params.get("pred_early_stop",
+                                                   False)):
+            early_stop = (
+                int(kwargs.get("pred_early_stop_freq",
+                               self.params.get("pred_early_stop_freq", 10))),
+                float(kwargs.get("pred_early_stop_margin",
+                                 self.params.get("pred_early_stop_margin",
+                                                 10.0))))
         return self._booster.predict(X, raw_score=raw_score,
                                      start_iteration=start_iteration,
-                                     num_iteration=num_iteration)
+                                     num_iteration=num_iteration,
+                                     early_stop=early_stop)
 
     # ------------------------------------------------------------------
     def model_to_string(self, num_iteration: Optional[int] = None,

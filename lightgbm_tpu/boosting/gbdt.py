@@ -66,6 +66,14 @@ class GBDT:
     # ------------------------------------------------------------------
     def init(self, config: Config, train_data, objective,
              training_metrics=()) -> None:
+        if str(config.forcedsplits_filename):
+            Log.fatal("forcedsplits_filename is not supported on "
+                      "device_type=tpu yet (ForceSplits, "
+                      "serial_tree_learner.cpp:411)")
+        if float(config.histogram_pool_size) > 0:
+            Log.warning("histogram_pool_size is ignored on device_type=tpu: "
+                        "all per-leaf histograms stay HBM-resident "
+                        "([num_leaves, total_bins, 2] tensor)")
         self.config = config
         self.train_data = train_data
         self.objective = objective
@@ -388,6 +396,45 @@ class GBDT:
         for su in self.valid_score:
             su.add_tree(tree, tree_id)
 
+    def refit(self, X: np.ndarray, decay_rate: float = 0.9) -> None:
+        """Refit leaf values on this booster's train data keeping the tree
+        structures (GBDT::RefitTree, gbdt.cpp:267 + FitByExistingTree /
+        CalculateSplittedLeafOutput): boost through the existing trees,
+        re-estimating each leaf's output from the gradients at the staged
+        scores and blending old/new by decay_rate. The objective must be
+        bound to the refit dataset (Booster.refit builds such a booster)."""
+        self._materialize_pending()
+        X = np.ascontiguousarray(X, dtype=np.float64)
+        n = X.shape[0]
+        ntpi = self.num_tree_per_iteration
+        cfg = self.config
+        if self.objective is None:
+            Log.fatal("Cannot refit a booster without an objective")
+        score = np.zeros((ntpi, n))
+        lam1, lam2 = float(cfg.lambda_l1), float(cfg.lambda_l2)
+        mds = float(cfg.max_delta_step)
+        for it in range(len(self.models) // ntpi):
+            sc_dev = jnp.asarray(score[0] if ntpi == 1 else score)
+            g, h = self.objective.get_gradients(sc_dev)
+            g = np.asarray(g).reshape(ntpi, n)
+            h = np.asarray(h).reshape(ntpi, n)
+            for k in range(ntpi):
+                tree = self.models[it * ntpi + k]
+                nl = max(tree.num_leaves, 1)
+                leaves = tree.predict_leaf(X)
+                sg = np.bincount(leaves, weights=g[k], minlength=nl)[:nl]
+                sh = np.bincount(leaves, weights=h[k], minlength=nl)[:nl]
+                thr = np.sign(sg) * np.maximum(0.0, np.abs(sg) - lam1)
+                out = -thr / (sh + lam2 + 1e-15)
+                if mds > 0:
+                    out = np.sign(out) * np.minimum(np.abs(out), mds)
+                out *= self.shrinkage_rate
+                old = tree.leaf_value[:nl]
+                tree.leaf_value[:nl] = (decay_rate * old
+                                        + (1 - decay_rate) * out)
+                tree.leaf_count[:nl] = np.bincount(leaves, minlength=nl)[:nl]
+                score[k] += tree.leaf_value[leaves]
+
     def rollback_one_iter(self) -> None:
         """gbdt.cpp:422-438."""
         self._materialize_pending()
@@ -494,23 +541,48 @@ class GBDT:
         return self.models[start * ntpi:end * ntpi]
 
     def predict_raw(self, X: np.ndarray, start_iteration=0,
-                    num_iteration=-1) -> np.ndarray:
-        """Raw scores [N, ntpi] (PredictRaw)."""
+                    num_iteration=-1, early_stop=None) -> np.ndarray:
+        """Raw scores [N, ntpi] (PredictRaw).
+
+        early_stop: optional (freq, margin) — the margin-based prediction
+        early exit of src/boosting/prediction_early_stop.cpp: every `freq`
+        iterations, rows whose margin (binary: 2|score|; multiclass: top1 -
+        top2) already exceeds `margin` stop accumulating further trees.
+        """
         X = np.ascontiguousarray(X, dtype=np.float64)
         n = X.shape[0]
         ntpi = self.num_tree_per_iteration
         out = np.zeros((n, ntpi))
         models = self._used_models(start_iteration, num_iteration)
-        for i, tree in enumerate(models):
-            out[:, i % ntpi] += tree.predict(X)
+        if early_stop is None:
+            for i, tree in enumerate(models):
+                out[:, i % ntpi] += tree.predict(X)
+        else:
+            freq, margin = early_stop
+            freq = max(int(freq), 1)
+            active = np.ones(n, dtype=bool)
+            idx = np.arange(n)
+            for i, tree in enumerate(models):
+                if not active.any():
+                    break
+                sub = idx[active]
+                out[sub, i % ntpi] += tree.predict(X[sub])
+                if (i + 1) % (freq * ntpi) == 0:
+                    if ntpi == 1:
+                        m = 2.0 * np.abs(out[sub, 0])
+                    else:
+                        top2 = np.partition(out[sub], -2, axis=1)[:, -2:]
+                        m = top2[:, 1] - top2[:, 0]
+                    active[sub[m >= margin]] = False
         if self.average_output:
             niter = max(len(models) // ntpi, 1)
             out /= niter
         return out
 
     def predict(self, X: np.ndarray, raw_score=False, start_iteration=0,
-                num_iteration=-1) -> np.ndarray:
-        raw = self.predict_raw(X, start_iteration, num_iteration)
+                num_iteration=-1, early_stop=None) -> np.ndarray:
+        raw = self.predict_raw(X, start_iteration, num_iteration,
+                               early_stop=early_stop)
         if not raw_score and self.objective is not None:
             if self.num_tree_per_iteration == 1:
                 return self.objective.convert_output(raw[:, 0])
@@ -525,6 +597,28 @@ class GBDT:
         for i, tree in enumerate(models):
             out[:, i] = tree.predict_leaf(X)
         return out
+
+    def predict_contrib(self, X: np.ndarray, start_iteration=0,
+                        num_iteration=-1) -> np.ndarray:
+        """SHAP feature contributions (GBDT::PredictContrib, gbdt.cpp:574):
+        per class, [N, num_features + 1] where columns sum to the raw score
+        and the last column is the expected value."""
+        X = np.ascontiguousarray(X, dtype=np.float64)
+        n = X.shape[0]
+        ntpi = self.num_tree_per_iteration
+        nf = self.max_feature_idx + 1
+        models = self._used_models(start_iteration, num_iteration)
+        phis = [np.zeros((n, nf + 1)) for _ in range(ntpi)]
+        for i, tree in enumerate(models):
+            tree.predict_contrib(X, nf, phis[i % ntpi])
+        if self.average_output:
+            niter = max(len(models) // ntpi, 1)
+            for p in phis:
+                p /= niter
+        if ntpi == 1:
+            return phis[0]
+        # reference layout: per-row concatenation over classes
+        return np.concatenate(phis, axis=1)
 
     # ------------------------------------------------------------------
     def feature_importance(self, importance_type: str = "split",

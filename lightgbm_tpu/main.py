@@ -3,8 +3,8 @@
 TPU-native rebuild of src/main.cpp + src/application/application.cpp: parse
 `key=value` args and an optional `config=<file>` (CLI wins over file,
 application.cpp:49-82), dispatch on `task` (train :164-210, predict
-:212-240; convert_model and refit report unimplemented for now). Usage is
-CLI-compatible with the reference:
+:212-240, refit via GBDT::RefitTree; convert_model reports unimplemented).
+Usage is CLI-compatible with the reference:
 
     python -m lightgbm_tpu config=train.conf [key=value ...]
 """
@@ -35,10 +35,23 @@ class Application:
             self.predict()
         elif task == "convert_model":
             Log.fatal("convert_model is not supported on device_type=tpu yet")
-        elif task == "refit":
-            Log.fatal("refit task is not supported on device_type=tpu yet")
+        elif task in ("refit", "refit_tree"):
+            self.refit()
         else:
             Log.fatal("Unknown task type %s" % task)
+
+    # ------------------------------------------------------------------
+    def refit(self):
+        """Refit task (application.cpp refit path + GBDT::RefitTree)."""
+        cfg = self.config
+        if not cfg.input_model:
+            Log.fatal("Need input_model for refit task")
+        booster = Booster(model_file=cfg.input_model, params=cfg.to_dict())
+        loaded = load_text_file(cfg.data, cfg)
+        new_b = booster.refit(loaded.X, loaded.label,
+                              decay_rate=cfg.refit_decay_rate)
+        new_b.save_model(cfg.output_model)
+        Log.info("Finished refit; model saved to %s" % cfg.output_model)
 
     # ------------------------------------------------------------------
     def train(self):

@@ -168,6 +168,12 @@ class Tree:
                   if self.right_child[k] < 0
                   else self.internal_weight[self.right_child[k]])
             self.internal_weight[k] = lw + rw
+        self._fill_leaf_depth()
+
+    def _fill_leaf_depth(self) -> None:
+        n = self.num_leaves
+        if n <= 1:
+            return
         depth = np.zeros(n - 1, dtype=np.int32)
         for k in range(n - 1):
             for child in (self.left_child[k], self.right_child[k]):
@@ -254,6 +260,73 @@ class Tree:
         if self.num_leaves <= 1:
             return np.full(X.shape[0], self.leaf_value[0])
         return self.leaf_value[self.predict_leaf(X)]
+
+    # -- SHAP feature contributions ------------------------------------
+    def expected_value(self) -> float:
+        """Count-weighted mean leaf value (Tree SHAP base value)."""
+        nl = self.num_leaves
+        cnt = self.leaf_count[:nl].astype(np.float64)
+        tot = cnt.sum()
+        if tot <= 0:
+            return float(self.leaf_value[:nl].mean())
+        return float((self.leaf_value[:nl] * cnt).sum() / tot)
+
+    def _decision_matrix(self, X: np.ndarray) -> np.ndarray:
+        """[rows, internal_nodes] go-left decisions (the same vectorized
+        Tree::Decision used for prediction, evaluated at EVERY node)."""
+        n = X.shape[0]
+        ni = self.num_leaves - 1
+        out = np.zeros((n, ni), dtype=np.uint8)
+        for j in range(ni):
+            fv = X[:, self.split_feature[j]]
+            out[:, j] = self._decision(fv, np.full(n, j, dtype=np.int32))
+        return out
+
+    def predict_contrib(self, X: np.ndarray, num_features: int,
+                        phi: Optional[np.ndarray] = None) -> np.ndarray:
+        """Accumulate per-feature SHAP contributions into phi
+        [rows, num_features + 1] (last column = expected value).
+
+        TreeSHAP, the same attribution the reference's PredictContrib
+        computes (tree.h:137); topology recursion runs in native C++
+        (native/treeshap.cpp) with a pure-Python fallback.
+        """
+        n = X.shape[0]
+        if phi is None:
+            phi = np.zeros((n, num_features + 1), dtype=np.float64)
+        phi[:, -1] += self.expected_value()
+        if self.num_leaves <= 1:
+            return phi
+        ni = self.num_leaves - 1
+        go_left = self._decision_matrix(X)
+        node_cover = self.internal_count[:ni].astype(np.float64)
+        leaf_cover = self.leaf_count[:self.num_leaves].astype(np.float64)
+        max_depth = int(self.leaf_depth[:self.num_leaves].max())
+        from .. import native
+        lib = native.load("treeshap")
+        if lib is not None:
+            import ctypes as ct
+            f64p = np.ctypeslib.ndpointer(np.float64, flags="C")
+            i32p = np.ctypeslib.ndpointer(np.int32, flags="C")
+            u8p = np.ctypeslib.ndpointer(np.uint8, flags="C")
+            lib.lgbt_tree_shap.argtypes = [
+                ct.c_int, ct.c_int, ct.c_int, ct.c_int,
+                i32p, i32p, i32p, f64p, f64p, f64p, u8p, f64p]
+            phi_c = np.ascontiguousarray(phi)
+            lib.lgbt_tree_shap(
+                n, ni, num_features + 1, max_depth,
+                np.ascontiguousarray(self.left_child[:ni]),
+                np.ascontiguousarray(self.right_child[:ni]),
+                np.ascontiguousarray(self.split_feature[:ni]),
+                np.ascontiguousarray(node_cover),
+                np.ascontiguousarray(leaf_cover),
+                np.ascontiguousarray(self.leaf_value[:self.num_leaves]),
+                np.ascontiguousarray(go_left), phi_c)
+            phi[...] = phi_c
+            return phi
+        for r in range(n):
+            _py_tree_shap(self, go_left[r], node_cover, leaf_cover, phi[r])
+        return phi
 
     # -- binned (inner) prediction: for cached-score updates -----------
     def predict_leaf_binned(self, dataset) -> np.ndarray:
@@ -455,6 +528,9 @@ class Tree:
         if t.num_cat > 0:
             t.cat_boundaries = [int(x) for x in kv["cat_boundaries"].split()]
             t.cat_threshold = [int(x) for x in kv["cat_threshold"].split()]
+        # leaf_depth is not serialized; recompute (predict_contrib sizes the
+        # native TreeSHAP scratch from it)
+        t._fill_leaf_depth()
         return t
 
     # ------------------------------------------------------------------
@@ -514,3 +590,76 @@ class Tree:
 def _fmt_g(x) -> str:
     """%g-style float formatting used for gains/weights."""
     return "%g" % float(x)
+
+
+# ---------------------------------------------------------------------------
+# Pure-Python TreeSHAP fallback (native/treeshap.cpp is the fast path).
+# Same recursion (Lundberg et al., Algorithm 2); used when g++ is absent.
+# ---------------------------------------------------------------------------
+
+def _py_extend(path, depth, pz, po, fi):
+    path.append([fi, pz, po, 1.0 if depth == 0 else 0.0])
+    for i in range(depth - 1, -1, -1):
+        path[i + 1][3] += po * path[i][3] * (i + 1) / (depth + 1)
+        path[i][3] = pz * path[i][3] * (depth - i) / (depth + 1)
+
+
+def _py_unwind(path, depth, idx):
+    po, pz = path[idx][2], path[idx][1]
+    nxt = path[depth][3]
+    for i in range(depth - 1, -1, -1):
+        if po != 0:
+            tmp = path[i][3]
+            path[i][3] = nxt * (depth + 1) / ((i + 1) * po)
+            nxt = tmp - path[i][3] * pz * (depth - i) / (depth + 1)
+        else:
+            path[i][3] = path[i][3] * (depth + 1) / (pz * (depth - i))
+    for i in range(idx, depth):
+        path[i][0], path[i][1], path[i][2] = \
+            path[i + 1][0], path[i + 1][1], path[i + 1][2]
+    path.pop()
+
+
+def _py_unwound_sum(path, depth, idx):
+    po, pz = path[idx][2], path[idx][1]
+    total, nxt = 0.0, path[depth][3]
+    for i in range(depth - 1, -1, -1):
+        if po != 0:
+            t = nxt * (depth + 1) / ((i + 1) * po)
+            total += t
+            nxt = path[i][3] - t * pz * (depth - i) / (depth + 1)
+        else:
+            total += path[i][3] * (depth + 1) / (pz * (depth - i))
+    return total
+
+
+def _py_tree_shap(tree, go_left_row, node_cover, leaf_cover, phi_row):
+    def cover(child):
+        return node_cover[child] if child >= 0 else leaf_cover[~child]
+
+    def recurse(node, path, pz, po, pf):
+        path = [list(e) for e in path]
+        depth = len(path)
+        _py_extend(path, depth, pz, po, pf)
+        if node < 0:
+            v = tree.leaf_value[~node]
+            for i in range(1, depth + 1):
+                w = _py_unwound_sum(path, depth, i)
+                phi_row[path[i][0]] += w * (path[i][2] - path[i][1]) * v
+            return
+        d = int(tree.split_feature[node])
+        hot = int(tree.left_child[node] if go_left_row[node]
+                  else tree.right_child[node])
+        cold = int(tree.right_child[node] if go_left_row[node]
+                   else tree.left_child[node])
+        iz = io = 1.0
+        for k in range(1, len(path)):
+            if path[k][0] == d:
+                iz, io = path[k][1], path[k][2]
+                _py_unwind(path, len(path) - 1, k)
+                break
+        cn = node_cover[node]
+        recurse(hot, path, iz * cover(hot) / cn, io, d)
+        recurse(cold, path, iz * cover(cold) / cn, 0.0, d)
+
+    recurse(0, [], 1.0, 1.0, -1)

@@ -1,0 +1,63 @@
+"""Native (C++) runtime pieces, compiled on demand with the system g++.
+
+The reference ships its runtime as C++ (src/); here the TPU compute path is
+JAX/Pallas and only the genuinely host-sequential pieces go native. Build
+is lazy: first use compiles the .cpp next to this file into a cache dir
+keyed by source hash; failures degrade to the pure-Python fallbacks.
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+from typing import Optional
+
+_CACHE = os.environ.get(
+    "LIGHTGBM_TPU_NATIVE_CACHE",
+    os.path.expanduser("~/.cache/lightgbm_tpu_native"))
+
+_libs = {}
+
+
+def _build(src_path: str) -> Optional[str]:
+    with open(src_path, "rb") as f:
+        src = f.read()
+    tag = hashlib.sha256(src).hexdigest()[:16]
+    name = os.path.splitext(os.path.basename(src_path))[0]
+    out = os.path.join(_CACHE, f"{name}-{tag}.so")
+    if os.path.exists(out):
+        return out
+    os.makedirs(_CACHE, exist_ok=True)
+    tmp = tempfile.mktemp(suffix=".so", dir=_CACHE)
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", src_path,
+           "-o", tmp]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, out)
+        return out
+    except Exception:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return None
+
+
+def load(name: str) -> Optional[ctypes.CDLL]:
+    """Load (building if needed) lightgbm_tpu/native/<name>.cpp; None if the
+    toolchain is unavailable."""
+    if name in _libs:
+        return _libs[name]
+    src = os.path.join(os.path.dirname(__file__), name + ".cpp")
+    lib = None
+    if os.path.exists(src):
+        so = _build(src)
+        if so is not None:
+            try:
+                lib = ctypes.CDLL(so)
+            except OSError:
+                lib = None
+    _libs[name] = lib
+    return lib

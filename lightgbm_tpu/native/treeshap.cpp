@@ -1,0 +1,152 @@
+// TreeSHAP (path-dependent) for lightgbm_tpu.
+//
+// Native analog of the reference's Tree::TreeSHAP recursion used by
+// PredictContrib (include/LightGBM/tree.h:137, src/io/tree.cpp) — the
+// runtime piece stays C++ (as in the reference) because the algorithm is an
+// inherently per-row, path-dependent recursion that neither XLA nor numpy
+// vectorize well. Feature-value semantics (thresholds, categorical bitsets,
+// missing handling) stay OUT of this file: the Python side precomputes a
+// [rows, internal_nodes] go-left matrix with the exact same vectorized
+// Decision used for prediction, so this file only walks topology.
+//
+// Algorithm follows Lundberg et al., "Consistent Individualized Feature
+// Attribution for Tree Ensembles" (Algorithm 2).
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+struct PathElem {
+  int feature;       // -1 for the root placeholder
+  double zero_frac;  // fraction of zero (excluded) paths flowing through
+  double one_frac;   // 1 if the row's value follows this branch, else 0
+  double pweight;    // permutation weight polynomial coefficient
+};
+
+inline void path_extend(PathElem* path, int depth, double pz, double po,
+                        int fi) {
+  path[depth].feature = fi;
+  path[depth].zero_frac = pz;
+  path[depth].one_frac = po;
+  path[depth].pweight = depth == 0 ? 1.0 : 0.0;
+  for (int i = depth - 1; i >= 0; --i) {
+    path[i + 1].pweight += po * path[i].pweight * (i + 1) / (depth + 1);
+    path[i].pweight = pz * path[i].pweight * (depth - i) / (depth + 1);
+  }
+}
+
+inline void path_unwind(PathElem* path, int depth, int idx) {
+  const double po = path[idx].one_frac;
+  const double pz = path[idx].zero_frac;
+  double next = path[depth].pweight;
+  for (int i = depth - 1; i >= 0; --i) {
+    if (po != 0) {
+      const double tmp = path[i].pweight;
+      path[i].pweight = next * (depth + 1) / ((i + 1) * po);
+      next = tmp - path[i].pweight * pz * (depth - i) / (depth + 1);
+    } else {
+      path[i].pweight = path[i].pweight * (depth + 1) / (pz * (depth - i));
+    }
+  }
+  for (int i = idx; i < depth; ++i) {
+    path[i].feature = path[i + 1].feature;
+    path[i].zero_frac = path[i + 1].zero_frac;
+    path[i].one_frac = path[i + 1].one_frac;
+  }
+}
+
+inline double path_unwound_sum(const PathElem* path, int depth, int idx) {
+  const double po = path[idx].one_frac;
+  const double pz = path[idx].zero_frac;
+  double total = 0, next = path[depth].pweight;
+  for (int i = depth - 1; i >= 0; --i) {
+    if (po != 0) {
+      const double t = next * (depth + 1) / ((i + 1) * po);
+      total += t;
+      next = path[i].pweight - t * pz * (depth - i) / (depth + 1);
+    } else {
+      total += path[i].pweight * (depth + 1) / (pz * (depth - i));
+    }
+  }
+  return total;
+}
+
+struct Ctx {
+  const int32_t* left;
+  const int32_t* right;
+  const int32_t* feat;
+  const double* node_cover;
+  const double* leaf_cover;
+  const double* leaf_value;
+  const uint8_t* go_left;   // this row's [n_internal] decisions
+  double* phi;              // this row's [n_out] output
+  PathElem* buf;            // triangular scratch
+};
+
+inline double cover_of(const Ctx& c, int child) {
+  return child >= 0 ? c.node_cover[child] : c.leaf_cover[~child];
+}
+
+void shap_recurse(const Ctx& c, int node, int depth, PathElem* parent,
+                  double pz, double po, int pf) {
+  // copy-on-descend: each level owns a (depth+1)-element slice
+  PathElem* path = parent + depth;  // triangular layout: safe upper bound
+  std::memmove(path, parent, sizeof(PathElem) * depth);
+  path_extend(path, depth, pz, po, pf);
+
+  if (node < 0) {
+    const double v = c.leaf_value[~node];
+    for (int i = 1; i <= depth; ++i) {
+      const double w = path_unwound_sum(path, depth, i);
+      c.phi[path[i].feature] +=
+          w * (path[i].one_frac - path[i].zero_frac) * v;
+    }
+    return;
+  }
+
+  const int d = c.feat[node];
+  const int hot = c.go_left[node] ? c.left[node] : c.right[node];
+  const int cold = c.go_left[node] ? c.right[node] : c.left[node];
+  double iz = 1.0, io = 1.0;
+  int udepth = depth;
+  for (int k = 1; k <= udepth; ++k) {
+    if (path[k].feature == d) {
+      iz = path[k].zero_frac;
+      io = path[k].one_frac;
+      path_unwind(path, udepth, k);
+      --udepth;
+      break;
+    }
+  }
+  const double cnode = c.node_cover[node];
+  shap_recurse(c, hot, udepth + 1, path, iz * cover_of(c, hot) / cnode, io,
+               d);
+  shap_recurse(c, cold, udepth + 1, path, iz * cover_of(c, cold) / cnode,
+               0.0, d);
+}
+
+}  // namespace
+
+extern "C" {
+
+// phi: [n_rows, n_out] preallocated (zeroed or accumulating across trees).
+// go_left: [n_rows, n_internal] uint8. max_depth: deepest leaf of the tree.
+void lgbt_tree_shap(int n_rows, int n_internal, int n_out, int max_depth,
+                    const int32_t* left, const int32_t* right,
+                    const int32_t* feat, const double* node_cover,
+                    const double* leaf_cover, const double* leaf_value,
+                    const uint8_t* go_left, double* phi) {
+  const int levels = max_depth + 2;
+  std::vector<PathElem> buf((size_t)levels * (levels + 1));
+  for (int r = 0; r < n_rows; ++r) {
+    Ctx c{left,       right,      feat,
+          node_cover, leaf_cover, leaf_value,
+          go_left + (size_t)r * n_internal, phi + (size_t)r * n_out,
+          buf.data()};
+    shap_recurse(c, 0, 0, buf.data(), 1.0, 1.0, -1);
+  }
+}
+
+}  // extern "C"

@@ -76,6 +76,31 @@ class GrowConfig(NamedTuple):
     use_mds: bool = True    # max_delta_step > 0 (USE_MAX_OUTPUT analog)
     hist_dtype: str = "f32"  # "f32" | "bf16x2" (hi/lo split bf16 MXU)
     pack_impl: str = "sort"  # "sort" (lax.sort, exact) | "matmul" (one-hot)
+    extra_trees: bool = False   # USE_RAND: one random threshold per feature
+    bynode_k: int = 0           # >0: feature_fraction_bynode sample size
+    use_cegb: bool = False      # CEGB split/coupled gain penalties
+
+
+class GrowExtras(NamedTuple):
+    """Per-tree inputs for the optional split policies (zeros when off)."""
+    key: jnp.ndarray            # [2] u32 PRNG key (extra_trees / bynode)
+    cegb_coupled: jnp.ndarray   # [F] f64 per-feature coupled penalty
+    cegb_split_pen: jnp.ndarray  # scalar f64 penalty_split
+    cegb_tradeoff: jnp.ndarray   # scalar f64
+    feature_used: jnp.ndarray    # [F] bool: features already split on in
+    #                            # EARLIER trees (CEGB coupled penalty is
+    #                            # charged once per model, not per tree —
+    #                            # is_feature_used_in_split_ lives on the
+    #                            # learner in the reference)
+
+
+def default_extras(num_features: int) -> GrowExtras:
+    return GrowExtras(
+        key=jnp.zeros((2,), jnp.uint32),
+        cegb_coupled=jnp.zeros((max(num_features, 1),), F64),
+        cegb_split_pen=jnp.asarray(0.0, F64),
+        cegb_tradeoff=jnp.asarray(1.0, F64),
+        feature_used=jnp.zeros((max(num_features, 1),), jnp.bool_))
 
 
 class FixInfo(NamedTuple):
@@ -123,6 +148,7 @@ class _LoopState(NamedTuple):
     leaf_depth: jnp.ndarray     # [L] i32
     leaf_cmin: jnp.ndarray      # [L] ft monotone lower bound
     leaf_cmax: jnp.ndarray      # [L] ft monotone upper bound
+    feature_used: jnp.ndarray   # [F] bool (CEGB coupled-penalty bookkeeping)
     best: SplitCandidate        # [L] pytree of per-leaf best splits
     tree: TreeArrays
 
@@ -214,20 +240,52 @@ def _empty_tree_arrays(n, L, cat_width, ft) -> TreeArrays:
     )
 
 
-def _make_eval_leaf(meta, params, feature_mask, cat, gc: GrowConfig):
-    """Per-leaf best-split evaluator over a [TB, 2] histogram."""
+def _make_eval_leaf(meta, params, feature_mask, cat, gc: GrowConfig,
+                    extras: GrowExtras, feat_nb):
+    """Per-leaf best-split evaluator over a [TB, 2] histogram.
+
+    `key` seeds the per-node randomness (extra_trees random thresholds,
+    feature_fraction_bynode column sample); `feature_used` feeds the CEGB
+    coupled penalty. Both are ignored unless the matching gc flag is set.
+    """
     F = gc.num_features
 
-    def eval_leaf(hist, sg, sh, cnt, depth, cmin, cmax):
+    def eval_leaf(hist, sg, sh, cnt, depth, cmin, cmax, key, feature_used):
+        fmask = feature_mask
+        if gc.bynode_k > 0:
+            # per-node column sample of exactly k features
+            # (ColSampler by-node, col_sampler.hpp:90-140)
+            r = jax.random.uniform(jax.random.fold_in(
+                jax.random.wrap_key_data(key), 1), (F,))
+            r = jnp.where(feature_mask, r, jnp.inf)
+            order = jnp.argsort(r)
+            node_mask = jnp.zeros((F,), BOOL).at[order[:gc.bynode_k]].set(True)
+            fmask = fmask & node_mask
+        rand_bins = None
+        if gc.extra_trees:
+            # USE_RAND: one uniform threshold in each feature's scan range
+            rand_bins = jax.random.randint(
+                jax.random.fold_in(jax.random.wrap_key_data(key), 2),
+                (F,), 0, jnp.maximum(feat_nb - 1, 1))
+        gain_penalty = None
+        if gc.use_cegb:
+            ft_ = acc_dtype(gc.use_dp)
+            gain_penalty = (
+                extras.cegb_tradeoff.astype(ft_)
+                * (extras.cegb_split_pen.astype(ft_) * cnt.astype(ft_)
+                   + jnp.where(feature_used, 0.0,
+                               extras.cegb_coupled.astype(ft_))))
         cand = find_best_split_numerical(
-            hist, sg, sh, cnt, meta, params, cmin, cmax, feature_mask,
+            hist, sg, sh, cnt, meta, params, cmin, cmax, fmask,
             num_features=F, use_mc=gc.use_mc, max_w=gc.scan_width,
-            use_dp=gc.use_dp, use_l1=gc.use_l1, use_mds=gc.use_mds)
+            use_dp=gc.use_dp, use_l1=gc.use_l1, use_mds=gc.use_mds,
+            rand_bins=rand_bins, gain_penalty=gain_penalty)
         cand = cand._replace(cat_mask=jnp.zeros((gc.cat_width,), BOOL))
         if cat.cat_feature.shape[0] > 0:
             cat_cand = find_best_split_categorical(
                 hist, sg, sh, cnt, cat, meta, params, cmin, cmax,
-                feature_mask, use_mc=gc.use_mc, use_dp=gc.use_dp)
+                fmask, use_mc=gc.use_mc, use_dp=gc.use_dp,
+                gain_penalty=gain_penalty)
             cand = merge_candidates(cand, cat_cand)
         if gc.max_depth > 0:
             blocked = depth >= gc.max_depth
@@ -238,7 +296,8 @@ def _make_eval_leaf(meta, params, feature_mask, cat, gc: GrowConfig):
 
 
 def _eval_children(eval_leaf, leaf_hist, l, s, cand, left_cnt, right_cnt,
-                   depth_child, l_cmin, l_cmax, r_cmin, r_cmax):
+                   depth_child, l_cmin, l_cmax, r_cmin, r_cmax, keys,
+                   feature_used):
     """Evaluate both children in ONE vectorized scan pass (vmap over a
     [2, TB, 2] stack) — halves the per-split fixed cost of the dense scan."""
     pair_hist = jnp.stack([leaf_hist[l], leaf_hist[s]])
@@ -247,8 +306,9 @@ def _eval_children(eval_leaf, leaf_hist, l, s, cand, left_cnt, right_cnt,
     cnts = jnp.stack([left_cnt, right_cnt])
     cmins = jnp.stack([l_cmin, r_cmin])
     cmaxs = jnp.stack([l_cmax, r_cmax])
-    pair = jax.vmap(eval_leaf, in_axes=(0, 0, 0, 0, None, 0, 0))(
-        pair_hist, sgs, shs, cnts, depth_child, cmins, cmaxs)
+    pair = jax.vmap(eval_leaf, in_axes=(0, 0, 0, 0, None, 0, 0, 0, None))(
+        pair_hist, sgs, shs, cnts, depth_child, cmins, cmaxs, keys,
+        feature_used)
     cand_l = jax.tree.map(lambda a: a[0], pair)
     cand_r = jax.tree.map(lambda a: a[1], pair)
     return cand_l, cand_r
@@ -275,6 +335,20 @@ def _hist_chunk_contract(bv, vc, W, hist_dtype):
           ).astype(jnp.float32)
     return jnp.einsum("rgw,rc->gwc", oh, vc,
                       preferred_element_type=jnp.float32)
+
+
+def _split_keys(extras: GrowExtras, s):
+    """Raw [2, 2]u32 child keys for split s (root uses tag 0; children use
+    2s / 2s+1, disjoint because s >= 1)."""
+    base = jax.random.wrap_key_data(extras.key)
+    kl = jax.random.key_data(jax.random.fold_in(base, s * 2))
+    kr = jax.random.key_data(jax.random.fold_in(base, s * 2 + 1))
+    return jnp.stack([kl, kr])
+
+
+def _root_key(extras: GrowExtras):
+    return jax.random.key_data(
+        jax.random.fold_in(jax.random.wrap_key_data(extras.key), 0))
 
 
 def _mono_bounds(st_cmin, st_cmax, mono, left_out, right_out, ft):
@@ -315,7 +389,8 @@ def _record_split(tree: TreeArrays, k, do, l, cand, parent_value,
 def grow_tree(layout: DataLayout, grad: jnp.ndarray, hess: jnp.ndarray,
               bag_mask: jnp.ndarray, meta: FeatureMeta, params: SplitParams,
               feature_mask: jnp.ndarray, fix: FixInfo, gc: GrowConfig,
-              axis_name=None, cat: CatLayout = None) -> TreeArrays:
+              axis_name=None, cat: CatLayout = None,
+              extras: GrowExtras = None) -> TreeArrays:
     """Grow one tree. grad/hess must already include bagging/GOSS weighting
     and be zero on padded/out-of-bag rows; bag_mask marks in-bag valid rows.
 
@@ -326,6 +401,8 @@ def grow_tree(layout: DataLayout, grad: jnp.ndarray, hess: jnp.ndarray,
     """
     if cat is None:
         cat = empty_cat_layout(gc.cat_width)
+    if extras is None:
+        extras = default_extras(gc.num_features)
     ft = acc_dtype(gc.use_dp)
     n = layout.bins.shape[0]
     L = gc.num_leaves
@@ -335,7 +412,7 @@ def grow_tree(layout: DataLayout, grad: jnp.ndarray, hess: jnp.ndarray,
         # no usable features: a single-leaf tree (reference warns and trains
         # constant trees when all features are trivial)
         return _single_leaf_tree(n, L, gc.cat_width, grad, hess, bag_mask,
-                                 params, axis_name, ft)
+                                 params, axis_name, ft), extras.feature_used
 
     grad = grad.astype(jnp.float32)
     hess = hess.astype(jnp.float32)
@@ -354,7 +431,9 @@ def grow_tree(layout: DataLayout, grad: jnp.ndarray, hess: jnp.ndarray,
                               max_w=gc.scan_width, use_dp=gc.use_dp)
 
     pcast = params.cast(ft)
-    eval_leaf = _make_eval_leaf(meta, params, feature_mask, cat, gc)
+    feat_nb_e = meta.bin_end - meta.bin_start
+    eval_leaf = _make_eval_leaf(meta, params, feature_mask, cat, gc,
+                                extras, feat_nb_e)
     root_out = _leaf_output_unconstrained(
         sum_grad, sum_hess, pcast.lambda_l1, pcast.lambda_l2,
         pcast.max_delta_step)
@@ -371,6 +450,7 @@ def grow_tree(layout: DataLayout, grad: jnp.ndarray, hess: jnp.ndarray,
         leaf_depth=jnp.zeros((L,), I32),
         leaf_cmin=jnp.full((L,), -jnp.inf, ft),
         leaf_cmax=jnp.full((L,), jnp.inf, ft),
+        feature_used=extras.feature_used,
         best=jax.tree.map(
             lambda x: jnp.broadcast_to(x, (L,) + x.shape),
             _root_candidate_dummy(gc.cat_width, ft)),
@@ -380,7 +460,8 @@ def grow_tree(layout: DataLayout, grad: jnp.ndarray, hess: jnp.ndarray,
     # root best split
     root_cand = eval_leaf(root_hist, sum_grad, sum_hess, root_count,
                           jnp.asarray(0, I32), state.leaf_cmin[0],
-                          state.leaf_cmax[0])
+                          state.leaf_cmax[0], _root_key(extras),
+                          state.feature_used)
     state = state._replace(
         best=jax.tree.map(lambda a, v: a.at[0].set(v), state.best, root_cand))
 
@@ -462,13 +543,18 @@ def grow_tree(layout: DataLayout, grad: jnp.ndarray, hess: jnp.ndarray,
         leaf_cmin = upd(st.leaf_cmin, l_cmin, r_cmin)
         leaf_cmax = upd(st.leaf_cmax, l_cmax, r_cmax)
 
+        feature_used = st.feature_used
+        if gc.use_cegb:
+            feature_used = feature_used.at[f].set(feature_used[f] | do)
+
         # evaluate children FROM THE UPDATED BUFFER: slicing leaf_hist (not
         # the hist_left/right expressions) ends the old buffer's liveness at
         # the update, letting XLA do the dynamic-update-slice in place
         # instead of copying the whole [L, TB, 2] tensor twice per split
         cand_l, cand_r = _eval_children(
             eval_leaf, leaf_hist, l, s, cand, left_cnt, right_cnt,
-            depth_child, l_cmin, l_cmax, r_cmin, r_cmax)
+            depth_child, l_cmin, l_cmax, r_cmin, r_cmax,
+            _split_keys(extras, s), feature_used)
         best = jax.tree.map(
             lambda a, vl, vr: a.at[l].set(jnp.where(do, vl, a[l]))
                                .at[s].set(jnp.where(do, vr, a[s])),
@@ -481,7 +567,8 @@ def grow_tree(layout: DataLayout, grad: jnp.ndarray, hess: jnp.ndarray,
             leaf_hist=leaf_hist, leaf_sum_grad=leaf_sum_grad,
             leaf_sum_hess=leaf_sum_hess, leaf_count=leaf_count,
             leaf_value=leaf_value, leaf_depth=leaf_depth,
-            leaf_cmin=leaf_cmin, leaf_cmax=leaf_cmax, best=best, tree=tree)
+            leaf_cmin=leaf_cmin, leaf_cmax=leaf_cmax,
+            feature_used=feature_used, best=best, tree=tree)
 
     final = jax.lax.while_loop(cond, body, state)
     return final.tree._replace(
@@ -490,7 +577,7 @@ def grow_tree(layout: DataLayout, grad: jnp.ndarray, hess: jnp.ndarray,
         leaf_count=final.leaf_count,
         leaf_weight=final.leaf_sum_hess,
         row_leaf=final.row_leaf,
-    )
+    ), final.feature_used
 
 
 # ---------------------------------------------------------------------------
@@ -544,6 +631,7 @@ class _PartState(NamedTuple):
     leaf_depth: jnp.ndarray
     leaf_cmin: jnp.ndarray
     leaf_cmax: jnp.ndarray
+    feature_used: jnp.ndarray   # [F] bool (CEGB coupled-penalty bookkeeping)
     best: SplitCandidate
     tree: TreeArrays
 
@@ -678,7 +766,8 @@ def grow_tree_partitioned(layout: DataLayout, grad: jnp.ndarray,
                           meta: FeatureMeta, params: SplitParams,
                           feature_mask: jnp.ndarray, fix: FixInfo,
                           gc: GrowConfig, gw_global=None, axis_name=None,
-                          cat: CatLayout = None) -> TreeArrays:
+                          cat: CatLayout = None,
+                          extras: GrowExtras = None) -> TreeArrays:
     """Leaf-wise growth with O(rows-in-child) per-split work and no gathers.
 
     Same trees as grow_tree (up to f32 summation order); see the section
@@ -688,6 +777,8 @@ def grow_tree_partitioned(layout: DataLayout, grad: jnp.ndarray,
     """
     if cat is None:
         cat = empty_cat_layout(gc.cat_width)
+    if extras is None:
+        extras = default_extras(gc.num_features)
     ft = acc_dtype(gc.use_dp)
     n = layout.bins.shape[0]
     L = gc.num_leaves
@@ -697,7 +788,7 @@ def grow_tree_partitioned(layout: DataLayout, grad: jnp.ndarray,
     C = max(256, int(gc.window_chunk))
     if F == 0 or TB == 0:
         return _single_leaf_tree(n, L, gc.cat_width, grad, hess, bag_mask,
-                                 params, axis_name, ft)
+                                 params, axis_name, ft), extras.feature_used
     grad = grad.astype(jnp.float32)
     hess = hess.astype(jnp.float32)
     bagf = bag_mask.astype(jnp.float32)
@@ -739,11 +830,14 @@ def grow_tree_partitioned(layout: DataLayout, grad: jnp.ndarray,
 
     feat_nb = meta.bin_end - meta.bin_start
     pcast = params.cast(ft)
-    eval_leaf = _make_eval_leaf(meta, params, feature_mask, cat, gc)
+    eval_leaf = _make_eval_leaf(meta, params, feature_mask, cat, gc,
+                                extras, feat_nb)
+    feature_used0 = extras.feature_used
 
     root_cand = eval_leaf(root_hist, sum_grad, sum_hess, root_count,
                           jnp.asarray(0, I32), jnp.asarray(-jnp.inf, ft),
-                          jnp.asarray(jnp.inf, ft))
+                          jnp.asarray(jnp.inf, ft), _root_key(extras),
+                          feature_used0)
     root_out = _leaf_output_unconstrained(
         sum_grad, sum_hess, pcast.lambda_l1, pcast.lambda_l2,
         pcast.max_delta_step)
@@ -772,6 +866,7 @@ def grow_tree_partitioned(layout: DataLayout, grad: jnp.ndarray,
         leaf_depth=jnp.zeros((L,), I32),
         leaf_cmin=jnp.full((L,), -jnp.inf, ft),
         leaf_cmax=jnp.full((L,), jnp.inf, ft),
+        feature_used=feature_used0,
         best=jax.tree.map(
             lambda a: jnp.broadcast_to(a, (L,) + a.shape),
             _root_candidate_dummy(gc.cat_width, ft)),
@@ -976,11 +1071,16 @@ def grow_tree_partitioned(layout: DataLayout, grad: jnp.ndarray,
             jnp.where(do, s0 + n_left, st.leaf_start[s]))
         leaf_nrows = upd(st.leaf_nrows, n_left, n_right)
 
+        feature_used = st.feature_used
+        if gc.use_cegb:
+            feature_used = feature_used.at[f].set(feature_used[f] | do)
+
         # children evaluated from the updated buffer (in-place DUS; see
         # grow_tree body comment)
         cand_l, cand_r = _eval_children(
             eval_leaf, leaf_hist, l, s, cand, left_cnt, right_cnt,
-            depth_child, l_cmin, l_cmax, r_cmin, r_cmax)
+            depth_child, l_cmin, l_cmax, r_cmin, r_cmax,
+            _split_keys(extras, s), feature_used)
         best = jax.tree.map(
             lambda a, vl, vr: a.at[l].set(jnp.where(do, vl, a[l]))
                                .at[s].set(jnp.where(do, vr, a[s])),
@@ -996,7 +1096,8 @@ def grow_tree_partitioned(layout: DataLayout, grad: jnp.ndarray,
             leaf_hist=leaf_hist, leaf_sum_grad=leaf_sum_grad,
             leaf_sum_hess=leaf_sum_hess, leaf_count=leaf_count,
             leaf_value=leaf_value, leaf_depth=leaf_depth,
-            leaf_cmin=leaf_cmin, leaf_cmax=leaf_cmax, best=best,
+            leaf_cmin=leaf_cmin, leaf_cmax=leaf_cmax,
+            feature_used=feature_used, best=best,
             tree=tree)
 
     final = jax.lax.while_loop(cond, body, state)
@@ -1010,4 +1111,4 @@ def grow_tree_partitioned(layout: DataLayout, grad: jnp.ndarray,
         leaf_count=final.leaf_count,
         leaf_weight=final.leaf_sum_hess,
         row_leaf=row_leaf,
-    )
+    ), final.feature_used

@@ -242,7 +242,8 @@ def find_best_split_numerical(hist, sum_grad, sum_hess, num_data,
                               cmin, cmax, feature_mask,
                               num_features: int, use_mc: bool = False,
                               max_w: int = 0, use_dp: bool = True,
-                              use_l1: bool = True, use_mds: bool = True):
+                              use_l1: bool = True, use_mds: bool = True,
+                              rand_bins=None, gain_penalty=None):
     """Best numerical split for one leaf over all features at once.
 
     hist: [TB, 2] f32; sums are leaf totals; num_data i32 (reference
@@ -312,6 +313,10 @@ def find_best_split_numerical(hist, sum_grad, sum_hess, num_data,
     valid_r &= (right_cnt >= min_data) & (sum_right_hess >= min_hess)
     valid_r &= (left_cnt >= min_data) & (sum_left_hess >= min_hess)
     valid_r &= fmask_f
+    if rand_bins is not None:
+        # extra_trees / USE_RAND (feature_histogram.hpp template arm): only
+        # one randomly drawn threshold per feature is considered
+        valid_r &= w == rand_bins[:, None]
 
     gains_r = _split_gains(sum_left_grad, sum_left_hess, sum_right_grad,
                            sum_right_hess, p.lambda_l1, p.lambda_l2,
@@ -343,6 +348,8 @@ def find_best_split_numerical(hist, sum_grad, sum_hess, num_data,
     valid_f &= (f_left_cnt >= min_data) & (f_left_hess >= min_hess)
     valid_f &= (f_right_cnt >= min_data) & (f_right_hess >= min_hess)
     valid_f &= fmask_f
+    if rand_bins is not None:
+        valid_f &= w == rand_bins[:, None]
 
     gains_f = _split_gains(f_left_grad, f_left_hess, f_right_grad,
                            f_right_hess, p.lambda_l1, p.lambda_l2,
@@ -375,6 +382,12 @@ def find_best_split_numerical(hist, sum_grad, sum_hess, num_data,
                               (feat_gain - min_gain_shift)
                               * meta.penalty.astype(ft),
                               K_MIN_SCORE)
+    if gain_penalty is not None:
+        # CEGB DetlaGain subtracted per feature before the cross-feature
+        # argmax (cost_effective_gradient_boosting.hpp:51-62)
+        feat_gain_out = jnp.where(feat_valid,
+                                  feat_gain_out - gain_penalty.astype(ft),
+                                  K_MIN_SCORE)
 
     # ---------------- best feature (ties -> smaller index) -----------------
     best_f = jnp.argmax(feat_gain_out)      # first max = smallest feature id
@@ -552,7 +565,8 @@ def find_best_split_categorical(hist, sum_grad, sum_hess, num_data,
                                 cat: CatLayout, meta: FeatureMeta,
                                 p: SplitParams, cmin, cmax, feature_mask,
                                 use_mc: bool = False,
-                                use_dp: bool = True) -> SplitCandidate:
+                                use_dp: bool = True,
+                                gain_penalty=None) -> SplitCandidate:
     """Best categorical split over all categorical features of one leaf.
 
     Mirrors FindBestThresholdCategoricalInner (feature_histogram.hpp:263-474):
@@ -591,6 +605,9 @@ def find_best_split_categorical(hist, sum_grad, sum_hess, num_data,
         ok = (gain > min_gain_shift) & feature_mask[f_idx]
         gain_out = jnp.where(ok, (gain - min_gain_shift)
                              * meta.penalty[f_idx].astype(ft), K_MIN_SCORE)
+        if gain_penalty is not None:
+            gain_out = jnp.where(ok, gain_out - gain_penalty[f_idx].astype(ft),
+                                 K_MIN_SCORE)
         return gain_out, mask, lg, lh, lc, l2_out
 
     if C == 0:

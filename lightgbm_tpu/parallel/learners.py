@@ -81,18 +81,18 @@ class DataParallelTreeLearner(SerialTreeLearner):
 
         @functools.partial(
             jax.shard_map, mesh=mesh,
-            in_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS), P()),
-            out_specs=_tree_arrays_spec(gc),
+            in_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(), P()),
+            out_specs=(_tree_arrays_spec(gc), P()),
             check_vma=False)
-        def run(bins, grad, hess, bag, fmask):
+        def run(bins, grad, hess, bag, fmask, extras):
             layout = DataLayout(bins, *layout_rest)
             if use_part:
                 return grow_tree_partitioned(
                     layout, grad, hess, bag, meta, params, fmask, fix, gc,
                     gw_global=gw_global, axis_name=AXIS,
-                    cat=cat)
+                    cat=cat, extras=extras)
             return grow_tree(layout, grad, hess, bag, meta, params, fmask,
-                             fix, gc, axis_name=AXIS, cat=cat)
+                             fix, gc, axis_name=AXIS, cat=cat, extras=extras)
         return run
 
     def train_arrays(self, grad: jnp.ndarray, hess: jnp.ndarray,
@@ -108,7 +108,9 @@ class DataParallelTreeLearner(SerialTreeLearner):
             hess = jnp.pad(hess, (0, pad))
             bag_mask = jnp.pad(bag_mask, (0, pad))
         fmask = jnp.asarray(self.col_sampler.sample())
-        arrays = self._sharded_grow(bins, grad, hess, bag_mask, fmask)
+        arrays, fu = self._sharded_grow(bins, grad, hess, bag_mask, fmask,
+                                        self._next_extras())
+        self._feature_used_dev = fu
         if pad:
             arrays = arrays._replace(
                 row_leaf=arrays.row_leaf[:self.dataset.num_data])

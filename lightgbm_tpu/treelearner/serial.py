@@ -21,14 +21,50 @@ import jax.numpy as jnp
 
 from ..config import Config
 from ..models.tree import Tree
-from ..ops.grow import (DataLayout, FixInfo, GrowConfig, empty_cat_layout,
-                        grow_tree, grow_tree_partitioned)
+from ..ops.grow import (DataLayout, FixInfo, GrowConfig, GrowExtras,
+                        default_extras, empty_cat_layout, grow_tree,
+                        grow_tree_partitioned)
 from ..ops.split import CatLayout, FeatureMeta, SplitParams
 from ..utils.log import Log
 
 # below this many rows the masked full-N grower compiles faster and the
 # O(N)-per-split cost is irrelevant
 PARTITION_MIN_ROWS = 65536
+
+
+def _cegb_enabled(config: Config) -> bool:
+    """CostEfficientGradientBoosting::IsEnable
+    (cost_effective_gradient_boosting.hpp:25-31); the per-row lazy feature
+    penalty needs [rows, features] bookkeeping we do not keep on device."""
+    if list(config.cegb_penalty_feature_lazy):
+        Log.fatal("cegb_penalty_feature_lazy is not supported on "
+                  "device_type=tpu (per-row feature bookkeeping); use "
+                  "cegb_penalty_feature_coupled / cegb_penalty_split")
+    return bool(float(config.cegb_penalty_split) > 0.0
+                or list(config.cegb_penalty_feature_coupled))
+
+
+def _build_extras(config: Config, dataset) -> GrowExtras:
+    import jax
+    import jax.numpy as jnp
+    F = max(dataset.num_features, 1)
+    coupled = np.zeros(F, dtype=np.float64)
+    pen = list(config.cegb_penalty_feature_coupled)
+    if pen:
+        if len(pen) != dataset.num_total_features:
+            Log.fatal("cegb_penalty_feature_coupled should be the same "
+                      "size as feature number.")
+        for inner, real in enumerate(dataset.used_features):
+            coupled[inner] = pen[real]
+    seed = int(config.extra_seed)
+    key = jax.random.key_data(jax.random.PRNGKey(seed))
+    ex = default_extras(dataset.num_features)
+    return ex._replace(
+        key=jnp.asarray(key, jnp.uint32),
+        cegb_coupled=jnp.asarray(coupled),
+        cegb_split_pen=jnp.asarray(float(config.cegb_penalty_split),
+                                   jnp.float64),
+        cegb_tradeoff=jnp.asarray(float(config.cegb_tradeoff), jnp.float64))
 
 
 def resolve_hist_impl(config: Config) -> str:
@@ -43,14 +79,22 @@ def resolve_hist_impl(config: Config) -> str:
     pallas_ok = HAS_PALLAS and backend in ("tpu", "axon")
     if impl == "onehot":
         return impl
+    f32_req = str(config.tpu_hist_dtype).lower() == "f32"
     if impl == "pallas":
         if not pallas_ok:
             Log.warning("tpu_histogram_impl=pallas unavailable on backend "
                         "%s; falling back to onehot" % backend)
             return "onehot"
+        if f32_req:
+            Log.warning("tpu_hist_dtype=f32 needs the XLA einsum path; "
+                        "using tpu_histogram_impl=onehot (the Pallas kernel "
+                        "is bf16 hi/lo only)")
+            return "onehot"
         return impl
     if backend == "cpu":
         return "scatter"
+    if f32_req:
+        return "onehot"
     return "pallas" if pallas_ok else "onehot"
 
 
@@ -111,15 +155,13 @@ def build_cat_layout(dataset, cat_width: int) -> CatLayout:
 
 
 class ColSampler:
-    """feature_fraction by-tree sampling (col_sampler.hpp:17-160)."""
+    """feature_fraction by-tree sampling (col_sampler.hpp:17-160); the
+    by-node sample runs inside the device grower (GrowConfig.bynode_k)."""
 
     def __init__(self, config: Config, num_features: int):
         self.fraction = float(config.feature_fraction)
         self.num_features = num_features
         self.rng = np.random.default_rng(config.feature_fraction_seed)
-        if config.feature_fraction_bynode < 1.0:
-            Log.warning("feature_fraction_bynode is not yet supported on "
-                        "device_type=tpu; using by-tree sampling only")
 
     def sample(self) -> np.ndarray:
         if self.fraction >= 1.0:
@@ -180,7 +222,19 @@ class SerialTreeLearner:
             use_l1=float(config.lambda_l1) > 0.0,
             use_mds=float(config.max_delta_step) > 0.0,
             pack_impl=str(config.tpu_pack_impl).lower(),
+            extra_trees=bool(config.extra_trees),
+            # by-node sample scales off the by-TREE sampled feature count
+            # (ColSampler::GetByNode, col_sampler.hpp:90-140)
+            bynode_k=(int(math.ceil(
+                float(config.feature_fraction_bynode)
+                * max(1, int(dataset.num_features
+                             * min(float(config.feature_fraction), 1.0)))))
+                      if float(config.feature_fraction_bynode) < 1.0 else 0),
+            use_cegb=_cegb_enabled(config),
         )
+        self._extras_base = _build_extras(config, dataset)
+        self._tree_counter = 0
+        self._feature_used_dev = None
         self.col_sampler = ColSampler(config, dataset.num_features)
         self.cat_layout = build_cat_layout(dataset, cat_width)
         self.use_partitioned = dataset.num_data >= PARTITION_MIN_ROWS
@@ -193,15 +247,36 @@ class SerialTreeLearner:
         host synchronization (the async fast path — dispatch returns
         immediately, XLA pipelines successive trees)."""
         fmask = jnp.asarray(self.col_sampler.sample())
+        extras = self._next_extras()
         if self.use_partitioned:
-            return grow_tree_partitioned(
+            arrays, fu = grow_tree_partitioned(
                 self.layout, grad, hess, bag_mask, self.meta, self.params,
                 fmask, self.fix, self.grow_config,
                 gw_global=self.gw_global, axis_name=self._axis_name,
-                cat=self.cat_layout)
-        return grow_tree(self.layout, grad, hess, bag_mask, self.meta,
-                         self.params, fmask, self.fix, self.grow_config,
-                         axis_name=self._axis_name, cat=self.cat_layout)
+                cat=self.cat_layout, extras=extras)
+        else:
+            arrays, fu = grow_tree(
+                self.layout, grad, hess, bag_mask, self.meta,
+                self.params, fmask, self.fix, self.grow_config,
+                axis_name=self._axis_name, cat=self.cat_layout,
+                extras=extras)
+        self._feature_used_dev = fu
+        return arrays
+
+    def _next_extras(self) -> GrowExtras:
+        """Per-tree randomness (fold the tree counter into the base key so
+        extra_trees / by-node draws differ across trees) plus the model-wide
+        used-feature set the previous tree returned (CEGB's
+        is_feature_used_in_split_ persists across iterations)."""
+        import jax
+        self._tree_counter += 1
+        key = jax.random.key_data(jax.random.fold_in(
+            jax.random.wrap_key_data(self._extras_base.key),
+            self._tree_counter))
+        ex = self._extras_base._replace(key=key)
+        if self._feature_used_dev is not None:
+            ex = ex._replace(feature_used=self._feature_used_dev)
+        return ex
 
     def train(self, grad: jnp.ndarray, hess: jnp.ndarray,
               bag_mask: jnp.ndarray) -> Tuple[Tree, jnp.ndarray]:

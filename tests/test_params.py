@@ -1,0 +1,122 @@
+"""Formerly-dead parameters: extra_trees, feature_fraction_bynode, CEGB,
+refit, pred_early_stop — each works (or errors loudly) per the reference
+semantics it mirrors."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.utils.log import LightGBMError
+
+
+def _data(n=1500, f=8, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    y = X[:, 0] + 0.5 * X[:, 1] - 0.3 * X[:, 2] + 0.1 * rng.normal(size=n)
+    return X, y
+
+
+def _trees_of(bst):
+    bst._booster._materialize_pending()
+    return bst._booster.models
+
+
+def test_extra_trees_changes_model_and_is_seeded():
+    X, y = _data()
+    base = {"objective": "regression", "num_leaves": 15, "verbosity": -1}
+    b0 = lgb.train(dict(base), lgb.Dataset(X, y), 5, verbose_eval=False)
+    b1 = lgb.train({**base, "extra_trees": True}, lgb.Dataset(X, y), 5,
+                   verbose_eval=False)
+    b2 = lgb.train({**base, "extra_trees": True}, lgb.Dataset(X, y), 5,
+                   verbose_eval=False)
+    t0, t1, t2 = _trees_of(b0), _trees_of(b1), _trees_of(b2)
+    # random thresholds differ from the exhaustive scan...
+    assert not np.array_equal(t0[0].threshold, t1[0].threshold)
+    # ...but are deterministic under the same extra_seed
+    for a, b in zip(t1, t2):
+        np.testing.assert_array_equal(a.threshold, b.threshold)
+    # and still learn something
+    r2 = 1 - np.var(y - b1.predict(X)) / np.var(y)
+    assert r2 > 0.5
+
+
+def test_feature_fraction_bynode():
+    X, y = _data()
+    base = {"objective": "regression", "num_leaves": 31, "verbosity": -1,
+            "feature_fraction_bynode": 0.5}
+    b = lgb.train(base, lgb.Dataset(X, y), 5, verbose_eval=False)
+    # per-node sampling: every feature should still appear somewhere
+    used = set()
+    for t in _trees_of(b):
+        used.update(t.split_feature[:t.num_leaves - 1].tolist())
+    assert len(used) > 3
+    r2 = 1 - np.var(y - b.predict(X)) / np.var(y)
+    assert r2 > 0.5
+
+
+def test_cegb_split_penalty_prunes():
+    X, y = _data()
+    base = {"objective": "regression", "num_leaves": 63, "verbosity": -1,
+            "min_gain_to_split": 0.0}
+    b0 = lgb.train(dict(base), lgb.Dataset(X, y), 3, verbose_eval=False)
+    b1 = lgb.train({**base, "cegb_penalty_split": 0.05},
+                   lgb.Dataset(X, y), 3, verbose_eval=False)
+    n0 = sum(t.num_leaves for t in _trees_of(b0))
+    n1 = sum(t.num_leaves for t in _trees_of(b1))
+    assert n1 < n0  # splitting now costs tradeoff*penalty*count
+
+
+def test_cegb_coupled_penalty_limits_features():
+    X, y = _data(f=8)
+    pen = [10.0] * 8  # high cost to introduce each new feature
+    base = {"objective": "regression", "num_leaves": 31, "verbosity": -1}
+    b0 = lgb.train(dict(base), lgb.Dataset(X, y), 3, verbose_eval=False)
+    b1 = lgb.train({**base, "cegb_tradeoff": 1.0,
+                    "cegb_penalty_feature_coupled": pen},
+                   lgb.Dataset(X, y), 3, verbose_eval=False)
+    used0 = set()
+    for t in _trees_of(b0):
+        used0.update(t.split_feature[:t.num_leaves - 1].tolist())
+    used1 = set()
+    for t in _trees_of(b1):
+        used1.update(t.split_feature[:t.num_leaves - 1].tolist())
+    assert len(used1) <= len(used0)
+
+
+def test_cegb_lazy_raises():
+    X, y = _data(n=300)
+    with pytest.raises(LightGBMError):
+        lgb.train({"objective": "regression", "verbosity": -1,
+                   "cegb_penalty_feature_lazy": [1.0] * 8},
+                  lgb.Dataset(X, y), 1, verbose_eval=False)
+
+
+def test_forcedsplits_raises():
+    X, y = _data(n=300)
+    with pytest.raises(LightGBMError):
+        lgb.train({"objective": "regression", "verbosity": -1,
+                   "forcedsplits_filename": "foo.json"},
+                  lgb.Dataset(X, y), 1, verbose_eval=False)
+
+
+def test_refit_keeps_structure_updates_leaves():
+    X, y = _data(seed=1)
+    X2, y2 = _data(seed=2)
+    bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                     "verbosity": -1}, lgb.Dataset(X, y), 10,
+                    verbose_eval=False)
+    new = bst.refit(X2, y2, decay_rate=0.5)
+    t_old, t_new = _trees_of(bst), _trees_of(new)
+    assert len(t_old) == len(t_new)
+    for a, b in zip(t_old, t_new):
+        np.testing.assert_array_equal(
+            a.split_feature[:a.num_leaves - 1],
+            b.split_feature[:b.num_leaves - 1])       # same structure
+    changed = any(
+        not np.allclose(a.leaf_value[:a.num_leaves],
+                        b.leaf_value[:b.num_leaves])
+        for a, b in zip(t_old, t_new))
+    assert changed                                     # new leaf values
+    # refitted model fits the new data better than the old model does
+    mse_old = np.mean((bst.predict(X2) - y2) ** 2)
+    mse_new = np.mean((new.predict(X2) - y2) ** 2)
+    assert mse_new < mse_old

@@ -40,9 +40,9 @@ def _grow_both(X, y, leaves, wc, cat_cols=()):
     h = jnp.ones(n, jnp.float32)
     args = (le.layout, g, h, jnp.ones(n, bool), le.meta, le.params,
             jnp.ones(ds.num_features, bool), le.fix, le.grow_config)
-    a1 = grow_tree(*args, cat=le.cat_layout)
-    a2 = grow_tree_partitioned(*args, gw_global=le.gw_global,
-                               cat=le.cat_layout)
+    a1, _ = grow_tree(*args, cat=le.cat_layout)
+    a2, _ = grow_tree_partitioned(*args, gw_global=le.gw_global,
+                                  cat=le.cat_layout)
     return ds, le, a1, a2
 
 

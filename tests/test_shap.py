@@ -1,0 +1,130 @@
+"""SHAP contribution tests.
+
+Two independent checks pin TreeSHAP correctness:
+1. columns of pred_contrib sum to the raw prediction (the local-accuracy
+   property, also the reference python package's usual assertion);
+2. a brute-force Shapley computation on a tiny model — explicit enumeration
+   over feature subsets with the tree-conditional expectation (EXPVALUE in
+   Lundberg et al., Algorithm 1) — must match exactly.
+The native C++ kernel and the pure-Python fallback are both exercised.
+"""
+import itertools
+import math
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _make(n=600, f=5, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    y = (X[:, 0] + 0.5 * X[:, 1] * X[:, 2] + 0.1 * rng.normal(size=n))
+    return X, y
+
+
+def _expvalue(tree, x, subset):
+    """Conditional expectation of the tree with only `subset` features known."""
+    def rec(node):
+        if node < 0:
+            return float(tree.leaf_value[~node])
+        f = int(tree.split_feature[node])
+        left, right = int(tree.left_child[node]), int(tree.right_child[node])
+        if f in subset:
+            go_left = bool(tree._decision(
+                np.array([x[f]]), np.array([node], dtype=np.int32))[0])
+            return rec(left if go_left else right)
+        def cover(c):
+            return (float(tree.internal_count[c]) if c >= 0
+                    else float(tree.leaf_count[~c]))
+        cn = cover(node)
+        return (cover(left) * rec(left) + cover(right) * rec(right)) / cn
+    return rec(0)
+
+
+def _brute_shap(tree, x, nf):
+    phi = np.zeros(nf + 1)
+    feats = list(range(nf))
+    for i in feats:
+        rest = [f for f in feats if f != i]
+        for k in range(len(rest) + 1):
+            for S in itertools.combinations(rest, k):
+                wt = (math.factorial(len(S)) * math.factorial(nf - len(S) - 1)
+                      / math.factorial(nf))
+                phi[i] += wt * (_expvalue(tree, x, set(S) | {i})
+                                - _expvalue(tree, x, set(S)))
+    phi[-1] = _expvalue(tree, x, set())
+    return phi
+
+
+def test_contrib_sums_to_prediction():
+    X, y = _make()
+    bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                     "verbosity": -1}, lgb.Dataset(X, y), 25,
+                    verbose_eval=False)
+    contrib = bst.predict(X[:100], pred_contrib=True)
+    assert contrib.shape == (100, X.shape[1] + 1)
+    raw = bst.predict(X[:100], raw_score=True)
+    np.testing.assert_allclose(contrib.sum(axis=1), raw, rtol=1e-6,
+                               atol=1e-8)
+
+
+def test_contrib_matches_bruteforce_shapley():
+    X, y = _make(n=400, f=4, seed=3)
+    bst = lgb.train({"objective": "regression", "num_leaves": 8,
+                     "min_data_in_leaf": 10, "verbosity": -1},
+                    lgb.Dataset(X, y), 1, verbose_eval=False)
+    bst._booster._materialize_pending()
+    tree = bst._booster.models[0]
+    nf = X.shape[1]
+    for r in range(5):
+        got = np.zeros((1, nf + 1))
+        tree.predict_contrib(X[r:r + 1], nf, got)
+        want = _brute_shap(tree, X[r], nf)
+        np.testing.assert_allclose(got[0], want, rtol=1e-9, atol=1e-10)
+
+
+def test_contrib_python_fallback_matches_native(monkeypatch):
+    from lightgbm_tpu import native
+    X, y = _make(n=200, f=4, seed=5)
+    bst = lgb.train({"objective": "regression", "num_leaves": 12,
+                     "verbosity": -1}, lgb.Dataset(X, y), 3,
+                    verbose_eval=False)
+    a = bst.predict(X[:40], pred_contrib=True)
+    monkeypatch.setattr(native, "load", lambda name: None)
+    b = bst.predict(X[:40], pred_contrib=True)
+    np.testing.assert_allclose(a, b, rtol=1e-10, atol=1e-12)
+
+
+def test_contrib_multiclass_layout():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(500, 4))
+    y = rng.integers(0, 3, size=500)
+    bst = lgb.train({"objective": "multiclass", "num_class": 3,
+                     "num_leaves": 7, "verbosity": -1},
+                    lgb.Dataset(X, y), 5, verbose_eval=False)
+    c = bst.predict(X[:20], pred_contrib=True)
+    assert c.shape == (20, 3 * (4 + 1))
+    raw = bst.predict(X[:20], raw_score=True)
+    got = c.reshape(20, 3, 5).sum(axis=2)
+    np.testing.assert_allclose(got, raw, rtol=1e-6, atol=1e-8)
+
+
+def test_pred_early_stop():
+    """prediction_early_stop.cpp analog: high-margin rows skip later trees
+    and predictions stay close (identical labels for confident rows)."""
+    X, y = _make(n=2000, f=5, seed=9)
+    labels = (y > np.median(y)).astype(float)
+    bst = lgb.train({"objective": "binary", "num_leaves": 31,
+                     "verbosity": -1}, lgb.Dataset(X, labels), 60,
+                    verbose_eval=False)
+    full = bst.predict(X)
+    es = bst.predict(X, pred_early_stop=True, pred_early_stop_freq=5,
+                     pred_early_stop_margin=4.0)
+    # early-stopped rows keep the same decision
+    assert (((full > 0.5) == (es > 0.5)).mean()) > 0.999
+    # and a huge margin threshold means no early stop at all
+    same = bst.predict(X, pred_early_stop=True, pred_early_stop_freq=5,
+                       pred_early_stop_margin=1e30)
+    np.testing.assert_allclose(same, full, rtol=1e-12)

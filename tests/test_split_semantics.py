@@ -190,7 +190,7 @@ def test_grow_tree_respects_min_data():
     gc = GrowConfig(num_leaves=31, total_bins=ds.total_bins,
                     num_features=ds.num_features, use_mc=False, max_depth=-1,
                     rows_per_chunk=0, cat_width=1)
-    tree = grow_tree(layout, grad, hess, jnp.ones(n, bool), meta,
+    tree, _ = grow_tree(layout, grad, hess, jnp.ones(n, bool), meta,
                      SplitParams.from_config(cfg),
                      jnp.ones(ds.num_features, bool), ds.fix_info(), gc)
     nl = int(tree.num_leaves)
@@ -212,7 +212,7 @@ def test_max_depth_limits_tree():
     gc = GrowConfig(num_leaves=64, total_bins=ds.total_bins,
                     num_features=ds.num_features, use_mc=False, max_depth=3,
                     rows_per_chunk=0, cat_width=1)
-    tree = grow_tree(layout, grad, hess, jnp.ones(n, bool), meta,
+    tree, _ = grow_tree(layout, grad, hess, jnp.ones(n, bool), meta,
                      SplitParams.from_config(cfg),
                      jnp.ones(ds.num_features, bool), ds.fix_info(), gc)
     assert int(tree.num_leaves) <= 8  # depth 3 -> at most 2^3 leaves
