@@ -122,6 +122,8 @@ class Dataset:
             raise LightGBMError(
                 "Cannot construct Dataset since the raw data has been freed; "
                 "set free_raw_data=False when creating the Dataset")
+        if isinstance(self.data, (str, bytes)):
+            return self._construct_from_path(str(self.data))
         cfg = params_to_config(self.params)
         X, names, cat_idx = _data_to_2d(self.data, self.feature_name,
                                         self.categorical_feature)
@@ -142,6 +144,51 @@ class Dataset:
         self._raw_X = None if self.free_raw_data else X
         if self.free_raw_data:
             self.data = None
+        return self
+
+    def _construct_from_path(self, path: str) -> "Dataset":
+        """File-path Dataset (reference Dataset('file') via
+        LGBM_DatasetCreateFromFile): binary cache fast path
+        (dataset_loader.cpp:179-274), two_round streaming, or one-round
+        text load; save_binary writes <path>.bin for next time."""
+        from .data.loader import load_text_file
+        cfg = params_to_config(self.params)
+
+        if not BinnedDataset.is_binary_file(path) \
+                and BinnedDataset.is_binary_file(path + ".bin"):
+            # CheckCanLoadFromBin probes <data>.bin (dataset_loader.cpp:179)
+            path = path + ".bin"
+        if BinnedDataset.is_binary_file(path):
+            self._inner = BinnedDataset.from_binary(path)
+            if self.label is not None:
+                self._inner.metadata.set_label(self.label)
+            self.data = None if self.free_raw_data else self.data
+            return self
+        cat_idx = (list(self.categorical_feature)
+                   if isinstance(self.categorical_feature, (list, tuple))
+                   else ())
+        ref_inner = None
+        if self.reference is not None:
+            self.reference.construct()
+            ref_inner = self.reference._inner
+        if cfg.two_round and ref_inner is None:
+            self._inner = BinnedDataset.from_text_two_round(
+                path, cfg, categorical_features=cat_idx)
+        else:
+            loaded = load_text_file(path, cfg)
+            self._inner = BinnedDataset.from_matrix(
+                loaded.X, cfg, categorical_features=cat_idx,
+                label=(self.label if self.label is not None
+                       else loaded.label),
+                weight=self.weight if self.weight is not None
+                else loaded.weight,
+                group=self.group if self.group is not None else loaded.group,
+                init_score=self.init_score,
+                feature_names=loaded.feature_names,
+                reference=ref_inner)
+        if cfg.save_binary and not path.endswith(".bin"):
+            self._inner.save_binary(path + ".bin")
+        self.data = None if self.free_raw_data else self.data
         return self
 
     @property

@@ -208,6 +208,16 @@ class BinnedDataset:
         cat_set = set(int(c) for c in categorical_features)
         sample = _sample_data(X, config.bin_construct_sample_cnt,
                               config.data_random_seed)
+        ds._construct_from_sample(sample, n, config, cat_set)
+        ds._push_matrix(X)
+        return ds
+
+    def _construct_from_sample(self, sample: np.ndarray, n: int,
+                               config: Config, cat_set) -> None:
+        """BinMapper construction + EFB grouping + layout from a row sample
+        (DatasetLoader::CostructFromSampleData, dataset_loader.cpp:528)."""
+        ds = self
+        nf = ds.num_total_features
         total_sample = sample.shape[0]
         filter_cnt = max(
             int(config.min_data_in_leaf * total_sample / max(n, 1)), 1)
@@ -257,7 +267,95 @@ class BinnedDataset:
             ds.groups = [[i] for i in range(n_inner)]
 
         ds._finish_layout(config)
-        ds._push_matrix(X)
+
+    @classmethod
+    def from_text_two_round(cls, filename: str, config: Config,
+                            categorical_features: Sequence[int] = ()
+                            ) -> "BinnedDataset":
+        """Two-pass streaming file load (two_round, DatasetLoader::
+        LoadFromFile sample-from-file branch, dataset_loader.cpp:168-274):
+        pass 1 reservoir-samples rows for binning and collects the small
+        metadata columns; pass 2 streams chunks straight into the binned
+        matrix — the full float matrix is never materialized."""
+        from .loader import _sidecar, iter_text_chunks
+        rng = np.random.default_rng(config.data_random_seed)
+        cap = int(config.bin_construct_sample_cnt)
+        sample_rows: List[np.ndarray] = []
+        seen = 0
+        labels, weights, groups_col = [], [], []
+        names = None
+        group_is_sizes = False
+        full_X = None
+        for chunk in iter_text_chunks(filename, config):
+            if names is None:
+                names = chunk.feature_names
+            if getattr(chunk, "group_is_sizes", False):
+                # LibSVM fallback: one full chunk — keep it so pass 2 does
+                # not re-parse the file
+                group_is_sizes = True
+                full_X = chunk.X
+            labels.append(chunk.label)
+            if chunk.weight is not None:
+                weights.append(chunk.weight)
+            if chunk.group is not None:
+                groups_col.append(chunk.group)
+            m = chunk.X.shape[0]
+            # chunk-reservoir: keep each row with prob cap/(seen+m) and
+            # evict uniformly (approximate reservoir, exact in expectation)
+            if seen + m <= cap:
+                sample_rows.append(chunk.X)
+            else:
+                k = max(0, cap - max(seen, 0)) if seen < cap else 0
+                take = rng.random(m) < cap / (seen + m)
+                take[:k] = True
+                sample_rows.append(chunk.X[take])
+            seen += m
+        n = seen
+        sample = np.concatenate(sample_rows) if sample_rows else np.zeros((0, 1))
+        if sample.shape[0] > cap:
+            sample = sample[rng.choice(sample.shape[0], cap, replace=False)]
+
+        ds = cls()
+        ds.num_data = n
+        ds.num_total_features = sample.shape[1]
+        ds.feature_names = names or ["Column_%d" % i
+                                     for i in range(ds.num_total_features)]
+        ds.metadata = Metadata(n)
+        ds.metadata.set_label(np.concatenate(labels) if labels else
+                              np.zeros(n, np.float32))
+        if weights:
+            ds.metadata.set_weight(np.concatenate(weights))
+        if groups_col:
+            gids = np.concatenate(groups_col)
+            if group_is_sizes:    # LibSVM fallback already returns sizes
+                ds.metadata.set_query(gids)
+            else:
+                change = np.nonzero(np.diff(gids) != 0)[0]
+                bounds = np.concatenate([[0], change + 1, [len(gids)]])
+                ds.metadata.set_query(np.diff(bounds))
+        else:
+            # sidecar files, same as the one-round loader
+            g_sc = _sidecar(filename, ".query", None)
+            if g_sc is not None:
+                ds.metadata.set_query(g_sc)
+        if not weights:
+            w_sc = _sidecar(filename, ".weight", None)
+            if w_sc is not None:
+                ds.metadata.set_weight(w_sc)
+        ds._construct_from_sample(sample, n, config,
+                                  set(int(c) for c in categorical_features))
+
+        G = len(ds.groups)
+        binned = np.zeros((n, G), dtype=ds._bin_dtype())
+        if full_X is not None:
+            ds._bin_rows(full_X, binned)
+        else:
+            row = 0
+            for chunk in iter_text_chunks(filename, config):
+                m = chunk.X.shape[0]
+                ds._bin_rows(chunk.X, binned[row:row + m])
+                row += m
+        ds.binned = binned
         return ds
 
     # ------------------------------------------------------------------
@@ -315,26 +413,27 @@ class BinnedDataset:
                      "monotone", "penalty"):
             setattr(self, attr, getattr(ref, attr))
 
-    def _push_matrix(self, X: np.ndarray) -> None:
-        """Quantize the full matrix into group-local bins."""
-        n = X.shape[0]
-        G = len(self.groups)
+    def _bin_dtype(self):
         widths = []
-        for gid, feats in enumerate(self.groups):
+        for feats in self.groups:
             multi = len(feats) > 1
             w = (1 if multi else 0) + sum(
                 self.bin_mappers[self.used_features[i]].num_bin for i in feats)
             widths.append(w)
-        dtype = np.uint8 if max(widths, default=1) <= 256 else (
+        return np.uint8 if max(widths, default=1) <= 256 else (
             np.uint16 if max(widths) <= 65536 else np.int32)
-        binned = np.zeros((n, G), dtype=dtype)
+
+    def _bin_rows(self, X: np.ndarray, out: np.ndarray) -> None:
+        """Quantize a row block into group-local bins (writes `out`)."""
+        n = X.shape[0]
+        dtype = out.dtype
         for gid, feats in enumerate(self.groups):
             multi = len(feats) > 1
             if not multi:
                 i = feats[0]
                 f = self.used_features[i]
                 m = self.bin_mappers[f]
-                binned[:, gid] = m.value_to_bin(X[:, f]).astype(dtype)
+                out[:, gid] = m.value_to_bin(X[:, f]).astype(dtype)
             else:
                 col = np.zeros(n, dtype=np.int64)
                 local = 1
@@ -345,7 +444,14 @@ class BinnedDataset:
                     nz = b != m.most_freq_bin
                     col[nz] = local + b[nz]
                     local += m.num_bin
-                binned[:, gid] = col.astype(dtype)
+                out[:, gid] = col.astype(dtype)
+
+    def _push_matrix(self, X: np.ndarray) -> None:
+        """Quantize the full matrix into group-local bins."""
+        n = X.shape[0]
+        G = len(self.groups)
+        binned = np.zeros((n, G), dtype=self._bin_dtype())
+        self._bin_rows(X, binned)
         self.binned = binned
 
     # ------------------------------------------------------------------
@@ -362,6 +468,102 @@ class BinnedDataset:
         f = self.used_features[inner_feature]
         return self.bin_mappers[f].bin_to_value(int(bin_threshold))
 
+    # -- binary cache (reference Dataset::SaveBinaryFile, dataset.cpp:890,
+    # and DatasetLoader::LoadFromBinFile / CheckCanLoadFromBin,
+    # dataset_loader.cpp:179-274). Format: npz with a versioned magic — the
+    # semantics match (skip text parsing + FindBin entirely on reload), the
+    # encoding is numpy-native instead of the reference's hand-rolled blob.
+    BINARY_MAGIC = "lightgbm_tpu.dataset.v1"
+
+    def save_binary(self, path: str) -> None:
+        import json
+        meta = self.metadata
+        arrays = {
+            "magic": np.frombuffer(self.BINARY_MAGIC.encode(), np.uint8),
+            "binned": self.binned,
+            "group_offset": self.group_offset,
+            "group_of": self.group_of,
+            "bin_start": self.bin_start,
+            "bin_end": self.bin_end,
+            "needs_fix": self.needs_fix,
+            "most_freq_bin": self.most_freq_bin,
+            "default_bin": self.default_bin,
+            "missing_type_arr": self.missing_type_arr,
+            "is_categorical": self.is_categorical,
+            "monotone": self.monotone,
+            "penalty": self.penalty,
+            "used_features": np.asarray(self.used_features, np.int32),
+            "total_bins": np.asarray([self.total_bins], np.int64),
+            "num_total_features": np.asarray([self.num_total_features],
+                                             np.int64),
+            "structure": np.frombuffer(json.dumps({
+                "groups": [list(map(int, g)) for g in self.groups],
+                "feature_names": list(self.feature_names),
+                "mappers": [m.to_state() for m in self.bin_mappers],
+            }).encode(), np.uint8),
+        }
+        if meta is not None:
+            for k in ("label", "weight", "query_boundaries", "init_score"):
+                v = getattr(meta, k)
+                if v is not None:
+                    arrays["meta_" + k] = v
+        with open(path, "wb") as f:
+            np.savez_compressed(f, **arrays)
+        Log.info("Saved binary dataset to %s" % path)
+
+    @staticmethod
+    def is_binary_file(path: str) -> bool:
+        try:
+            with open(path, "rb") as f:
+                head = f.read(4)
+            if head[:2] != b"PK":
+                return False
+            with np.load(path) as z:
+                magic = bytes(z["magic"]).decode()
+            return magic == BinnedDataset.BINARY_MAGIC
+        except Exception:
+            return False
+
+    @classmethod
+    def from_binary(cls, path: str) -> "BinnedDataset":
+        import json
+        from .bin_mapper import BinMapper
+        ds = cls()
+        with np.load(path) as z:
+            magic = bytes(z["magic"]).decode()
+            if magic != cls.BINARY_MAGIC:
+                Log.fatal("%s is not a lightgbm_tpu binary dataset" % path)
+            struct = json.loads(bytes(z["structure"]).decode())
+            ds.binned = z["binned"]
+            ds.group_offset = z["group_offset"]
+            ds.group_of = z["group_of"]
+            ds.bin_start = z["bin_start"]
+            ds.bin_end = z["bin_end"]
+            ds.needs_fix = z["needs_fix"]
+            ds.most_freq_bin = z["most_freq_bin"]
+            ds.default_bin = z["default_bin"]
+            ds.missing_type_arr = z["missing_type_arr"]
+            ds.is_categorical = z["is_categorical"]
+            ds.monotone = z["monotone"]
+            ds.penalty = z["penalty"]
+            ds.used_features = [int(x) for x in z["used_features"]]
+            ds.total_bins = int(z["total_bins"][0])
+            ds.num_total_features = int(z["num_total_features"][0])
+            meta_arrays = {k[5:]: z[k] for k in z.files
+                           if k.startswith("meta_")}
+        ds.groups = [list(g) for g in struct["groups"]]
+        ds.feature_names = list(struct["feature_names"])
+        ds.bin_mappers = [BinMapper.from_state(d) for d in struct["mappers"]]
+        ds.inner_of = {f: i for i, f in enumerate(ds.used_features)}
+        ds.num_data = int(ds.binned.shape[0])
+        ds.metadata = Metadata(ds.num_data)
+        for k, v in meta_arrays.items():
+            setattr(ds.metadata, k, v)
+        Log.info("Loaded binary dataset from %s (%d rows, %d features)"
+                 % (path, ds.num_data, ds.num_total_features))
+        return ds
+
+    # ------------------------------------------------------------------
     def fix_info(self):
         """FixInfo arrays for bundled features (ops.split.fix_histogram)."""
         import jax.numpy as jnp

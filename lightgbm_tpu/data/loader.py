@@ -176,3 +176,82 @@ def _parse_libsvm(data_lines: List[str], label_idx: int,
         X[i, ii] = vv
     names = ["Column_%d" % c for c in range(X.shape[1])]
     return LoadedData(X, labels.astype(np.float32), None, None, names)
+
+
+def iter_text_chunks(filename: str, config, chunk_rows: int = 131072):
+    """Stream a CSV/TSV file as LoadedData chunks (two_round loading,
+    DatasetLoader::LoadFromFile second-round branch): only `chunk_rows`
+    parsed rows are alive at a time. chunk.group carries RAW query ids (the
+    caller derives sizes after concatenation so chunk boundaries cannot
+    split a query's count). LibSVM falls back to one-round.
+    """
+    if not os.path.exists(filename):
+        Log.fatal("Data file %s does not exist" % filename)
+    with open(filename, "r") as f:
+        head = []
+        for _ in range(12):
+            ln = f.readline()
+            if not ln:
+                break
+            head.append(ln.rstrip("\n"))
+    has_header = bool(config.header)
+    fmt = _detect_format(head[1 if has_header else 0:][:10])
+    if fmt == "libsvm":
+        Log.warning("two_round is not supported for LibSVM input; "
+                    "loading in one round")
+        loaded = load_text_file(filename, config)
+        loaded.group_is_sizes = True   # load_text_file returns query SIZES
+        yield loaded
+        return
+    sep = {"csv": ",", "tsv": "\t"}[fmt]
+    header = None
+    if has_header:
+        header = [t.strip() for t in head[0].split(sep)]
+
+    label_idx = 0
+    if config.label_column:
+        label_idx = _resolve_column(config.label_column, header, "label")
+    weight_idx = _resolve_column(config.weight_column, header, "weight")
+    group_idx = _resolve_column(config.group_column, header, "group")
+    ignore_idx: List[int] = []
+    if config.ignore_column:
+        if config.ignore_column.startswith(NAME_PREFIX):
+            for nm in config.ignore_column[len(NAME_PREFIX):].split(","):
+                ignore_idx.append(_resolve_column(NAME_PREFIX + nm, header,
+                                                  "ignore"))
+        else:
+            ignore_idx = [int(x) for x in config.ignore_column.split(",")]
+
+    with open(filename, "r") as f:
+        if has_header:
+            f.readline()
+        while True:
+            lines = []
+            for ln in f:
+                if ln.strip():
+                    lines.append(ln)
+                if len(lines) >= chunk_rows:
+                    break
+            if not lines:
+                return
+            mat = np.genfromtxt(io.StringIO("".join(lines)), delimiter=sep,
+                                dtype=np.float64)
+            if mat.ndim == 0:
+                mat = mat.reshape(1, 1)
+            elif mat.ndim == 1:
+                # 1-D from genfromtxt: single column (multi-row) or a
+                # single row (multi-column)
+                mat = (mat.reshape(-1, 1) if len(lines) > 1
+                       else mat.reshape(1, -1))
+            ncol = mat.shape[1]
+            special = {label_idx} | {weight_idx, group_idx} | set(ignore_idx)
+            special.discard(-1)
+            feat_cols = [c for c in range(ncol) if c not in special]
+            label = (mat[:, label_idx] if label_idx >= 0
+                     else np.zeros(len(mat)))
+            weight = mat[:, weight_idx] if weight_idx >= 0 else None
+            group = mat[:, group_idx] if group_idx >= 0 else None
+            names = ([header[c] for c in feat_cols] if header is not None
+                     else ["Column_%d" % c for c in feat_cols])
+            yield LoadedData(mat[:, feat_cols], label.astype(np.float32),
+                             weight, group, names)

@@ -57,17 +57,13 @@ class Application:
     def train(self):
         cfg = self.config
         params = cfg.to_dict()
-        loaded = load_text_file(cfg.data, cfg)
-        train_set = Dataset(loaded.X, label=loaded.label,
-                            weight=loaded.weight, group=loaded.group,
-                            feature_name=loaded.feature_names,
-                            params=params)
+        # path Datasets get the binary cache (save_binary/<data>.bin) and
+        # two_round streaming through Dataset._construct_from_path
+        train_set = Dataset(cfg.data, params=params)
         valid_sets = []
         valid_names = []
         for i, vfile in enumerate(cfg.valid):
-            v = load_text_file(vfile, cfg)
-            valid_sets.append(Dataset(v.X, label=v.label, weight=v.weight,
-                                      group=v.group, reference=train_set,
+            valid_sets.append(Dataset(vfile, reference=train_set,
                                       params=params))
             valid_names.append("valid_%d" % i if len(cfg.valid) > 1
                                else "valid_1")

@@ -79,6 +79,10 @@ class GrowConfig(NamedTuple):
     extra_trees: bool = False   # USE_RAND: one random threshold per feature
     bynode_k: int = 0           # >0: feature_fraction_bynode sample size
     use_cegb: bool = False      # CEGB split/coupled gain penalties
+    parallel_mode: str = "data"  # "data" | "feature" | "voting" (see
+    #                            # parallel/learners.py for the mapping to
+    #                            # the reference's three learners)
+    top_k: int = 20              # voting-parallel per-shard vote size
 
 
 class GrowExtras(NamedTuple):
@@ -240,18 +244,106 @@ def _empty_tree_arrays(n, L, cat_width, ft) -> TreeArrays:
     )
 
 
+def _merge_cands_over_shards(cand, axis_name):
+    """SyncUpGlobalBestSplit (parallel_tree_learner.h:190) as an
+    all_gather + sequential merge: every shard sees every shard's local
+    best candidate and deterministically agrees on the global one."""
+    gathered = jax.lax.all_gather(cand, axis_name)   # leaves: [S, ...]
+    S = gathered.gain.shape[0]
+    best = jax.tree.map(lambda a: a[0], gathered)
+    for i in range(1, S):
+        best = merge_candidates(best, jax.tree.map(lambda a: a[i], gathered))
+    return best
+
+
+def _voting_reduce_hist(hist, feat_gains, meta, gc: GrowConfig, axis_name,
+                        feat_nb, always_mask):
+    """The PV-tree communication step (voting_parallel_tree_learner.cpp):
+    per-shard top-k feature vote (:321 allgather of LightSplitInfo),
+    GlobalVoting by vote count (:153-184), then psum of ONLY the winning
+    features' histogram bins (CopyLocalHistogram + ReduceScatter,
+    :186-243, :344). Returns (hist with winner bins globally summed,
+    winner feature mask) — identical on every shard."""
+    F = gc.num_features
+    k = min(max(gc.top_k, 1), F)
+    _, top_idx = jax.lax.top_k(feat_gains, k)                   # [k]
+    votes_local = jnp.zeros((F,), I32).at[top_idx].add(
+        (feat_gains[top_idx] > K_MIN_SCORE).astype(I32))
+    votes = jax.lax.psum(votes_local, axis_name)                # [F]
+    n_win = min(2 * k, F)
+    # stable vote ranking: ties keep the smaller feature id; the 2k quota
+    # is always filled (zero-vote features pad it, as in GlobalVoting)
+    rank_key = votes * F - jnp.arange(F, dtype=I32)
+    _, winners = jax.lax.top_k(rank_key, n_win)                 # [n_win]
+    win_mask = jnp.zeros((F,), BOOL).at[winners].set(True)
+    win_mask = win_mask | always_mask        # categorical: always reduced
+    # psum only the winning features' bin ranges: mask the flat histogram
+    # by bin ownership (bin_to_feat computed from meta.feat_id)
+    bin_win = win_mask[jnp.clip(meta.feat_id, 0, F - 1)] \
+        & (meta.feat_id >= 0)
+    masked = hist * bin_win[:, None].astype(hist.dtype)
+    reduced = jax.lax.psum(masked, axis_name)
+    hist_out = jnp.where(bin_win[:, None], reduced, hist)
+    return hist_out, win_mask
+
+
 def _make_eval_leaf(meta, params, feature_mask, cat, gc: GrowConfig,
-                    extras: GrowExtras, feat_nb):
+                    extras: GrowExtras, feat_nb, axis_name=None, fix=None):
     """Per-leaf best-split evaluator over a [TB, 2] histogram.
 
     `key` seeds the per-node randomness (extra_trees random thresholds,
     feature_fraction_bynode column sample); `feature_used` feeds the CEGB
     coupled penalty. Both are ignored unless the matching gc flag is set.
+
+    The three reference parallel learners dispatch here:
+      * "data": hist arrives globally psum-reduced — plain scan;
+      * "feature" (feature_parallel_tree_learner.cpp): data replicated,
+        each shard scans its round-robin-owned features, candidates merged
+        by SyncUpGlobalBestSplit (all_gather + deterministic merge);
+      * "voting" (voting_parallel_tree_learner.cpp): hist arrives LOCAL;
+        a per-shard scan with 1/S-scaled thresholds proposes top_k
+        features, the global vote picks 2k winners, only their bins are
+        psum-reduced, then the real scan runs on those features with the
+        global leaf sums.
     """
     F = gc.num_features
 
     def eval_leaf(hist, sg, sh, cnt, depth, cmin, cmax, key, feature_used):
         fmask = feature_mask
+        win_mask = None
+        if gc.parallel_mode == "voting" and axis_name is not None:
+            # exact LOCAL leaf sums: every row lands in exactly one bin of
+            # every group (EFB sentinel included), so the flat-hist total
+            # is num_groups * local_leaf_sum
+            S = jax.lax.psum(jnp.asarray(1.0, jnp.float32), axis_name)
+            local_sg = jnp.sum(hist[:, 0]) / _NG[0]
+            local_sh = jnp.sum(hist[:, 1]) / _NG[0]
+            sh_f = jnp.maximum(sh.astype(jnp.float32), 1e-12)
+            local_cnt = jnp.round(
+                local_sh * cnt.astype(jnp.float32) / sh_f).astype(I32)
+            pv = params._replace(
+                min_data_in_leaf=jnp.maximum(
+                    (params.min_data_in_leaf.astype(jnp.float32) / S)
+                    .astype(I32), 1),
+                min_sum_hessian_in_leaf=params.min_sum_hessian_in_leaf / S)
+            local_gains = find_best_split_numerical(
+                hist, local_sg, local_sh, local_cnt, meta, pv, cmin, cmax,
+                fmask & (~meta.is_categorical), num_features=F,
+                use_mc=gc.use_mc, max_w=gc.scan_width, use_dp=gc.use_dp,
+                use_l1=gc.use_l1, use_mds=gc.use_mds, feat_gains_only=True)
+            hist, win_mask = _voting_reduce_hist(
+                hist, local_gains, meta, gc, axis_name, feat_nb,
+                meta.is_categorical)
+            if fix is not None:
+                hist = fix_histogram(hist, sg, sh, fix.mf_global, fix.start,
+                                     fix.end, max_w=gc.scan_width,
+                                     use_dp=gc.use_dp)
+            fmask = fmask & win_mask
+        if gc.parallel_mode == "feature" and axis_name is not None:
+            shard = jax.lax.axis_index(axis_name)
+            owned = (jnp.arange(F, dtype=I32)
+                     % jax.lax.psum(1, axis_name)) == shard
+            fmask = fmask & owned
         if gc.bynode_k > 0:
             # per-node column sample of exactly k features
             # (ColSampler by-node, col_sampler.hpp:90-140)
@@ -291,7 +383,16 @@ def _make_eval_leaf(meta, params, feature_mask, cat, gc: GrowConfig,
             blocked = depth >= gc.max_depth
             cand = cand._replace(
                 gain=jnp.where(blocked, K_MIN_SCORE, cand.gain))
+        if gc.parallel_mode == "feature" and axis_name is not None:
+            cand = _merge_cands_over_shards(cand, axis_name)
         return cand
+
+    # static group count for the voting local-sum recovery
+    _NG = [1]
+
+    def set_num_groups(ng):
+        _NG[0] = max(int(ng), 1)
+    eval_leaf.set_num_groups = set_num_groups
     return eval_leaf
 
 
@@ -417,23 +518,37 @@ def grow_tree(layout: DataLayout, grad: jnp.ndarray, hess: jnp.ndarray,
     grad = grad.astype(jnp.float32)
     hess = hess.astype(jnp.float32)
 
+    # collectives per mode: "data" reduces hists+counts; "voting" reduces
+    # counts/sums only (hists reduce selectively inside eval); "feature"
+    # replicates data so nothing reduces
     def psum(x):
-        return jax.lax.psum(x, axis_name) if axis_name is not None else x
+        if axis_name is None or gc.parallel_mode == "feature":
+            return x
+        return jax.lax.psum(x, axis_name)
+
+    def hist_psum(x):
+        if axis_name is None or gc.parallel_mode != "data":
+            return x
+        return jax.lax.psum(x, axis_name)
 
     # ---- root ----------------------------------------------------------
-    root_hist = _hist_masked(layout.bins, layout.group_offset, grad, hess,
-                             bag_mask, TB, gc.rows_per_chunk, axis_name)
+    root_hist = hist_psum(_hist_masked(
+        layout.bins, layout.group_offset, grad, hess, bag_mask, TB,
+        gc.rows_per_chunk, None))
     sum_grad = psum(jnp.sum(grad, dtype=ft))
     sum_hess = psum(jnp.sum(hess, dtype=ft))
     root_count = psum(jnp.sum(bag_mask, dtype=I32))
-    root_hist = fix_histogram(root_hist, sum_grad, sum_hess,
-                              fix.mf_global, fix.start, fix.end,
-                              max_w=gc.scan_width, use_dp=gc.use_dp)
+    if gc.parallel_mode != "voting":
+        root_hist = fix_histogram(root_hist, sum_grad, sum_hess,
+                                  fix.mf_global, fix.start, fix.end,
+                                  max_w=gc.scan_width, use_dp=gc.use_dp)
 
     pcast = params.cast(ft)
     feat_nb_e = meta.bin_end - meta.bin_start
     eval_leaf = _make_eval_leaf(meta, params, feature_mask, cat, gc,
-                                extras, feat_nb_e)
+                                extras, feat_nb_e, axis_name=axis_name,
+                                fix=fix)
+    eval_leaf.set_num_groups(layout.bins.shape[1])
     root_out = _leaf_output_unconstrained(
         sum_grad, sum_hess, pcast.lambda_l1, pcast.lambda_l2,
         pcast.max_delta_step)
@@ -496,16 +611,17 @@ def grow_tree(layout: DataLayout, grad: jnp.ndarray, hess: jnp.ndarray,
 
         smaller_is_left = left_cnt <= right_cnt
         smaller_mask = in_leaf & (go_left == smaller_is_left)
-        hist_smaller = _hist_masked(
+        hist_smaller = hist_psum(_hist_masked(
             layout.bins, layout.group_offset, grad, hess, smaller_mask,
-            TB, gc.rows_per_chunk, axis_name)
+            TB, gc.rows_per_chunk, None))
         sm_sum_grad = jnp.where(smaller_is_left, cand.left_sum_grad,
                                 cand.right_sum_grad)
         sm_sum_hess = jnp.where(smaller_is_left, cand.left_sum_hess,
                                 cand.right_sum_hess)
-        hist_smaller = fix_histogram(hist_smaller, sm_sum_grad, sm_sum_hess,
-                                     fix.mf_global, fix.start, fix.end,
-                                     max_w=gc.scan_width, use_dp=gc.use_dp)
+        if gc.parallel_mode != "voting":
+            hist_smaller = fix_histogram(
+                hist_smaller, sm_sum_grad, sm_sum_hess, fix.mf_global,
+                fix.start, fix.end, max_w=gc.scan_width, use_dp=gc.use_dp)
         parent_hist = st.leaf_hist[l]
         hist_larger = parent_hist - hist_smaller
         hist_left = jnp.where(smaller_is_left, hist_smaller, hist_larger)
@@ -796,7 +912,14 @@ def grow_tree_partitioned(layout: DataLayout, grad: jnp.ndarray,
     goff = layout.group_offset
 
     def psum(x):
-        return jax.lax.psum(x, axis_name) if axis_name is not None else x
+        if axis_name is None or gc.parallel_mode == "feature":
+            return x
+        return jax.lax.psum(x, axis_name)
+
+    def hist_psum(x):
+        if axis_name is None or gc.parallel_mode != "data":
+            return x
+        return jax.lax.psum(x, axis_name)
 
     # ---- padded payload buffers ----------------------------------------
     # PAD covers both the per-split C-windows and the root's bigger chunks
@@ -820,18 +943,23 @@ def grow_tree_partitioned(layout: DataLayout, grad: jnp.ndarray,
                                  goff, jnp.asarray(0, I32),
                                  jnp.asarray(n, I32), root_chunk, gc,
                                  gw_global)
-    root_hist = psum(root_hist)
+    root_hist = hist_psum(root_hist)
     sum_grad = psum(jnp.sum(grad * bagf, dtype=ft))
     sum_hess = psum(jnp.sum(hess * bagf, dtype=ft))
     root_count = psum(jnp.sum(bag_mask, dtype=I32))
-    root_hist = fix_histogram(root_hist, sum_grad, sum_hess,
-                              fix.mf_global, fix.start, fix.end,
-                              max_w=gc.scan_width, use_dp=gc.use_dp)
+    if gc.parallel_mode != "voting":
+        # voting keeps hists LOCAL; the repair runs on the selectively
+        # reduced winner bins inside eval_leaf
+        root_hist = fix_histogram(root_hist, sum_grad, sum_hess,
+                                  fix.mf_global, fix.start, fix.end,
+                                  max_w=gc.scan_width, use_dp=gc.use_dp)
 
     feat_nb = meta.bin_end - meta.bin_start
     pcast = params.cast(ft)
     eval_leaf = _make_eval_leaf(meta, params, feature_mask, cat, gc,
-                                extras, feat_nb)
+                                extras, feat_nb, axis_name=axis_name,
+                                fix=fix)
+    eval_leaf.set_num_groups(G)
     feature_used0 = extras.feature_used
 
     root_cand = eval_leaf(root_hist, sum_grad, sum_hess, root_count,
@@ -975,7 +1103,7 @@ def grow_tree_partitioned(layout: DataLayout, grad: jnp.ndarray,
              jnp.asarray(0, I32), _hist_acc_init(gc, G, W)))
         n_right = n_l - n_left
 
-        hist_smaller = psum(_hist_acc_finish(hacc, gc, gw_global))
+        hist_smaller = hist_psum(_hist_acc_finish(hacc, gc, gw_global))
 
         left_cnt = psum(bag_left)
         right_cnt = st.leaf_count[l] - left_cnt
@@ -1031,10 +1159,10 @@ def grow_tree_partitioned(layout: DataLayout, grad: jnp.ndarray,
                                 cand.right_sum_grad)
         sm_sum_hess = jnp.where(smaller_is_left, cand.left_sum_hess,
                                 cand.right_sum_hess)
-        hist_smaller = fix_histogram(hist_smaller, sm_sum_grad,
-                                     sm_sum_hess, fix.mf_global,
-                                     fix.start, fix.end,
-                                     max_w=gc.scan_width, use_dp=gc.use_dp)
+        if gc.parallel_mode != "voting":
+            hist_smaller = fix_histogram(
+                hist_smaller, sm_sum_grad, sm_sum_hess, fix.mf_global,
+                fix.start, fix.end, max_w=gc.scan_width, use_dp=gc.use_dp)
         parent_hist = st.leaf_hist[l]
         hist_larger = parent_hist - hist_smaller
         hist_left = jnp.where(smaller_is_left, hist_smaller, hist_larger)

@@ -236,14 +236,16 @@ def fix_histogram(hist, sum_grad, sum_hess, fix_mf_global, fix_start, fix_end,
 
 @functools.partial(jax.jit,
                    static_argnames=("use_mc", "num_features", "max_w",
-                                    "use_dp", "use_l1", "use_mds"))
+                                    "use_dp", "use_l1", "use_mds",
+                                    "feat_gains_only"))
 def find_best_split_numerical(hist, sum_grad, sum_hess, num_data,
                               meta: FeatureMeta, p: SplitParams,
                               cmin, cmax, feature_mask,
                               num_features: int, use_mc: bool = False,
                               max_w: int = 0, use_dp: bool = True,
                               use_l1: bool = True, use_mds: bool = True,
-                              rand_bins=None, gain_penalty=None):
+                              rand_bins=None, gain_penalty=None,
+                              feat_gains_only: bool = False):
     """Best numerical split for one leaf over all features at once.
 
     hist: [TB, 2] f32; sums are leaf totals; num_data i32 (reference
@@ -388,6 +390,12 @@ def find_best_split_numerical(hist, sum_grad, sum_hess, num_data,
         feat_gain_out = jnp.where(feat_valid,
                                   feat_gain_out - gain_penalty.astype(ft),
                                   K_MIN_SCORE)
+
+    if feat_gains_only:
+        # voting-parallel local scan: per-feature best gains, no payload
+        # (LightSplitInfo, split_info.hpp — gain + feature is all the vote
+        # needs)
+        return feat_gain_out
 
     # ---------------- best feature (ties -> smaller index) -----------------
     best_f = jnp.argmax(feat_gain_out)      # first max = smallest feature id

@@ -12,11 +12,17 @@ plus SyncUpGlobalBestSplit (parallel_tree_learner.h:190) collapse into that
 one collective, because after psum every device scans identical histograms
 and deterministically agrees on the global best split.
 
-feature-parallel (rows replicated, features split) and voting-parallel
-(top-k vote to cut communication volume) currently run through the same
-row-sharded path: it is semantically identical (bit-equal trees) and on TPU
-the psum rides ICI, so the communication-volume optimization matters only at
-pod scale — tracked for the voting implementation.
+All three reference strategies are real here:
+  * data-parallel: rows sharded, full-histogram psum (ReduceScatter analog,
+    data_parallel_tree_learner.cpp:163);
+  * feature-parallel: data replicated, each shard scans its owned features,
+    the global best split is agreed via all_gather + deterministic merge
+    (feature_parallel_tree_learner.cpp:33-77);
+  * voting-parallel: rows sharded, per-shard top-k vote, and ONLY the
+    2k globally voted features' histogram bins are psum-reduced
+    (PV-tree; voting_parallel_tree_learner.cpp:153-344) — the
+    communication-volume compression that matters once the mesh axis
+    crosses DCN.
 """
 from __future__ import annotations
 
@@ -82,7 +88,7 @@ class DataParallelTreeLearner(SerialTreeLearner):
         @functools.partial(
             jax.shard_map, mesh=mesh,
             in_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(), P()),
-            out_specs=(_tree_arrays_spec(gc), P()),
+            out_specs=(_tree_arrays_spec(gc, row_sharded=True), P()),
             check_vma=False)
         def run(bins, grad, hess, bag, fmask, extras):
             layout = DataLayout(bins, *layout_rest)
@@ -125,23 +131,99 @@ class DataParallelTreeLearner(SerialTreeLearner):
         return tree, arrays.row_leaf
 
 
-def _tree_arrays_spec(gc: GrowConfig):
-    """A TreeArrays-shaped pytree of PartitionSpecs (replicated)."""
+def _tree_arrays_spec(gc: GrowConfig, row_sharded: bool = True):
+    """A TreeArrays-shaped pytree of PartitionSpecs (replicated except
+    row_leaf, which is row-sharded when the data is)."""
     from ..ops.grow import TreeArrays
     none = P()
     return TreeArrays(
         num_leaves=none, split_leaf=none, split_feature=none, threshold=none,
         default_left=none, gain=none, is_cat=none, cat_mask=none,
         internal_value=none, internal_count=none, leaf_value=none,
-        leaf_count=none, leaf_weight=none, row_leaf=P(AXIS))
+        leaf_count=none, leaf_weight=none,
+        row_leaf=P(AXIS) if row_sharded else none)
+
+
+class VotingParallelTreeLearner(DataParallelTreeLearner):
+    """PV-tree voting-parallel learner: the data-parallel sharding with the
+    histogram reduction compressed to the globally voted top-2k features
+    (voting_parallel_tree_learner.cpp). Trees match data-parallel exactly
+    whenever 2 * top_k covers every feature; with fewer votes the split
+    search is the PV-tree approximation, as in the reference."""
+
+    def __init__(self, config, dataset, mesh: Mesh = None):
+        super().__init__(config, dataset, mesh=mesh)
+        self.grow_config = self.grow_config._replace(
+            parallel_mode="voting", top_k=int(config.top_k))
+        self._sharded_grow = None
+
+
+class FeatureParallelTreeLearner(SerialTreeLearner):
+    """Feature-parallel learner: every shard holds ALL rows (like the
+    reference, feature_parallel_tree_learner.cpp:33-77 — no data movement),
+    scans only its round-robin-owned features, and the shards agree on the
+    global best split via all_gather + the SplitInfo merge order
+    (SyncUpGlobalBestSplit). The reference balances feature ownership by
+    bin count; round-robin is within a few percent for typical widths."""
+
+    def __init__(self, config, dataset, mesh: Mesh = None):
+        super().__init__(config, dataset)
+        self.mesh = mesh if mesh is not None else _make_mesh(
+            int(config.tpu_num_devices))
+        self.num_shards = self.mesh.devices.size
+        self._axis_name = AXIS
+        self.grow_config = self.grow_config._replace(parallel_mode="feature")
+        self._sharded_grow = None
+
+    def _build(self):
+        mesh = self.mesh
+        gc = self.grow_config
+        meta, params, fix = self.meta, self.params, self.fix
+        layout_rest = (self.layout.group_offset, self.layout.group_of,
+                       self.layout.most_freq_bin)
+        cat = self.cat_layout
+        use_part = self.dataset.num_data >= PARTITION_MIN_ROWS
+        gw_global = self.gw_global
+
+        @functools.partial(
+            jax.shard_map, mesh=mesh,
+            in_specs=(P(), P(), P(), P(), P(), P()),
+            out_specs=(_tree_arrays_spec(gc, row_sharded=False), P()),
+            check_vma=False)
+        def run(bins, grad, hess, bag, fmask, extras):
+            layout = DataLayout(bins, *layout_rest)
+            if use_part:
+                return grow_tree_partitioned(
+                    layout, grad, hess, bag, meta, params, fmask, fix, gc,
+                    gw_global=gw_global, axis_name=AXIS, cat=cat,
+                    extras=extras)
+            return grow_tree(layout, grad, hess, bag, meta, params, fmask,
+                             fix, gc, axis_name=AXIS, cat=cat, extras=extras)
+        return run
+
+    def train_arrays(self, grad, hess, bag_mask):
+        if self._sharded_grow is None:
+            self._sharded_grow = self._build()
+        fmask = jnp.asarray(self.col_sampler.sample())
+        arrays, fu = self._sharded_grow(self.layout.bins, grad, hess,
+                                        bag_mask, fmask,
+                                        self._next_extras())
+        self._feature_used_dev = fu
+        return arrays
+
+    def train(self, grad, hess, bag_mask):
+        arrays = self.train_arrays(grad, hess, bag_mask)
+        host = jax.device_get(
+            arrays._replace(row_leaf=jnp.zeros((0,), jnp.int32)))
+        tree = Tree.from_grower(host, self.dataset)
+        return tree, arrays.row_leaf
 
 
 def create_parallel_learner(learner_type: str, config, dataset):
-    if learner_type in ("data", "feature", "voting"):
-        if learner_type != "data":
-            Log.warning("tree_learner=%s currently runs via the row-sharded "
-                        "data-parallel path on TPU (same trees; the "
-                        "communication-volume optimization lands with the "
-                        "voting learner)" % learner_type)
+    if learner_type == "data":
         return DataParallelTreeLearner(config, dataset)
+    if learner_type == "voting":
+        return VotingParallelTreeLearner(config, dataset)
+    if learner_type == "feature":
+        return FeatureParallelTreeLearner(config, dataset)
     Log.fatal("Unknown tree learner type %s" % learner_type)

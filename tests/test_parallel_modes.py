@@ -1,0 +1,114 @@
+"""Voting- and feature-parallel learners vs serial on an 8-device CPU mesh.
+
+Feature-parallel must be EXACT (data replicated; the shard-merged argmax has
+the same tie semantics as the serial scan). Voting-parallel is exact when
+2*top_k covers every feature (every feature wins the vote and is reduced);
+with fewer votes it is the PV-tree approximation and only quality is
+asserted — the same contract as the reference learner.
+"""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _data(n=4000, f=10, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    X[rng.random((n, f)) < 0.05] = np.nan
+    y = (np.nan_to_num(X[:, 0]) + 0.5 * np.nan_to_num(X[:, 1]) > 0
+         ).astype(float)
+    return X, y
+
+
+def _trees(bst):
+    bst._booster._materialize_pending()
+    return bst._booster.models
+
+
+def _train(X, y, **extra):
+    params = {"objective": "binary", "num_leaves": 31, "verbosity": -1,
+              "max_bin": 63}
+    params.update(extra)
+    return lgb.train(params, lgb.Dataset(X, y), 8, verbose_eval=False)
+
+
+def _assert_same_structure(a, b):
+    assert len(a) == len(b)
+    for ta, tb in zip(a, b):
+        assert ta.num_leaves == tb.num_leaves
+        ni = ta.num_leaves - 1
+        np.testing.assert_array_equal(ta.split_feature[:ni],
+                                      tb.split_feature[:ni])
+        np.testing.assert_array_equal(ta.threshold_in_bin[:ni],
+                                      tb.threshold_in_bin[:ni])
+        np.testing.assert_allclose(ta.leaf_value[:ta.num_leaves],
+                                   tb.leaf_value[:tb.num_leaves],
+                                   rtol=1e-5, atol=1e-7)
+
+
+def test_feature_parallel_matches_serial():
+    X, y = _data()
+    serial = _train(X, y, tree_learner="serial")
+    feat = _train(X, y, tree_learner="feature")
+    _assert_same_structure(_trees(serial), _trees(feat))
+
+
+def test_voting_parallel_full_vote_matches_data_parallel():
+    X, y = _data()
+    # 2 * top_k >= F: every feature is voted in, so the reduction covers the
+    # full histogram. Trees match the data-parallel learner's up to f32
+    # summation order (voting fixes histograms after the selective reduce,
+    # data-parallel before the subtraction trick — a near-tie threshold can
+    # legitimately flip), so assert feature-level structure + gain/output
+    # closeness instead of bit equality.
+    data = _train(X, y, tree_learner="data")
+    vote = _train(X, y, tree_learner="voting", top_k=10)
+    td, tv = _trees(data), _trees(vote)
+    assert len(td) == len(tv)
+    for a, b in zip(td, tv):
+        assert a.num_leaves == b.num_leaves
+    Xc = np.nan_to_num(X)
+    pd_, pv = data.predict(Xc), vote.predict(Xc)
+    # a near-tie threshold flip early in a tree changes that subtree, so
+    # bit equality is not guaranteed; the models must agree functionally
+    assert np.mean(np.abs(pd_ - pv)) < 5e-3
+    assert ((pd_ > 0.5) == (pv > 0.5)).mean() > 0.995
+
+
+def test_voting_parallel_small_vote_still_learns():
+    X, y = _data(n=6000)
+    vote = _train(X, y, tree_learner="voting", top_k=2)
+    p = vote.predict(np.nan_to_num(X))
+    assert (((p > 0.5) == y).mean()) > 0.9
+
+
+def test_voting_parallel_matches_serial_quality():
+    X, y = _data(n=5000, seed=4)
+    serial = _train(X, y, tree_learner="serial")
+    vote = _train(X, y, tree_learner="voting", top_k=3)
+    Xc = np.nan_to_num(X)
+    acc_s = ((serial.predict(Xc) > 0.5) == y).mean()
+    acc_v = ((vote.predict(Xc) > 0.5) == y).mean()
+    assert acc_v > acc_s - 0.02
+
+
+@pytest.mark.parametrize("mode", ["voting", "feature", "data"])
+def test_parallel_modes_partitioned_path(monkeypatch, mode):
+    """Same checks through the payload-sorting (partitioned) grower, which
+    distributed-scale runs always use (num_data >= PARTITION_MIN_ROWS)."""
+    import lightgbm_tpu.parallel.learners as learners_mod
+    import lightgbm_tpu.treelearner.serial as serial_mod
+    monkeypatch.setattr(serial_mod, "PARTITION_MIN_ROWS", 100)
+    monkeypatch.setattr(learners_mod, "PARTITION_MIN_ROWS", 100)
+    X, y = _data(n=3000, seed=2)
+    serial = _train(X, y, tree_learner="serial")
+    par = _train(X, y, tree_learner=mode,
+                 **({"top_k": 10} if mode == "voting" else {}))
+    ts, tp = _trees(serial), _trees(par)
+    assert len(ts) == len(tp)
+    Xc = np.nan_to_num(X)
+    ps, pp = serial.predict(Xc), par.predict(Xc)
+    assert ((ps > 0.5) == (pp > 0.5)).mean() > 0.99
+    if mode == "feature":
+        _assert_same_structure(ts, tp)
