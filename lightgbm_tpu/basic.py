@@ -158,10 +158,24 @@ class Dataset:
                 and BinnedDataset.is_binary_file(path + ".bin"):
             # CheckCanLoadFromBin probes <data>.bin (dataset_loader.cpp:179)
             path = path + ".bin"
+        if BinnedDataset.is_binary_file(path) and self.reference is not None:
+            # a cached .bin was binned standalone; a reference-aligned set
+            # must share the reference's bin boundaries, so fall back
+            Log.warning("Ignoring binary cache %s: reference-aligned "
+                        "datasets must be re-binned against the reference"
+                        % path)
+            path = str(self.data)
         if BinnedDataset.is_binary_file(path):
             self._inner = BinnedDataset.from_binary(path)
+            md = self._inner.metadata
             if self.label is not None:
-                self._inner.metadata.set_label(self.label)
+                md.set_label(self.label)
+            if self.weight is not None:
+                md.set_weight(self.weight)
+            if self.group is not None:
+                md.set_query(self.group)
+            if self.init_score is not None:
+                md.set_init_score(self.init_score)
             self.data = None if self.free_raw_data else self.data
             return self
         cat_idx = (list(self.categorical_feature)
@@ -174,6 +188,15 @@ class Dataset:
         if cfg.two_round and ref_inner is None:
             self._inner = BinnedDataset.from_text_two_round(
                 path, cfg, categorical_features=cat_idx)
+            md = self._inner.metadata
+            if self.label is not None:
+                md.set_label(self.label)
+            if self.weight is not None:
+                md.set_weight(self.weight)
+            if self.group is not None:
+                md.set_query(self.group)
+            if self.init_score is not None:
+                md.set_init_score(self.init_score)
         else:
             loaded = load_text_file(path, cfg)
             self._inner = BinnedDataset.from_matrix(

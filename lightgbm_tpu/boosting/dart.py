@@ -16,6 +16,8 @@ from .gbdt import GBDT
 
 
 class DART(GBDT):
+
+    supports_batch = False  # per-iteration host work (drop/sample RNG)
     sub_model_name = "dart"
 
     def init(self, config, train_data, objective, training_metrics=()):

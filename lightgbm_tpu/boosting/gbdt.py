@@ -62,6 +62,13 @@ class GBDT:
         self.best_score_by_metric: Dict[str, float] = {}
         self.evals_output: List[tuple] = []   # (iter, dataset, name, value)
         self._pending: List[tuple] = []       # async fast-path device trees
+        self._pending_batches: List[tuple] = []  # (start_pos, stacked, shrink)
+        # engine sets allow_batch when no before-iteration callbacks/evals
+        # exist; then K iterations fuse into one jitted lax.scan dispatch
+        self.allow_batch = False
+        self.planned_rounds = 0
+        self._rounds_done = 0
+        self._batch_credit = 0
 
     # ------------------------------------------------------------------
     def init(self, config: Config, train_data, objective,
@@ -226,7 +233,56 @@ class GBDT:
                 and self.train_data.num_features > 0
                 and all(self.class_need_train))
 
+    supports_batch = True   # DART/GOSS/RF need host work per iteration
+
+    def _batch_size(self) -> int:
+        from ..treelearner.serial import SerialTreeLearner
+        cfg = self.config
+        if not (self.allow_batch and self.supports_batch
+                and self.num_tree_per_iteration == 1
+                and not (cfg.bagging_fraction < 1.0 and cfg.bagging_freq > 0)
+                and not (cfg.pos_bagging_fraction < 1.0
+                         or cfg.neg_bagging_fraction < 1.0)
+                and not self.need_re_bagging
+                and not self.balanced_bagging
+                and self._bag_weight_dev is None
+                and self.train_data.num_features > 0
+                and type(self.tree_learner) is SerialTreeLearner):
+            return 1
+        remaining = self.planned_rounds - self._rounds_done + 1
+        # fixed batch size: every distinct k compiles its own scan program,
+        # so the tail runs as single iterations instead of a second compile
+        K = 16
+        return K if remaining >= K else 1
+
+    def _train_multi_iter_fast(self, k: int) -> bool:
+        """K fused iterations (one device dispatch); see
+        SerialTreeLearner.train_arrays_scan."""
+        learner = self.tree_learner
+        init0 = self.boost_from_average(0, True)   # no-op past iteration 0
+        fmasks = jnp.asarray(
+            np.stack([learner.col_sampler.sample() for _ in range(k)]))
+        keys = jnp.stack([learner._next_extras().key for _ in range(k)])
+        score0 = self.train_score.score_device(0)
+        scoreK, fuK, stacked = learner.train_arrays_scan(
+            self.objective, score0, fmasks, keys, self.shrinkage_rate, k)
+        learner._feature_used_dev = fuK
+        self.train_score._score[0] = scoreK
+        start = len(self.models)
+        self._pending_batches.append((start, stacked, self.shrinkage_rate,
+                                      init0))
+        self.models.extend([None] * k)
+        self.iter += k
+        self._batch_credit = k - 1
+        return False
+
     def _train_one_iter_fast(self) -> bool:
+        if self._batch_credit > 0:
+            self._batch_credit -= 1
+            return False
+        k = self._batch_size()
+        if k > 1:
+            return self._train_multi_iter_fast(k)
         ntpi = self.num_tree_per_iteration
         init_scores = [self.boost_from_average(k, True) for k in range(ntpi)]
         g_dev, h_dev = self._compute_gradients()
@@ -259,16 +315,64 @@ class GBDT:
         no-split stop (reference stops and pops that iteration's trees —
         our device update contributed nothing for 1-leaf trees, so
         truncation reproduces the same model)."""
-        if not self._pending:
+        if not self._pending and not self._pending_batches:
             return
         import jax
+
+        def get_packed(pytree):
+            """One device->host transfer for a whole pytree: bitcast every
+            leaf to a flat u8 blob, concatenate, transfer once, re-split.
+            Each leaf transferred separately costs one ~100ms round trip
+            under remote-TPU dispatch."""
+            leaves, treedef = jax.tree.flatten(pytree)
+            blobs = []
+            for x in leaves:
+                if x.dtype == jnp.bool_:
+                    x = x.astype(jnp.uint8)
+                if x.dtype != jnp.uint8:
+                    x = jax.lax.bitcast_convert_type(x, jnp.uint8)
+                blobs.append(x.reshape(-1))
+            blob = np.asarray(jnp.concatenate(blobs) if blobs else
+                              jnp.zeros((0,), jnp.uint8))
+            out, off = [], 0
+            for x in leaves:
+                nb = (int(np.prod(x.shape)) * x.dtype.itemsize
+                      if x.ndim else x.dtype.itemsize)
+                raw = blob[off:off + nb]
+                off += nb
+                if x.dtype == jnp.bool_:
+                    out.append(raw.astype(bool).reshape(x.shape))
+                else:
+                    out.append(np.frombuffer(raw.tobytes(),
+                                             dtype=np.dtype(x.dtype))
+                               .reshape(x.shape))
+            return jax.tree.unflatten(treedef, out)
+
+        # batch-scan entries are already stacked on device: one transfer
+        for start, stacked, shrink, init0 in self._pending_batches:
+            host_b = get_packed(stacked)
+            kb = int(host_b.num_leaves.shape[0])
+            for i in range(kb):
+                ha = jax.tree.map(lambda a, i=i: a[i], host_b)
+                tree = Tree.from_grower(ha, self.train_data)
+                if tree.num_leaves > 1:
+                    tree.shrink(shrink)
+                    if i == 0 and abs(init0) > K_EPSILON:
+                        tree.add_bias(init0)
+                else:
+                    tree = Tree(1)
+                self.models[start + i] = tree
+        self._pending_batches = []
+        if not self._pending:
+            self._truncate_if_stopped()
+            return
         # one stacked transfer per FIELD, not per (tree, field): the host
         # Tree never reads row_leaf (it exists for device score updates),
         # and under remote-TPU dispatch every D2H round trip costs ~100ms+
         empty_rl = jnp.zeros((0,), jnp.int32)
         stripped = [p[1]._replace(row_leaf=empty_rl) for p in self._pending]
         batched = jax.tree.map(lambda *xs: jnp.stack(xs), *stripped)
-        host_batched = jax.device_get(batched)
+        host_batched = get_packed(batched)
         host_arrays = [jax.tree.map(lambda a, i=i: a[i], host_batched)
                        for i in range(len(stripped))]
         stop_pos = None
@@ -292,12 +396,34 @@ class GBDT:
                             "leaves that meet the split requirements")
                 del self.models[cut:]
                 self.iter = len(self.models) // ntpi
+        self._truncate_if_stopped()
+
+    def _truncate_if_stopped(self) -> None:
+        """Batch entries can contain a 1-leaf tree (no-split stop
+        mid-batch): truncate at the FIRST stub, exactly like the
+        single-iteration stop logic (initial constant trees and any trees
+        from a continued-training init model are exempt)."""
+        ntpi = self.num_tree_per_iteration
+        floor = max(ntpi, self.num_init_iteration * ntpi)
+        first_stub = None
+        for i, t in enumerate(self.models):
+            if t is not None and t.num_leaves <= 1 and i >= floor:
+                first_stub = i
+                break
+        if first_stub is not None:
+            cut = (first_stub // ntpi) * ntpi
+            if cut < len(self.models):
+                Log.warning("Stopped training because there are no more "
+                            "leaves that meet the split requirements")
+                del self.models[cut:]
+                self.iter = len(self.models) // ntpi
 
     def train_one_iter(self, gradients: Optional[np.ndarray] = None,
                        hessians: Optional[np.ndarray] = None) -> bool:
         """One boosting iteration; returns True when training should STOP
         (no splittable leaves), mirroring gbdt.cpp:338-420."""
         ntpi = self.num_tree_per_iteration
+        self._rounds_done += 1
         if gradients is None and hessians is None and self._fast_path_ok():
             return self._train_one_iter_fast()
         self._materialize_pending()

@@ -19,6 +19,8 @@ from .gbdt import GBDT
 
 
 class GOSS(GBDT):
+
+    supports_batch = False  # per-iteration host work (drop/sample RNG)
     sub_model_name = "goss"
 
     def init(self, config, train_data, objective, training_metrics=()):

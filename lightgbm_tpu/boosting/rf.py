@@ -18,6 +18,8 @@ from .gbdt import GBDT, K_EPSILON
 
 
 class RF(GBDT):
+
+    supports_batch = False  # per-iteration host work (drop/sample RNG)
     sub_model_name = "tree"   # reference RF still writes "tree"
     average_output = True
 

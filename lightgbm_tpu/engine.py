@@ -138,6 +138,14 @@ def train(params: Dict[str, Any], train_set: Dataset,
     for valid_set, name_valid_set in zip(reduced_valid_sets, name_valid_sets):
         booster.add_valid(valid_set, name_valid_set)
     booster.best_iteration = 0
+    # with no per-iteration host work (no before-iter callbacks, no eval
+    # sets, no custom objective), the booster may fuse iterations into one
+    # jitted multi-tree scan (one device dispatch per K trees)
+    inner = getattr(booster, "_booster", None)
+    if inner is not None:
+        inner.allow_batch = (not callbacks_before_iter
+                             and valid_sets is None and fobj is None)
+        inner.planned_rounds = num_boost_round
 
     evaluation_result_list: List = []
     for i in range(init_iteration, init_iteration + num_boost_round):

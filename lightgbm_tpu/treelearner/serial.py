@@ -278,6 +278,79 @@ class SerialTreeLearner:
             ex = ex._replace(feature_used=self._feature_used_dev)
         return ex
 
+    def train_arrays_scan(self, objective, score0, fmasks, keys,
+                          shrink: float, k: int):
+        """K boosting iterations in ONE jitted lax.scan: gradients ->
+        grow -> score update never leave the device. Under remote-TPU
+        dispatch each host->device call costs ~100ms of latency; batching
+        K iterations divides that by K. Returns (final score, final
+        feature_used, stacked TreeArrays with row_leaf dropped)."""
+        import jax
+        # cache the compiled scan ON THE DATASET: every Booster builds a
+        # fresh learner (bench warmup vs measured run, cv folds, ...), and
+        # a fresh closure means a ~35s recompile — the program only depends
+        # on the dataset layout + grow config + objective
+        cache = getattr(self.dataset, "_scan_cache", None)
+        if cache is None:
+            cache = self.dataset._scan_cache = {}
+        # everything config-valued (SplitParams, FeatureMeta's monotone/
+        # penalty, the CEGB extras) is passed as a TRACED argument — baking
+        # it into the closure would let a second training on the same
+        # Dataset silently reuse the first run's hyperparameters. Only
+        # array SHAPES and the static GrowConfig live in the key, plus the
+        # objective's model string (its hyperparameters, e.g. sigmoid).
+        cache_key = (k, self.grow_config, type(objective).__name__,
+                     objective.to_string())
+        fn = cache.get(cache_key)
+        if fn is None:
+            grad_fn = objective.grad_fn()
+            gargs = objective._grad_args()
+            gc = self.grow_config
+            use_part = self.use_partitioned
+            layout = self.layout
+            cat, gw = self.cat_layout, self.gw_global
+            n = self.dataset.num_data
+
+            @jax.jit
+            def run(score0, fu0, fmasks, keys, base_extras, shrink_t,
+                    meta, params, fix):
+                bag = jnp.ones(n, bool)
+
+                def body(carry, per):
+                    score, fu = carry
+                    fmask, kk = per
+                    g, h = grad_fn(score, *gargs)
+                    ex = base_extras._replace(key=kk, feature_used=fu)
+                    g = g.astype(jnp.float32)
+                    h = h.astype(jnp.float32)
+                    if use_part:
+                        arrays, fu2 = grow_tree_partitioned(
+                            layout, g, h, bag, meta, params, fmask, fix, gc,
+                            gw_global=gw, cat=cat, extras=ex)
+                    else:
+                        arrays, fu2 = grow_tree(
+                            layout, g, h, bag, meta, params, fmask, fix, gc,
+                            cat=cat, extras=ex)
+                    upd = arrays.leaf_value.astype(jnp.float64)[
+                        arrays.row_leaf] * shrink_t
+                    score2 = score + jnp.where(arrays.num_leaves > 1, upd,
+                                               0.0)
+                    out = arrays._replace(
+                        row_leaf=jnp.zeros((0,), jnp.int32))
+                    return (score2, fu2), out
+
+                (scoreK, fuK), stacked = jax.lax.scan(
+                    body, (score0, fu0), (fmasks, keys), length=k)
+                return scoreK, fuK, stacked
+            cache[cache_key] = run
+            fn = run
+        base = self._extras_base
+        fu0 = (self._feature_used_dev if self._feature_used_dev is not None
+               else base.feature_used)
+        return fn(score0, fu0, fmasks, keys, base,
+                  jnp.asarray(shrink, jnp.float64),
+                  self.meta, self.params, self.fix)
+
     def train(self, grad: jnp.ndarray, hess: jnp.ndarray,
               bag_mask: jnp.ndarray) -> Tuple[Tree, jnp.ndarray]:
         """Grow one tree; returns (host Tree, device row->leaf array).

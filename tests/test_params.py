@@ -120,3 +120,33 @@ def test_refit_keeps_structure_updates_leaves():
     mse_old = np.mean((bst.predict(X2) - y2) ** 2)
     mse_new = np.mean((new.predict(X2) - y2) ** 2)
     assert mse_new < mse_old
+
+
+def test_batched_scan_respects_changed_hyperparams():
+    """Two trainings on the SAME Dataset with different regularization must
+    differ (the fused-scan cache must not bake hyperparameters in)."""
+    X, y = _data(n=1200)
+    ds = lgb.Dataset(X, y)
+    base = {"objective": "regression", "num_leaves": 31, "verbosity": -1}
+    b1 = lgb.train(dict(base), ds, 8, verbose_eval=False)
+    b2 = lgb.train({**base, "lambda_l2": 1000.0}, ds, 8, verbose_eval=False)
+    p1, p2 = b1.predict(X), b2.predict(X)
+    assert not np.allclose(p1, p2)
+    assert np.abs(p2).mean() < np.abs(p1).mean()  # heavy L2 shrinks outputs
+
+
+def test_bagging_not_silently_dropped():
+    """bagging_fraction < 1 must keep bagging active every iteration (the
+    fused batch path must not engage and train full-data)."""
+    X, y = _data(n=3000)
+    base = {"objective": "regression", "num_leaves": 31, "verbosity": -1,
+            "bagging_freq": 1, "bagging_seed": 7}
+    b_full = lgb.train(dict(base), lgb.Dataset(X, y), 6, verbose_eval=False)
+    b_bag = lgb.train({**base, "bagging_fraction": 0.5},
+                      lgb.Dataset(X, y), 6, verbose_eval=False)
+    t_full, t_bag = _trees_of(b_full), _trees_of(b_bag)
+    # bagged trees must see ~half the rows at their roots, every iteration
+    for t in t_bag[1:]:
+        assert t.internal_count[0] < 0.7 * X.shape[0]
+    for t in t_full[1:]:
+        assert t.internal_count[0] == X.shape[0]
