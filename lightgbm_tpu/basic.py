@@ -159,23 +159,29 @@ class Dataset:
             # CheckCanLoadFromBin probes <data>.bin (dataset_loader.cpp:179)
             path = path + ".bin"
         if BinnedDataset.is_binary_file(path) and self.reference is not None:
-            # a cached .bin was binned standalone; a reference-aligned set
-            # must share the reference's bin boundaries, so fall back
-            Log.warning("Ignoring binary cache %s: reference-aligned "
-                        "datasets must be re-binned against the reference"
-                        % path)
-            path = str(self.data)
+            # a binary cache is only usable for a reference-aligned set when
+            # its binning layout matches the reference's exactly (e.g. it
+            # was saved FROM a reference-aligned validation set)
+            self.reference.construct()
+            cached = BinnedDataset.from_binary(path)
+            if cached.layout_matches(self.reference._inner):
+                self._inner = cached
+                self._apply_field_overrides()
+                self.data = None if self.free_raw_data else self.data
+                return self
+            if path != str(self.data):
+                # auto-probed <data>.bin next to a text file: re-bin the text
+                Log.warning("Ignoring binary cache %s: its bin layout does "
+                            "not match the reference dataset" % path)
+                path = str(self.data)
+            else:
+                raise LightGBMError(
+                    "Binary dataset %s was binned standalone and does not "
+                    "match the reference's bin layout; recreate it from the "
+                    "raw text/matrix" % path)
         if BinnedDataset.is_binary_file(path):
             self._inner = BinnedDataset.from_binary(path)
-            md = self._inner.metadata
-            if self.label is not None:
-                md.set_label(self.label)
-            if self.weight is not None:
-                md.set_weight(self.weight)
-            if self.group is not None:
-                md.set_query(self.group)
-            if self.init_score is not None:
-                md.set_init_score(self.init_score)
+            self._apply_field_overrides()
             self.data = None if self.free_raw_data else self.data
             return self
         cat_idx = (list(self.categorical_feature)
@@ -188,15 +194,7 @@ class Dataset:
         if cfg.two_round and ref_inner is None:
             self._inner = BinnedDataset.from_text_two_round(
                 path, cfg, categorical_features=cat_idx)
-            md = self._inner.metadata
-            if self.label is not None:
-                md.set_label(self.label)
-            if self.weight is not None:
-                md.set_weight(self.weight)
-            if self.group is not None:
-                md.set_query(self.group)
-            if self.init_score is not None:
-                md.set_init_score(self.init_score)
+            self._apply_field_overrides()
         else:
             loaded = load_text_file(path, cfg)
             self._inner = BinnedDataset.from_matrix(
@@ -213,6 +211,19 @@ class Dataset:
             self._inner.save_binary(path + ".bin")
         self.data = None if self.free_raw_data else self.data
         return self
+
+    def _apply_field_overrides(self) -> None:
+        """User-supplied fields take precedence over whatever the loaded
+        dataset (binary cache / parsed file) carried."""
+        md = self._inner.metadata
+        if self.label is not None:
+            md.set_label(self.label)
+        if self.weight is not None:
+            md.set_weight(self.weight)
+        if self.group is not None:
+            md.set_query(self.group)
+        if self.init_score is not None:
+            md.set_init_score(self.init_score)
 
     @property
     def constructed(self) -> bool:
@@ -355,6 +366,19 @@ class Dataset:
                       free_raw_data=self.free_raw_data)
         sub.used_indices = idx
         return sub
+
+    def add_features_from(self, other: "Dataset") -> "Dataset":
+        """Append other's features to this Dataset (reference
+        Dataset.add_features_from / LGBM_DatasetAddFeaturesFrom)."""
+        self.construct()
+        other.construct()
+        self._inner.add_features_from(other._inner)
+        if getattr(self, "_raw_X", None) is not None \
+                and getattr(other, "_raw_X", None) is not None:
+            self._raw_X = np.concatenate([self._raw_X, other._raw_X], axis=1)
+        else:
+            self._raw_X = None
+        return self
 
     def _update_params(self, params) -> "Dataset":
         if params:

@@ -24,6 +24,7 @@ from ..config import Config
 from ..models.tree import Tree
 from ..objectives import create_objective, parse_objective_string
 from ..treelearner import create_tree_learner
+from ..utils import timer
 from ..utils.log import Log
 from .score_updater import HostScoreUpdater, ScoreUpdater
 
@@ -255,6 +256,7 @@ class GBDT:
         K = 16
         return K if remaining >= K else 1
 
+    @timer.timed("boosting::TrainMultiIterFast(launch)")
     def _train_multi_iter_fast(self, k: int) -> bool:
         """K fused iterations (one device dispatch); see
         SerialTreeLearner.train_arrays_scan."""
@@ -310,6 +312,7 @@ class GBDT:
         self.iter += 1
         return False
 
+    @timer.timed("boosting::MaterializePending(D2H+wait)")
     def _materialize_pending(self) -> None:
         """Pull all pending device trees to host in one transfer; detect a
         no-split stop (reference stops and pops that iteration's trees —
@@ -361,6 +364,10 @@ class GBDT:
                         tree.add_bias(init0)
                 else:
                     tree = Tree(1)
+                    if start + i == 0:
+                        # reference keeps the iteration-0 constant tree at
+                        # the boosted-from-average output (gbdt.cpp:396-411)
+                        tree.leaf_value[0] = init0
                 self.models[start + i] = tree
         self._pending_batches = []
         if not self._pending:
@@ -376,6 +383,8 @@ class GBDT:
         host_arrays = [jax.tree.map(lambda a, i=i: a[i], host_batched)
                        for i in range(len(stripped))]
         stop_pos = None
+        iter0_stubs = 0
+        ntpi = self.num_tree_per_iteration
         for (pos, _, k, shrink, init), ha in zip(self._pending, host_arrays):
             tree = Tree.from_grower(ha, self.train_data)
             if tree.num_leaves > 1:
@@ -383,13 +392,21 @@ class GBDT:
                 if abs(init) > K_EPSILON:
                     tree.add_bias(init)
             else:
-                if stop_pos is None:
-                    stop_pos = pos
                 tree = Tree(1)
+                if pos < ntpi and self.num_init_iteration == 0:
+                    # reference keeps iteration-0 constant trees at the
+                    # boosted-from-average output (gbdt.cpp:396-411); the
+                    # model only STOPS if no class split at all
+                    # (should_continue is OR-ed across classes)
+                    tree.leaf_value[0] = init
+                    iter0_stubs += 1
+                elif stop_pos is None:
+                    stop_pos = pos
             self.models[pos] = tree
         self._pending = []
+        if iter0_stubs == ntpi:
+            stop_pos = ntpi if len(self.models) > ntpi else None
         if stop_pos is not None:
-            ntpi = self.num_tree_per_iteration
             cut = (stop_pos // ntpi) * ntpi
             if cut < len(self.models):
                 Log.warning("Stopped training because there are no more "
@@ -418,6 +435,7 @@ class GBDT:
                 del self.models[cut:]
                 self.iter = len(self.models) // ntpi
 
+    @timer.timed("boosting::TrainOneIter")
     def train_one_iter(self, gradients: Optional[np.ndarray] = None,
                        hessians: Optional[np.ndarray] = None) -> bool:
         """One boosting iteration; returns True when training should STOP
@@ -605,6 +623,7 @@ class GBDT:
             del self.models[-cut:]
         return met_early_stop
 
+    @timer.timed("boosting::OutputMetric(eval)")
     def output_metric(self, it: int) -> bool:
         """GBDT::OutputMetric (gbdt.cpp:485-543): print/record metrics and
         check early stopping. Returns True when early stop triggers."""

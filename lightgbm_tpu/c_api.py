@@ -1,0 +1,900 @@
+"""The LGBM_* C API surface, Python side.
+
+TPU-native rebuild of src/c_api.cpp (~70 entry points declared in
+include/LightGBM/c_api.h). The reference implements the C API in C++ on
+top of its C++ core; here the core is Python/JAX, so the layering inverts:
+this module implements every entry point against `basic.Dataset`/`Booster`,
+and the thin C ABI layer (native/c_api_shim.cpp) embeds CPython and
+forwards each exported LGBM_* symbol here — external C/C++/R/Java hosts
+get a genuine `lib_lightgbm`-compatible shared library whose compute runs
+on TPU.
+
+Calling convention of this module: pointers arrive as integer addresses
+(the shim passes them as uintptr_t); ctypes turns them into typed views.
+Out-parameters are written directly through those addresses — caller and
+callee share one process. Functions return 0 on success and raise on
+error; the shim converts exceptions into -1 + LGBM_GetLastError().
+
+Also usable without the shim: `lightgbm_tpu.c_api` + ctypes-allocated
+buffers from Python (see tests/test_c_api.py, the analog of the
+reference's tests/c_api_test/test_.py).
+"""
+from __future__ import annotations
+
+import ctypes
+import itertools
+import json
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .basic import Booster, Dataset
+from .config import params_to_config
+from .utils.log import LightGBMError, Log
+
+# dtype / predict-type constants (c_api.h:26-48)
+C_API_DTYPE_FLOAT32 = 0
+C_API_DTYPE_FLOAT64 = 1
+C_API_DTYPE_INT32 = 2
+C_API_DTYPE_INT64 = 3
+
+C_API_PREDICT_NORMAL = 0
+C_API_PREDICT_RAW_SCORE = 1
+C_API_PREDICT_LEAF_INDEX = 2
+C_API_PREDICT_CONTRIB = 3
+
+_NP_DTYPE = {
+    C_API_DTYPE_FLOAT32: np.float32,
+    C_API_DTYPE_FLOAT64: np.float64,
+    C_API_DTYPE_INT32: np.int32,
+    C_API_DTYPE_INT64: np.int64,
+}
+
+_handles: Dict[int, Any] = {}
+_next_handle = itertools.count(1)
+
+
+def _register(obj) -> int:
+    h = next(_next_handle)
+    _handles[h] = obj
+    return h
+
+
+def _get(handle) -> Any:
+    obj = _handles.get(int(handle))
+    if obj is None:
+        raise LightGBMError("Invalid handle %r" % (handle,))
+    return obj
+
+
+def _view(ptr: int, dtype, count: int) -> np.ndarray:
+    """Zero-copy numpy view over a raw address."""
+    if count == 0:
+        return np.empty(0, dtype=dtype)
+    ctype = np.ctypeslib.as_ctypes_type(np.dtype(dtype))
+    buf = (ctype * count).from_address(int(ptr))
+    return np.ctypeslib.as_array(buf)
+
+
+def _write_out(ptr: int, value, ctype=ctypes.c_int32) -> None:
+    ctype.from_address(int(ptr)).value = value
+
+
+def _params_dict(parameters) -> Dict[str, Any]:
+    """`key=value key2=value2` C-style parameter string -> dict
+    (Config::KV2Map / Str2Map, config.h:79)."""
+    if parameters is None:
+        return {}
+    if isinstance(parameters, bytes):
+        parameters = parameters.decode("utf-8")
+    out: Dict[str, Any] = {}
+    for tok in str(parameters).split():
+        if "=" in tok:
+            k, v = tok.split("=", 1)
+            out[k.strip()] = v.strip()
+    return out
+
+
+class _CDataset:
+    """C-API dataset wrapper: a basic.Dataset plus push-rows state."""
+
+    def __init__(self, ds: Dataset, params: Dict[str, Any]):
+        self.ds = ds
+        self.params = params
+        # streaming (PushRows) state
+        self.nrow_total = 0
+        self.ncol = 0
+        self.pending: Optional[np.ndarray] = None
+        self.pushed = 0
+        self.reference: Optional[_CDataset] = None
+
+    def construct(self):
+        self.ds.construct()
+        return self.ds
+
+
+# ---------------------------------------------------------------------------
+# Dataset creation (c_api.h:51-255)
+# ---------------------------------------------------------------------------
+
+def LGBM_DatasetCreateFromFile(filename, parameters, reference, out) -> int:
+    params = _params_dict(parameters)
+    ref = _get(reference).ds if reference else None
+    if isinstance(filename, bytes):
+        filename = filename.decode("utf-8")
+    ds = Dataset(str(filename), params=params, reference=ref,
+                 free_raw_data=False)
+    ds.construct()
+    _write_out(out, _register(_CDataset(ds, params)), ctypes.c_uint64)
+    return 0
+
+
+def _mat_from_ptr(data, data_type, nrow, ncol, is_row_major) -> np.ndarray:
+    arr = _view(data, _NP_DTYPE[int(data_type)], int(nrow) * int(ncol))
+    if is_row_major:
+        return arr.reshape(int(nrow), int(ncol)).astype(np.float64)
+    return arr.reshape(int(ncol), int(nrow)).T.astype(np.float64)
+
+
+def LGBM_DatasetCreateFromMat(data, data_type, nrow, ncol, is_row_major,
+                              parameters, reference, out) -> int:
+    X = _mat_from_ptr(data, data_type, nrow, ncol, is_row_major)
+    params = _params_dict(parameters)
+    ref = _get(reference).ds if reference else None
+    ds = Dataset(X, params=params, reference=ref, free_raw_data=False)
+    ds.construct()
+    _write_out(out, _register(_CDataset(ds, params)), ctypes.c_uint64)
+    return 0
+
+
+def LGBM_DatasetCreateFromMats(nmat, data_ptrs, data_type, nrows, ncol,
+                               is_row_major, parameters, reference,
+                               out) -> int:
+    ptrs = _view(data_ptrs, np.uint64, int(nmat))
+    rows = _view(nrows, np.int32, int(nmat))
+    mats = [_mat_from_ptr(int(ptrs[i]), data_type, int(rows[i]), ncol,
+                          is_row_major) for i in range(int(nmat))]
+    X = np.concatenate(mats, axis=0) if mats else np.empty((0, int(ncol)))
+    params = _params_dict(parameters)
+    ref = _get(reference).ds if reference else None
+    ds = Dataset(X, params=params, reference=ref, free_raw_data=False)
+    ds.construct()
+    _write_out(out, _register(_CDataset(ds, params)), ctypes.c_uint64)
+    return 0
+
+
+def _indptr_view(ptr, indptr_type, count):
+    dt = {C_API_DTYPE_INT32: np.int32, C_API_DTYPE_INT64: np.int64}[
+        int(indptr_type)]
+    return _view(ptr, dt, count)
+
+
+def LGBM_DatasetCreateFromCSR(indptr, indptr_type, indices, data, data_type,
+                              nindptr, nelem, num_col, parameters,
+                              reference, out) -> int:
+    ip = _indptr_view(indptr, indptr_type, int(nindptr))
+    idx = _view(indices, np.int32, int(nelem))
+    vals = _view(data, _NP_DTYPE[int(data_type)], int(nelem))
+    nrow = int(nindptr) - 1
+    X = np.zeros((nrow, int(num_col)), dtype=np.float64)
+    for r in range(nrow):
+        s, e = int(ip[r]), int(ip[r + 1])
+        X[r, idx[s:e]] = vals[s:e]
+    params = _params_dict(parameters)
+    ref = _get(reference).ds if reference else None
+    ds = Dataset(X, params=params, reference=ref, free_raw_data=False)
+    ds.construct()
+    _write_out(out, _register(_CDataset(ds, params)), ctypes.c_uint64)
+    return 0
+
+
+def LGBM_DatasetCreateFromCSC(col_ptr, col_ptr_type, indices, data,
+                              data_type, ncol_ptr, nelem, num_row,
+                              parameters, reference, out) -> int:
+    cp = _indptr_view(col_ptr, col_ptr_type, int(ncol_ptr))
+    idx = _view(indices, np.int32, int(nelem))
+    vals = _view(data, _NP_DTYPE[int(data_type)], int(nelem))
+    ncol = int(ncol_ptr) - 1
+    X = np.zeros((int(num_row), ncol), dtype=np.float64)
+    for c in range(ncol):
+        s, e = int(cp[c]), int(cp[c + 1])
+        X[idx[s:e], c] = vals[s:e]
+    params = _params_dict(parameters)
+    ref = _get(reference).ds if reference else None
+    ds = Dataset(X, params=params, reference=ref, free_raw_data=False)
+    ds.construct()
+    _write_out(out, _register(_CDataset(ds, params)), ctypes.c_uint64)
+    return 0
+
+
+def LGBM_DatasetCreateFromSampledColumn(sample_data, sample_indices, ncol,
+                                        num_per_col, num_sample_row,
+                                        num_total_row, parameters,
+                                        out) -> int:
+    """Streaming creation: bin mappers from a column sample, rows pushed
+    later via LGBM_DatasetPushRows (c_api.h:68 + :98)."""
+    params = _params_dict(parameters)
+    ncol = int(ncol)
+    counts = _view(num_per_col, np.int32, ncol)
+    sample_ptrs = _view(sample_data, np.uint64, ncol)
+    idx_ptrs = _view(sample_indices, np.uint64, ncol)
+    n_sample = int(num_sample_row)
+    sample = np.zeros((n_sample, ncol), dtype=np.float64)
+    for c in range(ncol):
+        cnt = int(counts[c])
+        if cnt == 0:
+            continue
+        vals = _view(int(sample_ptrs[c]), np.float64, cnt)
+        rows = _view(int(idx_ptrs[c]), np.int32, cnt)
+        sample[rows, c] = vals
+    cd = _CDataset(Dataset(sample, params=params, free_raw_data=False),
+                   params)
+    cd.nrow_total = int(num_total_row)
+    cd.ncol = ncol
+    cd.pending = np.zeros((cd.nrow_total, ncol), dtype=np.float64)
+    cd.sample = sample
+    _write_out(out, _register(cd), ctypes.c_uint64)
+    return 0
+
+
+def LGBM_DatasetPushRows(dataset, data, data_type, nrow, ncol,
+                         start_row) -> int:
+    cd = _get(dataset)
+    if cd.pending is None:
+        raise LightGBMError("Dataset was not created for streaming push")
+    X = _mat_from_ptr(data, data_type, nrow, ncol, 1)
+    s = int(start_row)
+    cd.pending[s:s + int(nrow)] = X
+    cd.pushed += int(nrow)
+    if cd.pushed >= cd.nrow_total:
+        _finish_pushed(cd)
+    return 0
+
+
+def _finish_pushed(cd: _CDataset) -> None:
+    ref = cd.reference.ds if cd.reference is not None else None
+    cd.ds = Dataset(cd.pending, params=cd.params, reference=ref,
+                    free_raw_data=False)
+    cd.ds.construct()
+    cd.pending = None
+
+
+def LGBM_DatasetPushRowsByCSR(dataset, indptr, indptr_type, indices, data,
+                              data_type, nindptr, nelem, num_col,
+                              start_row) -> int:
+    cd = _get(dataset)
+    if cd.pending is None:
+        raise LightGBMError("Dataset was not created for streaming push")
+    ip = _indptr_view(indptr, indptr_type, int(nindptr))
+    idx = _view(indices, np.int32, int(nelem))
+    vals = _view(data, _NP_DTYPE[int(data_type)], int(nelem))
+    nrow = int(nindptr) - 1
+    s = int(start_row)
+    for r in range(nrow):
+        a, b = int(ip[r]), int(ip[r + 1])
+        cd.pending[s + r, :] = 0.0
+        cd.pending[s + r, idx[a:b]] = vals[a:b]
+    cd.pushed += nrow
+    if cd.pushed >= cd.nrow_total:
+        _finish_pushed(cd)
+    return 0
+
+
+def LGBM_DatasetCreateByReference(reference, num_total_row, out) -> int:
+    ref = _get(reference)
+    cd = _CDataset(Dataset(None, free_raw_data=False), dict(ref.params))
+    cd.reference = ref
+    cd.nrow_total = int(num_total_row)
+    cd.ncol = ref.construct().num_feature()
+    cd.pending = np.zeros((cd.nrow_total, cd.ncol), dtype=np.float64)
+    _write_out(out, _register(cd), ctypes.c_uint64)
+    return 0
+
+
+def LGBM_DatasetGetSubset(handle, used_row_indices, num_used_row_indices,
+                          parameters, out) -> int:
+    cd = _get(handle)
+    idx = np.array(_view(used_row_indices, np.int32,
+                         int(num_used_row_indices)), copy=True)
+    params = _params_dict(parameters)
+    sub = cd.construct().subset(idx, params=params or None)
+    sub.construct()
+    _write_out(out, _register(_CDataset(sub, params)), ctypes.c_uint64)
+    return 0
+
+
+def LGBM_DatasetSetFeatureNames(handle, feature_names, num_feature) -> int:
+    cd = _get(handle)
+    ptrs = _view(feature_names, np.uint64, int(num_feature))
+    names = [ctypes.string_at(int(p)).decode("utf-8") for p in ptrs]
+    cd.construct()
+    cd.ds._inner.feature_names = names
+    return 0
+
+
+def LGBM_DatasetGetFeatureNames(handle, out_strs, num_feature) -> int:
+    cd = _get(handle)
+    names = cd.construct().get_feature_name()
+    _write_out(num_feature, len(names), ctypes.c_int32)
+    ptrs = _view(out_strs, np.uint64, len(names))
+    for i, n in enumerate(names):
+        raw = n.encode("utf-8") + b"\0"
+        ctypes.memmove(int(ptrs[i]), raw, len(raw))
+    return 0
+
+
+def LGBM_DatasetFree(handle) -> int:
+    _handles.pop(int(handle), None)
+    return 0
+
+
+def LGBM_DatasetSaveBinary(handle, filename) -> int:
+    cd = _get(handle)
+    if isinstance(filename, bytes):
+        filename = filename.decode("utf-8")
+    cd.construct()._inner.save_binary(str(filename))
+    return 0
+
+
+def LGBM_DatasetDumpText(handle, filename) -> int:
+    cd = _get(handle)
+    if isinstance(filename, bytes):
+        filename = filename.decode("utf-8")
+    inner = cd.construct()._inner
+    with open(str(filename), "w") as f:
+        f.write("num_data: %d\n" % inner.num_data)
+        f.write("num_features: %d\n" % inner.num_total_features)
+        f.write("feature_names: %s\n" % " ".join(inner.feature_names))
+    return 0
+
+
+_FIELD_DTYPE = {"label": np.float32, "weight": np.float32,
+                "init_score": np.float64, "group": np.int32,
+                "query": np.int32}
+
+
+def LGBM_DatasetSetField(handle, field_name, field_data, num_element,
+                         type_) -> int:
+    cd = _get(handle)
+    if isinstance(field_name, bytes):
+        field_name = field_name.decode("utf-8")
+    name = "group" if field_name == "query" else field_name
+    arr = np.array(_view(field_data, _NP_DTYPE[int(type_)],
+                         int(num_element)), copy=True)
+    cd.construct().set_field(name, arr)
+    return 0
+
+
+def LGBM_DatasetGetField(handle, field_name, out_len, out_ptr,
+                         out_type) -> int:
+    cd = _get(handle)
+    if isinstance(field_name, bytes):
+        field_name = field_name.decode("utf-8")
+    name = "group" if field_name == "query" else field_name
+    val = cd.construct().get_field(name)
+    if val is None:
+        _write_out(out_len, 0, ctypes.c_int32)
+        raise LightGBMError("Field %s is not set" % field_name)
+    dt = _FIELD_DTYPE.get(name, np.float32)
+    arr = np.ascontiguousarray(val, dtype=dt)
+    if name == "group":
+        # reference returns query BOUNDARIES [nq+1], not sizes
+        arr = np.concatenate([[0], np.cumsum(arr)]).astype(np.int32)
+    cd._field_cache = arr   # keep alive: caller reads the raw pointer
+    _write_out(out_len, arr.size, ctypes.c_int32)
+    _write_out(out_ptr, arr.ctypes.data, ctypes.c_uint64)
+    code = {np.dtype(np.float32): C_API_DTYPE_FLOAT32,
+            np.dtype(np.float64): C_API_DTYPE_FLOAT64,
+            np.dtype(np.int32): C_API_DTYPE_INT32}[arr.dtype]
+    _write_out(out_type, code, ctypes.c_int32)
+    return 0
+
+
+def LGBM_DatasetUpdateParamChecking(old_parameters, new_parameters) -> int:
+    return 0
+
+
+def LGBM_DatasetGetNumData(handle, out) -> int:
+    _write_out(out, _get(handle).construct().num_data(), ctypes.c_int32)
+    return 0
+
+
+def LGBM_DatasetGetNumFeature(handle, out) -> int:
+    _write_out(out, _get(handle).construct().num_feature(), ctypes.c_int32)
+    return 0
+
+
+def LGBM_DatasetAddFeaturesFrom(target, source) -> int:
+    tgt, src = _get(target), _get(source)
+    tgt.construct().add_features_from(src.construct())
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# Booster (c_api.h:387-1006)
+# ---------------------------------------------------------------------------
+
+class _CBooster:
+    def __init__(self, booster: Booster, train: Optional[_CDataset]):
+        self.booster = booster
+        self.train = train
+        self.valids: List[_CDataset] = []
+
+
+def LGBM_BoosterCreate(train_data, parameters, out) -> int:
+    cd = _get(train_data)
+    params = _params_dict(parameters)
+    bst = Booster(params=params, train_set=cd.construct())
+    _write_out(out, _register(_CBooster(bst, cd)), ctypes.c_uint64)
+    return 0
+
+
+def LGBM_BoosterCreateFromModelfile(filename, out_num_iterations,
+                                    out) -> int:
+    if isinstance(filename, bytes):
+        filename = filename.decode("utf-8")
+    bst = Booster(model_file=str(filename))
+    _write_out(out_num_iterations, bst.current_iteration, ctypes.c_int32)
+    _write_out(out, _register(_CBooster(bst, None)), ctypes.c_uint64)
+    return 0
+
+
+def LGBM_BoosterLoadModelFromString(model_str, out_num_iterations,
+                                    out) -> int:
+    if isinstance(model_str, bytes):
+        model_str = model_str.decode("utf-8")
+    bst = Booster(model_str=str(model_str))
+    _write_out(out_num_iterations, bst.current_iteration, ctypes.c_int32)
+    _write_out(out, _register(_CBooster(bst, None)), ctypes.c_uint64)
+    return 0
+
+
+def LGBM_BoosterFree(handle) -> int:
+    _handles.pop(int(handle), None)
+    return 0
+
+
+def LGBM_BoosterShuffleModels(handle, start_iter, end_iter) -> int:
+    raise LightGBMError("LGBM_BoosterShuffleModels is not supported on "
+                        "device_type=tpu")
+
+
+def LGBM_BoosterMerge(handle, other_handle) -> int:
+    dst, src = _get(handle), _get(other_handle)
+    dst.booster._booster._materialize_pending()
+    src.booster._booster._materialize_pending()
+    dst.booster._booster.models.extend(src.booster._booster.models)
+    return 0
+
+
+def LGBM_BoosterAddValidData(handle, valid_data) -> int:
+    cb, cd = _get(handle), _get(valid_data)
+    cb.booster.add_valid(cd.construct(),
+                         "valid_%d" % (len(cb.valids) + 1))
+    cb.valids.append(cd)
+    return 0
+
+
+def LGBM_BoosterResetTrainingData(handle, train_data) -> int:
+    raise LightGBMError("LGBM_BoosterResetTrainingData is not supported on "
+                        "device_type=tpu; create a new booster")
+
+
+def LGBM_BoosterResetParameter(handle, parameters) -> int:
+    """GBDT::ResetConfig (gbdt.cpp:704): learning-rate/bagging-class
+    updates take effect immediately; structural knobs (num_leaves,
+    max_bin, ...) are compiled into the device program and need a new
+    booster."""
+    cb = _get(handle)
+    params = _params_dict(parameters)
+    cb.booster.params.update(params)
+    new_cfg = params_to_config(cb.booster.params)
+    inner = cb.booster._booster
+    structural = ("num_leaves", "max_bin", "max_depth", "tree_learner")
+    if any(k in params for k in structural):
+        Log.warning("LGBM_BoosterResetParameter: %s are fixed after booster "
+                    "creation on device_type=tpu"
+                    % ", ".join(k for k in structural if k in params))
+    inner.config = new_cfg
+    inner.shrinkage_rate = float(new_cfg.learning_rate)
+    return 0
+
+
+def LGBM_BoosterGetNumClasses(handle, out_len) -> int:
+    _write_out(out_len, _get(handle).booster._booster.num_class,
+               ctypes.c_int32)
+    return 0
+
+
+def LGBM_BoosterUpdateOneIter(handle, is_finished) -> int:
+    fin = _get(handle).booster.update()
+    _write_out(is_finished, 1 if fin else 0, ctypes.c_int32)
+    return 0
+
+
+def LGBM_BoosterUpdateOneIterCustom(handle, grad, hess, is_finished) -> int:
+    cb = _get(handle)
+    inner = cb.booster._booster
+    n = inner.num_data * inner.num_tree_per_iteration
+    g = np.array(_view(grad, np.float32, n), copy=True)
+    h = np.array(_view(hess, np.float32, n), copy=True)
+    fin = inner.train_one_iter(g, h)
+    _write_out(is_finished, 1 if fin else 0, ctypes.c_int32)
+    return 0
+
+
+def LGBM_BoosterRefit(handle, leaf_preds, nrow, ncol) -> int:
+    cb = _get(handle)
+    if cb.train is None:
+        raise LightGBMError("Refit requires a booster with training data")
+    X = cb.train.construct()._raw_X
+    if X is None:
+        raise LightGBMError("Refit requires raw training data "
+                            "(free_raw_data=False)")
+    cb.booster._booster.refit(X)
+    return 0
+
+
+def LGBM_BoosterRollbackOneIter(handle) -> int:
+    _get(handle).booster.rollback_one_iter()
+    return 0
+
+
+def LGBM_BoosterGetCurrentIteration(handle, out_iteration) -> int:
+    _write_out(out_iteration, _get(handle).booster.current_iteration,
+               ctypes.c_int32)
+    return 0
+
+
+def LGBM_BoosterNumModelPerIteration(handle, out) -> int:
+    _write_out(out, _get(handle).booster.num_model_per_iteration(),
+               ctypes.c_int32)
+    return 0
+
+
+def LGBM_BoosterNumberOfTotalModel(handle, out) -> int:
+    _write_out(out, _get(handle).booster.num_trees(), ctypes.c_int32)
+    return 0
+
+
+def _eval_names(cb: _CBooster) -> List[str]:
+    names = []
+    for m in cb.booster._metrics:
+        names.extend(m.names)
+    return names
+
+
+def LGBM_BoosterGetEvalCounts(handle, out_len) -> int:
+    _write_out(out_len, len(_eval_names(_get(handle))), ctypes.c_int32)
+    return 0
+
+
+def LGBM_BoosterGetEvalNames(handle, out_len, out_strs) -> int:
+    names = _eval_names(_get(handle))
+    _write_out(out_len, len(names), ctypes.c_int32)
+    ptrs = _view(out_strs, np.uint64, len(names))
+    for i, n in enumerate(names):
+        raw = n.encode("utf-8") + b"\0"
+        ctypes.memmove(int(ptrs[i]), raw, len(raw))
+    return 0
+
+
+def LGBM_BoosterGetFeatureNames(handle, out_len, out_strs) -> int:
+    names = _get(handle).booster.feature_name()
+    _write_out(out_len, len(names), ctypes.c_int32)
+    ptrs = _view(out_strs, np.uint64, len(names))
+    for i, n in enumerate(names):
+        raw = n.encode("utf-8") + b"\0"
+        ctypes.memmove(int(ptrs[i]), raw, len(raw))
+    return 0
+
+
+def LGBM_BoosterGetNumFeature(handle, out_len) -> int:
+    _write_out(out_len, _get(handle).booster.num_feature(), ctypes.c_int32)
+    return 0
+
+
+def LGBM_BoosterGetEval(handle, data_idx, out_len, out_results) -> int:
+    """data_idx 0 = train, >=1 = valid sets (c_api.h:597)."""
+    cb = _get(handle)
+    if int(data_idx) == 0:
+        res = cb.booster.eval_train()
+    else:
+        b = cb.booster._booster
+        i = int(data_idx) - 1
+        res = cb.booster._eval_one(b.valid_score[i].score_host(),
+                                   b.valid_metrics[i],
+                                   b.valid_names[i])
+    vals = np.asarray([r[2] for r in res], dtype=np.float64)
+    _write_out(out_len, vals.size, ctypes.c_int32)
+    if vals.size:
+        ctypes.memmove(int(out_results), vals.ctypes.data, vals.nbytes)
+    return 0
+
+
+def LGBM_BoosterGetNumPredict(handle, data_idx, out_len) -> int:
+    cb = _get(handle)
+    b = cb.booster._booster
+    if int(data_idx) == 0:
+        n = b.num_data
+    else:
+        n = b.valid_score[int(data_idx) - 1].num_data
+    _write_out(out_len, n * b.num_tree_per_iteration, ctypes.c_int64)
+    return 0
+
+
+def LGBM_BoosterGetPredict(handle, data_idx, out_len, out_result) -> int:
+    cb = _get(handle)
+    b = cb.booster._booster
+    if int(data_idx) == 0:
+        score = b.train_score.score_host()
+    else:
+        score = b.valid_score[int(data_idx) - 1].score_host()
+    ntpi = b.num_tree_per_iteration
+    raw = np.asarray(score, dtype=np.float64).reshape(ntpi, -1)
+    if b.objective is not None:
+        conv = b.objective.convert_output(
+            raw[0] if ntpi == 1 else raw.T)
+        out = np.ascontiguousarray(conv, dtype=np.float64).reshape(-1)
+    else:
+        out = raw.T.reshape(-1)
+    _write_out(out_len, out.size, ctypes.c_int64)
+    ctypes.memmove(int(out_result), out.ctypes.data, out.nbytes)
+    return 0
+
+
+def _predict(cb: _CBooster, X: np.ndarray, predict_type, num_iteration,
+             parameter) -> np.ndarray:
+    params = _params_dict(parameter)
+    pt = int(predict_type)
+    kwargs = {}
+    for k in ("pred_early_stop", "pred_early_stop_freq",
+              "pred_early_stop_margin"):
+        if k in params:
+            v = params[k]
+            kwargs[k] = (v.lower() in ("true", "1", "+")
+                         if k == "pred_early_stop" else float(v))
+    out = cb.booster.predict(
+        X, num_iteration=int(num_iteration) if int(num_iteration) else None,
+        raw_score=(pt == C_API_PREDICT_RAW_SCORE),
+        pred_leaf=(pt == C_API_PREDICT_LEAF_INDEX),
+        pred_contrib=(pt == C_API_PREDICT_CONTRIB), **kwargs)
+    return np.ascontiguousarray(out, dtype=np.float64)
+
+
+def LGBM_BoosterCalcNumPredict(handle, num_row, predict_type, num_iteration,
+                               out_len) -> int:
+    cb = _get(handle)
+    b = cb.booster._booster
+    ntpi = b.num_tree_per_iteration
+    niter = b.current_iteration
+    if int(num_iteration) > 0:
+        niter = min(niter, int(num_iteration))
+    pt = int(predict_type)
+    if pt == C_API_PREDICT_LEAF_INDEX:
+        per_row = niter * ntpi
+    elif pt == C_API_PREDICT_CONTRIB:
+        per_row = (b.max_feature_idx + 2) * ntpi
+    else:
+        per_row = ntpi
+    _write_out(out_len, int(num_row) * per_row, ctypes.c_int64)
+    return 0
+
+
+def LGBM_BoosterPredictForMat(handle, data, data_type, nrow, ncol,
+                              is_row_major, predict_type, num_iteration,
+                              parameter, out_len, out_result) -> int:
+    cb = _get(handle)
+    X = _mat_from_ptr(data, data_type, nrow, ncol, is_row_major)
+    out = _predict(cb, X, predict_type, num_iteration, parameter)
+    _write_out(out_len, out.size, ctypes.c_int64)
+    ctypes.memmove(int(out_result), out.ctypes.data, out.nbytes)
+    return 0
+
+
+def LGBM_BoosterPredictForMats(handle, nrow_ptrs, data_type, nrow, ncol,
+                               predict_type, num_iteration, parameter,
+                               out_len, out_result) -> int:
+    cb = _get(handle)
+    ptrs = _view(nrow_ptrs, np.uint64, int(nrow))
+    rows = [_view(int(p), _NP_DTYPE[int(data_type)], int(ncol))
+            for p in ptrs]
+    X = np.asarray(rows, dtype=np.float64)
+    out = _predict(cb, X, predict_type, num_iteration, parameter)
+    _write_out(out_len, out.size, ctypes.c_int64)
+    ctypes.memmove(int(out_result), out.ctypes.data, out.nbytes)
+    return 0
+
+
+def LGBM_BoosterPredictForMatSingleRow(handle, data, data_type, ncol,
+                                       is_row_major, predict_type,
+                                       num_iteration, parameter, out_len,
+                                       out_result) -> int:
+    return LGBM_BoosterPredictForMat(handle, data, data_type, 1, ncol,
+                                     is_row_major, predict_type,
+                                     num_iteration, parameter, out_len,
+                                     out_result)
+
+
+def LGBM_BoosterPredictForCSR(handle, indptr, indptr_type, indices, data,
+                              data_type, nindptr, nelem, num_col,
+                              predict_type, num_iteration, parameter,
+                              out_len, out_result) -> int:
+    cb = _get(handle)
+    ip = _indptr_view(indptr, indptr_type, int(nindptr))
+    idx = _view(indices, np.int32, int(nelem))
+    vals = _view(data, _NP_DTYPE[int(data_type)], int(nelem))
+    nrow = int(nindptr) - 1
+    X = np.zeros((nrow, int(num_col)), dtype=np.float64)
+    for r in range(nrow):
+        s, e = int(ip[r]), int(ip[r + 1])
+        X[r, idx[s:e]] = vals[s:e]
+    out = _predict(cb, X, predict_type, num_iteration, parameter)
+    _write_out(out_len, out.size, ctypes.c_int64)
+    ctypes.memmove(int(out_result), out.ctypes.data, out.nbytes)
+    return 0
+
+
+def LGBM_BoosterPredictForCSRSingleRow(handle, indptr, indptr_type, indices,
+                                       data, data_type, nindptr, nelem,
+                                       num_col, predict_type, num_iteration,
+                                       parameter, out_len,
+                                       out_result) -> int:
+    return LGBM_BoosterPredictForCSR(handle, indptr, indptr_type, indices,
+                                     data, data_type, nindptr, nelem,
+                                     num_col, predict_type, num_iteration,
+                                     parameter, out_len, out_result)
+
+
+def LGBM_BoosterPredictForCSC(handle, col_ptr, col_ptr_type, indices, data,
+                              data_type, ncol_ptr, nelem, num_row,
+                              predict_type, num_iteration, parameter,
+                              out_len, out_result) -> int:
+    cb = _get(handle)
+    cp = _indptr_view(col_ptr, col_ptr_type, int(ncol_ptr))
+    idx = _view(indices, np.int32, int(nelem))
+    vals = _view(data, _NP_DTYPE[int(data_type)], int(nelem))
+    ncol = int(ncol_ptr) - 1
+    X = np.zeros((int(num_row), ncol), dtype=np.float64)
+    for c in range(ncol):
+        s, e = int(cp[c]), int(cp[c + 1])
+        X[idx[s:e], c] = vals[s:e]
+    out = _predict(cb, X, predict_type, num_iteration, parameter)
+    _write_out(out_len, out.size, ctypes.c_int64)
+    ctypes.memmove(int(out_result), out.ctypes.data, out.nbytes)
+    return 0
+
+
+def LGBM_BoosterPredictForFile(handle, data_filename, data_has_header,
+                               predict_type, num_iteration, parameter,
+                               result_filename) -> int:
+    from .data.loader import load_text_file
+    cb = _get(handle)
+    if isinstance(data_filename, bytes):
+        data_filename = data_filename.decode("utf-8")
+    if isinstance(result_filename, bytes):
+        result_filename = result_filename.decode("utf-8")
+    cfg = params_to_config(_params_dict(parameter))
+    cfg.header = bool(data_has_header)
+    loaded = load_text_file(str(data_filename), cfg)
+    out = _predict(cb, loaded.X, predict_type, num_iteration, parameter)
+    if out.ndim == 1:
+        out = out.reshape(-1, 1)
+    np.savetxt(str(result_filename), out, fmt="%.10g", delimiter="\t")
+    return 0
+
+
+def LGBM_BoosterSaveModel(handle, start_iteration, num_iteration,
+                          filename) -> int:
+    cb = _get(handle)
+    if isinstance(filename, bytes):
+        filename = filename.decode("utf-8")
+    text = cb.booster._booster.save_model_to_string(
+        int(start_iteration),
+        int(num_iteration) if int(num_iteration) else -1)
+    with open(str(filename), "w") as f:
+        f.write(text)
+    return 0
+
+
+def LGBM_BoosterSaveModelToString(handle, start_iteration, num_iteration,
+                                  buffer_len, out_len, out_str) -> int:
+    cb = _get(handle)
+    text = cb.booster._booster.save_model_to_string(
+        int(start_iteration),
+        int(num_iteration) if int(num_iteration) else -1)
+    raw = text.encode("utf-8") + b"\0"
+    _write_out(out_len, len(raw), ctypes.c_int64)
+    if int(buffer_len) >= len(raw):
+        ctypes.memmove(int(out_str), raw, len(raw))
+    return 0
+
+
+def LGBM_BoosterDumpModel(handle, start_iteration, num_iteration,
+                          buffer_len, out_len, out_str) -> int:
+    cb = _get(handle)
+    d = cb.booster._booster.dump_model(
+        int(start_iteration),
+        int(num_iteration) if int(num_iteration) else -1)
+    raw = json.dumps(d).encode("utf-8") + b"\0"
+    _write_out(out_len, len(raw), ctypes.c_int64)
+    if int(buffer_len) >= len(raw):
+        ctypes.memmove(int(out_str), raw, len(raw))
+    return 0
+
+
+def LGBM_BoosterGetLeafValue(handle, tree_idx, leaf_idx, out_val) -> int:
+    cb = _get(handle)
+    cb.booster._booster._materialize_pending()
+    tree = cb.booster._booster.models[int(tree_idx)]
+    ctypes.c_double.from_address(int(out_val)).value = float(
+        tree.leaf_value[int(leaf_idx)])
+    return 0
+
+
+def LGBM_BoosterSetLeafValue(handle, tree_idx, leaf_idx, val) -> int:
+    cb = _get(handle)
+    cb.booster._booster._materialize_pending()
+    tree = cb.booster._booster.models[int(tree_idx)]
+    tree.set_leaf_output(int(leaf_idx), float(val))
+    return 0
+
+
+def LGBM_BoosterFeatureImportance(handle, num_iteration, importance_type,
+                                  out_results) -> int:
+    cb = _get(handle)
+    kind = "split" if int(importance_type) == 0 else "gain"
+    imp = cb.booster._booster.feature_importance(
+        kind, int(num_iteration) if int(num_iteration) else 0)
+    arr = np.ascontiguousarray(imp, dtype=np.float64)
+    ctypes.memmove(int(out_results), arr.ctypes.data, arr.nbytes)
+    return 0
+
+
+def LGBM_BoosterGetUpperBoundValue(handle, out_results) -> int:
+    cb = _get(handle)
+    cb.booster._booster._materialize_pending()
+    total = 0.0
+    for t in cb.booster._booster.models:
+        nl = max(t.num_leaves, 1)
+        total += float(np.max(t.leaf_value[:nl]))
+    ctypes.c_double.from_address(int(out_results)).value = total
+    return 0
+
+
+def LGBM_BoosterGetLowerBoundValue(handle, out_results) -> int:
+    cb = _get(handle)
+    cb.booster._booster._materialize_pending()
+    total = 0.0
+    for t in cb.booster._booster.models:
+        nl = max(t.num_leaves, 1)
+        total += float(np.min(t.leaf_value[:nl]))
+    ctypes.c_double.from_address(int(out_results)).value = total
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# Network (c_api.h:1017-1036) — single-process JAX meshes replace socket
+# rank wiring; multi-host runs initialize jax.distributed out of band.
+# ---------------------------------------------------------------------------
+
+def LGBM_NetworkInit(machines, local_listen_port, listen_time_out,
+                     num_machines) -> int:
+    if int(num_machines) > 1:
+        Log.warning(
+            "LGBM_NetworkInit: socket machine lists are not used on "
+            "device_type=tpu; distributed training shards over the JAX "
+            "mesh (tree_learner=data/voting/feature + jax.distributed)")
+    return 0
+
+
+def LGBM_NetworkFree() -> int:
+    return 0
+
+
+def LGBM_NetworkInitWithFunctions(num_machines, rank, reduce_scatter_ext_fun,
+                                  allgather_ext_fun) -> int:
+    raise LightGBMError(
+        "External collective function injection is not supported; the TPU "
+        "backend's collectives are XLA psum_scatter/all_gather over the "
+        "device mesh")

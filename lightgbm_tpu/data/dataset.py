@@ -25,6 +25,7 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from ..config import Config
+from ..utils import timer
 from ..utils.log import Log
 from .bin_mapper import BinMapper, BinType, MissingType, kZeroThreshold
 
@@ -208,8 +209,10 @@ class BinnedDataset:
         cat_set = set(int(c) for c in categorical_features)
         sample = _sample_data(X, config.bin_construct_sample_cnt,
                               config.data_random_seed)
-        ds._construct_from_sample(sample, n, config, cat_set)
-        ds._push_matrix(X)
+        with timer.scope("io::FindBinAndGroup"):
+            ds._construct_from_sample(sample, n, config, cat_set)
+        with timer.scope("io::PushMatrix(binning)"):
+            ds._push_matrix(X)
         return ds
 
     def _construct_from_sample(self, sample: np.ndarray, n: int,
@@ -454,6 +457,52 @@ class BinnedDataset:
         self._bin_rows(X, binned)
         self.binned = binned
 
+    def add_features_from(self, other: "BinnedDataset") -> None:
+        """Merge another dataset's features into this one (reference
+        Dataset::AddFeaturesFrom, src/io/dataset.cpp:1465). Both must hold
+        the same rows; the other's feature groups are appended with their
+        global bin ranges shifted past this dataset's."""
+        if self.num_data != other.num_data:
+            Log.fatal("Cannot add features from a dataset with a different "
+                      "number of rows (%d vs %d)"
+                      % (other.num_data, self.num_data))
+        if self.binned is None or other.binned is None:
+            Log.fatal("Both datasets must be constructed before "
+                      "add_features_from")
+        nf0 = self.num_total_features
+        ni0 = len(self.used_features)
+        G0 = len(self.groups)
+        tb0 = self.total_bins
+        self.bin_mappers = list(self.bin_mappers) + list(other.bin_mappers)
+        self.feature_names = (list(self.feature_names)
+                              + list(other.feature_names))
+        self.used_features = (list(self.used_features)
+                              + [nf0 + f for f in other.used_features])
+        self.inner_of = {f: i for i, f in enumerate(self.used_features)}
+        self.groups = (list(self.groups)
+                       + [[ni0 + i for i in g] for g in other.groups])
+        self.num_total_features += other.num_total_features
+        self.group_of = np.concatenate([self.group_of,
+                                        other.group_of + G0])
+        self.bin_start = np.concatenate([self.bin_start,
+                                         other.bin_start + tb0])
+        self.bin_end = np.concatenate([self.bin_end, other.bin_end + tb0])
+        self.needs_fix = np.concatenate([self.needs_fix, other.needs_fix])
+        self.group_offset = np.concatenate([self.group_offset,
+                                            other.group_offset + tb0])
+        self.total_bins += other.total_bins
+        for attr in ("most_freq_bin", "default_bin", "missing_type_arr",
+                     "is_categorical", "monotone", "penalty"):
+            setattr(self, attr, np.concatenate([getattr(self, attr),
+                                                getattr(other, attr)]))
+        dt = np.promote_types(self.binned.dtype, other.binned.dtype)
+        self.binned = np.concatenate(
+            [self.binned.astype(dt, copy=False),
+             other.binned.astype(dt, copy=False)], axis=1)
+        # compiled programs are shaped by the old layout
+        if hasattr(self, "_scan_cache"):
+            self._scan_cache = {}
+
     # ------------------------------------------------------------------
     @property
     def num_features(self) -> int:
@@ -523,6 +572,24 @@ class BinnedDataset:
             return magic == BinnedDataset.BINARY_MAGIC
         except Exception:
             return False
+
+    def layout_matches(self, other: "BinnedDataset") -> bool:
+        """True when both datasets share the exact binning layout (bin
+        boundaries, grouping, feature set) — i.e. a binary cache of a
+        reference-aligned validation set is still valid against this
+        reference."""
+        if (self.total_bins != other.total_bins
+                or self.used_features != other.used_features
+                or self.groups != other.groups
+                or self.num_total_features != other.num_total_features):
+            return False
+
+        import json
+
+        def norm(state):
+            return json.dumps(state, sort_keys=True, default=str)
+        return all(norm(a.to_state()) == norm(b.to_state())
+                   for a, b in zip(self.bin_mappers, other.bin_mappers))
 
     @classmethod
     def from_binary(cls, path: str) -> "BinnedDataset":

@@ -1,0 +1,601 @@
+// lib_lightgbm-compatible C ABI over the TPU framework.
+//
+// The reference implements its C API in C++ on top of the C++ core
+// (src/c_api.cpp, entry points declared in include/LightGBM/c_api.h).
+// Here the core is Python/JAX, so this shim embeds CPython: every
+// exported LGBM_* symbol packs its raw arguments (pointers as uintptr_t)
+// and forwards to the same-named function in lightgbm_tpu.c_api, which
+// does all marshalling ctypes-side — caller and callee share one address
+// space, so out-pointers are written directly.
+//
+// Works two ways:
+//   * dlopen'd from a process that already hosts Python (e.g. the ctypes
+//     smoke test, the analog of tests/c_api_test/test_.py): the existing
+//     interpreter is reused via the GIL API.
+//   * linked into a plain C/C++/R/Java host: the first call initializes
+//     an interpreter (set PYTHONPATH so lightgbm_tpu is importable).
+//
+// Error handling mirrors API_BEGIN/API_END + LGBM_GetLastError
+// (c_api.cpp): Python exceptions become return code -1 and the message is
+// readable via LGBM_GetLastError().
+
+#include <Python.h>
+
+#include <cstdarg>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+
+#if defined(_WIN32)
+#define LGBM_EXPORT extern "C" __declspec(dllexport)
+#else
+#define LGBM_EXPORT extern "C" __attribute__((visibility("default")))
+#endif
+
+typedef void* DatasetHandle;
+typedef void* BoosterHandle;
+
+static thread_local char g_last_error[4096] = "everything is fine";
+
+static void set_error(const char* msg) {
+  std::snprintf(g_last_error, sizeof(g_last_error), "%s", msg);
+}
+
+static void ensure_python() {
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    // release the GIL taken by initialization so any thread can
+    // PyGILState_Ensure later
+    PyEval_SaveThread();
+  }
+}
+
+// Forward one call: fmt is a Py_BuildValue format producing the args
+// tuple, e.g. "(KiiiisKK)". Returns 0 on success, -1 on Python exception.
+static int invoke(const char* name, const char* fmt, ...) {
+  ensure_python();
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject *mod = nullptr, *fn = nullptr, *args = nullptr, *res = nullptr;
+  mod = PyImport_ImportModule("lightgbm_tpu.c_api");
+  if (mod == nullptr) goto fail;
+  fn = PyObject_GetAttrString(mod, name);
+  if (fn == nullptr) goto fail;
+  {
+    va_list va;
+    va_start(va, fmt);
+    args = Py_VaBuildValue(fmt, va);
+    va_end(va);
+  }
+  if (args == nullptr) goto fail;
+  res = PyObject_CallObject(fn, args);
+  if (res == nullptr) goto fail;
+  rc = res == Py_None ? 0 : (int)PyLong_AsLong(res);
+  if (PyErr_Occurred()) goto fail;
+  goto done;
+
+fail:
+  if (PyErr_Occurred()) {
+    PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+    PyErr_Fetch(&type, &value, &tb);
+    PyErr_NormalizeException(&type, &value, &tb);
+    PyObject* s = value ? PyObject_Str(value) : nullptr;
+    const char* msg = s ? PyUnicode_AsUTF8(s) : "unknown Python error";
+    set_error(msg ? msg : "unknown Python error");
+    Py_XDECREF(s);
+    Py_XDECREF(type);
+    Py_XDECREF(value);
+    Py_XDECREF(tb);
+  } else {
+    set_error("lightgbm_tpu.c_api call failed");
+  }
+  rc = -1;
+
+done:
+  Py_XDECREF(res);
+  Py_XDECREF(args);
+  Py_XDECREF(fn);
+  Py_XDECREF(mod);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+#define U64(p) ((unsigned long long)(uintptr_t)(p))
+
+LGBM_EXPORT const char* LGBM_GetLastError() { return g_last_error; }
+
+// ---------------------------------------------------------------------------
+// Dataset
+// ---------------------------------------------------------------------------
+
+LGBM_EXPORT int LGBM_DatasetCreateFromFile(const char* filename,
+                                           const char* parameters,
+                                           const DatasetHandle reference,
+                                           DatasetHandle* out) {
+  return invoke("LGBM_DatasetCreateFromFile", "(ssKK)", filename, parameters,
+                U64(reference), U64(out));
+}
+
+LGBM_EXPORT int LGBM_DatasetCreateFromSampledColumn(
+    double** sample_data, int** sample_indices, int32_t ncol,
+    const int* num_per_col, int32_t num_sample_row, int32_t num_total_row,
+    const char* parameters, DatasetHandle* out) {
+  return invoke("LGBM_DatasetCreateFromSampledColumn", "(KKiKiisK)",
+                U64(sample_data), U64(sample_indices), (int)ncol,
+                U64(num_per_col), (int)num_sample_row, (int)num_total_row,
+                parameters, U64(out));
+}
+
+LGBM_EXPORT int LGBM_DatasetCreateByReference(const DatasetHandle reference,
+                                              int64_t num_total_row,
+                                              DatasetHandle* out) {
+  return invoke("LGBM_DatasetCreateByReference", "(KLK)", U64(reference),
+                (long long)num_total_row, U64(out));
+}
+
+LGBM_EXPORT int LGBM_DatasetPushRows(DatasetHandle dataset, const void* data,
+                                     int data_type, int32_t nrow,
+                                     int32_t ncol, int32_t start_row) {
+  return invoke("LGBM_DatasetPushRows", "(KKiiii)", U64(dataset), U64(data),
+                data_type, (int)nrow, (int)ncol, (int)start_row);
+}
+
+LGBM_EXPORT int LGBM_DatasetPushRowsByCSR(
+    DatasetHandle dataset, const void* indptr, int indptr_type,
+    const int32_t* indices, const void* data, int data_type,
+    int64_t nindptr, int64_t nelem, int64_t num_col, int64_t start_row) {
+  return invoke("LGBM_DatasetPushRowsByCSR", "(KKiKKiLLLL)", U64(dataset),
+                U64(indptr), indptr_type, U64(indices), U64(data), data_type,
+                (long long)nindptr, (long long)nelem, (long long)num_col,
+                (long long)start_row);
+}
+
+LGBM_EXPORT int LGBM_DatasetCreateFromCSR(
+    const void* indptr, int indptr_type, const int32_t* indices,
+    const void* data, int data_type, int64_t nindptr, int64_t nelem,
+    int64_t num_col, const char* parameters, const DatasetHandle reference,
+    DatasetHandle* out) {
+  return invoke("LGBM_DatasetCreateFromCSR", "(KiKKiLLLsKK)", U64(indptr),
+                indptr_type, U64(indices), U64(data), data_type,
+                (long long)nindptr, (long long)nelem, (long long)num_col,
+                parameters, U64(reference), U64(out));
+}
+
+LGBM_EXPORT int LGBM_DatasetCreateFromCSRFunc(
+    void* get_row_funptr, int num_rows, int64_t num_col,
+    const char* parameters, const DatasetHandle reference,
+    DatasetHandle* out) {
+  set_error("LGBM_DatasetCreateFromCSRFunc is not supported by the TPU "
+            "backend; use LGBM_DatasetCreateFromCSR");
+  return -1;
+}
+
+LGBM_EXPORT int LGBM_DatasetCreateFromCSC(
+    const void* col_ptr, int col_ptr_type, const int32_t* indices,
+    const void* data, int data_type, int64_t ncol_ptr, int64_t nelem,
+    int64_t num_row, const char* parameters, const DatasetHandle reference,
+    DatasetHandle* out) {
+  return invoke("LGBM_DatasetCreateFromCSC", "(KiKKiLLLsKK)", U64(col_ptr),
+                col_ptr_type, U64(indices), U64(data), data_type,
+                (long long)ncol_ptr, (long long)nelem, (long long)num_row,
+                parameters, U64(reference), U64(out));
+}
+
+LGBM_EXPORT int LGBM_DatasetCreateFromMat(const void* data, int data_type,
+                                          int32_t nrow, int32_t ncol,
+                                          int is_row_major,
+                                          const char* parameters,
+                                          const DatasetHandle reference,
+                                          DatasetHandle* out) {
+  return invoke("LGBM_DatasetCreateFromMat", "(KiiiisKK)", U64(data),
+                data_type, (int)nrow, (int)ncol, is_row_major, parameters,
+                U64(reference), U64(out));
+}
+
+LGBM_EXPORT int LGBM_DatasetCreateFromMats(int32_t nmat, const void** data,
+                                           int data_type, int32_t* nrow,
+                                           int32_t ncol, int is_row_major,
+                                           const char* parameters,
+                                           const DatasetHandle reference,
+                                           DatasetHandle* out) {
+  return invoke("LGBM_DatasetCreateFromMats", "(iKiKiisKK)", (int)nmat,
+                U64(data), data_type, U64(nrow), (int)ncol, is_row_major,
+                parameters, U64(reference), U64(out));
+}
+
+LGBM_EXPORT int LGBM_DatasetGetSubset(const DatasetHandle handle,
+                                      const int32_t* used_row_indices,
+                                      int32_t num_used_row_indices,
+                                      const char* parameters,
+                                      DatasetHandle* out) {
+  return invoke("LGBM_DatasetGetSubset", "(KKisK)", U64(handle),
+                U64(used_row_indices), (int)num_used_row_indices, parameters,
+                U64(out));
+}
+
+LGBM_EXPORT int LGBM_DatasetSetFeatureNames(DatasetHandle handle,
+                                            const char** feature_names,
+                                            int32_t num_feature) {
+  return invoke("LGBM_DatasetSetFeatureNames", "(KKi)", U64(handle),
+                U64(feature_names), (int)num_feature);
+}
+
+LGBM_EXPORT int LGBM_DatasetGetFeatureNames(DatasetHandle handle,
+                                            char** feature_names,
+                                            int* num_feature) {
+  return invoke("LGBM_DatasetGetFeatureNames", "(KKK)", U64(handle),
+                U64(feature_names), U64(num_feature));
+}
+
+LGBM_EXPORT int LGBM_DatasetFree(DatasetHandle handle) {
+  return invoke("LGBM_DatasetFree", "(K)", U64(handle));
+}
+
+LGBM_EXPORT int LGBM_DatasetSaveBinary(DatasetHandle handle,
+                                       const char* filename) {
+  return invoke("LGBM_DatasetSaveBinary", "(Ks)", U64(handle), filename);
+}
+
+LGBM_EXPORT int LGBM_DatasetDumpText(DatasetHandle handle,
+                                     const char* filename) {
+  return invoke("LGBM_DatasetDumpText", "(Ks)", U64(handle), filename);
+}
+
+LGBM_EXPORT int LGBM_DatasetSetField(DatasetHandle handle,
+                                     const char* field_name,
+                                     const void* field_data,
+                                     int num_element, int type) {
+  return invoke("LGBM_DatasetSetField", "(KsKii)", U64(handle), field_name,
+                U64(field_data), num_element, type);
+}
+
+LGBM_EXPORT int LGBM_DatasetGetField(DatasetHandle handle,
+                                     const char* field_name, int* out_len,
+                                     const void** out_ptr, int* out_type) {
+  return invoke("LGBM_DatasetGetField", "(KsKKK)", U64(handle), field_name,
+                U64(out_len), U64(out_ptr), U64(out_type));
+}
+
+LGBM_EXPORT int LGBM_DatasetUpdateParamChecking(const char* old_parameters,
+                                                const char* new_parameters) {
+  return invoke("LGBM_DatasetUpdateParamChecking", "(ss)", old_parameters,
+                new_parameters);
+}
+
+LGBM_EXPORT int LGBM_DatasetGetNumData(DatasetHandle handle, int* out) {
+  return invoke("LGBM_DatasetGetNumData", "(KK)", U64(handle), U64(out));
+}
+
+LGBM_EXPORT int LGBM_DatasetGetNumFeature(DatasetHandle handle, int* out) {
+  return invoke("LGBM_DatasetGetNumFeature", "(KK)", U64(handle), U64(out));
+}
+
+LGBM_EXPORT int LGBM_DatasetAddFeaturesFrom(DatasetHandle target,
+                                            DatasetHandle source) {
+  return invoke("LGBM_DatasetAddFeaturesFrom", "(KK)", U64(target),
+                U64(source));
+}
+
+// ---------------------------------------------------------------------------
+// Booster
+// ---------------------------------------------------------------------------
+
+LGBM_EXPORT int LGBM_BoosterCreate(const DatasetHandle train_data,
+                                   const char* parameters,
+                                   BoosterHandle* out) {
+  return invoke("LGBM_BoosterCreate", "(KsK)", U64(train_data), parameters,
+                U64(out));
+}
+
+LGBM_EXPORT int LGBM_BoosterCreateFromModelfile(const char* filename,
+                                                int* out_num_iterations,
+                                                BoosterHandle* out) {
+  return invoke("LGBM_BoosterCreateFromModelfile", "(sKK)", filename,
+                U64(out_num_iterations), U64(out));
+}
+
+LGBM_EXPORT int LGBM_BoosterLoadModelFromString(const char* model_str,
+                                                int* out_num_iterations,
+                                                BoosterHandle* out) {
+  return invoke("LGBM_BoosterLoadModelFromString", "(sKK)", model_str,
+                U64(out_num_iterations), U64(out));
+}
+
+LGBM_EXPORT int LGBM_BoosterFree(BoosterHandle handle) {
+  return invoke("LGBM_BoosterFree", "(K)", U64(handle));
+}
+
+LGBM_EXPORT int LGBM_BoosterShuffleModels(BoosterHandle handle,
+                                          int start_iter, int end_iter) {
+  return invoke("LGBM_BoosterShuffleModels", "(Kii)", U64(handle),
+                start_iter, end_iter);
+}
+
+LGBM_EXPORT int LGBM_BoosterMerge(BoosterHandle handle,
+                                  BoosterHandle other_handle) {
+  return invoke("LGBM_BoosterMerge", "(KK)", U64(handle), U64(other_handle));
+}
+
+LGBM_EXPORT int LGBM_BoosterAddValidData(BoosterHandle handle,
+                                         const DatasetHandle valid_data) {
+  return invoke("LGBM_BoosterAddValidData", "(KK)", U64(handle),
+                U64(valid_data));
+}
+
+LGBM_EXPORT int LGBM_BoosterResetTrainingData(BoosterHandle handle,
+                                              const DatasetHandle train) {
+  return invoke("LGBM_BoosterResetTrainingData", "(KK)", U64(handle),
+                U64(train));
+}
+
+LGBM_EXPORT int LGBM_BoosterResetParameter(BoosterHandle handle,
+                                           const char* parameters) {
+  return invoke("LGBM_BoosterResetParameter", "(Ks)", U64(handle),
+                parameters);
+}
+
+LGBM_EXPORT int LGBM_BoosterGetNumClasses(BoosterHandle handle,
+                                          int* out_len) {
+  return invoke("LGBM_BoosterGetNumClasses", "(KK)", U64(handle),
+                U64(out_len));
+}
+
+LGBM_EXPORT int LGBM_BoosterUpdateOneIter(BoosterHandle handle,
+                                          int* is_finished) {
+  return invoke("LGBM_BoosterUpdateOneIter", "(KK)", U64(handle),
+                U64(is_finished));
+}
+
+LGBM_EXPORT int LGBM_BoosterRefit(BoosterHandle handle,
+                                  const double* leaf_preds, int32_t nrow,
+                                  int32_t ncol) {
+  return invoke("LGBM_BoosterRefit", "(KKii)", U64(handle), U64(leaf_preds),
+                (int)nrow, (int)ncol);
+}
+
+LGBM_EXPORT int LGBM_BoosterUpdateOneIterCustom(BoosterHandle handle,
+                                                const float* grad,
+                                                const float* hess,
+                                                int* is_finished) {
+  return invoke("LGBM_BoosterUpdateOneIterCustom", "(KKKK)", U64(handle),
+                U64(grad), U64(hess), U64(is_finished));
+}
+
+LGBM_EXPORT int LGBM_BoosterRollbackOneIter(BoosterHandle handle) {
+  return invoke("LGBM_BoosterRollbackOneIter", "(K)", U64(handle));
+}
+
+LGBM_EXPORT int LGBM_BoosterGetCurrentIteration(BoosterHandle handle,
+                                                int* out_iteration) {
+  return invoke("LGBM_BoosterGetCurrentIteration", "(KK)", U64(handle),
+                U64(out_iteration));
+}
+
+LGBM_EXPORT int LGBM_BoosterNumModelPerIteration(BoosterHandle handle,
+                                                 int* out) {
+  return invoke("LGBM_BoosterNumModelPerIteration", "(KK)", U64(handle),
+                U64(out));
+}
+
+LGBM_EXPORT int LGBM_BoosterNumberOfTotalModel(BoosterHandle handle,
+                                               int* out_models) {
+  return invoke("LGBM_BoosterNumberOfTotalModel", "(KK)", U64(handle),
+                U64(out_models));
+}
+
+LGBM_EXPORT int LGBM_BoosterGetEvalCounts(BoosterHandle handle,
+                                          int* out_len) {
+  return invoke("LGBM_BoosterGetEvalCounts", "(KK)", U64(handle),
+                U64(out_len));
+}
+
+LGBM_EXPORT int LGBM_BoosterGetEvalNames(BoosterHandle handle, int* out_len,
+                                         char** out_strs) {
+  return invoke("LGBM_BoosterGetEvalNames", "(KKK)", U64(handle),
+                U64(out_len), U64(out_strs));
+}
+
+LGBM_EXPORT int LGBM_BoosterGetFeatureNames(BoosterHandle handle,
+                                            int* out_len, char** out_strs) {
+  return invoke("LGBM_BoosterGetFeatureNames", "(KKK)", U64(handle),
+                U64(out_len), U64(out_strs));
+}
+
+LGBM_EXPORT int LGBM_BoosterGetNumFeature(BoosterHandle handle,
+                                          int* out_len) {
+  return invoke("LGBM_BoosterGetNumFeature", "(KK)", U64(handle),
+                U64(out_len));
+}
+
+LGBM_EXPORT int LGBM_BoosterGetEval(BoosterHandle handle, int data_idx,
+                                    int* out_len, double* out_results) {
+  return invoke("LGBM_BoosterGetEval", "(KiKK)", U64(handle), data_idx,
+                U64(out_len), U64(out_results));
+}
+
+LGBM_EXPORT int LGBM_BoosterGetNumPredict(BoosterHandle handle, int data_idx,
+                                          int64_t* out_len) {
+  return invoke("LGBM_BoosterGetNumPredict", "(KiK)", U64(handle), data_idx,
+                U64(out_len));
+}
+
+LGBM_EXPORT int LGBM_BoosterGetPredict(BoosterHandle handle, int data_idx,
+                                       int64_t* out_len,
+                                       double* out_result) {
+  return invoke("LGBM_BoosterGetPredict", "(KiKK)", U64(handle), data_idx,
+                U64(out_len), U64(out_result));
+}
+
+LGBM_EXPORT int LGBM_BoosterPredictForFile(BoosterHandle handle,
+                                           const char* data_filename,
+                                           int data_has_header,
+                                           int predict_type,
+                                           int num_iteration,
+                                           const char* parameter,
+                                           const char* result_filename) {
+  return invoke("LGBM_BoosterPredictForFile", "(Ksiiiss)", U64(handle),
+                data_filename, data_has_header, predict_type, num_iteration,
+                parameter, result_filename);
+}
+
+LGBM_EXPORT int LGBM_BoosterCalcNumPredict(BoosterHandle handle,
+                                           int num_row, int predict_type,
+                                           int num_iteration,
+                                           int64_t* out_len) {
+  return invoke("LGBM_BoosterCalcNumPredict", "(KiiiK)", U64(handle),
+                num_row, predict_type, num_iteration, U64(out_len));
+}
+
+LGBM_EXPORT int LGBM_BoosterPredictForCSR(
+    BoosterHandle handle, const void* indptr, int indptr_type,
+    const int32_t* indices, const void* data, int data_type,
+    int64_t nindptr, int64_t nelem, int64_t num_col, int predict_type,
+    int num_iteration, const char* parameter, int64_t* out_len,
+    double* out_result) {
+  return invoke("LGBM_BoosterPredictForCSR", "(KKiKKiLLLiisKK)", U64(handle),
+                U64(indptr), indptr_type, U64(indices), U64(data), data_type,
+                (long long)nindptr, (long long)nelem, (long long)num_col,
+                predict_type, num_iteration, parameter, U64(out_len),
+                U64(out_result));
+}
+
+LGBM_EXPORT int LGBM_BoosterPredictForCSRSingleRow(
+    BoosterHandle handle, const void* indptr, int indptr_type,
+    const int32_t* indices, const void* data, int data_type,
+    int64_t nindptr, int64_t nelem, int64_t num_col, int predict_type,
+    int num_iteration, const char* parameter, int64_t* out_len,
+    double* out_result) {
+  return invoke("LGBM_BoosterPredictForCSRSingleRow", "(KKiKKiLLLiisKK)",
+                U64(handle), U64(indptr), indptr_type, U64(indices),
+                U64(data), data_type, (long long)nindptr, (long long)nelem,
+                (long long)num_col, predict_type, num_iteration, parameter,
+                U64(out_len), U64(out_result));
+}
+
+LGBM_EXPORT int LGBM_BoosterPredictForCSC(
+    BoosterHandle handle, const void* col_ptr, int col_ptr_type,
+    const int32_t* indices, const void* data, int data_type,
+    int64_t ncol_ptr, int64_t nelem, int64_t num_row, int predict_type,
+    int num_iteration, const char* parameter, int64_t* out_len,
+    double* out_result) {
+  return invoke("LGBM_BoosterPredictForCSC", "(KKiKKiLLLiisKK)", U64(handle),
+                U64(col_ptr), col_ptr_type, U64(indices), U64(data),
+                data_type, (long long)ncol_ptr, (long long)nelem,
+                (long long)num_row, predict_type, num_iteration, parameter,
+                U64(out_len), U64(out_result));
+}
+
+LGBM_EXPORT int LGBM_BoosterPredictForMat(BoosterHandle handle,
+                                          const void* data, int data_type,
+                                          int32_t nrow, int32_t ncol,
+                                          int is_row_major, int predict_type,
+                                          int num_iteration,
+                                          const char* parameter,
+                                          int64_t* out_len,
+                                          double* out_result) {
+  return invoke("LGBM_BoosterPredictForMat", "(KKiiiiiisKK)", U64(handle),
+                U64(data), data_type, (int)nrow, (int)ncol, is_row_major,
+                predict_type, num_iteration, parameter, U64(out_len),
+                U64(out_result));
+}
+
+LGBM_EXPORT int LGBM_BoosterPredictForMatSingleRow(
+    BoosterHandle handle, const void* data, int data_type, int ncol,
+    int is_row_major, int predict_type, int num_iteration,
+    const char* parameter, int64_t* out_len, double* out_result) {
+  return invoke("LGBM_BoosterPredictForMatSingleRow", "(KKiiiiisKK)",
+                U64(handle), U64(data), data_type, ncol, is_row_major,
+                predict_type, num_iteration, parameter, U64(out_len),
+                U64(out_result));
+}
+
+LGBM_EXPORT int LGBM_BoosterPredictForMats(
+    BoosterHandle handle, const void** data, int data_type, int32_t nrow,
+    int32_t ncol, int predict_type, int num_iteration,
+    const char* parameter, int64_t* out_len, double* out_result) {
+  return invoke("LGBM_BoosterPredictForMats", "(KKiiiiisKK)", U64(handle),
+                U64(data), data_type, (int)nrow, (int)ncol, predict_type,
+                num_iteration, parameter, U64(out_len), U64(out_result));
+}
+
+LGBM_EXPORT int LGBM_BoosterSaveModel(BoosterHandle handle,
+                                      int start_iteration,
+                                      int num_iteration,
+                                      const char* filename) {
+  return invoke("LGBM_BoosterSaveModel", "(Kiis)", U64(handle),
+                start_iteration, num_iteration, filename);
+}
+
+LGBM_EXPORT int LGBM_BoosterSaveModelToString(BoosterHandle handle,
+                                              int start_iteration,
+                                              int num_iteration,
+                                              int64_t buffer_len,
+                                              int64_t* out_len,
+                                              char* out_str) {
+  return invoke("LGBM_BoosterSaveModelToString", "(KiiLKK)", U64(handle),
+                start_iteration, num_iteration, (long long)buffer_len,
+                U64(out_len), U64(out_str));
+}
+
+LGBM_EXPORT int LGBM_BoosterDumpModel(BoosterHandle handle,
+                                      int start_iteration, int num_iteration,
+                                      int64_t buffer_len, int64_t* out_len,
+                                      char* out_str) {
+  return invoke("LGBM_BoosterDumpModel", "(KiiLKK)", U64(handle),
+                start_iteration, num_iteration, (long long)buffer_len,
+                U64(out_len), U64(out_str));
+}
+
+LGBM_EXPORT int LGBM_BoosterGetLeafValue(BoosterHandle handle, int tree_idx,
+                                         int leaf_idx, double* out_val) {
+  return invoke("LGBM_BoosterGetLeafValue", "(KiiK)", U64(handle), tree_idx,
+                leaf_idx, U64(out_val));
+}
+
+LGBM_EXPORT int LGBM_BoosterSetLeafValue(BoosterHandle handle, int tree_idx,
+                                         int leaf_idx, double val) {
+  return invoke("LGBM_BoosterSetLeafValue", "(Kiid)", U64(handle), tree_idx,
+                leaf_idx, val);
+}
+
+LGBM_EXPORT int LGBM_BoosterFeatureImportance(BoosterHandle handle,
+                                              int num_iteration,
+                                              int importance_type,
+                                              double* out_results) {
+  return invoke("LGBM_BoosterFeatureImportance", "(KiiK)", U64(handle),
+                num_iteration, importance_type, U64(out_results));
+}
+
+LGBM_EXPORT int LGBM_BoosterGetUpperBoundValue(BoosterHandle handle,
+                                               double* out_results) {
+  return invoke("LGBM_BoosterGetUpperBoundValue", "(KK)", U64(handle),
+                U64(out_results));
+}
+
+LGBM_EXPORT int LGBM_BoosterGetLowerBoundValue(BoosterHandle handle,
+                                               double* out_results) {
+  return invoke("LGBM_BoosterGetLowerBoundValue", "(KK)", U64(handle),
+                U64(out_results));
+}
+
+// ---------------------------------------------------------------------------
+// Network
+// ---------------------------------------------------------------------------
+
+LGBM_EXPORT int LGBM_NetworkInit(const char* machines,
+                                 int local_listen_port, int listen_time_out,
+                                 int num_machines) {
+  return invoke("LGBM_NetworkInit", "(siii)", machines, local_listen_port,
+                listen_time_out, num_machines);
+}
+
+LGBM_EXPORT int LGBM_NetworkFree() {
+  return invoke("LGBM_NetworkFree", "()");
+}
+
+LGBM_EXPORT int LGBM_NetworkInitWithFunctions(int num_machines, int rank,
+                                              void* reduce_scatter_ext_fun,
+                                              void* allgather_ext_fun) {
+  return invoke("LGBM_NetworkInitWithFunctions", "(iiKK)", num_machines,
+                rank, U64(reduce_scatter_ext_fun), U64(allgather_ext_fun));
+}

@@ -105,6 +105,28 @@ class ObjectiveFunction:
         """New leaf output from the leaf's rows (RenewTreeOutput)."""
         raise NotImplementedError
 
+    def static_fingerprint(self) -> tuple:
+        """Hashable digest of every scalar the grad_fn CLOSURE bakes in
+        (sigmoid, class weights, alpha, need_train flags, ...). Compiled-
+        program caches keyed on this stay valid across objective instances
+        with equal hyperparameters while instances that differ in any
+        scalar get their own compilation. Device arrays (label, weight,
+        masks) are excluded — they are traced arguments, not constants."""
+        items = []
+        for k, v in sorted(vars(self).items()):
+            if k == "config":
+                continue
+            if isinstance(v, (np.number, np.bool_)):
+                items.append((k, v.item()))
+            elif isinstance(v, (int, float, bool, str, bytes, type(None))):
+                items.append((k, v))
+            elif isinstance(v, (list, tuple)) and all(
+                    isinstance(x, (int, float, bool, str, np.number))
+                    for x in v):
+                items.append((k, tuple(
+                    x.item() if isinstance(x, np.number) else x for x in v)))
+        return (type(self).__name__, tuple(items))
+
     def to_string(self) -> str:
         """Model-file objective string (ToString)."""
         return self.name

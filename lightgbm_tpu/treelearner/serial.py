@@ -296,15 +296,15 @@ class SerialTreeLearner:
         # everything config-valued (SplitParams, FeatureMeta's monotone/
         # penalty, the CEGB extras) is passed as a TRACED argument — baking
         # it into the closure would let a second training on the same
-        # Dataset silently reuse the first run's hyperparameters. Only
-        # array SHAPES and the static GrowConfig live in the key, plus the
-        # objective's model string (its hyperparameters, e.g. sigmoid).
-        cache_key = (k, self.grow_config, type(objective).__name__,
-                     objective.to_string())
+        # Dataset silently reuse the first run's hyperparameters. The
+        # objective's device data (labels, weights, masks) is likewise
+        # traced (gargs below); its closure-baked scalars (sigmoid, class
+        # weights, ...) are captured in static_fingerprint so differing
+        # hyperparameters compile separately.
+        cache_key = (k, self.grow_config, objective.static_fingerprint())
         fn = cache.get(cache_key)
         if fn is None:
             grad_fn = objective.grad_fn()
-            gargs = objective._grad_args()
             gc = self.grow_config
             use_part = self.use_partitioned
             layout = self.layout
@@ -313,7 +313,7 @@ class SerialTreeLearner:
 
             @jax.jit
             def run(score0, fu0, fmasks, keys, base_extras, shrink_t,
-                    meta, params, fix):
+                    meta, params, fix, gargs):
                 bag = jnp.ones(n, bool)
 
                 def body(carry, per):
@@ -349,7 +349,7 @@ class SerialTreeLearner:
                else base.feature_used)
         return fn(score0, fu0, fmasks, keys, base,
                   jnp.asarray(shrink, jnp.float64),
-                  self.meta, self.params, self.fix)
+                  self.meta, self.params, self.fix, objective._grad_args())
 
     def train(self, grad: jnp.ndarray, hess: jnp.ndarray,
               bag_mask: jnp.ndarray) -> Tuple[Tree, jnp.ndarray]:

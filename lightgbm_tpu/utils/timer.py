@@ -1,0 +1,133 @@
+"""Named-scope wall-clock accounting: where does training time go?
+
+TPU-native analog of the reference's compile-time-gated ``Timer`` /
+``FunctionTimer`` pair (include/LightGBM/utils/common.h:1026-1105, enabled
+with -DUSE_TIMETAG): one process-global accumulator of named durations,
+RAII-style scopes on the hot functions, a sorted report at exit.
+
+Differences driven by the JAX execution model:
+  * dispatch is async — a scope that merely *launches* a jitted program
+    measures launch cost, not device time. Scopes that want device time
+    must block (``sync=True`` passes the scope's result through
+    ``jax.block_until_ready``). The growers keep async pipelining, so by
+    default the report shows the honest host-side decomposition (binning,
+    gradient compute, launch, materialize/transfer, eval) and one "device
+    wait" bucket where the pipeline actually blocks.
+  * enablement is a runtime env var (``LIGHTGBM_TPU_TIMETAG=1``) or
+    ``timer.enable()``, not a compile flag.
+
+Report via ``lightgbm_tpu.utils.timer.print_report()`` (also auto-printed
+at interpreter exit when enabled, like the reference's global_timer dtor).
+"""
+from __future__ import annotations
+
+import atexit
+import contextlib
+import functools
+import os
+import threading
+import time
+from collections import defaultdict
+from typing import Callable, Dict, Tuple
+
+_lock = threading.Lock()
+_acc: Dict[str, float] = defaultdict(float)
+_cnt: Dict[str, int] = defaultdict(int)
+_enabled = os.environ.get("LIGHTGBM_TPU_TIMETAG", "") not in ("", "0")
+_stack = threading.local()
+
+
+def enable() -> None:
+    global _enabled
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def reset() -> None:
+    with _lock:
+        _acc.clear()
+        _cnt.clear()
+
+
+def add(name: str, seconds: float) -> None:
+    with _lock:
+        _acc[name] += seconds
+        _cnt[name] += 1
+
+
+@contextlib.contextmanager
+def scope(name: str, sync_value=None):
+    """Accumulate the wall time of the enclosed block under `name`.
+
+    When `sync_value` is a callable, it is invoked on exit and its result
+    passed to jax.block_until_ready before the clock stops — use for
+    scopes whose cost is a device computation.
+    """
+    if not _enabled:
+        yield
+        return
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        if sync_value is not None:
+            try:
+                import jax
+                jax.block_until_ready(sync_value())
+            except Exception:
+                pass
+        add(name, time.perf_counter() - t0)
+
+
+def timed(name: str) -> Callable:
+    """Decorator form (the FunctionTimer analog)."""
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrap(*a, **k):
+            if not _enabled:
+                return fn(*a, **k)
+            t0 = time.perf_counter()
+            try:
+                return fn(*a, **k)
+            finally:
+                add(name, time.perf_counter() - t0)
+        return wrap
+    return deco
+
+
+def snapshot() -> Dict[str, Tuple[float, int]]:
+    with _lock:
+        return {k: (_acc[k], _cnt[k]) for k in _acc}
+
+
+def print_report(out=None) -> None:
+    """Sorted-by-time table, like Timer::Print (common.h:1059)."""
+    snap = snapshot()
+    if not snap:
+        return
+    import sys
+    out = out or sys.stderr
+    total = sum(v for v, _ in snap.values())
+    print("[LightGBM-TPU] [Info] time-tag report "
+          "(host wall per named scope; async launches exclude device time)",
+          file=out)
+    width = max(len(k) for k in snap)
+    for name, (sec, n) in sorted(snap.items(), key=lambda kv: -kv[1][0]):
+        print("  %-*s %10.3fs  x%-7d %5.1f%%"
+              % (width, name, sec, n, 100.0 * sec / max(total, 1e-12)),
+              file=out)
+    print("  %-*s %10.3fs" % (width, "(sum)", total), file=out)
+
+
+@atexit.register
+def _report_at_exit() -> None:  # pragma: no cover - exit path
+    if _enabled:
+        print_report()

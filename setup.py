@@ -1,0 +1,9 @@
+"""Thin setup.py shim — metadata lives in pyproject.toml.
+
+Kept for editable installs on older pips and so the native C++ sources
+(lightgbm_tpu/native/*.cpp, compiled lazily at first use with the system
+g++ — see lightgbm_tpu/native/__init__.py) ship inside wheels/sdists.
+"""
+from setuptools import setup
+
+setup()

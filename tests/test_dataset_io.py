@@ -85,6 +85,35 @@ def test_two_round_param_via_dataset(tmp_path):
     assert (((pr > 0.5) == y).mean()) > 0.8
 
 
+def test_add_features_from():
+    """Dataset.add_features_from (Dataset::AddFeaturesFrom,
+    src/io/dataset.cpp:1465): merged dataset must train identically to
+    binning the concatenated matrix in one shot when grouping is disabled
+    (EFB may bundle across the halves otherwise)."""
+    rng = np.random.default_rng(5)
+    n = 1200
+    Xa = rng.normal(size=(n, 3))
+    Xb = rng.normal(size=(n, 2))
+    y = (Xa[:, 0] + Xb[:, 0] > 0).astype(float)
+    params = {"max_bin": 63, "enable_bundle": False, "verbosity": -1}
+    da = lgb.Dataset(Xa, y, params=dict(params), free_raw_data=False)
+    db = lgb.Dataset(Xb, params=dict(params), free_raw_data=False)
+    da.construct()
+    db.construct()
+    da.add_features_from(db)
+    assert da.num_feature() == 5
+    dc = lgb.Dataset(np.concatenate([Xa, Xb], axis=1), y,
+                     params=dict(params), free_raw_data=False)
+    dc.construct()
+    np.testing.assert_array_equal(da._inner.binned, dc._inner.binned)
+    tp = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+          "max_bin": 63, "enable_bundle": False}
+    b1 = lgb.train(dict(tp), da, 5, verbose_eval=False)
+    b2 = lgb.train(dict(tp), dc, 5, verbose_eval=False)
+    X = np.concatenate([Xa, Xb], axis=1)
+    np.testing.assert_array_equal(b1.predict(X), b2.predict(X))
+
+
 def test_cli_save_binary_then_retrain(tmp_path):
     import subprocess
     import sys

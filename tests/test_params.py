@@ -135,6 +135,28 @@ def test_batched_scan_respects_changed_hyperparams():
     assert np.abs(p2).mean() < np.abs(p1).mean()  # heavy L2 shrinks outputs
 
 
+def test_batched_scan_respects_objective_hyperparams_and_new_labels():
+    """The fused-scan cache must also honor (a) scalars baked into the
+    objective's grad closure (scale_pos_weight) and (b) replaced dataset
+    fields — both bypass the traced SplitParams."""
+    rng = np.random.default_rng(11)
+    X = rng.normal(size=(1500, 5))
+    y = (X[:, 0] + 0.3 * X[:, 1] > 0).astype(float)
+    base = {"objective": "binary", "num_leaves": 15, "verbosity": -1}
+    ds = lgb.Dataset(X, y, free_raw_data=False)
+    b1 = lgb.train(dict(base), ds, 20, verbose_eval=False)
+    b2 = lgb.train({**base, "scale_pos_weight": 25.0}, ds, 20,
+                   verbose_eval=False)
+    p1, p2 = b1.predict(X), b2.predict(X)
+    assert not np.allclose(p1, p2)
+    assert p2.mean() > p1.mean()   # up-weighted positives push probs up
+    # replaced labels on the SAME Dataset retrain against the new targets
+    ds.set_label(1.0 - y)
+    b3 = lgb.train(dict(base), ds, 20, verbose_eval=False)
+    p3 = b3.predict(X)
+    assert np.corrcoef(p1, p3)[0, 1] < -0.5
+
+
 def test_bagging_not_silently_dropped():
     """bagging_fraction < 1 must keep bagging active every iteration (the
     fused batch path must not engage and train full-data)."""
