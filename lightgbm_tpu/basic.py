@@ -204,7 +204,8 @@ class Dataset:
                 weight=self.weight if self.weight is not None
                 else loaded.weight,
                 group=self.group if self.group is not None else loaded.group,
-                init_score=self.init_score,
+                init_score=(self.init_score if self.init_score is not None
+                            else loaded.init_score),
                 feature_names=loaded.feature_names,
                 reference=ref_inner)
         if cfg.save_binary and not path.endswith(".bin"):

@@ -240,6 +240,8 @@ class GBDT:
         from ..treelearner.serial import SerialTreeLearner
         cfg = self.config
         if not (self.allow_batch and self.supports_batch
+                and (self.objective is None
+                     or self.objective.supports_fused_scan)
                 and self.num_tree_per_iteration == 1
                 and not (cfg.bagging_fraction < 1.0 and cfg.bagging_freq > 0)
                 and not (cfg.pos_bagging_fraction < 1.0

@@ -198,6 +198,7 @@ PARAMS: List[_P] = [
     _P("tpu_window_chunk", int, 0),          # 0 = auto; partitioned-grower chunk rows
     _P("tpu_hist_dtype", str, "auto"),       # auto | f32 | bf16x2
     _P("tpu_pack_impl", str, "sort"),        # sort | matmul (partition pack)
+    _P("tpu_scan_impl", str, "auto"),        # auto | xla | pallas (split scan)
 ]
 
 _BY_NAME: Dict[str, _P] = {p.name: p for p in PARAMS}

@@ -345,6 +345,11 @@ class BinnedDataset:
             w_sc = _sidecar(filename, ".weight", None)
             if w_sc is not None:
                 ds.metadata.set_weight(w_sc)
+        i_sc = _sidecar(filename, ".init", None)
+        if i_sc is not None:
+            if i_sc.ndim == 2:   # class-major flat, like the one-round path
+                i_sc = i_sc.T.reshape(-1)
+            ds.metadata.set_init_score(i_sc)
         ds._construct_from_sample(sample, n, config,
                                   set(int(c) for c in categorical_features))
 

@@ -61,12 +61,35 @@ def _resolve_column(spec: str, header: Optional[List[str]], what: str) -> int:
 
 
 class LoadedData:
-    def __init__(self, X, label, weight, group, feature_names):
+    def __init__(self, X, label, weight, group, feature_names,
+                 init_score=None):
         self.X = X
         self.label = label
         self.weight = weight
         self.group = group
         self.feature_names = feature_names
+        self.init_score = init_score
+
+
+def _apply_sidecars(filename: str, loaded: "LoadedData") -> "LoadedData":
+    """Metadata files alongside the data file (reference Metadata::
+    LoadQueryBoundaries / LoadWeights / LoadInitialScore read <data>.query,
+    <data>.weight, <data>.init; dataset_loader.cpp + metadata.cpp)."""
+    group = _sidecar(filename, ".query", None)
+    if group is not None:
+        loaded.group = group
+    weight = _sidecar(filename, ".weight", None)
+    if weight is not None:
+        loaded.weight = weight
+    init = _sidecar(filename, ".init", None)
+    if init is not None:
+        if init.ndim == 2:
+            # multi-class init files are row-major columns; the score
+            # updater expects the reference's class-major flat layout
+            # (init_score_[k * num_data + i], metadata.cpp:425)
+            init = init.T.reshape(-1)
+        loaded.init_score = init
+    return loaded
 
 
 def load_text_file(filename: str, config) -> LoadedData:
@@ -108,7 +131,8 @@ def load_text_file(filename: str, config) -> LoadedData:
     data_lines = [ln for ln in data_lines if ln.strip()]
 
     if fmt == "libsvm":
-        return _parse_libsvm(data_lines, label_idx, header)
+        return _apply_sidecars(filename,
+                               _parse_libsvm(data_lines, label_idx, header))
 
     mat = np.genfromtxt(io.StringIO("\n".join(data_lines)), delimiter=sep,
                         dtype=np.float64)
@@ -132,13 +156,9 @@ def load_text_file(filename: str, config) -> LoadedData:
     X = mat[:, feat_cols]
     names = ([header[c] for c in feat_cols] if header is not None
              else ["Column_%d" % c for c in feat_cols])
-    # query file alongside (reference Metadata::LoadQueryBoundaries from
-    # <data>.query); weight file <data>.weight
-    group = _sidecar(filename, ".query", group)
-    weight_sc = _sidecar(filename, ".weight", None)
-    if weight_sc is not None:
-        weight = weight_sc
-    return LoadedData(X, label.astype(np.float32), weight, group, names)
+    return _apply_sidecars(
+        filename, LoadedData(X, label.astype(np.float32), weight, group,
+                             names))
 
 
 def _sidecar(filename: str, suffix: str, default):

@@ -61,6 +61,11 @@ class ObjectiveFunction:
     def is_renew_tree_output(self) -> bool:
         return False
 
+    # objectives whose gradients need fresh per-iteration host inputs
+    # (e.g. rank_xendcg's randomization) opt out of the fused K-iteration
+    # device scan, whose traced inputs are fixed for the whole batch
+    supports_fused_scan = True
+
     @property
     def average_output(self) -> bool:
         """RF sets this through boosting, not the objective (kept for parity

@@ -8,13 +8,14 @@ pair loop becomes a vmapped [P, P] pairwise tensor computation, chunked with
 lax.map to bound memory. XE-NDCG (rank_objective.hpp:288-352) is O(n) per
 query and is expressed with segment sums over the flat row axis — no padding.
 
-Deliberate deviations from the reference (documented for the parity tests):
-  * the 1M-entry sigmoid lookup table (:237-257) is replaced by exact
-    sigmoid evaluation — on TPU computing exp is cheaper than a 1M-gather,
-    and it is strictly more accurate;
-  * XE-NDCG's per-query Random stream (:305-312) is replaced by a
-    jax.random.PRNGKey folded with (iteration, query) so gradients stay
-    deterministic under jit.
+Deliberate deviation from the reference (documented for the parity tests):
+the 1M-entry sigmoid lookup table (:237-257) is replaced by exact sigmoid
+evaluation — on TPU computing exp is cheaper than a 1M-gather, and it is
+strictly more accurate. XE-NDCG's per-query Random stream (:305-312) is
+reproduced BIT-EXACTLY: the host advances the reference's LCG per query
+(RankXENDCG._next_floats) and ships each iteration's draws to the jitted
+gradient function, so the golden parity suite matches the reference's
+stochastic gradients too.
 """
 from __future__ import annotations
 
@@ -199,9 +200,14 @@ class LambdarankNDCG(RankingObjective):
 class RankXENDCG(RankingObjective):
     name = "rank_xendcg"
 
-    def __init__(self, config):
-        super().__init__(config)
-        self._iteration = 0
+    # per-iteration fresh randomization cannot ride the fused K-iteration
+    # scan (its traced inputs are fixed across the batch)
+    supports_fused_scan = False
+
+    # the reference's LCG (include/LightGBM/utils/random.h:101-110):
+    # x = 214013 x + 2531011 (mod 2^32); NextFloat = ((x>>16) & 0x7fff)/2^15
+    _LCG_A = np.uint32(214013)
+    _LCG_B = np.uint32(2531011)
 
     def init(self, metadata, num_data):
         super().init(metadata, num_data)
@@ -212,6 +218,35 @@ class RankXENDCG(RankingObjective):
             qid[qb[q]:qb[q + 1]] = q
         self._qid = qid
         self._counts = np.diff(qb).astype(np.int32)
+        # reference-exact per-query Random streams (rands_[i] = Random(seed+i),
+        # rank_objective.hpp:300): vectorized k-step LCG jump tables so draw
+        # j of a query reads the state after j+1 advances
+        self._lcg_x = (np.uint32(self.seed)
+                       + np.arange(self.num_queries, dtype=np.uint32))
+        kmax = int(self._counts.max()) if len(self._counts) else 1
+        A = np.empty(kmax + 1, dtype=np.uint32)
+        C = np.empty(kmax + 1, dtype=np.uint32)
+        A[0], C[0] = np.uint32(1), np.uint32(0)
+        with np.errstate(over="ignore"):
+            for k in range(kmax):
+                A[k + 1] = self._LCG_A * A[k]
+                C[k + 1] = self._LCG_A * C[k] + self._LCG_B
+        self._lcg_A, self._lcg_C = A, C
+        self._pos_in_query = (np.arange(self.num_data, dtype=np.int64)
+                              - qb[qid]).astype(np.int64)
+
+    def _next_floats(self) -> np.ndarray:
+        """One iteration's [num_data] NextFloat() draws, bit-identical to
+        the reference's sequential per-query stream."""
+        j1 = self._pos_in_query + 1
+        with np.errstate(over="ignore"):
+            v = (self._lcg_A[j1] * self._lcg_x[self._qid]
+                 + self._lcg_C[j1])
+            cnt = self._counts.astype(np.int64)
+            self._lcg_x = (self._lcg_A[cnt] * self._lcg_x
+                           + self._lcg_C[cnt])
+        return (((v >> np.uint32(16)) & np.uint32(0x7FFF))
+                .astype(np.float32) / np.float32(32768.0)).astype(np.float64)
 
     def grad_fn(self):
         num_queries = self.num_queries
@@ -223,13 +258,12 @@ class RankXENDCG(RankingObjective):
         def seg_max(x, qid):
             return jax.ops.segment_max(x, qid, num_segments=num_queries)
 
-        def fn(score, label, weight, qid, counts, key):
+        def fn(score, label, weight, qid, counts, g_rand):
             # masked softmax per query (Common::Softmax over each query)
             mx = seg_max(score, qid)
             e = jnp.exp(score - mx[qid])
             rho = e / seg_sum(e, qid)[qid]
 
-            g_rand = jax.random.uniform(key, (num_data,), dtype=jnp.float64)
             phi = jnp.power(2.0, jnp.floor(label).astype(jnp.float64)) - g_rand
             sum_labels = jnp.maximum(K_EPSILON, seg_sum(phi, qid))
             l1 = -phi / sum_labels[qid] + rho
@@ -256,9 +290,8 @@ class RankXENDCG(RankingObjective):
             weight = jnp.asarray(self.weight) if self.weight is not None else None
             self._jit_args = (jnp.asarray(self.label), weight,
                               jnp.asarray(self._qid), jnp.asarray(self._counts))
-        key = jax.random.fold_in(jax.random.PRNGKey(self.seed), self._iteration)
-        self._iteration += 1
-        return self._jit_fn(score, *self._jit_args, key)
+        return self._jit_fn(score, *self._jit_args,
+                            jnp.asarray(self._next_floats()))
 
     def to_string(self):
         return self.name

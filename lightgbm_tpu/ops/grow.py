@@ -83,6 +83,8 @@ class GrowConfig(NamedTuple):
     #                            # parallel/learners.py for the mapping to
     #                            # the reference's three learners)
     top_k: int = 20              # voting-parallel per-shard vote size
+    scan_impl: str = "xla"       # "xla" | "pallas" fused split-scan kernel
+    #                            # (fast path only; resolve_scan_impl gates)
 
 
 class GrowExtras(NamedTuple):
@@ -415,6 +417,100 @@ def _eval_children(eval_leaf, leaf_hist, l, s, cand, left_cnt, right_cnt,
     return cand_l, cand_r
 
 
+def _make_eval_pair_fused(meta, params, feature_mask, cat, gc: GrowConfig):
+    """Fused Pallas scan-pair evaluator (fast path; see ops/pallas_scan.py).
+
+    Built once per tree: dense gather layout + direction masks precompute
+    (~15 ops), then every split pays one gather + one kernel + a ~25-op
+    scalar assembly instead of the ~300-op XLA pair scan. Falls back never
+    — the CALLER gates on gc.scan_impl (resolve_scan_impl checks every
+    semantic knob this kernel does not implement).
+    """
+    from .pallas_scan import ScanLayout, scan_pair
+    F = gc.num_features
+    layout = ScanLayout(meta, feature_mask, F, gc.scan_width, gc.total_bins)
+    p32 = params.cast(jnp.float32)
+    f32 = jnp.float32
+    # CPU (tests) runs the kernel in interpreter mode — the equivalence
+    # suite compares it against the XLA scan there
+    interpret = jax.default_backend() not in ("tpu", "axon")
+
+    def eval_pair(leaf_hist, l, s, cand, left_cnt, right_cnt, depth_child):
+        hist2 = leaf_hist[jnp.stack([l, s])]          # [2, TB, 2]
+        dense = hist2[:, layout.gidx, :]              # [2, Fp, Wp, 2]
+        gb = dense[..., 0]
+        hb = dense[..., 1]
+        sg = jnp.stack([cand.left_sum_grad,
+                        cand.right_sum_grad]).astype(f32)
+        sh = jnp.stack([cand.left_sum_hess,
+                        cand.right_sum_hess]).astype(f32)
+        cnt = jnp.stack([left_cnt, right_cnt]).astype(f32)
+        l2 = p32.lambda_l2.astype(f32)
+        cf = cnt / sh
+        gain_shift = sg * sg / (sh + l2)
+        mgs = gain_shift + p32.min_gain_to_split.astype(f32)
+        md = p32.min_data_in_leaf.astype(f32)
+        mh = p32.min_sum_hessian_in_leaf.astype(f32)
+        scal = jnp.stack([
+            sg, sh, cnt, cf,
+            jnp.broadcast_to(md, (2,)), jnp.broadcast_to(mh, (2,)),
+            mgs, jnp.broadcast_to(l2, (2,))], axis=1)  # [2, 8]
+        out = scan_pair(scal, gb, hb, layout.keep_r, layout.keep_f,
+                        layout.valid_r, layout.valid_f, layout.aux,
+                        interpret=interpret)
+        gains = out[:, 0, :]                          # [2, Fp]
+        best_f = jnp.argmax(gains, axis=1)            # [2] first max
+
+        def take(row):
+            return jnp.take_along_axis(out[:, row, :], best_f[:, None],
+                                       axis=1)[:, 0]
+        gain_b = take(0)
+        t_b = take(1).astype(I32)
+        use_f_b = take(2) > 0.5
+        lg = take(3)
+        lh = take(4)
+        lc = take(5)
+        best_valid = jnp.isfinite(gain_b)
+        if gc.max_depth > 0:
+            best_valid &= depth_child < gc.max_depth
+        rg = sg - lg
+        rh = sh - lh
+        rc = cnt - lc
+        lo = -lg / (lh + l2)
+        ro = -rg / (rh + l2)
+        default_left = (~use_f_b) & (~layout.forced_right[best_f])
+        neg = jnp.asarray(K_MIN_SCORE, f32)
+        pair = SplitCandidate(
+            gain=jnp.where(best_valid, gain_b, neg),
+            feature=jnp.where(best_valid, best_f.astype(I32), -1),
+            threshold=jnp.where(best_valid, t_b, 0),
+            default_left=jnp.where(best_valid, default_left, True),
+            left_output=lo, right_output=ro,
+            left_sum_grad=lg, left_sum_hess=lh,
+            right_sum_grad=rg, right_sum_hess=rh,
+            left_count=jnp.floor(lc + 0.5).astype(I32),
+            right_count=jnp.floor(rc + 0.5).astype(I32),
+            is_cat=jnp.zeros((2,), BOOL),
+            cat_mask=jnp.zeros((2, gc.cat_width), BOOL),
+        )
+        if cat.cat_feature.shape[0] > 0:
+            cat_pair = jax.vmap(
+                lambda h, a, b, c: find_best_split_categorical(
+                    h, a, b, c, cat, meta, params,
+                    jnp.asarray(-jnp.inf, f32), jnp.asarray(jnp.inf, f32),
+                    feature_mask, use_mc=False, use_dp=gc.use_dp))(
+                hist2, sg, sh, jnp.stack([left_cnt, right_cnt]))
+            if gc.max_depth > 0:
+                cat_pair = cat_pair._replace(gain=jnp.where(
+                    depth_child < gc.max_depth, cat_pair.gain, neg))
+            pair = merge_candidates(pair, cat_pair)
+        cand_l = jax.tree.map(lambda a: a[0], pair)
+        cand_r = jax.tree.map(lambda a: a[1], pair)
+        return cand_l, cand_r
+
+    return eval_pair
+
+
 def _hist_chunk_contract(bv, vc, W, hist_dtype):
     """One chunk's one-hot MXU contraction -> [G, W, 2] f32.
 
@@ -549,6 +645,9 @@ def grow_tree(layout: DataLayout, grad: jnp.ndarray, hess: jnp.ndarray,
                                 extras, feat_nb_e, axis_name=axis_name,
                                 fix=fix)
     eval_leaf.set_num_groups(layout.bins.shape[1])
+    eval_pair_fused = (_make_eval_pair_fused(meta, params, feature_mask,
+                                             cat, gc)
+                       if gc.scan_impl == "pallas" else None)
     root_out = _leaf_output_unconstrained(
         sum_grad, sum_hess, pcast.lambda_l1, pcast.lambda_l2,
         pcast.max_delta_step)
@@ -667,10 +766,14 @@ def grow_tree(layout: DataLayout, grad: jnp.ndarray, hess: jnp.ndarray,
         # the hist_left/right expressions) ends the old buffer's liveness at
         # the update, letting XLA do the dynamic-update-slice in place
         # instead of copying the whole [L, TB, 2] tensor twice per split
-        cand_l, cand_r = _eval_children(
-            eval_leaf, leaf_hist, l, s, cand, left_cnt, right_cnt,
-            depth_child, l_cmin, l_cmax, r_cmin, r_cmax,
-            _split_keys(extras, s), feature_used)
+        if eval_pair_fused is not None:
+            cand_l, cand_r = eval_pair_fused(
+                leaf_hist, l, s, cand, left_cnt, right_cnt, depth_child)
+        else:
+            cand_l, cand_r = _eval_children(
+                eval_leaf, leaf_hist, l, s, cand, left_cnt, right_cnt,
+                depth_child, l_cmin, l_cmax, r_cmin, r_cmax,
+                _split_keys(extras, s), feature_used)
         best = jax.tree.map(
             lambda a, vl, vr: a.at[l].set(jnp.where(do, vl, a[l]))
                                .at[s].set(jnp.where(do, vr, a[s])),
@@ -726,17 +829,15 @@ def grow_tree(layout: DataLayout, grad: jnp.ndarray, hess: jnp.ndarray,
 class _PartState(NamedTuple):
     s: jnp.ndarray
     done: jnp.ndarray
-    binsP: jnp.ndarray          # [N + C, G]  leaf-sorted bins
-    gradP: jnp.ndarray          # [N + C] f32
-    hessP: jnp.ndarray          # [N + C] f32
-    bagP: jnp.ndarray           # [N + C] bool
-    ridP: jnp.ndarray           # [N + C] i32 original row id per position
-    posL: jnp.ndarray           # [N + C] i32 leaf id per position
-    binsS: jnp.ndarray          # [N + 3C, G] scratch (writes top out at
-    gradS: jnp.ndarray          # [N + 3C]    N + 2C; the extra C rows are
-    hessS: jnp.ndarray          # [N + 3C]    read slack so the final right
-    bagS: jnp.ndarray           # [N + 3C]    copy-back chunk's slice stays
-    ridS: jnp.ndarray           # [N + 3C]    in range instead of clamping)
+    binsP: jnp.ndarray          # [N + PAD, G]  leaf-sorted bins
+    gradP: jnp.ndarray          # [N + PAD] f32
+    hessP: jnp.ndarray          # [N + PAD] f32
+    rbP: jnp.ndarray            # [N + PAD] u32: row id | bag_flag << 30
+    posL: jnp.ndarray           # [N + PAD] i32 leaf id per position
+    binsS: jnp.ndarray          # [N + 2C + CB, G] scratch (writes top out
+    gradS: jnp.ndarray          # at N + 2C; the extra CB rows are read
+    hessS: jnp.ndarray          # slack so the final right copy-back
+    rbS: jnp.ndarray            # chunk's slice stays in range)
     leaf_start: jnp.ndarray     # [L] i32 segment starts (local rows)
     leaf_nrows: jnp.ndarray     # [L] i32 segment lengths (local rows)
     leaf_hist: jnp.ndarray
@@ -792,23 +893,22 @@ def _bitunpack_cols(packed, bits: int, G: int, bdt):
     return vals.reshape(C, ncol * per)[:, :G].astype(bdt)
 
 
-def _pack_sort(key, bw, gw, hw, bgw, rw, bits: int):
+def _pack_sort(key, bw, gw, hw, rbw, bits: int):
     """Two-way partition of a chunk's payload via one vectorized sort.
 
     key: [C] u32 with 0 = left, 1 = invalid, 2 = right, so the sorted chunk
     is [left block | dropped rows | right block] — the same two-ended layout
     the scratch writes expect. Payload rides as u32 columns (bins bit-packed,
-    grad/hess bit-cast, row id and bag flag packed together), so the pack is
-    EXACT by construction: lax.sort moves words, it never does arithmetic.
-    Returns (bins [C, G_as_input], grad, hess, bag, rid).
+    grad/hess bit-cast, row id carrying the bag flag in bit 30), so the pack
+    is EXACT by construction: lax.sort moves words, it never does arithmetic.
+    Returns (bins [C, G_as_input], grad, hess, ridbag).
     """
     C, G = bw.shape
     bin_cols = _bitpack_cols(bw, bits)
     g_u = jax.lax.bitcast_convert_type(gw, U32)
     h_u = jax.lax.bitcast_convert_type(hw, U32)
-    ridbag = rw.astype(U32) | (bgw.astype(U32) << U32(30))
     ops = [key] + [bin_cols[:, i] for i in range(bin_cols.shape[1])] \
-        + [g_u, h_u, ridbag]
+        + [g_u, h_u, rbw]
     out = jax.lax.sort(ops, num_keys=1, is_stable=False)
     nbc = bin_cols.shape[1]
     pb = _bitunpack_cols(jnp.stack(out[1:1 + nbc], axis=-1), bits, G,
@@ -816,9 +916,7 @@ def _pack_sort(key, bw, gw, hw, bgw, rw, bits: int):
     pg = jax.lax.bitcast_convert_type(out[1 + nbc], jnp.float32)
     ph = jax.lax.bitcast_convert_type(out[2 + nbc], jnp.float32)
     prb = out[3 + nbc]
-    pbag = ((prb >> U32(30)) & U32(1)).astype(BOOL)
-    prid = (prb & U32((1 << 30) - 1)).astype(I32)
-    return pb, pg, ph, pbag, prid
+    return pb, pg, ph, prb
 
 
 def _hist_chunk_accum(acc, bw, gw, hw, gc: GrowConfig, group_offset, W):
@@ -922,17 +1020,21 @@ def grow_tree_partitioned(layout: DataLayout, grad: jnp.ndarray,
         return jax.lax.psum(x, axis_name)
 
     # ---- padded payload buffers ----------------------------------------
-    # PAD covers both the per-split C-windows and the root's bigger chunks
-    # (dynamic_slice clamps out-of-range starts, which would silently shift
-    # a window onto the wrong rows — padding keeps every slice in range)
+    # PAD covers the per-split C-windows, the CB copy-back windows, and the
+    # root's bigger chunks (dynamic_slice clamps out-of-range starts, which
+    # would silently shift a window onto the wrong rows — padding keeps
+    # every slice in range)
+    CB = C                       # copy-back chunk (larger hurts small leaves)
     CR = min(max(C, 65536), max(C, n))
-    PAD = max(2 * C, CR)
-    # row ids share a u32 with the bag bit in the pack sort
+    PAD = max(2 * C, CB, CR)
+    # row ids share a u32 with the bag bit
     assert n + PAD < (1 << 30), "per-shard row count must be < 2^30"
     binsP0 = jnp.concatenate([layout.bins, jnp.zeros((PAD, G), bdt)])
     gradP0 = jnp.concatenate([grad, jnp.zeros((PAD,), jnp.float32)])
     hessP0 = jnp.concatenate([hess, jnp.zeros((PAD,), jnp.float32)])
     bagP0 = jnp.concatenate([bag_mask, jnp.zeros((PAD,), BOOL)])
+    rbP0 = (jnp.arange(n + PAD, dtype=U32)
+            | (bagP0.astype(U32) << U32(30)))
 
     # ---- root ----------------------------------------------------------
     # root histogram streams the (identity-ordered) payload in big chunks;
@@ -960,6 +1062,9 @@ def grow_tree_partitioned(layout: DataLayout, grad: jnp.ndarray,
                                 extras, feat_nb, axis_name=axis_name,
                                 fix=fix)
     eval_leaf.set_num_groups(G)
+    eval_pair_fused = (_make_eval_pair_fused(meta, params, feature_mask,
+                                             cat, gc)
+                       if gc.scan_impl == "pallas" else None)
     feature_used0 = extras.feature_used
 
     root_cand = eval_leaf(root_hist, sum_grad, sum_hess, root_count,
@@ -970,20 +1075,19 @@ def grow_tree_partitioned(layout: DataLayout, grad: jnp.ndarray,
         sum_grad, sum_hess, pcast.lambda_l1, pcast.lambda_l2,
         pcast.max_delta_step)
 
+    SS = n + 2 * C + CB          # scratch size (write top + read slack)
     state = _PartState(
         s=jnp.asarray(1, I32),
         done=jnp.asarray(False),
         binsP=binsP0,
         gradP=gradP0,
         hessP=hessP0,
-        bagP=bagP0,
-        ridP=jnp.arange(n + PAD, dtype=I32),
+        rbP=rbP0,
         posL=jnp.zeros((n + PAD,), I32),
-        binsS=jnp.zeros((n + 3 * C, G), bdt),
-        gradS=jnp.zeros((n + 3 * C,), jnp.float32),
-        hessS=jnp.zeros((n + 3 * C,), jnp.float32),
-        bagS=jnp.zeros((n + 3 * C,), BOOL),
-        ridS=jnp.zeros((n + 3 * C,), I32),
+        binsS=jnp.zeros((SS, G), bdt),
+        gradS=jnp.zeros((SS,), jnp.float32),
+        hessS=jnp.zeros((SS,), jnp.float32),
+        rbS=jnp.zeros((SS,), U32),
         leaf_start=jnp.zeros((L,), I32),
         leaf_nrows=jnp.zeros((L,), I32).at[0].set(n),
         leaf_hist=jnp.zeros((L, TB, 2), jnp.float32).at[0].set(root_hist),
@@ -1030,14 +1134,14 @@ def grow_tree_partitioned(layout: DataLayout, grad: jnp.ndarray,
         nch = (n_l + C - 1) // C
 
         def pa_body(i, carry):
-            (binsS, gradS, hessS, bagS, ridS, lf, rf, bag_left, hacc) = carry
+            (binsS, gradS, hessS, rbS, lf, rf, bag_left, hacc) = carry
             off = (s0 + i * C).astype(I32)
             bw = jax.lax.dynamic_slice(st.binsP,
                                        (off, jnp.asarray(0, I32)), (C, G))
             gw = jax.lax.dynamic_slice(st.gradP, (off,), (C,))
             hw = jax.lax.dynamic_slice(st.hessP, (off,), (C,))
-            bgw = jax.lax.dynamic_slice(st.bagP, (off,), (C,))
-            rw = jax.lax.dynamic_slice(st.ridP, (off,), (C,))
+            rbw = jax.lax.dynamic_slice(st.rbP, (off,), (C,))
+            bgw = (rbw >> U32(30)) & U32(1)
             valid = arangeC < (n_l - i * C)
 
             col = bw[:, g].astype(I32) + goff[g]
@@ -1054,25 +1158,23 @@ def grow_tree_partitioned(layout: DataLayout, grad: jnp.ndarray,
             # it again at rf - C puts the right block's end exactly at rf
             if gc.pack_impl == "sort":
                 key = jnp.where(gl, U32(0), jnp.where(gr, U32(2), U32(1)))
-                pb, pg, ph, pbag, prid = _pack_sort(key, bw, gw, hw, bgw, rw,
-                                                    _bits_of(bdt))
+                pb, pg, ph, prb = _pack_sort(key, bw, gw, hw, rbw,
+                                             _bits_of(bdt))
             else:
                 posl = jnp.cumsum(gl, dtype=I32) - 1
                 posr = (C - nR) + jnp.cumsum(gr, dtype=I32) - 1
                 slot = jnp.where(gl, posl, jnp.where(gr, posr, C))
-                rid_hi = (rw // jnp.asarray(4096, I32)).astype(jnp.float32)
-                rid_lo = (rw % jnp.asarray(4096, I32)).astype(jnp.float32)
+                rb_hi = (rbw >> U32(12)).astype(jnp.float32)
+                rb_lo = (rbw & U32(4095)).astype(jnp.float32)
                 payload = jnp.concatenate([
                     bw.astype(jnp.float32), gw[:, None], hw[:, None],
-                    bgw.astype(jnp.float32)[:, None],
-                    rid_hi[:, None], rid_lo[:, None]], axis=1)
+                    rb_hi[:, None], rb_lo[:, None]], axis=1)
                 packed = _pack_matmul(slot, payload, C)
                 pb = packed[:, :G].astype(bdt)
                 pg = packed[:, G]
                 ph = packed[:, G + 1]
-                pbag = packed[:, G + 2] > 0.5
-                prid = (packed[:, G + 3].astype(I32) * 4096
-                        + packed[:, G + 4].astype(I32))
+                prb = ((packed[:, G + 2].astype(U32) << U32(12))
+                       | packed[:, G + 3].astype(U32))
 
             # scratch layout: left blocks stack up from 0, right blocks
             # stack down from n+2C; the 2C padding keeps the two whole-[C]
@@ -1080,25 +1182,23 @@ def grow_tree_partitioned(layout: DataLayout, grad: jnp.ndarray,
             binsS = jax.lax.dynamic_update_slice(binsS, pb, (lf, jnp.asarray(0, I32)))
             gradS = jax.lax.dynamic_update_slice(gradS, pg, (lf,))
             hessS = jax.lax.dynamic_update_slice(hessS, ph, (lf,))
-            bagS = jax.lax.dynamic_update_slice(bagS, pbag, (lf,))
-            ridS = jax.lax.dynamic_update_slice(ridS, prid, (lf,))
+            rbS = jax.lax.dynamic_update_slice(rbS, prb, (lf,))
             binsS = jax.lax.dynamic_update_slice(binsS, pb, (rf - C, jnp.asarray(0, I32)))
             gradS = jax.lax.dynamic_update_slice(gradS, pg, (rf - C,))
             hessS = jax.lax.dynamic_update_slice(hessS, ph, (rf - C,))
-            bagS = jax.lax.dynamic_update_slice(bagS, pbag, (rf - C,))
-            ridS = jax.lax.dynamic_update_slice(ridS, prid, (rf - C,))
+            rbS = jax.lax.dynamic_update_slice(rbS, prb, (rf - C,))
 
-            bag_left = bag_left + jnp.sum(gl & bgw, dtype=I32)
+            bag_left = bag_left + jnp.sum(gl & (bgw > 0), dtype=I32)
             m = (valid & (go_left == smaller_is_left)).astype(jnp.float32)
             hacc = _hist_chunk_accum(hacc, bw.astype(I32), gw * m, hw * m,
                                      gc, goff, W)
-            return (binsS, gradS, hessS, bagS, ridS,
+            return (binsS, gradS, hessS, rbS,
                     lf + nL, rf - nR, bag_left, hacc)
 
-        (binsS, gradS, hessS, bagS, ridS, n_left, rf_end, bag_left,
+        (binsS, gradS, hessS, rbS, n_left, rf_end, bag_left,
          hacc) = jax.lax.fori_loop(
             0, nch, pa_body,
-            (st.binsS, st.gradS, st.hessS, st.bagS, st.ridS,
+            (st.binsS, st.gradS, st.hessS, st.rbS,
              jnp.asarray(0, I32), jnp.asarray(n + 2 * C, I32),
              jnp.asarray(0, I32), _hist_acc_init(gc, G, W)))
         n_right = n_l - n_left
@@ -1108,47 +1208,49 @@ def grow_tree_partitioned(layout: DataLayout, grad: jnp.ndarray,
         left_cnt = psum(bag_left)
         right_cnt = st.leaf_count[l] - left_cnt
 
-        # ---- pass B: copy packed blocks back (contiguous, masked tails) --
-        nchL = (n_left + C - 1) // C
-        nchR = (n_right + C - 1) // C
+        # ---- pass B: copy packed blocks back (contiguous, masked tails;
+        # CB-wide chunks — currently CB = C, wider measured slower because
+        # every split pays two whole-CB minimum passes) --
+        nchL = (n_left + CB - 1) // CB
+        nchR = (n_right + CB - 1) // CB
         right_src0 = jnp.asarray(n + 2 * C, I32) - n_right
+        arangeCB = jnp.arange(CB, dtype=I32)
 
         def copy_back(j, carry, src0, dst0, count, stamp):
-            binsP, gradP, hessP, bagP, ridP, posL = carry
-            src = (src0 + j * C).astype(I32)
-            dst = (dst0 + j * C).astype(I32)
-            keep = arangeC < (count - j * C)
+            binsP, gradP, hessP, rbP, posL = carry
+            src = (src0 + j * CB).astype(I32)
+            dst = (dst0 + j * CB).astype(I32)
+            keep = arangeCB < (count - j * CB)
 
             def blend(P, S, is2d):
                 if is2d:
                     z = jnp.asarray(0, I32)
-                    new = jax.lax.dynamic_slice(S, (src, z), (C, G))
-                    old = jax.lax.dynamic_slice(P, (dst, z), (C, G))
+                    new = jax.lax.dynamic_slice(S, (src, z), (CB, G))
+                    old = jax.lax.dynamic_slice(P, (dst, z), (CB, G))
                     out = jnp.where(keep[:, None], new, old)
                     return jax.lax.dynamic_update_slice(P, out, (dst, z))
-                new = jax.lax.dynamic_slice(S, (src,), (C,))
-                old = jax.lax.dynamic_slice(P, (dst,), (C,))
+                new = jax.lax.dynamic_slice(S, (src,), (CB,))
+                old = jax.lax.dynamic_slice(P, (dst,), (CB,))
                 return jax.lax.dynamic_update_slice(
                     P, jnp.where(keep, new, old), (dst,))
 
             binsP = blend(binsP, binsS, True)
             gradP = blend(gradP, gradS, False)
             hessP = blend(hessP, hessS, False)
-            bagP = blend(bagP, bagS, False)
-            ridP = blend(ridP, ridS, False)
+            rbP = blend(rbP, rbS, False)
             if stamp is not None:
-                oldp = jax.lax.dynamic_slice(posL, (dst,), (C,))
+                oldp = jax.lax.dynamic_slice(posL, (dst,), (CB,))
                 posL = jax.lax.dynamic_update_slice(
                     posL, jnp.where(keep, stamp, oldp), (dst,))
-            return binsP, gradP, hessP, bagP, ridP, posL
+            return binsP, gradP, hessP, rbP, posL
 
-        carry0 = (st.binsP, st.gradP, st.hessP, st.bagP, st.ridP, st.posL)
+        carry0 = (st.binsP, st.gradP, st.hessP, st.rbP, st.posL)
         carry1 = jax.lax.fori_loop(
             0, nchL,
             lambda j, c: copy_back(j, c, jnp.asarray(0, I32), s0,
                                    n_left, None),
             carry0)
-        binsP, gradP, hessP, bagP, ridP, posL = jax.lax.fori_loop(
+        binsP, gradP, hessP, rbP, posL = jax.lax.fori_loop(
             0, nchR,
             lambda j, c: copy_back(j, c, right_src0, s0 + n_left,
                                    n_right, s),
@@ -1205,10 +1307,14 @@ def grow_tree_partitioned(layout: DataLayout, grad: jnp.ndarray,
 
         # children evaluated from the updated buffer (in-place DUS; see
         # grow_tree body comment)
-        cand_l, cand_r = _eval_children(
-            eval_leaf, leaf_hist, l, s, cand, left_cnt, right_cnt,
-            depth_child, l_cmin, l_cmax, r_cmin, r_cmax,
-            _split_keys(extras, s), feature_used)
+        if eval_pair_fused is not None:
+            cand_l, cand_r = eval_pair_fused(
+                leaf_hist, l, s, cand, left_cnt, right_cnt, depth_child)
+        else:
+            cand_l, cand_r = _eval_children(
+                eval_leaf, leaf_hist, l, s, cand, left_cnt, right_cnt,
+                depth_child, l_cmin, l_cmax, r_cmin, r_cmax,
+                _split_keys(extras, s), feature_used)
         best = jax.tree.map(
             lambda a, vl, vr: a.at[l].set(jnp.where(do, vl, a[l]))
                                .at[s].set(jnp.where(do, vr, a[s])),
@@ -1218,9 +1324,9 @@ def grow_tree_partitioned(layout: DataLayout, grad: jnp.ndarray,
                              st.leaf_count[l], s)
         return st._replace(
             s=s + do.astype(I32), done=~do,
-            binsP=binsP, gradP=gradP, hessP=hessP, bagP=bagP, ridP=ridP,
-            posL=posL, binsS=binsS, gradS=gradS, hessS=hessS, bagS=bagS,
-            ridS=ridS, leaf_start=leaf_start, leaf_nrows=leaf_nrows,
+            binsP=binsP, gradP=gradP, hessP=hessP, rbP=rbP,
+            posL=posL, binsS=binsS, gradS=gradS, hessS=hessS, rbS=rbS,
+            leaf_start=leaf_start, leaf_nrows=leaf_nrows,
             leaf_hist=leaf_hist, leaf_sum_grad=leaf_sum_grad,
             leaf_sum_hess=leaf_sum_hess, leaf_count=leaf_count,
             leaf_value=leaf_value, leaf_depth=leaf_depth,
@@ -1230,8 +1336,9 @@ def grow_tree_partitioned(layout: DataLayout, grad: jnp.ndarray,
 
     final = jax.lax.while_loop(cond, body, state)
     # per-row leaf ids in original row order: one scatter through the carried
-    # row ids (ridP[:n] is a permutation of 0..n-1)
-    row_leaf = jnp.zeros((n,), I32).at[final.ridP[:n]].set(
+    # row ids (rbP[:n] & rid-mask is a permutation of 0..n-1)
+    rid = (final.rbP[:n] & U32((1 << 30) - 1)).astype(I32)
+    row_leaf = jnp.zeros((n,), I32).at[rid].set(
         final.posL[:n], mode="drop", unique_indices=True)
     return final.tree._replace(
         num_leaves=final.s,

@@ -153,8 +153,10 @@ class VotingParallelTreeLearner(DataParallelTreeLearner):
 
     def __init__(self, config, dataset, mesh: Mesh = None):
         super().__init__(config, dataset, mesh=mesh)
+        # the fused Pallas pair scan has no voting local-scan path
         self.grow_config = self.grow_config._replace(
-            parallel_mode="voting", top_k=int(config.top_k))
+            parallel_mode="voting", top_k=int(config.top_k),
+            scan_impl="xla")
         self._sharded_grow = None
 
 
@@ -172,7 +174,9 @@ class FeatureParallelTreeLearner(SerialTreeLearner):
             int(config.tpu_num_devices))
         self.num_shards = self.mesh.devices.size
         self._axis_name = AXIS
-        self.grow_config = self.grow_config._replace(parallel_mode="feature")
+        # the fused Pallas pair scan has no per-shard feature ownership path
+        self.grow_config = self.grow_config._replace(parallel_mode="feature",
+                                                     scan_impl="xla")
         self._sharded_grow = None
 
     def _build(self):

@@ -98,6 +98,32 @@ def resolve_hist_impl(config: Config) -> str:
     return "pallas" if pallas_ok else "onehot"
 
 
+def resolve_scan_impl(config: Config, gc_kwargs: dict) -> str:
+    """'auto' -> the fused Pallas split-scan kernel on TPU when every
+    semantic knob it implements covers the run (fast path: f32, no monotone
+    constraints, no L1/max_delta_step, no extra_trees/by-node/CEGB, not the
+    voting/feature parallel scans); otherwise the general XLA scan."""
+    impl = str(config.tpu_scan_impl).lower()
+    if impl == "xla":
+        return "xla"
+    import jax
+    from ..ops.pallas_scan import HAS_PALLAS
+    backend = jax.default_backend()
+    ok = (HAS_PALLAS and backend in ("tpu", "axon")
+          and not gc_kwargs["use_dp"] and not gc_kwargs["use_mc"]
+          and not gc_kwargs["use_l1"] and not gc_kwargs["use_mds"]
+          and not gc_kwargs["extra_trees"] and gc_kwargs["bynode_k"] == 0
+          and not gc_kwargs["use_cegb"])
+    if impl == "pallas":
+        if not ok:
+            Log.warning("tpu_scan_impl=pallas requires the fast-path "
+                        "config (f32, no monotone/L1/max_delta_step/"
+                        "extra_trees/by-node/CEGB); using the XLA scan")
+            return "xla"
+        return "pallas"
+    return "pallas" if ok else "xla"
+
+
 def resolve_use_dp(config: Config) -> bool:
     """Precision of leaf sums / gain math. The CPU backend always uses f64
     (it stands in for the reference CPU learner, which is double-only); on
@@ -206,7 +232,7 @@ class SerialTreeLearner:
             import jax
             hist_dtype = ("f32" if jax.default_backend() == "cpu"
                           else "bf16x2")
-        self.grow_config = GrowConfig(
+        gc_kwargs = dict(
             num_leaves=int(config.num_leaves),
             total_bins=int(dataset.total_bins),
             num_features=int(dataset.num_features),
@@ -232,6 +258,8 @@ class SerialTreeLearner:
                       if float(config.feature_fraction_bynode) < 1.0 else 0),
             use_cegb=_cegb_enabled(config),
         )
+        self.grow_config = GrowConfig(
+            scan_impl=resolve_scan_impl(config, gc_kwargs), **gc_kwargs)
         self._extras_base = _build_extras(config, dataset)
         self._tree_counter = 0
         self._feature_used_dev = None
@@ -307,13 +335,16 @@ class SerialTreeLearner:
             grad_fn = objective.grad_fn()
             gc = self.grow_config
             use_part = self.use_partitioned
-            layout = self.layout
             cat, gw = self.cat_layout, self.gw_global
             n = self.dataset.num_data
 
+            # layout is a traced ARGUMENT: closure-captured device arrays
+            # embed as HLO constants, and a [N, G] constant both bloats
+            # every compile and overflows the remote-compile transport at
+            # HIGGS-scale row counts
             @jax.jit
-            def run(score0, fu0, fmasks, keys, base_extras, shrink_t,
-                    meta, params, fix, gargs):
+            def run(layout, score0, fu0, fmasks, keys, base_extras,
+                    shrink_t, meta, params, fix, gargs):
                 bag = jnp.ones(n, bool)
 
                 def body(carry, per):
@@ -347,7 +378,7 @@ class SerialTreeLearner:
         base = self._extras_base
         fu0 = (self._feature_used_dev if self._feature_used_dev is not None
                else base.feature_used)
-        return fn(score0, fu0, fmasks, keys, base,
+        return fn(self.layout, score0, fu0, fmasks, keys, base,
                   jnp.asarray(shrink, jnp.float64),
                   self.meta, self.params, self.fix, objective._grad_args())
 

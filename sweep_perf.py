@@ -22,7 +22,10 @@ def run(n_rows, n_iters, leaves, wc, hd, ds_cache={}):
               "verbosity": -1, "metric": "none",
               "tpu_window_chunk": wc, "tpu_hist_dtype": hd}
     t0 = time.time()
-    warm = lgb.train(dict(params), ds, 1, verbose_eval=False)
+    # 17 = one fused 16-iteration scan + one single-tree program: compiles
+    # BOTH steady-state paths so the measured run is compile-free
+    warm = lgb.train(dict(params), ds, 17, verbose_eval=False)
+    warm._booster._materialize_pending()
     compile_s = time.time() - t0
     del warm
     t0 = time.time()
