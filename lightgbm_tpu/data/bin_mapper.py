@@ -470,6 +470,17 @@ class BinMapper:
             self.sparse_rate = 1.0
 
     # ------------------------------------------------------------------
+    def categorical_lut(self) -> np.ndarray:
+        """Dense category -> bin lookup table; indices outside it (and
+        negatives/NaN) map to num_bin - 1. Shared by the numpy and native
+        (binrows.cpp) binning paths so their semantics cannot diverge."""
+        lut_size = max([k for k in self.categorical_2_bin] or [0]) + 2
+        lut = np.full(lut_size, self.num_bin - 1, dtype=np.int32)
+        for k, b in self.categorical_2_bin.items():
+            if k >= 0:
+                lut[k] = b
+        return lut
+
     def value_to_bin(self, values: np.ndarray) -> np.ndarray:
         """Vectorized value->bin (reference bin.h:522-556 binary search)."""
         values = np.asarray(values, dtype=np.float64)
@@ -485,11 +496,8 @@ class BinMapper:
                 out[nan_mask] = self.num_bin - 1
         else:
             iv = np.where(nan_mask, -1, np.where(np.isfinite(values), values, -1)).astype(np.int64)
-            lut_size = max([k for k in self.categorical_2_bin] or [0]) + 2
-            lut = np.full(lut_size, self.num_bin - 1, dtype=np.int32)
-            for k, b in self.categorical_2_bin.items():
-                if k >= 0:
-                    lut[k] = b
+            lut = self.categorical_lut()
+            lut_size = len(lut)
             bad = (iv < 0) | (iv >= lut_size)
             out = np.where(bad, self.num_bin - 1, lut[np.clip(iv, 0, lut_size - 1)]).astype(np.int32)
         return out

@@ -345,10 +345,9 @@ class BinnedDataset:
             w_sc = _sidecar(filename, ".weight", None)
             if w_sc is not None:
                 ds.metadata.set_weight(w_sc)
-        i_sc = _sidecar(filename, ".init", None)
+        from .loader import load_init_sidecar
+        i_sc = load_init_sidecar(filename)
         if i_sc is not None:
-            if i_sc.ndim == 2:   # class-major flat, like the one-round path
-                i_sc = i_sc.T.reshape(-1)
             ds.metadata.set_init_score(i_sc)
         ds._construct_from_sample(sample, n, config,
                                   set(int(c) for c in categorical_features))
@@ -431,8 +430,81 @@ class BinnedDataset:
         return np.uint8 if max(widths, default=1) <= 256 else (
             np.uint16 if max(widths) <= 65536 else np.int32)
 
+    def _native_bin_meta(self):
+        """Flattened per-feature metadata for the C++ binning kernel
+        (native/binrows.cpp); built once and cached."""
+        if getattr(self, "_nb_meta", None) is not None:
+            return self._nb_meta
+        gp = [0]
+        cols, nb, mf, mt, cat = [], [], [], [], []
+        bptr, bvals = [0], []
+        lptr, lvals = [0], []
+        for feats in self.groups:
+            for i in feats:
+                f = self.used_features[i]
+                m = self.bin_mappers[f]
+                cols.append(f)
+                nb.append(m.num_bin)
+                mf.append(m.most_freq_bin)
+                mt.append(int(m.missing_type))
+                cat.append(int(m.is_categorical))
+                if m.is_categorical:
+                    lvals.append(m.categorical_lut())
+                    bvals.append(np.zeros(0))
+                else:
+                    bvals.append(np.asarray(m.bin_upper_bound, np.float64))
+                    lvals.append(np.zeros(0, np.int32))
+                bptr.append(bptr[-1] + len(bvals[-1]))
+                lptr.append(lptr[-1] + len(lvals[-1]))
+            gp.append(len(cols))
+        self._nb_meta = dict(
+            group_ptr=np.asarray(gp, np.int32),
+            feat_col=np.asarray(cols, np.int32),
+            feat_numbin=np.asarray(nb, np.int32),
+            feat_mostfreq=np.asarray(mf, np.int32),
+            feat_missing=np.asarray(mt, np.int32),
+            feat_iscat=np.asarray(cat, np.int32),
+            bounds_ptr=np.asarray(bptr, np.int64),
+            bounds=(np.concatenate(bvals) if bvals
+                    else np.zeros(0)).astype(np.float64),
+            lut_ptr=np.asarray(lptr, np.int64),
+            lut=(np.concatenate(lvals) if lvals
+                 else np.zeros(0)).astype(np.int32),
+        )
+        return self._nb_meta
+
+    def _bin_rows_native(self, X: np.ndarray, out: np.ndarray) -> bool:
+        """C++/OpenMP binning (native/binrows.cpp); False -> use numpy."""
+        from ..native import load
+        import ctypes
+        if not out.flags["C_CONTIGUOUS"]:
+            return False
+        lib = load("binrows", extra_flags=("-fopenmp",))
+        if lib is None:
+            return False
+        m = self._native_bin_meta()
+        X = np.ascontiguousarray(X, dtype=np.float64)
+        p = ctypes.c_void_p
+
+        def arr(a):
+            return a.ctypes.data_as(p)
+        lib.bin_rows(arr(X), ctypes.c_int64(X.shape[0]),
+                     ctypes.c_int64(X.shape[1]),
+                     ctypes.c_int32(len(self.groups)),
+                     arr(m["group_ptr"]), arr(m["feat_col"]),
+                     arr(m["feat_numbin"]), arr(m["feat_mostfreq"]),
+                     arr(m["feat_missing"]), arr(m["feat_iscat"]),
+                     arr(m["bounds_ptr"]), arr(m["bounds"]),
+                     arr(m["lut_ptr"]), arr(m["lut"]),
+                     out.ctypes.data_as(p),
+                     ctypes.c_int32(out.dtype.itemsize),
+                     ctypes.c_int64(out.shape[1]))
+        return True
+
     def _bin_rows(self, X: np.ndarray, out: np.ndarray) -> None:
         """Quantize a row block into group-local bins (writes `out`)."""
+        if out.dtype.itemsize in (1, 2, 4) and self._bin_rows_native(X, out):
+            return
         n = X.shape[0]
         dtype = out.dtype
         for gid, feats in enumerate(self.groups):

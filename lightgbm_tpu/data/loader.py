@@ -81,15 +81,21 @@ def _apply_sidecars(filename: str, loaded: "LoadedData") -> "LoadedData":
     weight = _sidecar(filename, ".weight", None)
     if weight is not None:
         loaded.weight = weight
-    init = _sidecar(filename, ".init", None)
+    init = load_init_sidecar(filename)
     if init is not None:
-        if init.ndim == 2:
-            # multi-class init files are row-major columns; the score
-            # updater expects the reference's class-major flat layout
-            # (init_score_[k * num_data + i], metadata.cpp:425)
-            init = init.T.reshape(-1)
         loaded.init_score = init
     return loaded
+
+
+def load_init_sidecar(filename: str):
+    """<data>.init scores, class-major flat (the reference stores
+    init_score_[k * num_data + i], metadata.cpp:425; multi-class files are
+    row-major columns on disk). Shared by the one-round and two_round
+    loaders. None when the file does not exist."""
+    init = _sidecar(filename, ".init", None)
+    if init is not None and init.ndim == 2:
+        init = init.T.reshape(-1)
+    return init
 
 
 def load_text_file(filename: str, config) -> LoadedData:

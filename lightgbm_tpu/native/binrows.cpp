@@ -1,0 +1,121 @@
+// Parallel host binning: raw feature matrix -> group-local bin matrix.
+//
+// Native rebuild of the reference's ingestion hot loop
+// (DatasetLoader::ExtractFeaturesFromMemory -> Dataset::PushOneRow ->
+// BinMapper::ValueToBin, src/io/dataset_loader.cpp:1004 + bin.h:522-556,
+// parallelized with OpenMP like the reference's TextReader pipeline). The
+// Python layer (data/dataset.py:_bin_rows) keeps a vectorized numpy
+// fallback; this path must match it bit-for-bit — semantics:
+//
+//   numerical: searchsorted(bounds[:n_search], v, side=left) clipped to
+//     n_search-1, where n_search = num_bin - (missing_type == NaN);
+//     NaN -> last bin when missing_type == NaN, else binned as 0.0;
+//   categorical: int(value) (toward zero) looked up in a LUT,
+//     NaN/negative/overflow -> num_bin - 1;
+//   EFB bundles: group-local sentinel 0, sub-features stacked at
+//     local offsets, rows at a sub-feature's most_freq bin skipped,
+//     LATER sub-features overwrite earlier ones on conflict.
+#include <cmath>
+#include <cstdint>
+
+#if defined(_OPENMP)
+#include <omp.h>
+#endif
+
+extern "C" {
+
+// searchsorted(bounds, v, side=left): first i with bounds[i] >= v
+static inline int32_t lower_bound_idx(const double* bounds, int32_t n,
+                                      double v) {
+  int32_t lo = 0, hi = n;
+  while (lo < hi) {
+    int32_t mid = (lo + hi) >> 1;
+    if (bounds[mid] < v) {
+      lo = mid + 1;
+    } else {
+      hi = mid;
+    }
+  }
+  return lo;
+}
+
+static inline int32_t value_to_bin(
+    double v, int32_t num_bin, int32_t missing_type, int32_t is_cat,
+    const double* bounds, const int32_t* lut, int64_t lut_size) {
+  if (is_cat) {
+    if (std::isnan(v) || !std::isfinite(v)) return num_bin - 1;
+    int64_t iv = static_cast<int64_t>(v);  // toward zero, like numpy astype
+    if (iv < 0 || iv >= lut_size) return num_bin - 1;
+    return lut[iv];
+  }
+  if (std::isnan(v)) {
+    if (missing_type == 2) return num_bin - 1;
+    v = 0.0;
+  }
+  int32_t n_search = num_bin - (missing_type == 2 ? 1 : 0);
+  int32_t idx = lower_bound_idx(bounds, n_search, v);
+  return idx < n_search - 1 ? idx : n_search - 1;
+}
+
+// out element width selected by out_bytes in {1, 2, 4}
+void bin_rows(const double* X, int64_t n, int64_t stride, int32_t G,
+              const int32_t* group_ptr, const int32_t* feat_col,
+              const int32_t* feat_numbin, const int32_t* feat_mostfreq,
+              const int32_t* feat_missing, const int32_t* feat_iscat,
+              const int64_t* bounds_ptr, const double* bounds,
+              const int64_t* lut_ptr, const int32_t* lut,
+              void* out, int32_t out_bytes, int64_t out_stride) {
+  uint8_t* out8 = static_cast<uint8_t*>(out);
+  uint16_t* out16 = static_cast<uint16_t*>(out);
+  int32_t* out32 = static_cast<int32_t*>(out);
+
+#if defined(_OPENMP)
+#pragma omp parallel for schedule(static)
+#endif
+  for (int64_t i = 0; i < n; ++i) {
+    const double* row = X + i * stride;
+    for (int32_t g = 0; g < G; ++g) {
+      int32_t k0 = group_ptr[g], k1 = group_ptr[g + 1];
+      int64_t val;
+      if (k1 - k0 == 1) {
+        int32_t k = k0;
+        val = value_to_bin(row[feat_col[k]], feat_numbin[k],
+                           feat_missing[k], feat_iscat[k],
+                           bounds + bounds_ptr[k], lut + lut_ptr[k],
+                           lut_ptr[k + 1] - lut_ptr[k]);
+      } else {
+        val = 0;  // group-local sentinel (default) bin
+        int64_t local = 1;
+        for (int32_t k = k0; k < k1; ++k) {
+          int32_t b = value_to_bin(row[feat_col[k]], feat_numbin[k],
+                                   feat_missing[k], feat_iscat[k],
+                                   bounds + bounds_ptr[k],
+                                   lut + lut_ptr[k],
+                                   lut_ptr[k + 1] - lut_ptr[k]);
+          if (b != feat_mostfreq[k]) {
+            val = local + b;
+          }
+          local += feat_numbin[k];
+        }
+      }
+      int64_t pos = i * out_stride + g;
+      if (out_bytes == 1) {
+        out8[pos] = static_cast<uint8_t>(val);
+      } else if (out_bytes == 2) {
+        out16[pos] = static_cast<uint16_t>(val);
+      } else {
+        out32[pos] = static_cast<int32_t>(val);
+      }
+    }
+  }
+}
+
+int32_t binrows_num_threads() {
+#if defined(_OPENMP)
+  return omp_get_max_threads();
+#else
+  return 1;
+#endif
+}
+
+}  // extern "C"

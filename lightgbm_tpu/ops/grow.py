@@ -442,8 +442,10 @@ def _make_eval_pair_fused(meta, params, feature_mask, cat, gc: GrowConfig):
         hb = dense[..., 1]
         sg = jnp.stack([cand.left_sum_grad,
                         cand.right_sum_grad]).astype(f32)
+        # the XLA scan's sum_hess_adj = sum_hess + 2*kEpsilon: NOT a no-op
+        # when a child's hessians are all zero (keeps cnt_factor finite)
         sh = jnp.stack([cand.left_sum_hess,
-                        cand.right_sum_hess]).astype(f32)
+                        cand.right_sum_hess]).astype(f32) + f32(2e-15)
         cnt = jnp.stack([left_cnt, right_cnt]).astype(f32)
         l2 = p32.lambda_l2.astype(f32)
         cf = cnt / sh

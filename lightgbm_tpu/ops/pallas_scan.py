@@ -63,7 +63,7 @@ def _scan_kernel(scal_ref, gb_ref, hb_ref, keepr_ref, keepf_ref,
     """
     F, W = keepr_ref.shape
     sg = scal_ref[0, 0, 0]
-    sh = scal_ref[0, 0, 1]       # sum_hess (+2eps is a no-op in f32)
+    sh = scal_ref[0, 0, 1]       # sum_hess + 2*kEpsilon (caller adds it)
     nd = scal_ref[0, 0, 2]
     cf = scal_ref[0, 0, 3]
     min_data = scal_ref[0, 0, 4]

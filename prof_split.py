@@ -29,34 +29,32 @@ def bench_pack(C, G_=28):
     bw = jnp.asarray(rng.integers(0, 255, (C, G_)), jnp.uint8)
     gw = jnp.asarray(rng.normal(size=C), jnp.float32)
     hw = jnp.asarray(rng.random(C), jnp.float32)
-    bgw = jnp.asarray(rng.random(C) < 0.9)
-    rw = jnp.arange(C, dtype=jnp.int32)
+    rbw = jnp.asarray(rng.integers(0, 1 << 30, C), jnp.uint32)
     key = jnp.asarray(rng.integers(0, 3, C), jnp.uint32)
 
     @jax.jit
-    def sort_pack(key, bw, gw, hw, bgw, rw):
-        return G._pack_sort(key, bw, gw, hw, bgw, rw, 8)
+    def sort_pack(key, bw, gw, hw, rbw):
+        return G._pack_sort(key, bw, gw, hw, rbw, 8)
 
-    t_sort = timeit(sort_pack, key, bw, gw, hw, bgw, rw)
+    t_sort = timeit(sort_pack, key, bw, gw, hw, rbw)
 
     gl = key == 0
     gr = key == 2
 
     @jax.jit
-    def mm_pack(gl, gr, bw, gw, hw, bgw, rw):
+    def mm_pack(gl, gr, bw, gw, hw, rbw):
         posl = jnp.cumsum(gl, dtype=jnp.int32) - 1
         nR = jnp.sum(gr, dtype=jnp.int32)
         posr = (C - nR) + jnp.cumsum(gr, dtype=jnp.int32) - 1
         slot = jnp.where(gl, posl, jnp.where(gr, posr, C))
-        rid_hi = (rw // 4096).astype(jnp.float32)
-        rid_lo = (rw % 4096).astype(jnp.float32)
+        rb_hi = (rbw >> jnp.uint32(12)).astype(jnp.float32)
+        rb_lo = (rbw & jnp.uint32(4095)).astype(jnp.float32)
         payload = jnp.concatenate([
             bw.astype(jnp.float32), gw[:, None], hw[:, None],
-            bgw.astype(jnp.float32)[:, None], rid_hi[:, None],
-            rid_lo[:, None]], axis=1)
+            rb_hi[:, None], rb_lo[:, None]], axis=1)
         return G._pack_matmul(slot, payload, C)
 
-    t_mm = timeit(mm_pack, gl, gr, bw, gw, hw, bgw, rw)
+    t_mm = timeit(mm_pack, gl, gr, bw, gw, hw, rbw)
     print(f"pack C={C:6d}: sort={t_sort*1e6:8.1f}us "
           f"({t_sort/C*1e9:6.2f} ns/row)  matmul={t_mm*1e6:8.1f}us "
           f"({t_mm/C*1e9:6.2f} ns/row)")
