@@ -720,8 +720,44 @@ class BinnedDataset:
             end=jnp.asarray(self.bin_end[idx]),
         )
 
+    def device_pack_plan(self, config: Config):
+        """Nibble-packing plan for HBM storage (the Dense4bitsBin analog,
+        src/io/dense_nbits_bin.hpp): pairs of logical groups whose width
+        fits 4 bits share one storage byte. Returns None when packing is
+        off or fewer than 2 groups qualify; else (storage_of [G_l],
+        shift [G_l], n_storage, unpack_mask [G_l])."""
+        if not bool(config.tpu_4bit_packing):
+            return None
+        G = len(self.groups)
+        widths = np.diff(np.append(self.group_offset, self.total_bins))
+        narrow = [g for g in range(G) if widths[g] <= 16]
+        if len(narrow) < 2:
+            return None
+        narrow_set = set(narrow)
+        storage_of = np.zeros(G, dtype=np.int32)
+        shift = np.zeros(G, dtype=np.int32)
+        sc = 0
+        for g in range(G):
+            if g not in narrow_set:
+                storage_of[g] = sc
+                sc += 1
+        # pair narrow groups two per storage column
+        for k in range(0, len(narrow) - 1, 2):
+            storage_of[narrow[k]] = sc
+            storage_of[narrow[k + 1]] = sc
+            shift[narrow[k + 1]] = 4
+            sc += 1
+        if len(narrow) % 2:
+            storage_of[narrow[-1]] = sc
+            sc += 1
+        # any narrow group's values fit in 4 bits, so &15 is safe even for
+        # an unpaired trailing one; wide groups pass through unmasked
+        mask = np.where(widths <= 16, 15, 0x7FFFFFFF).astype(np.int32)
+        return storage_of, shift, sc, mask
+
     def to_device(self, config: Config):
-        """Produce (DataLayout, FeatureMeta) jnp structures."""
+        """Produce (DataLayout, FeatureMeta) jnp structures. Sets
+        self.device_packed for the learner's GrowConfig."""
         import jax.numpy as jnp
         from ..ops.grow import DataLayout
         from ..ops.split import FeatureMeta
@@ -732,12 +768,35 @@ class BinnedDataset:
         for i in range(self.num_features):
             owner[self.bin_start[i]:self.bin_end[i]] = i
         feat_id = np.where(owner < 0, 0, owner).astype(np.int32)
-        layout = DataLayout(
-            bins=jnp.asarray(self.binned),
-            group_offset=jnp.asarray(self.group_offset),
-            group_of=jnp.asarray(self.group_of),
-            most_freq_bin=jnp.asarray(self.most_freq_bin),
-        )
+
+        plan = self.device_pack_plan(config)
+        self.device_packed = plan is not None
+        if plan is not None:
+            storage_of, shift, n_storage, mask = plan
+            storage = np.zeros((self.num_data, n_storage),
+                               dtype=self.binned.dtype)
+            for g in range(len(self.groups)):
+                np.bitwise_or(
+                    storage[:, storage_of[g]],
+                    (self.binned[:, g].astype(np.int64)
+                     << int(shift[g])).astype(self.binned.dtype),
+                    out=storage[:, storage_of[g]])
+            layout = DataLayout(
+                bins=jnp.asarray(storage),
+                group_offset=jnp.asarray(self.group_offset),
+                group_of=jnp.asarray(self.group_of),
+                most_freq_bin=jnp.asarray(self.most_freq_bin),
+                unpack_col=jnp.asarray(storage_of),
+                unpack_shift=jnp.asarray(shift),
+                unpack_mask=jnp.asarray(mask),
+            )
+        else:
+            layout = DataLayout(
+                bins=jnp.asarray(self.binned),
+                group_offset=jnp.asarray(self.group_offset),
+                group_of=jnp.asarray(self.group_of),
+                most_freq_bin=jnp.asarray(self.most_freq_bin),
+            )
         meta = FeatureMeta(
             feat_id=jnp.asarray(feat_id),
             bin_start=jnp.asarray(self.bin_start),

@@ -85,6 +85,7 @@ class GrowConfig(NamedTuple):
     top_k: int = 20              # voting-parallel per-shard vote size
     scan_impl: str = "xla"       # "xla" | "pallas" fused split-scan kernel
     #                            # (fast path only; resolve_scan_impl gates)
+    packed_4bit: bool = False    # layout.bins nibble-packs <=16-bin groups
 
 
 class GrowExtras(NamedTuple):
@@ -117,11 +118,39 @@ class FixInfo(NamedTuple):
 
 
 class DataLayout(NamedTuple):
-    """Device-resident binned dataset layout (built once by Dataset)."""
-    bins: jnp.ndarray           # [N, G] uint8/16/32 group-local bins
-    group_offset: jnp.ndarray   # [G] i32 global bin offset per group
-    group_of: jnp.ndarray       # [F] i32 feature -> group
+    """Device-resident binned dataset layout (built once by Dataset).
+
+    When gc.packed_4bit is set, `bins` holds STORAGE columns where pairs of
+    <=16-bin logical groups share one byte (the Dense4bitsBin analog,
+    src/io/dense_nbits_bin.hpp — half the HBM footprint/bandwidth for
+    narrow-feature datasets); unpack_col/unpack_shift map each LOGICAL
+    group to (storage column, nibble shift). Without packing they are the
+    identity and unused.
+    """
+    bins: jnp.ndarray           # [N, G_storage] uint8/16/32 bins
+    group_offset: jnp.ndarray   # [G_logical] i32 global bin offset per group
+    group_of: jnp.ndarray       # [F] i32 feature -> logical group
     most_freq_bin: jnp.ndarray  # [F] i32 local most_freq bin (EFB fallback)
+    unpack_col: jnp.ndarray = None    # [G_logical] i32 storage column
+    unpack_shift: jnp.ndarray = None  # [G_logical] i32 shift (0 or 4)
+    unpack_mask: jnp.ndarray = None   # [G_logical] i32 (15 packed, else wide)
+
+
+def _logical_bins(bw, layout: DataLayout, packed: bool):
+    """[rows, G_storage] storage window -> [rows, G_logical] i32 bins."""
+    if not packed:
+        return bw.astype(I32)
+    u = jnp.take(bw.astype(I32), layout.unpack_col, axis=1)
+    return (u >> layout.unpack_shift[None, :]) & layout.unpack_mask[None, :]
+
+
+def _logical_col(bins, g, layout: DataLayout, packed: bool):
+    """One logical group's [rows] column from the storage matrix."""
+    if not packed:
+        return bins[:, g].astype(I32)
+    sc = layout.unpack_col[g]
+    return ((bins[:, sc].astype(I32) >> layout.unpack_shift[g])
+            & layout.unpack_mask[g])
 
 
 class TreeArrays(NamedTuple):
@@ -159,11 +188,12 @@ class _LoopState(NamedTuple):
     tree: TreeArrays
 
 
-def _hist_masked(bins, group_offset, grad, hess, mask, total_bins, rows_per_chunk,
-                 axis_name=None):
+def _hist_masked(layout: DataLayout, grad, hess, mask, total_bins,
+                 rows_per_chunk, packed: bool, axis_name=None):
     from .histogram import build_histogram
     m = mask.astype(grad.dtype)
-    idx = bins.astype(I32) + group_offset[None, :]
+    idx = (_logical_bins(layout.bins, layout, packed)
+           + layout.group_offset[None, :])
     h = build_histogram(idx, grad * m, hess * m, total_bins=total_bins,
                         rows_per_chunk=rows_per_chunk)
     if axis_name is not None:
@@ -631,8 +661,8 @@ def grow_tree(layout: DataLayout, grad: jnp.ndarray, hess: jnp.ndarray,
 
     # ---- root ----------------------------------------------------------
     root_hist = hist_psum(_hist_masked(
-        layout.bins, layout.group_offset, grad, hess, bag_mask, TB,
-        gc.rows_per_chunk, None))
+        layout, grad, hess, bag_mask, TB, gc.rows_per_chunk,
+        gc.packed_4bit, None))
     sum_grad = psum(jnp.sum(grad, dtype=ft))
     sum_hess = psum(jnp.sum(hess, dtype=ft))
     root_count = psum(jnp.sum(bag_mask, dtype=I32))
@@ -646,7 +676,7 @@ def grow_tree(layout: DataLayout, grad: jnp.ndarray, hess: jnp.ndarray,
     eval_leaf = _make_eval_leaf(meta, params, feature_mask, cat, gc,
                                 extras, feat_nb_e, axis_name=axis_name,
                                 fix=fix)
-    eval_leaf.set_num_groups(layout.bins.shape[1])
+    eval_leaf.set_num_groups(layout.group_offset.shape[0])
     eval_pair_fused = (_make_eval_pair_fused(meta, params, feature_mask,
                                              cat, gc)
                        if gc.scan_impl == "pallas" else None)
@@ -695,7 +725,8 @@ def grow_tree(layout: DataLayout, grad: jnp.ndarray, hess: jnp.ndarray,
         f = jnp.maximum(cand.feature, 0)
         g = layout.group_of[f]
         # per-row local bin of feature f (EFB fallback to most_freq)
-        col = layout.bins[:, g].astype(I32) + layout.group_offset[g]
+        col = (_logical_col(layout.bins, g, layout, gc.packed_4bit)
+               + layout.group_offset[g])
         in_range = (col >= meta.bin_start[f]) & (col < meta.bin_end[f])
         local_bin = col - meta.bin_start[f]
         go_left = _go_left_decision(
@@ -713,8 +744,8 @@ def grow_tree(layout: DataLayout, grad: jnp.ndarray, hess: jnp.ndarray,
         smaller_is_left = left_cnt <= right_cnt
         smaller_mask = in_leaf & (go_left == smaller_is_left)
         hist_smaller = hist_psum(_hist_masked(
-            layout.bins, layout.group_offset, grad, hess, smaller_mask,
-            TB, gc.rows_per_chunk, None))
+            layout, grad, hess, smaller_mask, TB, gc.rows_per_chunk,
+            gc.packed_4bit, None))
         sm_sum_grad = jnp.where(smaller_is_left, cand.left_sum_grad,
                                 cand.right_sum_grad)
         sm_sum_hess = jnp.where(smaller_is_left, cand.left_sum_hess,
@@ -954,10 +985,11 @@ def _hist_acc_finish(acc, gc: GrowConfig, gw_global):
     return acc
 
 
-def _hist_contiguous(binsP, grad, hess, group_offset, start, length, C,
-                     gc: GrowConfig, gw_global):
+def _hist_contiguous(binsP, grad, hess, layout: DataLayout, start, length,
+                     C, gc: GrowConfig, gw_global):
     """[TB, 2] histogram over a contiguous payload segment, chunked by C."""
-    G = binsP.shape[1]
+    Gs = binsP.shape[1]                       # storage columns
+    Gl = layout.group_offset.shape[0]         # logical groups
     W = gw_global.shape[1] if gw_global is not None else 0
     arangeC = jnp.arange(C, dtype=I32)
     nch = (length + C - 1) // C
@@ -965,13 +997,15 @@ def _hist_contiguous(binsP, grad, hess, group_offset, start, length, C,
     def body(i, acc):
         off = (start + i * C).astype(I32)
         bw = jax.lax.dynamic_slice(
-            binsP, (off, jnp.asarray(0, I32)), (C, G)).astype(I32)
+            binsP, (off, jnp.asarray(0, I32)), (C, Gs))
+        bwl = _logical_bins(bw, layout, gc.packed_4bit)
         m = (arangeC < (length - i * C)).astype(jnp.float32)
         gw = jax.lax.dynamic_slice(grad, (off,), (C,)) * m
         hw = jax.lax.dynamic_slice(hess, (off,), (C,)) * m
-        return _hist_chunk_accum(acc, bw, gw, hw, gc, group_offset, W)
+        return _hist_chunk_accum(acc, bwl, gw, hw, gc,
+                                 layout.group_offset, W)
 
-    acc = jax.lax.fori_loop(0, nch, body, _hist_acc_init(gc, G, W))
+    acc = jax.lax.fori_loop(0, nch, body, _hist_acc_init(gc, Gl, W))
     return _hist_acc_finish(acc, gc, gw_global)
 
 
@@ -1044,7 +1078,7 @@ def grow_tree_partitioned(layout: DataLayout, grad: jnp.ndarray,
     # chunk (the Pallas kernel re-tiles internally and takes the full CR)
     root_chunk = CR if gc.hist_impl != "onehot" else min(CR, 8192)
     root_hist = _hist_contiguous(binsP0, gradP0 * bagP0, hessP0 * bagP0,
-                                 goff, jnp.asarray(0, I32),
+                                 layout, jnp.asarray(0, I32),
                                  jnp.asarray(n, I32), root_chunk, gc,
                                  gw_global)
     root_hist = hist_psum(root_hist)
@@ -1063,7 +1097,7 @@ def grow_tree_partitioned(layout: DataLayout, grad: jnp.ndarray,
     eval_leaf = _make_eval_leaf(meta, params, feature_mask, cat, gc,
                                 extras, feat_nb, axis_name=axis_name,
                                 fix=fix)
-    eval_leaf.set_num_groups(G)
+    eval_leaf.set_num_groups(layout.group_offset.shape[0])
     eval_pair_fused = (_make_eval_pair_fused(meta, params, feature_mask,
                                              cat, gc)
                        if gc.scan_impl == "pallas" else None)
@@ -1146,7 +1180,7 @@ def grow_tree_partitioned(layout: DataLayout, grad: jnp.ndarray,
             bgw = (rbw >> U32(30)) & U32(1)
             valid = arangeC < (n_l - i * C)
 
-            col = bw[:, g].astype(I32) + goff[g]
+            col = _logical_col(bw, g, layout, gc.packed_4bit) + goff[g]
             in_range = (col >= meta.bin_start[f]) & (col < meta.bin_end[f])
             local_bin = col - meta.bin_start[f]
             go_left = _go_left_decision(local_bin, in_range, fmeta, cand,
@@ -1192,8 +1226,10 @@ def grow_tree_partitioned(layout: DataLayout, grad: jnp.ndarray,
 
             bag_left = bag_left + jnp.sum(gl & (bgw > 0), dtype=I32)
             m = (valid & (go_left == smaller_is_left)).astype(jnp.float32)
-            hacc = _hist_chunk_accum(hacc, bw.astype(I32), gw * m, hw * m,
-                                     gc, goff, W)
+            hacc = _hist_chunk_accum(hacc,
+                                     _logical_bins(bw, layout,
+                                                   gc.packed_4bit),
+                                     gw * m, hw * m, gc, goff, W)
             return (binsS, gradS, hessS, rbS,
                     lf + nL, rf - nR, bag_left, hacc)
 
@@ -1202,7 +1238,8 @@ def grow_tree_partitioned(layout: DataLayout, grad: jnp.ndarray,
             0, nch, pa_body,
             (st.binsS, st.gradS, st.hessS, st.rbS,
              jnp.asarray(0, I32), jnp.asarray(n + 2 * C, I32),
-             jnp.asarray(0, I32), _hist_acc_init(gc, G, W)))
+             jnp.asarray(0, I32),
+             _hist_acc_init(gc, layout.group_offset.shape[0], W)))
         n_right = n_l - n_left
 
         hist_smaller = hist_psum(_hist_acc_finish(hacc, gc, gw_global))

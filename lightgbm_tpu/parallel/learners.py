@@ -77,8 +77,8 @@ class DataParallelTreeLearner(SerialTreeLearner):
         mesh = self.mesh
         gc = self.grow_config._replace()
         meta, params, fix = self.meta, self.params, self.fix
-        layout_rest = (self.layout.group_offset, self.layout.group_of,
-                       self.layout.most_freq_bin)
+        layout_rest = tuple(self.layout)[1:]   # all fields after bins
+        #              (incl. the 4-bit unpack maps when packing is on)
 
         cat = self.cat_layout
         n_shard = (self.dataset.num_data + self._pad) // self.num_shards
@@ -183,8 +183,8 @@ class FeatureParallelTreeLearner(SerialTreeLearner):
         mesh = self.mesh
         gc = self.grow_config
         meta, params, fix = self.meta, self.params, self.fix
-        layout_rest = (self.layout.group_offset, self.layout.group_of,
-                       self.layout.most_freq_bin)
+        layout_rest = tuple(self.layout)[1:]   # all fields after bins
+        #              (incl. the 4-bit unpack maps when packing is on)
         cat = self.cat_layout
         use_part = self.dataset.num_data >= PARTITION_MIN_ROWS
         gw_global = self.gw_global

@@ -81,6 +81,8 @@ def plot_importance(booster, ax=None, height=0.2, xlim=None, ylim=None,
         order = order[values[order] > 0]
     if max_num_features is not None and max_num_features > 0:
         order = order[max(0, len(order) - max_num_features):]
+    if order.size == 0:
+        raise ValueError("Booster's feature_importance is empty")
     values = values[order]
     names = names[order]
 

@@ -257,6 +257,7 @@ class SerialTreeLearner:
                              * min(float(config.feature_fraction), 1.0)))))
                       if float(config.feature_fraction_bynode) < 1.0 else 0),
             use_cegb=_cegb_enabled(config),
+            packed_4bit=bool(getattr(dataset, "device_packed", False)),
         )
         self.grow_config = GrowConfig(
             scan_impl=resolve_scan_impl(config, gc_kwargs), **gc_kwargs)

@@ -1,0 +1,77 @@
+"""4-bit nibble-packed HBM storage (the Dense4bitsBin analog,
+src/io/dense_nbits_bin.hpp): pairs of <=16-bin groups share one storage
+byte on device. Packing is a pure storage transform — models must be
+IDENTICAL with it on and off, across both growers and mixed
+narrow/wide/categorical/NaN features."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.data.dataset import BinnedDataset
+
+
+def _narrow_wide_data(n=4000, seed=6):
+    rng = np.random.default_rng(seed)
+    wide = rng.normal(size=(n, 3))                       # 255-bin features
+    narrow = rng.integers(0, 9, size=(n, 6)).astype(float)  # <=16-bin
+    narrow[rng.random((n, 6)) < 0.05] = np.nan
+    X = np.column_stack([wide, narrow])
+    y = ((X[:, 0] > 0) ^ (np.nan_to_num(X[:, 3]) > 4)).astype(float)
+    return X, y
+
+
+def test_pack_plan_and_storage_width():
+    X, y = _narrow_wide_data()
+    cfg = lgb.Config({"max_bin": 255, "min_data_in_bin": 1,
+                      "enable_bundle": False})
+    ds = BinnedDataset.from_matrix(X, cfg, label=y)
+    plan = ds.device_pack_plan(cfg)
+    assert plan is not None
+    storage_of, shift, n_storage, _mask = plan
+    G = len(ds.groups)
+    assert n_storage < G                       # pairs actually formed
+    layout, meta = ds.to_device(cfg)
+    assert ds.device_packed
+    assert layout.bins.shape[1] == n_storage
+    # unpacking the storage must reproduce the logical bin matrix
+    import jax.numpy as jnp
+    from lightgbm_tpu.ops.grow import _logical_bins
+    logical = np.asarray(_logical_bins(layout.bins, layout, True))
+    np.testing.assert_array_equal(logical, ds.binned.astype(np.int32))
+
+
+@pytest.mark.parametrize("force_partitioned", [False, True])
+def test_packed_model_identical(monkeypatch, force_partitioned):
+    X, y = _narrow_wide_data()
+    if force_partitioned:
+        import lightgbm_tpu.treelearner.serial as s
+        monkeypatch.setattr(s, "PARTITION_MIN_ROWS", 1000)
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+              "min_data_in_bin": 1}
+    m_on = lgb.train(dict(params), lgb.Dataset(X, y), 8,
+                     verbose_eval=False).model_to_string()
+    m_off = lgb.train(dict(params, tpu_4bit_packing=False),
+                      lgb.Dataset(X, y), 8,
+                      verbose_eval=False).model_to_string()
+    assert m_on.split("parameters:")[0] == m_off.split("parameters:")[0]
+
+
+def test_packed_with_categoricals_and_bundles():
+    rng = np.random.default_rng(8)
+    n = 3000
+    cat = rng.integers(0, 5, n).astype(float)            # categorical, narrow
+    sparse1 = (rng.random(n) < 0.04) * rng.integers(1, 4, n)   # EFB bundle
+    sparse2 = (rng.random(n) < 0.04) * rng.integers(1, 4, n)
+    wide = rng.normal(size=n)
+    X = np.column_stack([wide, cat, sparse1, sparse2])
+    y = ((wide > 0) | (cat == 2)).astype(float)
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+              "min_data_in_bin": 1}
+    p_on = lgb.train(dict(params), lgb.Dataset(X, y, categorical_feature=[1]),
+                     8, verbose_eval=False).predict(X)
+    p_off = lgb.train(dict(params, tpu_4bit_packing=False),
+                      lgb.Dataset(X, y, categorical_feature=[1]), 8,
+                      verbose_eval=False).predict(X)
+    np.testing.assert_array_equal(p_on, p_off)
+    acc = ((p_on > 0.5) == y).mean()
+    assert acc > 0.95

@@ -98,6 +98,25 @@ def _train_example(name):
             for m, vals in res.items()}
 
 
+def test_reference_model_text_interop():
+    """A model file written by the REAL LightGBM binary (fixture
+    tests/fixtures/interop_model.txt, 20 trees on the binary_classification
+    example) loaded through our Booster must reproduce the reference CLI's
+    own predictions to double round-trip precision — pinning model-text
+    READ parity (gbdt_model_text.cpp format: decision_type bits, missing
+    handling, threshold %.17g round-trip)."""
+    import numpy as np
+    fixdir = os.path.join(os.path.dirname(__file__), "fixtures")
+    import lightgbm_tpu as lgb2
+    bst = lgb2.Booster(
+        model_file=os.path.join(fixdir, "interop_model.txt"))
+    X = np.loadtxt(os.path.join(EXAMPLES, "binary_classification",
+                                "binary.test"))[:, 1:]
+    ours = bst.predict(X)
+    ref = np.loadtxt(os.path.join(fixdir, "interop_preds.txt"))
+    np.testing.assert_allclose(ours, ref, rtol=0, atol=1e-14)
+
+
 @pytest.mark.parametrize("name", sorted(GOLDEN))
 def test_example_parity(name):
     ours = _train_example(name)

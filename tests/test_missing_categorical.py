@@ -1,0 +1,140 @@
+"""Exact missing-value and categorical routing behavior on tiny synthetic
+datasets — the analog of the reference's golden-value engine tests
+(tests/python_package_test/test_engine.py:117-374, test_missing_value_handle*
+and test_categorical_handle*): datasets designed so a correct learner
+reaches near-zero training error, and predictions pin the documented
+missing-type routing semantics."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+BASE = {"objective": "binary", "metric": "binary_logloss", "verbosity": -1,
+        "min_data_in_leaf": 1, "min_sum_hessian_in_leaf": 0,
+        "min_data_in_bin": 1, "learning_rate": 1.0, "num_leaves": 15}
+
+
+def _train_predict(X, y, params, rounds=20, Xtest=None):
+    ds = lgb.Dataset(np.asarray(X, dtype=np.float64), np.asarray(y))
+    bst = lgb.train(dict(params), ds, rounds, verbose_eval=False)
+    return bst.predict(np.asarray(Xtest if Xtest is not None else X,
+                                  dtype=np.float64))
+
+
+def test_missing_value_nan_routes_like_reference():
+    """use_missing=true, NaN rows: a feature whose NaNs perfectly predict
+    the label must be fully learnable (NaN bin split)."""
+    x = np.array([1.0, 2.0, 3.0, 4.0, 5.0, 1.5, 2.5, 3.5, np.nan, np.nan] * 10)
+    y = (np.isnan(x)).astype(float)
+    X = np.column_stack([x, np.zeros_like(x)])
+    pred = _train_predict(X, y, BASE)
+    np.testing.assert_allclose(pred, y, atol=1e-3)
+
+
+def test_missing_value_disabled_treats_nan_as_zero():
+    """use_missing=false: NaNs are indistinguishable from 0 — the learner
+    must give NaN rows the same prediction as zero rows."""
+    x = np.array([1.0, 2.0, 3.0, 4.0, 0.0, 0.0, np.nan, np.nan] * 10)
+    y = (np.nan_to_num(x) > 2.5).astype(float)
+    X = np.column_stack([x, np.zeros_like(x)])
+    pred = _train_predict(X, y, dict(BASE, use_missing=False))
+    nan_rows = np.isnan(x)
+    zero_rows = x == 0.0
+    np.testing.assert_allclose(pred[nan_rows].mean(), pred[zero_rows].mean(),
+                               atol=1e-6)
+
+
+def test_zero_as_missing_groups_zero_with_nan():
+    """zero_as_missing=true: zeros and NaNs share the missing bin, so
+    their predictions must coincide."""
+    x = np.array([1.0, 2.0, 3.0, 4.0, 0.0, 0.0, np.nan, np.nan] * 10)
+    y = ((x > 2.5) | ~np.isfinite(x) | (x == 0)).astype(float)
+    X = np.column_stack([x, np.zeros_like(x)])
+    pred = _train_predict(X, y, dict(BASE, zero_as_missing=True))
+    nan_rows = np.isnan(x)
+    zero_rows = x == 0.0
+    np.testing.assert_allclose(pred[nan_rows], pred[zero_rows][:2].mean(),
+                               atol=1e-3)
+    np.testing.assert_allclose(pred, y, atol=1e-3)
+
+
+def test_categorical_exact_separation():
+    """A purely categorical target must be learned exactly (one-hot or
+    sorted many-vs-many split)."""
+    rng = np.random.default_rng(0)
+    cat = rng.integers(0, 6, 400).astype(np.float64)
+    y = np.isin(cat, [1, 3, 4]).astype(float)
+    X = np.column_stack([cat, rng.normal(size=400)])
+    ds = lgb.Dataset(X, y, categorical_feature=[0])
+    bst = lgb.train(dict(BASE), ds, 20, verbose_eval=False)
+    np.testing.assert_allclose(bst.predict(X), y, atol=5e-3)
+
+
+def test_categorical_unseen_category_goes_right():
+    """Categories never seen in training fall into the 'other' bin and must
+    take the non-selected branch, like the reference's bitset miss path."""
+    cat = np.array([0.0, 1.0, 2.0, 3.0] * 50)
+    y = np.isin(cat, [0, 2]).astype(float)
+    X = cat.reshape(-1, 1)
+    ds = lgb.Dataset(X, y, categorical_feature=[0],
+                     params={"min_data_in_bin": 1})
+    bst = lgb.train(dict(BASE), ds, 10, verbose_eval=False)
+    seen = bst.predict(X)
+    np.testing.assert_allclose(seen, y, atol=1e-3)
+    unseen = bst.predict(np.array([[97.0], [1.0]]))
+    # unseen category routed with the "other" side: prediction must match
+    # one of the training outputs, not explode
+    assert 0.0 - 1e-6 <= unseen[0] <= 1.0 + 1e-6
+    np.testing.assert_allclose(unseen[1], 0.0, atol=1e-3)
+
+
+def test_max_cat_to_onehot_paths_agree_on_separable_data():
+    """One-hot path (few categories) and sorted many-vs-many path must both
+    learn a separable categorical exactly."""
+    rng = np.random.default_rng(2)
+    cat = rng.integers(0, 12, 600).astype(np.float64)
+    y = np.isin(cat, [2, 5, 7, 11]).astype(float)
+    X = cat.reshape(-1, 1)
+    for onehot_cap in (99, 2):      # force one-hot vs sorted
+        ds = lgb.Dataset(X, y, categorical_feature=[0])
+        bst = lgb.train(dict(BASE, max_cat_to_onehot=onehot_cap), ds, 25,
+                        verbose_eval=False)
+        np.testing.assert_allclose(bst.predict(X), y, atol=1e-2)
+
+
+def test_forced_bins(tmp_path):
+    """forcedbins_filename pins bin boundaries (reference
+    test_engine.py:1817): with a forced boundary at 0.5, rows on either
+    side must be separable even when quantile binning would merge them."""
+    import json
+    n = 200
+    rng = np.random.default_rng(4)
+    x = np.concatenate([rng.uniform(0.0, 0.5, n // 2),
+                        rng.uniform(0.5, 1.0, n // 2)])
+    forced = str(tmp_path / "forced.json")
+    with open(forced, "w") as f:
+        json.dump([{"feature": 0, "bin_upper_bound": [0.5]}], f)
+    y = (x > 0.5).astype(float)
+    X = x.reshape(-1, 1)
+    ds = lgb.Dataset(X, y, params={"forcedbins_filename": forced,
+                                   "max_bin": 3})
+    bst = lgb.train(dict(BASE, max_bin=3,
+                         forcedbins_filename=forced), ds, 8,
+                    verbose_eval=False)
+    np.testing.assert_allclose(bst.predict(X), y, atol=5e-3)
+
+
+def test_deterministic_same_seed_same_model():
+    """Two trainings with identical data/params produce identical model
+    text (the analog of tests/cpp_test determinism)."""
+    rng = np.random.default_rng(9)
+    X = rng.normal(size=(500, 5))
+    y = (X[:, 0] > 0).astype(float)
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+              "bagging_fraction": 0.8, "bagging_freq": 1,
+              "feature_fraction": 0.8, "seed": 77}
+    t1 = lgb.train(dict(params), lgb.Dataset(X, y), 8,
+                   verbose_eval=False).model_to_string()
+    t2 = lgb.train(dict(params), lgb.Dataset(X, y), 8,
+                   verbose_eval=False).model_to_string()
+    assert t1.split("parameters:")[0] == t2.split("parameters:")[0]

@@ -1,0 +1,193 @@
+"""sklearn-wrapper conformance (analog of the reference's
+tests/python_package_test/test_sklearn.py, 24 tests incl. check_estimator):
+estimator contracts, fit/predict quality thresholds per task family, custom
+objectives/metrics through the sklearn API, pickling, pipelines/grid
+search interop, class weights, early stopping."""
+import pickle
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.sklearn import (LGBMClassifier, LGBMModel, LGBMRanker,
+                                  LGBMRegressor)
+
+sklearn = pytest.importorskip("sklearn")
+from sklearn import datasets  # noqa: E402
+from sklearn.metrics import log_loss, mean_squared_error  # noqa: E402
+from sklearn.model_selection import GridSearchCV, train_test_split  # noqa: E402
+from sklearn.pipeline import make_pipeline  # noqa: E402
+from sklearn.preprocessing import StandardScaler  # noqa: E402
+
+FAST = {"n_estimators": 25, "num_leaves": 15, "verbosity": -1}
+
+
+def _reg_data(n=600, seed=4):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 6))
+    y = X[:, 0] * 2 + np.sin(X[:, 1] * 3) + 0.1 * rng.normal(size=n)
+    return train_test_split(X, y, test_size=0.25, random_state=1)
+
+
+def _cls_data(n=700, classes=2, seed=5):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 6))
+    logit = X[:, 0] + 0.7 * X[:, 1] - 0.4 * X[:, 2]
+    if classes == 2:
+        y = (logit > 0).astype(int)
+    else:
+        y = np.digitize(logit, np.quantile(logit, [0.33, 0.66]))
+    return train_test_split(X, y, test_size=0.25, random_state=1)
+
+
+def test_regressor_quality():
+    X_tr, X_te, y_tr, y_te = _reg_data()
+    m = LGBMRegressor(**FAST).fit(X_tr, y_tr)
+    assert mean_squared_error(y_te, m.predict(X_te)) < 0.6
+    # score() via the sklearn mixin (R^2)
+    assert m.score(X_te, y_te) > 0.8
+
+
+def test_classifier_quality_and_proba():
+    X_tr, X_te, y_tr, y_te = _cls_data()
+    m = LGBMClassifier(**FAST).fit(X_tr, y_tr)
+    assert (m.predict(X_te) == y_te).mean() > 0.9
+    p = m.predict_proba(X_te)
+    assert p.shape == (len(y_te), 2)
+    np.testing.assert_allclose(p.sum(axis=1), 1.0, atol=1e-6)
+    assert log_loss(y_te, p) < 0.4
+    np.testing.assert_array_equal(m.classes_, [0, 1])
+
+
+def test_multiclass_classifier():
+    X_tr, X_te, y_tr, y_te = _cls_data(classes=3)
+    m = LGBMClassifier(**FAST).fit(X_tr, y_tr)
+    assert (m.predict(X_te) == y_te).mean() > 0.8
+    p = m.predict_proba(X_te)
+    assert p.shape == (len(y_te), 3)
+    np.testing.assert_allclose(p.sum(axis=1), 1.0, atol=1e-6)
+
+
+def test_string_class_labels():
+    X_tr, X_te, y_tr, y_te = _cls_data()
+    names = np.array(["neg", "pos"])
+    m = LGBMClassifier(**FAST).fit(X_tr, names[y_tr])
+    pred = m.predict(X_te)
+    assert set(pred) <= {"neg", "pos"}
+    assert (pred == names[y_te]).mean() > 0.9
+
+
+def test_ranker_ndcg():
+    rng = np.random.default_rng(7)
+    n_q, per_q = 60, 12
+    X = rng.normal(size=(n_q * per_q, 5))
+    rel = np.clip((X[:, 0] + 0.5 * rng.normal(size=len(X))) * 2, 0, 4)
+    y = np.floor(rel).astype(int)
+    group = np.full(n_q, per_q)
+    m = LGBMRanker(n_estimators=30, num_leaves=15, verbosity=-1)
+    m.fit(X, y, group=group)
+    scores = m.predict(X)
+    # within-query score order should correlate with labels
+    corr = []
+    for q in range(n_q):
+        s = slice(q * per_q, (q + 1) * per_q)
+        if y[s].std() > 0:
+            corr.append(np.corrcoef(scores[s], y[s])[0, 1])
+    assert np.mean(corr) > 0.5
+
+
+def test_custom_objective_and_metric():
+    X_tr, X_te, y_tr, y_te = _reg_data()
+
+    def l2_obj(y_true, y_pred):
+        return y_pred - y_true, np.ones_like(y_true)
+
+    def half_rmse(y_true, y_pred):
+        return "half_rmse", np.sqrt(np.mean((y_true - y_pred) ** 2)) / 2, False
+
+    m = LGBMRegressor(objective=l2_obj, **FAST)
+    m.fit(X_tr, y_tr, eval_set=[(X_te, y_te)], eval_metric=half_rmse,
+          verbose=False)
+    assert mean_squared_error(y_te, m.predict(X_te)) < 0.7
+    assert "half_rmse" in str(m.evals_result_)
+
+
+def test_class_weight_balanced_shifts_minority():
+    rng = np.random.default_rng(8)
+    X = rng.normal(size=(1200, 5))
+    y = ((X[:, 0] + rng.normal(size=1200) * 0.6) > 1.3).astype(int)  # ~10% pos
+    base = LGBMClassifier(**FAST).fit(X, y)
+    weighted = LGBMClassifier(class_weight="balanced", **FAST).fit(X, y)
+    # balancing must raise minority-class probabilities on average
+    assert weighted.predict_proba(X)[:, 1].mean() \
+        > base.predict_proba(X)[:, 1].mean()
+
+
+def test_early_stopping_sets_best_iteration():
+    X_tr, X_te, y_tr, y_te = _reg_data()
+    m = LGBMRegressor(n_estimators=200, num_leaves=15, verbosity=-1)
+    m.fit(X_tr, y_tr, eval_set=[(X_te, y_te)], eval_metric="l2",
+          early_stopping_rounds=5, verbose=False)
+    assert m.best_iteration_ is not None
+    assert m.best_iteration_ <= 200
+
+
+def test_pickle_roundtrip():
+    X_tr, X_te, y_tr, _ = _cls_data()
+    m = LGBMClassifier(**FAST).fit(X_tr, y_tr)
+    m2 = pickle.loads(pickle.dumps(m))
+    np.testing.assert_allclose(m.predict_proba(X_te), m2.predict_proba(X_te),
+                               rtol=1e-10)
+
+
+def test_get_set_params_clone():
+    from sklearn.base import clone
+    m = LGBMRegressor(learning_rate=0.05, n_estimators=11)
+    params = m.get_params()
+    assert params["learning_rate"] == 0.05
+    assert params["n_estimators"] == 11
+    m2 = clone(m)
+    assert m2.get_params()["n_estimators"] == 11
+    m.set_params(num_leaves=7)
+    assert m.get_params()["num_leaves"] == 7
+
+
+def test_pipeline_and_grid_search():
+    X_tr, X_te, y_tr, y_te = _reg_data(n=400)
+    pipe = make_pipeline(StandardScaler(),
+                         LGBMRegressor(n_estimators=15, num_leaves=7,
+                                       verbosity=-1))
+    pipe.fit(X_tr, y_tr)
+    assert pipe.score(X_te, y_te) > 0.6
+    gs = GridSearchCV(LGBMRegressor(n_estimators=10, verbosity=-1),
+                      {"num_leaves": [7, 15]}, cv=2)
+    gs.fit(X_tr, y_tr)
+    assert gs.best_params_["num_leaves"] in (7, 15)
+
+
+def test_feature_importances_and_n_features():
+    X_tr, _, y_tr, _ = _reg_data()
+    m = LGBMRegressor(**FAST).fit(X_tr, y_tr)
+    imp = m.feature_importances_
+    assert imp.shape == (X_tr.shape[1],)
+    assert imp.sum() > 0
+    assert int(np.argmax(imp)) in (0, 1)   # the two signal features
+    assert m.n_features_ == X_tr.shape[1]
+
+
+def test_unfitted_predict_raises():
+    m = LGBMRegressor()
+    with pytest.raises(Exception):
+        m.predict(np.zeros((3, 4)))
+
+
+def test_sklearn_check_estimator_subset():
+    """A curated subset of sklearn's check_estimator battery (the full
+    battery requires tag plumbing the reference wrapper also skips)."""
+    from sklearn.utils.estimator_checks import (
+        check_estimators_pickle, check_fit2d_predict1d)
+    try:
+        check_estimators_pickle("LGBMRegressor",
+                                LGBMRegressor(n_estimators=5, verbosity=-1,
+                                              min_data_in_leaf=1))
+    except TypeError:
+        pytest.skip("sklearn check API version mismatch")
