@@ -835,6 +835,84 @@ class GBDT:
         with open(filename, "w") as f:
             f.write(self.save_model_to_string(start_iteration, num_iteration))
 
+    def model_to_if_else(self, num_iteration=-1) -> str:
+        """Standalone C++ source hard-coding the model's prediction
+        functions (GBDT::SaveModelToIfElse / ModelToIfElse,
+        src/boosting/gbdt_model_text.cpp:105-300 + Tree::ToIfElse): per-tree
+        PredictTree%d / PredictTree%dLeaf, and extern "C" PredictRaw /
+        Predict / PredictLeafIndex aggregates. The objective transform is
+        generated for the common cases (sigmoid / softmax / identity)."""
+        models = self._used_models(0, num_iteration)
+        ntpi = self.num_tree_per_iteration
+        buf = ["// generated by lightgbm_tpu convert_model",
+               "#include <cmath>", ""]
+        for i, t in enumerate(models):
+            buf.append(t.to_if_else(i, False))
+            buf.append(t.to_if_else(i, True))
+            buf.append("")
+        n = len(models)
+        ptrs = ", ".join("PredictTree%d" % i for i in range(n)) or ""
+        lptrs = ", ".join("PredictTree%dLeaf" % i for i in range(n)) or ""
+        buf.append("typedef double (*TreeFn)(const double*);")
+        buf.append("static const TreeFn kTrees[] = {%s};" % ptrs)
+        buf.append("static const TreeFn kTreeLeaves[] = {%s};" % lptrs)
+        buf.append("static const int kNumTrees = %d;" % n)
+        buf.append("static const int kNumClass = %d;" % ntpi)
+        avg = ("/ (kNumTrees / kNumClass)" if self.average_output else "")
+        buf.append("""
+extern "C" void PredictRaw(const double* arr, double* out) {
+  for (int k = 0; k < kNumClass; ++k) out[k] = 0.0;
+  for (int i = 0; i < kNumTrees; ++i) out[i %% kNumClass] += kTrees[i](arr);
+  for (int k = 0; k < kNumClass; ++k) out[k] = out[k] %s;
+}
+
+extern "C" void PredictLeafIndex(const double* arr, double* out) {
+  for (int i = 0; i < kNumTrees; ++i) out[i] = kTreeLeaves[i](arr);
+}
+""" % (avg if avg else ""))
+        obj = self.objective.name if self.objective is not None else ""
+        if obj == "binary":
+            sig = float(getattr(self.objective, "sigmoid", 1.0))
+            transform = ("out[0] = 1.0 / (1.0 + std::exp(-%s * out[0]));"
+                         % repr(sig))
+        elif obj == "multiclass":
+            transform = """double wmax = out[0];
+  for (int k = 1; k < kNumClass; ++k) if (out[k] > wmax) wmax = out[k];
+  double wsum = 0.0;
+  for (int k = 0; k < kNumClass; ++k) { out[k] = std::exp(out[k] - wmax); wsum += out[k]; }
+  for (int k = 0; k < kNumClass; ++k) out[k] /= wsum;"""
+        elif obj == "multiclassova":
+            sig = float(getattr(self.objective, "sigmoid", 1.0))
+            transform = ("for (int k = 0; k < kNumClass; ++k) "
+                         "out[k] = 1.0 / (1.0 + std::exp(-%s * out[k]));"
+                         % repr(sig))
+        elif obj == "cross_entropy":
+            transform = ("for (int k = 0; k < kNumClass; ++k) "
+                         "out[k] = 1.0 / (1.0 + std::exp(-out[k]));")
+        elif obj == "cross_entropy_lambda":
+            transform = ("for (int k = 0; k < kNumClass; ++k) "
+                         "out[k] = std::log1p(std::exp(out[k]));")
+        elif obj in ("poisson", "gamma", "tweedie"):
+            transform = ("for (int k = 0; k < kNumClass; ++k) "
+                         "out[k] = std::exp(out[k]);")
+        elif obj == "regression" and getattr(self.objective, "sqrt", False):
+            transform = ("out[0] = (out[0] >= 0 ? 1.0 : -1.0) "
+                         "* out[0] * out[0];")
+        elif self.objective is None or obj in (
+                "regression", "regression_l1", "huber", "fair", "quantile",
+                "mape", "lambdarank", "rank_xendcg"):
+            transform = "// identity transform"
+        else:
+            Log.fatal("convert_model has no output transform for "
+                      "objective %s" % obj)
+        buf.append("""
+extern "C" void Predict(const double* arr, double* out) {
+  PredictRaw(arr, out);
+  %s
+}
+""" % transform)
+        return "\n".join(buf)
+
     def load_model_from_string(self, text: str) -> None:
         """GBDT::LoadModelFromString (gbdt_model_text.cpp:385+)."""
         self.models = []

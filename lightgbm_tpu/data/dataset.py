@@ -272,6 +272,40 @@ class BinnedDataset:
         ds._finish_layout(config)
 
     @classmethod
+    def from_matrix_with_mappers(cls, X, config: Config,
+                                 mappers, label=None, weight=None,
+                                 group=None, init_score=None,
+                                 feature_names=None) -> "BinnedDataset":
+        """Build a shard dataset from PRE-AGREED BinMappers (distributed
+        loading: parallel/distributed.distributed_bin_mappers). EFB is
+        off — each feature is its own group — so every rank derives the
+        identical layout from the identical mappers and sharded histogram
+        psums line up bin-for-bin."""
+        X = np.ascontiguousarray(X, dtype=np.float64)
+        n, nf = X.shape
+        if len(mappers) != nf:
+            Log.fatal("%d mappers for %d features" % (len(mappers), nf))
+        ds = cls()
+        ds.num_data = n
+        ds.num_total_features = nf
+        ds.feature_names = (list(feature_names) if feature_names
+                            else ["Column_%d" % i for i in range(nf)])
+        ds.metadata = Metadata(n)
+        if label is not None:
+            ds.metadata.set_label(label)
+        ds.metadata.set_weight(weight)
+        ds.metadata.set_query(group)
+        ds.metadata.set_init_score(init_score)
+        ds.bin_mappers = list(mappers)
+        ds.used_features = [f for f in range(nf)
+                            if not ds.bin_mappers[f].is_trivial]
+        ds.inner_of = {f: i for i, f in enumerate(ds.used_features)}
+        ds.groups = [[i] for i in range(len(ds.used_features))]
+        ds._finish_layout(config)
+        ds._push_matrix(X)
+        return ds
+
+    @classmethod
     def from_text_two_round(cls, filename: str, config: Config,
                             categorical_features: Sequence[int] = ()
                             ) -> "BinnedDataset":

@@ -34,11 +34,24 @@ class Application:
         elif task in ("predict", "prediction", "test"):
             self.predict()
         elif task == "convert_model":
-            Log.fatal("convert_model is not supported on device_type=tpu yet")
+            self.convert_model()
         elif task in ("refit", "refit_tree"):
             self.refit()
         else:
             Log.fatal("Unknown task type %s" % task)
+
+    # ------------------------------------------------------------------
+    def convert_model(self):
+        """convert_model task (application.cpp ConvertModel +
+        GBDT::SaveModelToIfElse): model text -> standalone C++ source."""
+        cfg = self.config
+        if not cfg.input_model:
+            Log.fatal("Need input_model for convert_model task")
+        booster = Booster(model_file=cfg.input_model)
+        out = cfg.convert_model or "gbdt_prediction.cpp"
+        with open(out, "w") as f:
+            f.write(booster._booster.model_to_if_else())
+        Log.info("Finished converting; C++ code saved to %s" % out)
 
     # ------------------------------------------------------------------
     def refit(self):

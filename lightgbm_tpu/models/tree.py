@@ -261,6 +261,91 @@ class Tree:
             return np.full(X.shape[0], self.leaf_value[0])
         return self.leaf_value[self.predict_leaf(X)]
 
+    # -- C++ codegen (Tree::ToIfElse, src/io/tree.cpp:383-440) ----------
+    def to_if_else(self, index: int, predict_leaf_index: bool) -> str:
+        """Hard-coded C++ prediction function for this tree — the
+        convert_model output. Reproduces the model's Decision semantics
+        exactly: NaN->0 unless missing_type==NaN, zero/NaN default
+        routing, categorical bitset tests."""
+        def cfloat(v):
+            v = float(v)
+            if np.isinf(v):
+                return "INFINITY" if v > 0 else "-INFINITY"
+            return repr(v)
+
+        name = "PredictTree%d%s" % (index, "Leaf" if predict_leaf_index
+                                    else "")
+        buf = ["double %s(const double* arr) {" % name]
+        if self.num_leaves <= 1:
+            out = "0" if predict_leaf_index else cfloat(self.leaf_value[0])
+            buf.append("  return %s;" % out)
+            buf.append("}")
+            return "\n".join(buf)
+        if self.num_cat > 0:
+            words = ",".join("%uu" % (w & 0xFFFFFFFF)
+                             for w in self.cat_threshold)
+            buf.append("  static const unsigned int cat_threshold[] = {%s};"
+                       % words)
+            buf.append("  long int_fval = 0;")
+        buf.append("  double fval = 0.0;")
+
+        def leaf(i):
+            if predict_leaf_index:
+                return "  return %d;" % i
+            return "  return %s;" % cfloat(self.leaf_value[i])
+
+        def node(k, indent):
+            pad = "  " * indent
+            dt = int(self.decision_type[k])
+            f = int(self.split_feature[k])
+            lines = ["%sfval = arr[%d];" % (pad, f)]
+            if dt & kCategoricalMask:
+                # mirrors Tree._decision: NaN acts as category 0 unless
+                # missing_type==NaN (-> right); fractional negatives in
+                # (-1, 0) go right even though (long) truncates them to 0
+                ci = int(self.threshold[k])
+                b0, b1 = self.cat_boundaries[ci], self.cat_boundaries[ci + 1]
+                nbits = (b1 - b0) * 32
+                mt = (dt >> 2) & 3
+                lines.append("%sint_fval = std::isnan(fval) ? 0 "
+                             ": (long)fval;" % pad)
+                nan_guard = ("!std::isnan(fval) && " if mt == 2 else "")
+                lines.append(
+                    "%sif (%s(std::isnan(fval) || fval >= 0.0) && "
+                    "int_fval < %d && ((cat_threshold[%d + int_fval / 32]"
+                    " >> (int_fval %% 32)) & 1)) {"
+                    % (pad, nan_guard, nbits, b0))
+            else:
+                mt = (dt >> 2) & 3
+                default_left = bool(dt & kDefaultLeftMask)
+                thr = cfloat(self.threshold[k])
+                if mt != 2:
+                    lines.append("%sif (std::isnan(fval)) fval = 0.0;" % pad)
+                if mt == 1:      # zero -> default direction
+                    guard = "std::fabs(fval) <= 1e-35"
+                elif mt == 2:    # NaN -> default direction
+                    guard = "std::isnan(fval)"
+                else:
+                    guard = None
+                cond = "fval <= %s" % thr
+                if guard is not None:
+                    cond = ("(%s) || (%s)" % (guard, cond) if default_left
+                            else "!(%s) && (%s)" % (guard, cond))
+                lines.append("%sif (%s) {" % (pad, cond))
+            def emit(child):
+                if child < 0:
+                    return [pad + "  " + leaf(~child).strip()]
+                return node(child, indent + 1)
+            lines.extend(emit(int(self.left_child[k])))
+            lines.append("%s} else {" % pad)
+            lines.extend(emit(int(self.right_child[k])))
+            lines.append("%s}" % pad)
+            return lines
+
+        buf.extend(node(0, 1))
+        buf.append("}")
+        return "\n".join(buf)
+
     # -- SHAP feature contributions ------------------------------------
     def expected_value(self) -> float:
         """Count-weighted mean leaf value (Tree SHAP base value)."""

@@ -1,0 +1,66 @@
+"""CLI vs Python-API consistency on the reference's example configs — the
+analog of tests/python_package_test/test_consistency.py:69-118: training
+through `python -m lightgbm_tpu config=train.conf` must produce the exact
+model the Python API produces from the same parameters, and its
+predictions must round-trip through task=predict."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+
+EXAMPLES = "/root/reference/examples"
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(EXAMPLES),
+    reason="reference examples not available")
+
+DET = ["feature_fraction=1.0", "bagging_fraction=1.0", "bagging_freq=0",
+       "enable_bundle=false", "num_trees=15", "verbosity=-1"]
+
+
+def _run_cli(args, cwd):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH="/root/repo")
+    r = subprocess.run([sys.executable, "-m", "lightgbm_tpu"] + args,
+                       env=env, capture_output=True, text=True, cwd=cwd)
+    assert r.returncode == 0, r.stderr[-1500:]
+
+
+@pytest.mark.parametrize("name,data,valid", [
+    ("binary_classification", "binary.train", "binary.test"),
+    ("regression", "regression.train", "regression.test"),
+])
+def test_cli_matches_python(name, data, valid, tmp_path):
+    exdir = os.path.join(EXAMPLES, name)
+    model_cli = str(tmp_path / "cli.txt")
+    _run_cli(["config=train.conf", "output_model=" + model_cli] + DET,
+             cwd=exdir)
+
+    cfg = Config.from_cli_args(
+        ["config=" + os.path.join(exdir, "train.conf")] + DET)
+    params = cfg.to_dict()
+    for drop in ("data", "valid", "valid_data", "output_model", "task",
+                 "machine_list_filename", "config"):
+        params.pop(drop, None)
+    train = lgb.Dataset(os.path.join(exdir, data), params=dict(params))
+    vset = lgb.Dataset(os.path.join(exdir, valid), reference=train,
+                       params=dict(params))
+    bst = lgb.train(params, train, num_boost_round=15, valid_sets=[vset],
+                    verbose_eval=False)
+
+    cli_trees = open(model_cli).read().split("parameters:")[0]
+    py_trees = bst.model_to_string().split("parameters:")[0]
+    assert cli_trees == py_trees
+
+    # CLI predict on the valid file must equal Python predict
+    preds_path = str(tmp_path / "preds.txt")
+    _run_cli(["task=predict", "input_model=" + model_cli, "data=" + valid,
+              "output_result=" + preds_path], cwd=exdir)
+    cli_preds = np.loadtxt(preds_path)
+    X = np.loadtxt(os.path.join(exdir, valid))[:, 1:]
+    np.testing.assert_allclose(cli_preds, bst.predict(X), rtol=1e-12)
