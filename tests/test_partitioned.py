@@ -100,3 +100,32 @@ def test_train_partitioned_end_to_end(monkeypatch):
     p = bst.predict(X)
     acc = ((p > 0.5) == labels).mean()
     assert acc > 0.85, acc
+
+
+def test_batched_scan_matches_single_iterations(monkeypatch):
+    """The fused K-iteration scan must produce the exact model the
+    single-iteration path produces — same trees, same predictions (the
+    per-tree RNG streams and histogram accumulation order are identical)."""
+    import lightgbm_tpu.treelearner.serial as serial_mod
+    monkeypatch.setattr(serial_mod, "PARTITION_MIN_ROWS", 100)
+    X, y = _make(3000, seed=11)
+    labels = (y > np.median(y)).astype(float)
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1}
+    # batched: engine enables the fused scan (no callbacks, 20 >= 16)
+    b_batch = lgb.train(dict(params), lgb.Dataset(X, labels), 20,
+                        verbose_eval=False)
+    # per-iteration: a BEFORE-iteration callback disables batching
+    seen = []
+
+    def cb(env):
+        seen.append(env.iteration)
+    cb.before_iteration = True
+    cb.order = 0
+    b_single = lgb.train(dict(params), lgb.Dataset(X, labels), 20,
+                         callbacks=[cb], verbose_eval=False)
+    assert len(seen) == 20
+    assert not b_single._booster._pending_batches
+    t_b = b_batch.model_to_string().split("parameters:")[0]
+    t_s = b_single.model_to_string().split("parameters:")[0]
+    assert t_b == t_s
+    np.testing.assert_array_equal(b_batch.predict(X), b_single.predict(X))
