@@ -1,0 +1,476 @@
+"""Fused Pallas TPU kernels for the persistent-payload tree grower.
+
+TPU-native re-design of the reference's per-split hot loop — the
+DataPartition::Split row shuffle (src/treelearner/data_partition.hpp:101),
+the OrderedBin leaf-sorted histogram walk (include/LightGBM/bin.h:229) and
+the ConstructHistograms inner loops (src/io/dense_bin.hpp:74-110) — as TWO
+Mosaic kernels over a single transposed payload matrix:
+
+  payload: u32 [WP, NP]   (rows on lanes; one matrix, one DMA per window)
+     rows 0..nbw-1   bit-packed bin bytes, 4 storage columns per word
+     row  nbw        label     (f32 bitcast; objective input)
+     row  nbw+1      row id    (u32; positions -> original rows at the end)
+     row  nbw+2      gradient  (f32 bitcast; rewritten every iteration)
+     row  nbw+3      hessian   (f32 bitcast)
+
+  * split_pass (one call per split, DYNAMIC grid over chunks): streams the
+    splitting leaf's contiguous payload segment once, and per chunk
+      - decides go_left per row (DenseBin::Split semantics at the bin
+        level, src/io/dense_bin.hpp:112-207; numerical features),
+      - accumulates the SMALLER child's histogram as radix-16 one-hot MXU
+        contractions (the GPU histogram kernel analog,
+        src/treelearner/ocl/histogram256.cl, re-derived for the MXU),
+      - packs the chunk with a Kogge-Stone hole-shift compaction (log2 E
+        stages of static lane rolls + selects — word moves only, bit-exact,
+        no sort, no scratch matmul),
+      - partitions the payload IN PLACE: a two-ended writeback with a
+        2-chunk FIFO. Chunks are read from whichever end has the smaller
+        write-space gap and drained two steps later, so reads always lead
+        writes on both ends (left blocks fill bottom-up, right blocks
+        top-down) with no scratch buffer and no second pass — this replaces
+        v1's scratch + copy-back design (ops/grow.py pass A + pass B).
+    Chunk windows are DMAed at 128-aligned lane offsets and re-aligned in
+    VMEM with one dynamic roll; partial-lane writes blend read-modify-write
+    so neighbouring leaves' rows are untouched.
+
+  * root_hist (static grid): one streaming pass building the root histogram
+    and the gradient/hessian totals.
+
+Both kernels keep the histogram in the PADDED [G, 256] per-group layout
+(group g's bins at flat offset g*256), so the flat [TB, 2] view used by the
+split scan is a reshape — no gather, no scatter (v1's _hist_acc_finish
+scatter and dense-scan gather cost ~80us per split).
+
+Gated to the fast path: numerical features only, groups == features (no
+EFB bundles), <= 256 bins per feature, f32 accumulation. Everything else
+falls back to ops/grow.py. Equivalence is tested on CPU in interpreter
+mode against the v1 growers (tests/test_persist_grower.py).
+"""
+from __future__ import annotations
+
+import functools
+import sys
+
+import jax
+import jax.numpy as jnp
+
+try:  # pallas ships with jax; guard for exotic builds
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    HAS_PALLAS = False
+
+I32 = jnp.int32
+U32 = jnp.uint32
+F32 = jnp.float32
+
+# the unrolled compaction stages trace deeper than CPython's default limit
+if sys.getrecursionlimit() < 20000:
+    sys.setrecursionlimit(20000)
+
+# scalar-prefetch slot indices for split_pass
+S_NCH = 0         # number of payload chunks of the segment
+S_S0 = 1          # segment start lane
+S_NL = 2          # segment length (rows)
+S_WG = 3          # payload word row of the split feature's storage byte
+S_SH = 4          # shift of the feature's bits inside the word
+S_MASK = 5        # value mask after shift (15 nibble / 255 byte)
+S_NB = 6          # feature bin count
+S_MT = 7          # missing type (0 none / 1 zero / 2 nan)
+S_DB = 8          # default (zero) bin
+S_THR = 9         # threshold (local bin)
+S_DL = 10         # default_left flag
+S_SMALL_L = 11    # smaller child is the left one
+N_SCALARS = 12
+
+
+def _log2_ceil(x: int) -> int:
+    n = 0
+    while (1 << n) < x:
+        n += 1
+    return n
+
+
+def _lane_iota(E: int):
+    return jax.lax.broadcasted_iota(I32, (1, E), 1)
+
+
+def _prefix_sum_lanes(x, E: int):
+    """Inclusive prefix sum along lanes of [1, E] i32 (Kogge-Stone)."""
+    lane = _lane_iota(E)
+    for b in range(_log2_ceil(E)):
+        sh = 1 << b
+        shifted = pltpu.roll(x, sh, 1)
+        x = x + jnp.where(lane >= sh, shifted, jnp.int32(0))
+    return x
+
+
+def _compact(block, keep, E: int, to_right: bool):
+    """Stable compaction of [R, E] u32 lanes with keep toward lane 0
+    (or toward lane E-1 when to_right).
+
+    Hole-shift method: each kept lane moves by r = number of dropped lanes
+    before it (after it, for to_right); process r bit by bit from the low
+    end — at stage b every kept lane whose remaining shift has bit b set
+    moves 2^b. Low-to-high is collision-free: two kept lanes whose
+    positions differ by < 2^b have equal remaining shifts (both multiples
+    of 2^b), so if the arriving lane moves the vacating lane moves too.
+    Word moves + selects only: bit-exact for any payload.
+    """
+    keep_i = keep.astype(I32)[None, :]                       # [1, E]
+    drop_incl = _prefix_sum_lanes(1 - keep_i, E)
+    if to_right:
+        # holes AFTER lane i = total_dropped - inclusive_prefix(i)
+        total = jnp.max(drop_incl)                           # last lane
+        holes = total - drop_incl
+    else:
+        holes = drop_incl - (1 - keep_i)
+    r = jnp.where(keep_i > 0, holes, 0)                      # [1, E]
+    x = block
+    k = keep_i
+    for b in range(_log2_ceil(E)):
+        sh = 1 << b
+        step = sh if to_right else E - sh                    # roll direction
+        x_s = pltpu.roll(x, step, 1)
+        r_s = pltpu.roll(r, step, 1)
+        k_s = pltpu.roll(k, step, 1)
+        arrives = (k_s > 0) & (((r_s >> b) & 1) > 0)         # [1, E]
+        moved = (k > 0) & (((r >> b) & 1) > 0)
+        x = jnp.where(arrives, x_s, x)
+        r = jnp.where(arrives, r_s - sh, r)
+        k = jnp.where(arrives, 1, jnp.where(moved, 0, k))
+    return x
+
+
+def _unpack_group_bins(pay_block, plan):
+    """[G, E] i32 group-local bins from the packed word rows of [WP, E].
+
+    plan: static tuple of (word_row, shift, mask) per logical group.
+    """
+    rows = []
+    for (w, sh, mk) in plan:
+        rows.append(((pay_block[w, :] >> U32(sh)) & U32(mk)).astype(I32))
+    return jnp.stack(rows, axis=0)
+
+
+def _hist_accum(hist_ref, bins_g, grad, hess, G: int):
+    """hist_ref[g] += radix-16 one-hot MXU contraction of one chunk.
+
+    bins_g: [G, E] i32; grad/hess: [E] f32 already masked to valid rows.
+    hist_ref: [G, 16, 16, 2] f32 VMEM ref. grad/hess ride as bf16 hi+lo
+    pairs so the contraction is exact to f32 (ops/pallas_histogram docs).
+    """
+    E = bins_g.shape[1]
+    n16 = jax.lax.broadcasted_iota(I32, (16, E), 0)
+    g_hi = grad.astype(jnp.bfloat16)
+    h_hi = hess.astype(jnp.bfloat16)
+    g_lo = (grad - g_hi.astype(F32)).astype(jnp.bfloat16)
+    h_lo = (hess - h_hi.astype(F32)).astype(jnp.bfloat16)
+    vt = (g_hi, h_hi, g_lo, h_lo)
+    dn = (((1,), (1,)), ((), ()))
+    for g in range(G):
+        b = bins_g[g, :]
+        oh_hi = (n16 == (b >> 4)[None, :]).astype(jnp.bfloat16)   # [16, E]
+        oh_lo = (n16 == (b & 15)[None, :]).astype(jnp.bfloat16)
+        hs = []
+        for v in range(4):
+            bv = oh_lo * vt[v][None, :]
+            hs.append(jax.lax.dot_general(
+                oh_hi, bv, dn, preferred_element_type=F32))        # [16, 16]
+        hist_ref[g] = hist_ref[g] + jnp.stack(
+            [hs[0] + hs[2], hs[1] + hs[3]], axis=-1)
+
+
+def _f32r(row):
+    return jax.lax.bitcast_convert_type(row, F32)
+
+
+def _align128(ptr):
+    c128 = jnp.int32(128)
+    al = jax.lax.mul(jax.lax.div(ptr, c128), c128)
+    return pl.multiple_of(al, 128)
+
+
+# ---------------------------------------------------------------------------
+# split_pass
+# ---------------------------------------------------------------------------
+
+def make_split_pass(WPA: int, NP: int, G: int, plan, nbw: int,
+                    C: int = 4096, interpret: bool = False):
+    """Build the fused per-split kernel for one payload geometry.
+
+    plan: tuple of (word_row, shift, mask) per group; rows nbw..nbw+3 are
+    label/rowid/grad/hess (nbw = WP - 4).
+
+    Returns fn(pay, scalars_i32) -> (pay', hist [G*256, 2] f32, n_left).
+    """
+    assert WPA % 8 == 0, "payload row count must be padded to 8"
+    E = C + 128
+    grad_row = nbw + 2
+
+    def kernel(ns, pay_in, pay_out, hist_ref, cnt_ref,
+               wbuf, obuf, rbuf, slots, st, sem_r, sem_w, sem_rmw):
+        # st (SMEM i32): 0 fr, 1 br, 2 lf, 3 rf, 4 pendL, 5 pendR,
+        #                6 nleft, 7+2p nL(slot p), 8+2p nR(slot p)
+        i = pl.program_id(0)
+        nch = ns[S_NCH]
+        nch2 = jax.lax.add(nch, jnp.int32(2))
+        lane = _lane_iota(E)[0]
+
+        @pl.when(i == 0)
+        def _init():
+            st[0] = ns[S_S0]
+            st[1] = ns[S_S0] + ns[S_NL]
+            st[2] = ns[S_S0]
+            st[3] = ns[S_S0] + ns[S_NL]
+            st[4] = 0
+            st[5] = 0
+            st[6] = 0
+            hist_ref[...] = jnp.zeros_like(hist_ref)
+
+        # ---- drain phase first: write slot (i-2)%2 ----------------------
+        # (drain before read so the read below may refill the same slot)
+        @pl.when((i >= 2) & (i < nch2))
+        def _drain():
+            p = jax.lax.rem(i, jnp.int32(2))  # == (i-2) % 2
+            nL_ = jnp.where(p == 0, st[7], st[9])
+            nR_ = jnp.where(p == 0, st[8], st[10])
+            src_l = jnp.where(p == 0, slots[0], slots[2])
+            src_r = jnp.where(p == 0, slots[1], slots[3])
+
+            # left block: slot lanes [0, nL) -> payload [lf, lf+nL)
+            lf = st[2]
+            al = _align128(lf)
+            dL = lf - al
+            cp = pltpu.make_async_copy(
+                pay_in.at[:, pl.ds(al, E)], rbuf, sem_rmw)
+            cp.start()
+            cp.wait()
+            sel = (lane >= dL) & (lane < dL + nL_)
+            obuf[...] = jnp.where(sel[None, :],
+                                  pltpu.roll(src_l, dL, 1), rbuf[...])
+            cpw = pltpu.make_async_copy(
+                obuf, pay_out.at[:, pl.ds(al, E)], sem_w)
+            cpw.start()
+            cpw.wait()
+            st[2] = lf + nL_
+            st[4] = st[4] - nL_
+
+            # right block: slot lanes [E-nR, E) -> payload [rf-nR, rf)
+            rf = st[3]
+            rs = rf - nR_
+            al2 = _align128(rs)
+            dR = rs - al2
+            cp2 = pltpu.make_async_copy(
+                pay_in.at[:, pl.ds(al2, E)], rbuf, sem_rmw)
+            cp2.start()
+            cp2.wait()
+            sel2 = (lane >= dR) & (lane < dR + nR_)
+            obuf[...] = jnp.where(sel2[None, :],
+                                  pltpu.roll(src_r, dR + nR_, 1), rbuf[...])
+            cpw2 = pltpu.make_async_copy(
+                obuf, pay_out.at[:, pl.ds(al2, E)], sem_w)
+            cpw2.start()
+            cpw2.wait()
+            st[3] = rf - nR_
+            st[5] = st[5] - nR_
+
+        # ---- read + process phase (steps 0 .. nch-1) --------------------
+        @pl.when(i < nch)
+        def _read():
+            fr = st[0]
+            br = st[1]
+            front_gap = fr - st[2] - st[4]   # virtual: pending included
+            back_gap = st[3] - st[5] - br
+            m = jnp.minimum(jnp.int32(C), jax.lax.sub(br, fr))
+            use_front = front_gap <= back_gap
+            ptr = jnp.where(use_front, fr, br - m)
+            st[0] = jnp.where(use_front, fr + m, fr)
+            st[1] = jnp.where(use_front, br, br - m)
+
+            al = _align128(ptr)
+            cp = pltpu.make_async_copy(
+                pay_in.at[:, pl.ds(al, E)], wbuf, sem_r)
+            cp.start()
+            cp.wait()
+            d = ptr - al
+            w = pltpu.roll(wbuf[...], jax.lax.sub(jnp.int32(E), d), 1)   # chunk rows at lanes 0..m
+            valid = lane < m
+
+            # decision (numerical; dense_bin.hpp:112 semantics)
+            word = w[0, :] * U32(0)
+            for r_ in range(nbw):
+                word = jnp.where(ns[S_WG] == r_, w[r_, :], word)
+            b = ((word >> ns[S_SH].astype(U32)) & ns[S_MASK].astype(U32)) \
+                .astype(I32)
+            cmp_left = b <= ns[S_THR]
+            is_na = (ns[S_MT] == 2) & (b == ns[S_NB] - 1)
+            is_zero = (ns[S_MT] == 1) & (b == ns[S_DB])
+            # dl as a VECTOR compare: a scalar-bool broadcast lowers to an
+            # unsupported i8->i1 truncation in Mosaic
+            dlv = (jnp.zeros_like(b) + ns[S_DL]) > 0
+            gd = is_na | is_zero
+            go_left = (gd & dlv) | ((~gd) & cmp_left)
+
+            gl = valid & go_left
+            gr = valid & (~go_left)
+            nL = jnp.sum(gl.astype(F32), dtype=F32).astype(I32)
+            nR = m - nL
+            st[6] = st[6] + nL
+
+            # smaller-child histogram
+            hm = (valid & (go_left == (ns[S_SMALL_L] > 0))).astype(F32)
+            grad = _f32r(w[grad_row, :]) * hm
+            hess = _f32r(w[grad_row + 1, :]) * hm
+            bins_g = _unpack_group_bins(w, plan)
+            _hist_accum(hist_ref, bins_g, grad, hess, G)
+
+            # pack both sides into this step's FIFO slot
+            packedL = _compact(w, gl, E, to_right=False)
+            packedR = _compact(w, gr, E, to_right=True)
+
+            pr = jax.lax.rem(i, jnp.int32(2))
+
+            @pl.when(pr == 0)
+            def _():
+                slots[0] = packedL
+                slots[1] = packedR
+                st[7] = nL
+                st[8] = nR
+
+            @pl.when(pr == 1)
+            def _():
+                slots[2] = packedL
+                slots[3] = packedR
+                st[9] = nL
+                st[10] = nR
+            st[4] = st[4] + nL
+            st[5] = st[5] + nR
+
+        @pl.when(i == jax.lax.add(nch, jnp.int32(1)))
+        def _fin():
+            cnt_ref[0] = st[6]
+
+    @jax.jit
+    def split_pass(pay, scalars):
+        do_run = scalars[S_NL] > 0
+        grid = jnp.where(do_run, scalars[S_NCH] + 2, 0).astype(jnp.int32)
+        # trace the kernel with 32-bit default dtypes: under jax_enable_x64
+        # (on for reference-parity f64 host math) weak-int promotion inside
+        # Mosaic recurses/lowers to unsupported i64
+        with jax.enable_x64(False):
+            pay2, hist, cnt = _call(pay, scalars, grid)
+        return pay2, hist.reshape(G * 256, 2), cnt[0]
+
+    def _call(pay, scalars, grid):
+        return pl.pallas_call(
+            kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1,
+                grid=(grid,),
+                in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+                out_specs=[
+                    pl.BlockSpec(memory_space=pltpu.ANY),
+                    pl.BlockSpec((G, 16, 16, 2),
+                                 lambda i, s: (i * 0, i * 0, i * 0, i * 0)),
+                    pl.BlockSpec((1,), lambda i, s: (i * 0,),
+                                 memory_space=pltpu.SMEM),
+                ],
+                scratch_shapes=[
+                    pltpu.VMEM((WPA, E), U32),     # wbuf
+                    pltpu.VMEM((WPA, E), U32),     # obuf
+                    pltpu.VMEM((WPA, E), U32),     # rbuf
+                    pltpu.VMEM((4, WPA, E), U32),  # FIFO slots (2 x L/R)
+                    pltpu.SMEM((12,), I32),        # st
+                    pltpu.SemaphoreType.DMA,
+                    pltpu.SemaphoreType.DMA,
+                    pltpu.SemaphoreType.DMA,
+                ],
+            ),
+            out_shape=[
+                jax.ShapeDtypeStruct((WPA, NP), U32),
+                jax.ShapeDtypeStruct((G, 16, 16, 2), F32),
+                jax.ShapeDtypeStruct((1,), I32),
+            ],
+            input_output_aliases={1: 0},
+            interpret=interpret,
+        )(scalars, pay)
+
+    return split_pass
+
+
+# ---------------------------------------------------------------------------
+# root_hist
+# ---------------------------------------------------------------------------
+
+def make_root_hist(WPA: int, NP: int, G: int, plan, nbw: int, n: int,
+                   C: int = 65536, interpret: bool = False):
+    """One streaming pass: padded root histogram + grad/hess totals.
+
+    Returns fn(pay) -> (hist [G*256, 2] f32, sums [2] f32).
+    Totals are f32 chunk-partial sums (deterministic order).
+    """
+    assert WPA % 8 == 0
+    grad_row = nbw + 2
+    nch = (n + C - 1) // C
+    assert NP >= nch * C, "payload lanes must cover whole root chunks"
+
+    def kernel(pay_hbm, hist_ref, sums_ref, wbuf, acc, sem_r):
+        i = pl.program_id(0)
+
+        @pl.when(i == 0)
+        def _init():
+            hist_ref[...] = jnp.zeros_like(hist_ref)
+            acc[0] = 0.0
+            acc[1] = 0.0
+
+        cp = pltpu.make_async_copy(
+            pay_hbm.at[:, pl.ds(i * C, C)], wbuf, sem_r)
+        cp.start()
+        cp.wait()
+        w = wbuf[...]
+        lane = jax.lax.broadcasted_iota(I32, (1, C), 1)[0]
+        valid = (lane < (n - i * C)).astype(F32)
+        grad = _f32r(w[grad_row, :]) * valid
+        hess = _f32r(w[grad_row + 1, :]) * valid
+        bins_g = _unpack_group_bins(w, plan)
+        _hist_accum(hist_ref, bins_g, grad, hess, G)
+        acc[0] = acc[0] + jnp.sum(grad)
+        acc[1] = acc[1] + jnp.sum(hess)
+
+        @pl.when(i == nch - 1)
+        def _fin():
+            sums_ref[0] = acc[0]
+            sums_ref[1] = acc[1]
+
+    @jax.jit
+    def root_hist(pay):
+        with jax.enable_x64(False):
+            hist, sums = _call(pay)
+        return hist.reshape(G * 256, 2), sums
+
+    def _call(pay):
+        return pl.pallas_call(
+            kernel,
+            grid=(nch,),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+            out_specs=[
+                pl.BlockSpec((G, 16, 16, 2),
+                             lambda i: (i * 0, i * 0, i * 0, i * 0)),
+                pl.BlockSpec((2,), lambda i: (i * 0,),
+                             memory_space=pltpu.SMEM),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((G, 16, 16, 2), F32),
+                jax.ShapeDtypeStruct((2,), F32),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((WPA, C), U32),
+                pltpu.SMEM((2,), F32),
+                pltpu.SemaphoreType.DMA,
+            ],
+            interpret=interpret,
+        )(pay)
+
+    return root_hist

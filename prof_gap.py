@@ -1,0 +1,47 @@
+"""Where does wall time go between fused-scan dispatches? (dev tool)"""
+import sys
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import lightgbm_tpu as lgb
+from bench import make_higgs_like
+
+rows = int(sys.argv[1]) if len(sys.argv) > 1 else 2_000_000
+X, y = make_higgs_like(rows)
+ds = lgb.Dataset(X, y)
+ds.construct()
+params = {"objective": "binary", "num_leaves": 255, "max_bin": 255,
+          "verbosity": -1, "metric": "none"}
+warm = lgb.train(dict(params), ds, 17, verbose_eval=False)
+warm._booster._materialize_pending()
+del warm
+
+booster = lgb.Booster(params=dict(params), train_set=ds)
+b = booster._booster
+b.planned_rounds = 32
+t0 = time.perf_counter()
+b.train_one_iter(None, None)  # batch 1 dispatch
+t1 = time.perf_counter()
+for _ in range(15):
+    b.train_one_iter(None, None)  # credit burn
+t2 = time.perf_counter()
+b.train_one_iter(None, None)  # batch 2 dispatch
+t3 = time.perf_counter()
+for _ in range(15):
+    b.train_one_iter(None, None)
+t4 = time.perf_counter()
+sc = b.train_score.score_device(0)
+jax.block_until_ready(sc)
+t5 = time.perf_counter()
+b._materialize_pending()
+t6 = time.perf_counter()
+print(f"batch1 dispatch: {t1-t0:.3f}s")
+print(f"credit iters:    {t2-t1:.3f}s")
+print(f"batch2 dispatch: {t3-t2:.3f}s")
+print(f"credit iters:    {t4-t3:.3f}s")
+print(f"block on score:  {t5-t4:.3f}s")
+print(f"materialize:     {t6-t5:.3f}s")
+print(f"total 32 iters:  {t6-t0:.3f}s -> {rows*32/(t6-t0)/1e6:.2f} Mri/s")
