@@ -197,7 +197,8 @@ def _align128(ptr):
 # ---------------------------------------------------------------------------
 
 def make_split_pass(WPA: int, NP: int, G: int, plan, nbw: int,
-                    C: int = 4096, interpret: bool = False):
+                    C: int = 8192, interpret: bool = False,
+                    _skip_hist: bool = False, _skip_pack: bool = False):
     """Build the fused per-split kernel for one payload geometry.
 
     plan: tuple of (word_row, shift, mask) per group; rows nbw..nbw+3 are
@@ -208,6 +209,7 @@ def make_split_pass(WPA: int, NP: int, G: int, plan, nbw: int,
     assert WPA % 8 == 0, "payload row count must be padded to 8"
     E = C + 128
     grad_row = nbw + 2
+    WP_LIVE = nbw + 4          # rows that carry real payload
 
     def kernel(ns, pay_in, pay_out, hist_ref, cnt_ref,
                wbuf, obuf, rbuf, slots, st, sem_r, sem_w, sem_rmw):
@@ -248,8 +250,10 @@ def make_split_pass(WPA: int, NP: int, G: int, plan, nbw: int,
             cp.start()
             cp.wait()
             sel = (lane >= dL) & (lane < dL + nL_)
-            obuf[...] = jnp.where(sel[None, :],
-                                  pltpu.roll(src_l, dL, 1), rbuf[...])
+            obuf[:WP_LIVE] = jnp.where(sel[None, :],
+                                       pltpu.roll(src_l, dL, 1),
+                                       rbuf[:WP_LIVE])
+            obuf[WP_LIVE:] = rbuf[WP_LIVE:]
             cpw = pltpu.make_async_copy(
                 obuf, pay_out.at[:, pl.ds(al, E)], sem_w)
             cpw.start()
@@ -267,8 +271,10 @@ def make_split_pass(WPA: int, NP: int, G: int, plan, nbw: int,
             cp2.start()
             cp2.wait()
             sel2 = (lane >= dR) & (lane < dR + nR_)
-            obuf[...] = jnp.where(sel2[None, :],
-                                  pltpu.roll(src_r, dR + nR_, 1), rbuf[...])
+            obuf[:WP_LIVE] = jnp.where(sel2[None, :],
+                                       pltpu.roll(src_r, dR + nR_, 1),
+                                       rbuf[:WP_LIVE])
+            obuf[WP_LIVE:] = rbuf[WP_LIVE:]
             cpw2 = pltpu.make_async_copy(
                 obuf, pay_out.at[:, pl.ds(al2, E)], sem_w)
             cpw2.start()
@@ -323,12 +329,18 @@ def make_split_pass(WPA: int, NP: int, G: int, plan, nbw: int,
             hm = (valid & (go_left == (ns[S_SMALL_L] > 0))).astype(F32)
             grad = _f32r(w[grad_row, :]) * hm
             hess = _f32r(w[grad_row + 1, :]) * hm
-            bins_g = _unpack_group_bins(w, plan)
-            _hist_accum(hist_ref, bins_g, grad, hess, G)
+            if not _skip_hist:
+                bins_g = _unpack_group_bins(w, plan)
+                _hist_accum(hist_ref, bins_g, grad, hess, G)
 
             # pack both sides into this step's FIFO slot
-            packedL = _compact(w, gl, E, to_right=False)
-            packedR = _compact(w, gr, E, to_right=True)
+            wp_live = w[:WP_LIVE]
+            if _skip_pack:
+                packedL = wp_live
+                packedR = wp_live
+            else:
+                packedL = _compact(wp_live, gl, E, to_right=False)
+                packedR = _compact(wp_live, gr, E, to_right=True)
 
             pr = jax.lax.rem(i, jnp.int32(2))
 
@@ -381,7 +393,7 @@ def make_split_pass(WPA: int, NP: int, G: int, plan, nbw: int,
                     pltpu.VMEM((WPA, E), U32),     # wbuf
                     pltpu.VMEM((WPA, E), U32),     # obuf
                     pltpu.VMEM((WPA, E), U32),     # rbuf
-                    pltpu.VMEM((4, WPA, E), U32),  # FIFO slots (2 x L/R)
+                    pltpu.VMEM((4, WP_LIVE, E), U32),  # FIFO slots (2 x L/R)
                     pltpu.SMEM((12,), I32),        # st
                     pltpu.SemaphoreType.DMA,
                     pltpu.SemaphoreType.DMA,
@@ -405,7 +417,7 @@ def make_split_pass(WPA: int, NP: int, G: int, plan, nbw: int,
 # ---------------------------------------------------------------------------
 
 def make_root_hist(WPA: int, NP: int, G: int, plan, nbw: int, n: int,
-                   C: int = 65536, interpret: bool = False):
+                   C: int = 16384, interpret: bool = False):
     """One streaming pass: padded root histogram + grad/hess totals.
 
     Returns fn(pay) -> (hist [G*256, 2] f32, sums [2] f32).
