@@ -261,17 +261,28 @@ class GBDT:
     @timer.timed("boosting::TrainMultiIterFast(launch)")
     def _train_multi_iter_fast(self, k: int) -> bool:
         """K fused iterations (one device dispatch); see
-        SerialTreeLearner.train_arrays_scan."""
+        SerialTreeLearner.train_arrays_scan / train_arrays_scan_persist."""
         learner = self.tree_learner
         init0 = self.boost_from_average(0, True)   # no-op past iteration 0
         fmasks = jnp.asarray(
             np.stack([learner.col_sampler.sample() for _ in range(k)]))
-        keys = jnp.stack([learner._next_extras().key for _ in range(k)])
-        score0 = self.train_score.score_device(0)
-        scoreK, fuK, stacked = learner.train_arrays_scan(
-            self.objective, score0, fmasks, keys, self.shrinkage_rate, k)
-        learner._feature_used_dev = fuK
-        self.train_score._score[0] = scoreK
+        if getattr(learner, "can_persist_scan", None) \
+                and learner.can_persist_scan(self.objective):
+            score0 = (self.train_score.score_device(0)
+                      if getattr(learner, "_persist_carry", None) is None
+                      else None)
+            stacked = learner.train_arrays_scan_persist(
+                self.objective, score0, fmasks, self.shrinkage_rate, k)
+            # scores live payload-ordered on the learner until synced
+            self._persist_scores_dirty = True
+        else:
+            self._sync_persist_scores()
+            keys = jnp.stack([learner._next_extras().key for _ in range(k)])
+            score0 = self.train_score.score_device(0)
+            scoreK, fuK, stacked = learner.train_arrays_scan(
+                self.objective, score0, fmasks, keys, self.shrinkage_rate, k)
+            learner._feature_used_dev = fuK
+            self.train_score._score[0] = scoreK
         start = len(self.models)
         self._pending_batches.append((start, stacked, self.shrinkage_rate,
                                       init0))
@@ -280,6 +291,16 @@ class GBDT:
         self._batch_credit = k - 1
         return False
 
+    def _sync_persist_scores(self) -> None:
+        """Write the persistent-payload carry's scores back into the
+        row-ordered score buffer (one device scatter; keeps the carry)."""
+        if not getattr(self, "_persist_scores_dirty", False):
+            return
+        sc = self.tree_learner.persist_finalize_scores()
+        if sc is not None:
+            self.train_score._score[0] = sc
+        self._persist_scores_dirty = False
+
     def _train_one_iter_fast(self) -> bool:
         if self._batch_credit > 0:
             self._batch_credit -= 1
@@ -287,6 +308,7 @@ class GBDT:
         k = self._batch_size()
         if k > 1:
             return self._train_multi_iter_fast(k)
+        self._sync_persist_scores()
         ntpi = self.num_tree_per_iteration
         init_scores = [self.boost_from_average(k, True) for k in range(ntpi)]
         g_dev, h_dev = self._compute_gradients()
@@ -320,6 +342,7 @@ class GBDT:
         no-split stop (reference stops and pops that iteration's trees —
         our device update contributed nothing for 1-leaf trees, so
         truncation reproduces the same model)."""
+        self._sync_persist_scores()
         if not self._pending and not self._pending_batches:
             return
         import jax

@@ -199,6 +199,7 @@ PARAMS: List[_P] = [
     _P("tpu_hist_dtype", str, "auto"),       # auto | f32 | bf16x2
     _P("tpu_pack_impl", str, "sort"),        # sort | matmul (partition pack)
     _P("tpu_scan_impl", str, "auto"),        # auto | xla | pallas (split scan)
+    _P("tpu_persist_scan", str, "auto"),     # auto | off (persistent-payload scan)
     _P("tpu_4bit_packing", bool, True),      # nibble-pack <=16-bin groups in HBM
 ]
 

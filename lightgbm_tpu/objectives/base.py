@@ -81,6 +81,14 @@ class ObjectiveFunction:
         device_args defaults to (label, weight) — see `_grad_args`."""
         raise NotImplementedError
 
+    def payload_grad_fn(self):
+        """Pure (score, label) -> (grad, hess) for the persistent-payload
+        scan (ops/grow_persist.py), where the LABEL rides in the payload and
+        no other per-row inputs exist. Returns None when this objective
+        needs more than the label (weights, query groups, per-iteration
+        host inputs) — those configurations take the v1 path."""
+        return None
+
     def _grad_args(self):
         """Device arrays bound as extra args of the jitted grad function."""
         import jax.numpy as jnp

@@ -79,6 +79,15 @@ class BinaryLogloss(ObjectiveFunction):
         weight = jnp.asarray(self.weight) if self.weight is not None else None
         return (jnp.asarray(self._pos_mask), weight)
 
+    def payload_grad_fn(self):
+        if self.weight is not None or not self.need_train:
+            return None
+        base = self.grad_fn()
+
+        def fn(score, label):
+            return base(score, label > 0, None)
+        return fn
+
     def boost_from_score(self, class_id):
         pos = self._pos_mask.astype(np.float64)
         if self.weight is not None:

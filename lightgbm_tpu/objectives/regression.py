@@ -46,6 +46,15 @@ class RegressionL2Loss(ObjectiveFunction):
             return diff * weight, weight
         return fn
 
+    def payload_grad_fn(self):
+        if self.weight is not None or self.sqrt:
+            return None
+        base = self.grad_fn()
+
+        def fn(score, label):
+            return base(score, label, None)
+        return fn
+
     @property
     def is_constant_hessian(self):
         return self.weight is None

@@ -1,0 +1,453 @@
+"""Persistent-payload tree grower: the TPU fast path for boosting.
+
+Builds whole boosting batches on device with ZERO per-row gathers/scatters:
+the binned rows, label, row id, gradient and hessian live in ONE transposed
+u32 payload matrix (ops/pallas_grow.py) that stays leaf-partitioned across
+an entire K-iteration scan. Replaces, for the fast-path configuration, the
+v1 partitioned grower (ops/grow.py grow_tree_partitioned) plus the
+row-ordered score/gradient plumbing around it:
+
+  * per split: ONE fused kernel call (split_pass) does the partition,
+    the smaller-child histogram and the exact left-count — the reference's
+    DataPartition::Split + ConstructHistograms pair
+    (src/treelearner/serial_tree_learner.cpp:690-775);
+  * per-leaf state, best-split candidates and split records are single
+    [L, K] f32 matrices — two dynamic row writes per split instead of the
+    ~56 separate [L]-array updates of v1;
+  * histograms use the padded [G, 256] layout end to end, so the dense
+    scan kernel input is a reshape (no gather) and the leaf-wise
+    subtraction trick (hist_larger = parent - smaller,
+    serial_tree_learner.cpp:290-298) stays [TBp, 2] arithmetic;
+  * the score update is segment-ordered: leaves partition the payload into
+    contiguous segments, so "score += leaf_output[leaf_of_row]" becomes a
+    255-element scatter of value deltas at segment starts + one cumsum —
+    no [N] gather by leaf id (GBDT::UpdateScore, src/boosting/gbdt.cpp:459);
+  * gradients are computed in payload order from the label row; the score
+    vector itself is a payload row (it must permute with the rows), and
+    scores return to row order ONCE per batch via a single scatter through
+    the carried row ids.
+
+Numerics: f32 accumulation everywhere (the reference GPU learner's
+gpu_use_dp=false trade); trees match the v1 f32 grower up to f32 summation
+order. Gated by treelearner.serial.can_persist_scan — anything outside the
+fast path (categoricals, EFB bundles, bagging, weights, monotone, f64)
+takes the v1 path.
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .grow import TreeArrays
+from .pallas_grow import (N_SCALARS, S_DB, S_DL, S_MASK, S_MT, S_NB, S_NCH,
+                          S_NL, S_S0, S_SH, S_SMALL_L, S_THR, S_WG,
+                          make_root_hist, make_split_pass)
+from .pallas_scan import ScanLayout, scan_pair
+from .split import K_MIN_SCORE, SplitParams
+
+I32 = jnp.int32
+U32 = jnp.uint32
+F32 = jnp.float32
+BOOL = jnp.bool_
+
+# leaf-state matrix columns
+LS_SG, LS_SH, LS_CNT, LS_VAL, LS_DEPTH, LS_START, LS_NROWS, LS_PAD = range(8)
+# best-candidate matrix columns
+(BC_GAIN, BC_FEAT, BC_THR, BC_DL, BC_LSG, BC_LSH, BC_RSG, BC_RSH,
+ BC_LCNT, BC_RCNT, BC_LOUT, BC_ROUT) = range(12)
+# split-record matrix columns
+(TR_LEAF, TR_FEAT, TR_THR, TR_DL, TR_GAIN, TR_IVAL, TR_ICNT, TR_PAD) = range(8)
+
+
+class PersistAssets(NamedTuple):
+    """Per-dataset device arrays + static geometry for the persist path."""
+    pay0: jnp.ndarray          # [WPA, NP] u32 (bins words + label + rid)
+    dec_word: jnp.ndarray      # [F] i32 payload word row per feature
+    dec_shift: jnp.ndarray     # [F] i32
+    dec_mask: jnp.ndarray      # [F] i32
+    nb: jnp.ndarray            # [F] i32 per-feature bin count
+    mt: jnp.ndarray            # [F] i32 missing type
+    db: jnp.ndarray            # [F] i32 default bin
+    geometry: tuple            # (WPA, NP, G, plan, nbw, n, C, CR) static
+
+
+def build_assets(dataset, labels: np.ndarray, C: int = 8192,
+                 CR: int = 16384) -> PersistAssets:
+    """Host-side payload construction (once per dataset).
+
+    dataset: BinnedDataset with groups == features, widths <= 256.
+    """
+    n = int(dataset.num_data)
+    G = len(dataset.groups)
+    binned = dataset.binned          # [n, Gs] narrow int storage
+    packed = getattr(dataset, "device_packed", False)
+    if packed:
+        raise NotImplementedError  # plan below assumes byte storage
+    Gs = binned.shape[1]
+    nbw = (Gs + 3) // 4
+    WP = nbw + 5                 # + label, rid, grad, hess, score
+    WPA = ((WP + 7) // 8) * 8
+    NP = max(((n + 127) // 128 + 2) * 128 + C + 256,
+             ((n + CR - 1) // CR) * CR)
+    pay = np.zeros((WPA, NP), np.uint32)
+    plan = []
+    col = binned.astype(np.uint32)
+    for g in range(G):
+        sc = g
+        w, sh = sc // 4, (sc % 4) * 8
+        np.bitwise_or(pay[w, :n], col[:, g] << np.uint32(sh),
+                      out=pay[w, :n])
+        plan.append((w, sh, 255))
+    pay[nbw, :n] = np.ascontiguousarray(
+        labels.astype(np.float32)).view(np.uint32)
+    pay[nbw + 1, :n] = np.arange(n, dtype=np.uint32)
+    pay[nbw + 1, n:] = n                     # sentinel: dropped at finalize
+    F = dataset.num_features
+    sc = np.arange(F, dtype=np.int32)
+    return PersistAssets(
+        pay0=jnp.asarray(pay),
+        dec_word=jnp.asarray(sc // 4),
+        dec_shift=jnp.asarray((sc % 4) * 8),
+        dec_mask=jnp.asarray(np.full(F, 255, np.int32)),
+        nb=jnp.asarray((dataset.bin_end - dataset.bin_start)
+                       .astype(np.int32)),
+        mt=jnp.asarray(dataset.missing_type_arr.astype(np.int32)),
+        db=jnp.asarray(dataset.default_bin.astype(np.int32)),
+        geometry=(WPA, NP, G, tuple(plan), nbw, n, C, CR),
+    )
+
+
+class _PState(NamedTuple):
+    s: jnp.ndarray
+    done: jnp.ndarray
+    pay: jnp.ndarray           # [WPA, NP] u32
+    leaf_hist: jnp.ndarray     # [L, TBp, 2] f32
+    lstate: jnp.ndarray        # [L, 8] f32
+    best: jnp.ndarray          # [L, 12] f32
+    tree: jnp.ndarray          # [L, 8] f32
+
+
+def make_persist_grower(assets: PersistAssets, meta, gc,
+                        interpret: bool = False):
+    """Build grow/score/gradient closures for one dataset + grow config.
+
+    gc: GrowConfig (num_leaves, max_depth, num_features, scan_width used).
+    Returns an object with .grow(pay, params, fmask), .apply_scores,
+    .fill_grad, .finalize_scores.
+    """
+    WPA, NP, G, plan, nbw, n, C, CR = assets.geometry
+    F = gc.num_features
+    L = gc.num_leaves
+    W = 256
+    TBp = G * W
+    split_pass = make_split_pass(WPA, NP, G, plan, nbw, C=C,
+                                 interpret=interpret)
+    root_hist = make_root_hist(WPA, NP, G, plan, nbw, n, C=CR,
+                               interpret=interpret)
+    grad_row = nbw + 2
+    score_row = nbw + 4
+
+    # padded meta for the dense scan: feature f's window at flat f*W
+    pad_meta = meta._replace(
+        bin_start=jnp.arange(F, dtype=I32) * W,
+        bin_end=jnp.arange(F, dtype=I32) * W + assets.nb)
+
+    def eval_pair(leaf_hist, rows, sgs, shs, cnts, depth_child, params,
+                  layout: ScanLayout):
+        """Best splits for two leaves from the padded hist tensor.
+
+        rows: [2] i32 leaf-hist row ids; sgs/shs/cnts: [2] f32 sums.
+        Returns a [2, 12] f32 best-candidate matrix.
+        """
+        hist2 = leaf_hist[rows]                     # [2, TBp, 2]
+        dense = hist2.reshape(2, G, W, 2)
+        if layout.Fp > G:
+            dense = jnp.pad(dense, ((0, 0), (0, layout.Fp - G),
+                                    (0, 0), (0, 0)))
+        gb = dense[..., 0]
+        hb = dense[..., 1]
+        p32 = params.cast(F32)
+        sg = sgs.astype(F32)
+        sh = shs.astype(F32) + F32(2e-15)
+        cnt = cnts.astype(F32)
+        l2 = p32.lambda_l2.astype(F32)
+        cf = cnt / sh
+        gain_shift = sg * sg / (sh + l2)
+        mgs = gain_shift + p32.min_gain_to_split.astype(F32)
+        md = p32.min_data_in_leaf.astype(F32)
+        mh = p32.min_sum_hessian_in_leaf.astype(F32)
+        scal = jnp.stack([
+            sg, sh, cnt, cf,
+            jnp.broadcast_to(md, (2,)), jnp.broadcast_to(mh, (2,)),
+            mgs, jnp.broadcast_to(l2, (2,))], axis=1)
+        out = scan_pair(scal, gb, hb, layout.keep_r, layout.keep_f,
+                        layout.valid_r, layout.valid_f, layout.aux,
+                        interpret=interpret)
+        gains = out[:, 0, :]
+        best_f = jnp.argmax(gains, axis=1)
+
+        def take(row):
+            return jnp.take_along_axis(out[:, row, :], best_f[:, None],
+                                       axis=1)[:, 0]
+        gain_b = take(0)
+        t_b = take(1)
+        use_f_b = take(2) > 0.5
+        lg = take(3)
+        lh = take(4)
+        lc = take(5)
+        best_valid = jnp.isfinite(gain_b)
+        if gc.max_depth > 0:
+            best_valid &= depth_child < gc.max_depth
+        rg = sg - lg
+        rh = sh - lh
+        rc = cnt - lc
+        lo = -lg / (lh + l2)
+        ro = -rg / (rh + l2)
+        default_left = (~use_f_b) & (~layout.forced_right[best_f])
+        neg = jnp.asarray(K_MIN_SCORE, F32)
+        return jnp.stack([
+            jnp.where(best_valid, gain_b, neg),
+            jnp.where(best_valid, best_f.astype(F32), -1.0),
+            jnp.where(best_valid, t_b, 0.0),
+            jnp.where(best_valid, default_left, True).astype(F32),
+            lg, lh, rg, rh,
+            jnp.floor(lc + 0.5), jnp.floor(rc + 0.5),
+            lo, ro], axis=1)                        # [2, 12]
+
+    def grow(pay, params: SplitParams, fmask):
+        """Grow one tree in place; returns (pay', lstate, tree, num_leaves,
+        root_value)."""
+        layout = ScanLayout(pad_meta, fmask, F, W, TBp)
+        rhist, sums = root_hist(pay)
+        sum_grad = sums[0]
+        sum_hess = sums[1]
+        root_cnt = jnp.asarray(n, F32)
+        p32 = params.cast(F32)
+        root_out = -sum_grad / (sum_hess + p32.lambda_l2.astype(F32))
+
+        leaf_hist = jnp.zeros((L, TBp, 2), F32).at[0].set(rhist)
+        lstate = jnp.zeros((L, 8), F32).at[0].set(
+            jnp.asarray([0, 0, 0, 0, 0, 0, 0, 0], F32)
+            .at[LS_SG].set(sum_grad).at[LS_SH].set(sum_hess)
+            .at[LS_CNT].set(root_cnt).at[LS_VAL].set(root_out)
+            .at[LS_NROWS].set(jnp.asarray(n, F32)))
+        pair0 = eval_pair(leaf_hist, jnp.asarray([0, 0], I32),
+                          jnp.stack([sum_grad, sum_grad]),
+                          jnp.stack([sum_hess, sum_hess]),
+                          jnp.stack([root_cnt, root_cnt]),
+                          jnp.asarray(0, F32), params, layout)
+        best = jnp.full((L, 12), K_MIN_SCORE, F32).at[0].set(pair0[0])
+        # depth gate for the root itself: eval_pair checked depth 1
+        state = _PState(
+            s=jnp.asarray(1, I32),
+            done=jnp.asarray(False),
+            pay=pay,
+            leaf_hist=leaf_hist,
+            lstate=lstate,
+            best=best,
+            tree=jnp.zeros((L, 8), F32),
+        )
+
+        def cond(st: _PState):
+            return (~st.done) & (st.s < L)
+
+        def body(st: _PState) -> _PState:
+            gains = st.best[:, BC_GAIN]
+            l = jnp.argmax(gains).astype(I32)
+            do = gains[l] > 0.0
+            s = st.s
+            bl = st.best[l]
+            ls = st.lstate[l]
+            f = jnp.maximum(bl[BC_FEAT].astype(I32), 0)
+            smaller_is_left = bl[BC_LCNT] <= bl[BC_RCNT]
+            s0 = ls[LS_START].astype(I32)
+            n_l = jnp.where(do, ls[LS_NROWS].astype(I32), 0)
+            scal = jnp.zeros((N_SCALARS,), I32)
+            scal = scal.at[S_NCH].set((n_l + C - 1) // C)
+            scal = scal.at[S_S0].set(s0)
+            scal = scal.at[S_NL].set(n_l)
+            scal = scal.at[S_WG].set(assets.dec_word[f])
+            scal = scal.at[S_SH].set(assets.dec_shift[f])
+            scal = scal.at[S_MASK].set(assets.dec_mask[f])
+            scal = scal.at[S_NB].set(assets.nb[f])
+            scal = scal.at[S_MT].set(assets.mt[f])
+            scal = scal.at[S_DB].set(assets.db[f])
+            scal = scal.at[S_THR].set(bl[BC_THR].astype(I32))
+            scal = scal.at[S_DL].set(bl[BC_DL].astype(I32))
+            scal = scal.at[S_SMALL_L].set(smaller_is_left.astype(I32))
+            pay, hist_sm, n_left = split_pass(st.pay, scal)
+            left_cnt = n_left
+            right_cnt = n_l - left_cnt
+
+            parent_hist = st.leaf_hist[l]
+            hist_larger = parent_hist - hist_sm
+            hist_left = jnp.where(smaller_is_left, hist_sm, hist_larger)
+            hist_right = jnp.where(smaller_is_left, hist_larger, hist_sm)
+            val_l, val_r = jax.lax.optimization_barrier(
+                (jnp.where(do, hist_left, parent_hist),
+                 jnp.where(do, hist_right, jnp.zeros_like(hist_right))))
+            leaf_hist = st.leaf_hist.at[l].set(val_l).at[s].set(val_r)
+
+            depth_child = ls[LS_DEPTH] + 1.0
+            pair = eval_pair(
+                leaf_hist, jnp.stack([l, s]),
+                jnp.stack([bl[BC_LSG], bl[BC_RSG]]),
+                jnp.stack([bl[BC_LSH], bl[BC_RSH]]),
+                jnp.stack([left_cnt, right_cnt]).astype(F32),
+                depth_child, params, layout)
+            best = st.best.at[l].set(jnp.where(do, pair[0], st.best[l])) \
+                          .at[s].set(jnp.where(do, pair[1], st.best[s]))
+
+            row_l = jnp.zeros((8,), F32) \
+                .at[LS_SG].set(bl[BC_LSG]).at[LS_SH].set(bl[BC_LSH]) \
+                .at[LS_CNT].set(left_cnt.astype(F32)) \
+                .at[LS_VAL].set(bl[BC_LOUT]) \
+                .at[LS_DEPTH].set(depth_child) \
+                .at[LS_START].set(s0.astype(F32)) \
+                .at[LS_NROWS].set(left_cnt.astype(F32))
+            row_s = jnp.zeros((8,), F32) \
+                .at[LS_SG].set(bl[BC_RSG]).at[LS_SH].set(bl[BC_RSH]) \
+                .at[LS_CNT].set(right_cnt.astype(F32)) \
+                .at[LS_VAL].set(bl[BC_ROUT]) \
+                .at[LS_DEPTH].set(depth_child) \
+                .at[LS_START].set((s0 + left_cnt).astype(F32)) \
+                .at[LS_NROWS].set(right_cnt.astype(F32))
+            lstate = st.lstate.at[l].set(jnp.where(do, row_l, st.lstate[l])) \
+                              .at[s].set(jnp.where(do, row_s, st.lstate[s]))
+
+            rec = jnp.zeros((8,), F32) \
+                .at[TR_LEAF].set(l.astype(F32)) \
+                .at[TR_FEAT].set(bl[BC_FEAT]) \
+                .at[TR_THR].set(bl[BC_THR]) \
+                .at[TR_DL].set(bl[BC_DL]) \
+                .at[TR_GAIN].set(bl[BC_GAIN]) \
+                .at[TR_IVAL].set(ls[LS_VAL]) \
+                .at[TR_ICNT].set(ls[LS_CNT])
+            tree = st.tree.at[s - 1].set(
+                jnp.where(do, rec, st.tree[s - 1]))
+            return st._replace(
+                s=s + do.astype(I32), done=~do, pay=pay,
+                leaf_hist=leaf_hist, lstate=lstate, best=best, tree=tree)
+
+        final = jax.lax.while_loop(cond, body, state)
+        return final.pay, final.lstate, final.tree, final.s, root_out
+
+    def to_tree_arrays(lstate, tree, num_leaves) -> TreeArrays:
+        """The host-facing TreeArrays pytree (models.tree.Tree input)."""
+        ft = F32
+        return TreeArrays(
+            num_leaves=num_leaves,
+            split_leaf=tree[:L - 1, TR_LEAF].astype(I32),
+            split_feature=jnp.where(
+                jnp.arange(L - 1, dtype=I32) < num_leaves - 1,
+                tree[:L - 1, TR_FEAT].astype(I32), -1),
+            threshold=tree[:L - 1, TR_THR].astype(I32),
+            default_left=tree[:L - 1, TR_DL] > 0.5,
+            gain=tree[:L - 1, TR_GAIN].astype(ft),
+            is_cat=jnp.zeros((L - 1,), BOOL),
+            cat_mask=jnp.zeros((L - 1, gc.cat_width), BOOL),
+            internal_value=tree[:L - 1, TR_IVAL].astype(ft),
+            internal_count=tree[:L - 1, TR_ICNT].astype(I32),
+            leaf_value=lstate[:, LS_VAL].astype(ft),
+            leaf_count=lstate[:, LS_CNT].astype(I32),
+            leaf_weight=lstate[:, LS_SH].astype(ft),
+            row_leaf=jnp.zeros((0,), I32),
+        )
+
+    def apply_scores(pay, lstate, num_leaves, shrink):
+        """score-row += shrink * leaf_value[leaf_of_position] via segment
+        deltas: leaves partition positions into contiguous runs."""
+        starts = lstate[:, LS_START]
+        nrows = lstate[:, LS_NROWS]
+        vals = lstate[:, LS_VAL] * shrink.astype(F32)
+        live = (nrows > 0) & (jnp.arange(L, dtype=I32) < num_leaves)
+        key = jnp.where(live, starts, jnp.inf)
+        order = jnp.argsort(key)
+        sv = vals[order]
+        live_o = live[order]
+        prev = jnp.concatenate([jnp.zeros((1,), F32), sv[:-1]])
+        delta = jnp.where(live_o, sv - prev, 0.0)
+        pos = jnp.where(live_o, starts[order].astype(I32), NP)
+        upd = jnp.zeros((NP,), F32).at[pos].add(delta, mode="drop")
+        cum = jnp.cumsum(upd)
+        sc = jax.lax.bitcast_convert_type(pay[score_row], F32)
+        sc = sc + jnp.where(num_leaves > 1, cum, 0.0)
+        return jax.lax.dynamic_update_slice(
+            pay, jax.lax.bitcast_convert_type(sc[None, :], U32),
+            (jnp.asarray(score_row, I32), jnp.asarray(0, I32)))
+
+    def fill_grad(pay, payload_grad_fn):
+        label = jax.lax.bitcast_convert_type(pay[nbw], F32)
+        score = jax.lax.bitcast_convert_type(pay[score_row], F32)
+        g, h = payload_grad_fn(score, label)
+        live = jnp.arange(NP, dtype=I32) < n
+        g = jnp.where(live, g.astype(F32), 0.0)
+        h = jnp.where(live, h.astype(F32), 0.0)
+        gh = jax.lax.bitcast_convert_type(jnp.stack([g, h]), U32)
+        return jax.lax.dynamic_update_slice(
+            pay, gh, (jnp.asarray(grad_row, I32), jnp.asarray(0, I32)))
+
+    def finalize_scores(pay):
+        """Payload-order scores -> row order (one scatter per batch)."""
+        rid = pay[nbw + 1].astype(I32)
+        score = jax.lax.bitcast_convert_type(pay[score_row], F32)
+        return jnp.zeros((n,), F32).at[rid].set(
+            score, mode="drop", unique_indices=True)
+
+    def set_scores(pay, score_pos):
+        """Write a payload-order score vector into the score row."""
+        return jax.lax.dynamic_update_slice(
+            pay, jax.lax.bitcast_convert_type(
+                score_pos.astype(F32)[None, :], U32),
+            (jnp.asarray(score_row, I32), jnp.asarray(0, I32)))
+
+    @jax.jit
+    def init_carry(pay, score0_row):
+        """Fresh carry from the pristine payload + a row-ordered score
+        vector ([n], any float dtype). One fused device program — the
+        eager op chain costs seconds of dispatch latency under remote
+        TPU."""
+        sc = jnp.zeros((NP,), F32).at[:n].set(score0_row.astype(F32))
+        return set_scores(pay, sc)
+
+    class _Grower:
+        pass
+
+    gr = _Grower()
+    gr.grow = grow
+    gr.to_tree_arrays = to_tree_arrays
+    gr.apply_scores = apply_scores
+    gr.fill_grad = fill_grad
+    gr.finalize_scores = finalize_scores
+    gr.set_scores = set_scores
+    gr.init_carry = init_carry
+    gr.NP = NP
+    gr.n = n
+    gr.nbw = nbw
+    return gr
+
+
+def make_scan_driver(gr, gc, k: int, grad_fn):
+    """K fused boosting iterations over the persistent payload.
+
+    grad_fn(score_pos, label_pos) -> (grad, hess) is baked statically.
+    Returns fn(pay, score_pos, fmasks [k, F], params, shrink) ->
+    (pay', score_pos', stacked TreeArrays).
+    """
+
+    @jax.jit
+    def run(pay, fmasks, params, shrink):
+        def body(pay, fmask):
+            pay = gr.fill_grad(pay, grad_fn)
+            pay, lstate, tree, nl, _root = gr.grow(pay, params, fmask)
+            pay = gr.apply_scores(pay, lstate, nl, shrink)
+            out = gr.to_tree_arrays(lstate, tree, nl)
+            return pay, out
+        payK, stacked = jax.lax.scan(body, pay, fmasks, length=k)
+        return payK, stacked
+
+    return run

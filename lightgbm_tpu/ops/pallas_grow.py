@@ -12,6 +12,8 @@ Mosaic kernels over a single transposed payload matrix:
      row  nbw+1      row id    (u32; positions -> original rows at the end)
      row  nbw+2      gradient  (f32 bitcast; rewritten every iteration)
      row  nbw+3      hessian   (f32 bitcast)
+     row  nbw+4      score     (f32 bitcast; permutes WITH the rows, so the
+                                boosting state follows the partition)
 
   * split_pass (one call per split, DYNAMIC grid over chunks): streams the
     splitting leaf's contiguous payload segment once, and per chunk
@@ -209,7 +211,7 @@ def make_split_pass(WPA: int, NP: int, G: int, plan, nbw: int,
     assert WPA % 8 == 0, "payload row count must be padded to 8"
     E = C + 128
     grad_row = nbw + 2
-    WP_LIVE = nbw + 4          # rows that carry real payload
+    WP_LIVE = nbw + 5          # payload rows incl. the score row
 
     def kernel(ns, pay_in, pay_out, hist_ref, cnt_ref,
                wbuf, obuf, rbuf, slots, st, sem_r, sem_w, sem_rmw):

@@ -307,6 +307,86 @@ class SerialTreeLearner:
             ex = ex._replace(feature_used=self._feature_used_dev)
         return ex
 
+    # -- persistent-payload fast path (ops/grow_persist.py) -------------
+    def can_persist_scan(self, objective) -> bool:
+        """True when the whole K-iteration scan can run on the persistent
+        transposed payload (fused split kernel, no per-row gathers).
+        Requirements beyond the Pallas-scan fast path: numerical features
+        only, one feature per group (no EFB bundles), <= 256 bins, label-
+        only objective, unweighted, single device, n in [PARTITION_MIN_ROWS,
+        2^24)."""
+        import jax
+        from ..ops.pallas_grow import HAS_PALLAS
+        ds = self.dataset
+        gc = self.grow_config
+        if not (HAS_PALLAS and jax.default_backend() in ("tpu", "axon")):
+            return False
+        opt = str(getattr(self.config, "tpu_persist_scan", "auto")).lower()
+        if opt in ("false", "0", "off"):
+            return False
+        widths = (ds.bin_end - ds.bin_start) if ds.num_features else None
+        return (gc.scan_impl == "pallas"
+                and not gc.packed_4bit
+                and self.cat_layout.cat_feature.shape[0] == 0
+                and ds.num_features > 0
+                and len(ds.groups) == ds.num_features
+                and not bool(np.any(ds.needs_fix))
+                and int(widths.max()) <= 256
+                and ds.num_data >= PARTITION_MIN_ROWS
+                and ds.num_data < (1 << 24)
+                and self._axis_name is None
+                and objective is not None
+                and objective.payload_grad_fn() is not None
+                and ds.metadata.weight is None)
+
+    def _persist_cached(self, objective, k: int):
+        from ..ops.grow_persist import (build_assets, make_persist_grower,
+                                        make_scan_driver)
+        cache = getattr(self.dataset, "_persist_cache", None)
+        if cache is None:
+            cache = self.dataset._persist_cache = {}
+        assets = cache.get("assets")
+        if assets is None:
+            assets = build_assets(self.dataset, self.dataset.metadata.label)
+            cache["assets"] = assets
+        gkey = ("grower", self.grow_config)
+        gr = cache.get(gkey)
+        if gr is None:
+            gr = make_persist_grower(assets, self.meta, self.grow_config)
+            cache[gkey] = gr
+        dkey = ("driver", k, self.grow_config,
+                objective.static_fingerprint())
+        driver = cache.get(dkey)
+        if driver is None:
+            driver = make_scan_driver(gr, self.grow_config, k,
+                                      objective.payload_grad_fn())
+            cache[dkey] = driver
+        return assets, gr, driver
+
+    def train_arrays_scan_persist(self, objective, score0, fmasks,
+                                  shrink: float, k: int):
+        """K iterations on the persistent payload. Keeps (pay, score_pos)
+        as a device carry on this learner; scores return to row order only
+        in persist_finalize_scores()."""
+        assets, gr, driver = self._persist_cached(objective, k)
+        pay = getattr(self, "_persist_carry", None)
+        if pay is None:
+            pay = gr.init_carry(assets.pay0, jnp.asarray(score0))
+        pay, stacked = driver(pay, jnp.asarray(fmasks), self.params,
+                              jnp.asarray(shrink, jnp.float64))
+        self._persist_carry = pay
+        self._persist_gr = gr
+        return stacked
+
+    def persist_finalize_scores(self):
+        """Row-ordered f64 scores from the live carry (None when no carry).
+        Keeps the carry alive — finalize is a pure read."""
+        pay = getattr(self, "_persist_carry", None)
+        if pay is None:
+            return None
+        gr = self._persist_gr
+        return gr.finalize_scores(pay).astype(jnp.float64)
+
     def train_arrays_scan(self, objective, score0, fmasks, keys,
                           shrink: float, k: int):
         """K boosting iterations in ONE jitted lax.scan: gradients ->
