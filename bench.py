@@ -40,8 +40,8 @@ def make_higgs_like(n_rows: int, n_features: int = 28, seed: int = 7):
 
 
 def main():
-    n_rows = int(os.environ.get("BENCH_ROWS", 4_000_000))
-    n_iters = int(os.environ.get("BENCH_ITERS", 32))
+    n_rows = int(os.environ.get("BENCH_ROWS", 10_500_000))
+    n_iters = int(os.environ.get("BENCH_ITERS", 500))
     num_leaves = int(os.environ.get("BENCH_LEAVES", 255))
     max_bin = int(os.environ.get("BENCH_MAX_BIN", 255))
 

@@ -439,7 +439,7 @@ def make_scan_driver(gr, gc, k: int, grad_fn):
     (pay', score_pos', stacked TreeArrays).
     """
 
-    @jax.jit
+    @functools.partial(jax.jit, donate_argnums=(0,))
     def run(pay, fmasks, params, shrink):
         def body(pay, fmask):
             pay = gr.fill_grad(pay, grad_fn)
