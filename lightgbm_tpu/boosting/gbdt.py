@@ -74,10 +74,6 @@ class GBDT:
     # ------------------------------------------------------------------
     def init(self, config: Config, train_data, objective,
              training_metrics=()) -> None:
-        if str(config.forcedsplits_filename):
-            Log.fatal("forcedsplits_filename is not supported on "
-                      "device_type=tpu yet (ForceSplits, "
-                      "serial_tree_learner.cpp:411)")
         if float(config.histogram_pool_size) > 0:
             Log.warning("histogram_pool_size is ignored on device_type=tpu: "
                         "all per-leaf histograms stay HBM-resident "

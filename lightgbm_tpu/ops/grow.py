@@ -41,11 +41,11 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from .split import (CatLayout, F64, I32, K_MIN_SCORE, FeatureMeta,
-                    SplitCandidate, SplitParams, _leaf_output_unconstrained,
-                    acc_dtype, find_best_split_categorical,
-                    find_best_split_numerical, fix_histogram,
-                    merge_candidates)
+from .split import (CatLayout, F64, I32, K_EPSILON, K_MIN_SCORE, FeatureMeta,
+                    SplitCandidate, SplitParams, _leaf_gain,
+                    _leaf_output_unconstrained, acc_dtype,
+                    find_best_split_categorical, find_best_split_numerical,
+                    fix_histogram, merge_candidates)
 
 
 def empty_cat_layout(cat_width: int = 1) -> CatLayout:
@@ -86,6 +86,7 @@ class GrowConfig(NamedTuple):
     scan_impl: str = "xla"       # "xla" | "pallas" fused split-scan kernel
     #                            # (fast path only; resolve_scan_impl gates)
     packed_4bit: bool = False    # layout.bins nibble-packs <=16-bin groups
+    n_forced: int = 0            # forcedsplits_filename node count
 
 
 class GrowExtras(NamedTuple):
@@ -174,6 +175,7 @@ class TreeArrays(NamedTuple):
 class _LoopState(NamedTuple):
     s: jnp.ndarray              # next split index (== current num_leaves)
     done: jnp.ndarray           # bool
+    fidx: jnp.ndarray           # i32 next forced-split index
     row_leaf: jnp.ndarray       # [N] i32
     leaf_hist: jnp.ndarray      # [L, TB, 2] f32
     leaf_sum_grad: jnp.ndarray  # [L] ft
@@ -566,6 +568,109 @@ def _hist_chunk_contract(bv, vc, W, hist_dtype):
                       preferred_element_type=jnp.float32)
 
 
+class ForcedInfo(NamedTuple):
+    """forcedsplits_filename JSON flattened to application order (BFS).
+
+    thr holds the kernel-convention threshold (bins <= thr go left) =
+    reference threshold bin T - 1 (the reference sends bins >= T right,
+    GatherInfoForThresholdNumerical, feature_histogram.hpp:488-571).
+    """
+    leaf: jnp.ndarray       # [K] i32 leaf the forced split applies to
+    feature: jnp.ndarray    # [K] i32 inner feature
+    thr: jnp.ndarray        # [K] i32 local-bin threshold (ours)
+
+
+def empty_forced() -> ForcedInfo:
+    z = jnp.zeros((1,), I32)
+    return ForcedInfo(leaf=z, feature=z, thr=z)
+
+
+def _forced_candidate(hist, sum_grad, sum_hess, cnt, f, thr, meta,
+                      params, gc: GrowConfig, ft):
+    """SplitCandidate for a FORCED (feature, threshold) on one leaf.
+
+    The reference walks the histogram top-down summing bins >= T into the
+    right side, skipping the zero bin (MissingType::Zero) and starting
+    below the NaN bin (MissingType::NaN), always default_left
+    (GatherInfoForThresholdNumerical, feature_histogram.hpp:488-571);
+    invalid forced splits (gain <= min_gain_shift) come back with
+    K_MIN_SCORE gain and the caller aborts further forcing.
+    """
+    p = params.cast(ft)
+    sum_grad = sum_grad.astype(ft)
+    sum_hess = sum_hess.astype(ft)
+    W = gc.scan_width if gc.scan_width > 0 else 256
+    start = meta.bin_start[f]
+    nb = meta.bin_end[f] - start
+    mt = meta.missing_type[f]
+    db = meta.default_bin[f]
+    win = jax.lax.dynamic_slice(
+        hist, (start, jnp.asarray(0, I32)), (W, 2)).astype(ft)
+    w = jnp.arange(W, dtype=I32)
+    T = thr + 1
+    right = (w >= jnp.maximum(T, 1)) & (w < nb)
+    right &= ~((mt == 1) & (w == db))           # zero bin rides left
+    right &= ~((mt == 2) & (w == nb - 1))       # NaN bin rides left
+    m = right.astype(ft)
+    rg = jnp.sum(win[:, 0] * m)
+    rh = jnp.sum(win[:, 1] * m) + ft(K_EPSILON)
+    cf = cnt.astype(ft) / sum_hess
+    rc = jnp.floor(jnp.sum(win[:, 1] * m) * cf + 0.5).astype(I32)
+    lg = sum_grad - rg
+    lh = sum_hess - rh
+    lc = cnt - rc
+    l1, l2, mds = p.lambda_l1, p.lambda_l2, p.max_delta_step
+    gain_shift = _leaf_gain(sum_grad, sum_hess, l1, l2, mds)
+    min_gain_shift = gain_shift + p.min_gain_to_split
+    cur = _leaf_gain(lg, lh, l1, l2, mds) + _leaf_gain(rg, rh, l1, l2, mds)
+    ok = jnp.isfinite(cur) & (cur > min_gain_shift)
+    neg = jnp.asarray(K_MIN_SCORE, ft)
+    return SplitCandidate(
+        gain=jnp.where(ok, cur - min_gain_shift, neg),
+        feature=f.astype(I32),
+        threshold=thr.astype(I32),
+        default_left=jnp.asarray(True),
+        left_output=_leaf_output_unconstrained(lg, lh, l1, l2, mds),
+        right_output=_leaf_output_unconstrained(
+            sum_grad - lg, sum_hess - lh, l1, l2, mds),
+        left_sum_grad=lg, left_sum_hess=lh - ft(K_EPSILON),
+        right_sum_grad=sum_grad - lg,
+        right_sum_hess=sum_hess - lh - ft(K_EPSILON),
+        left_count=lc, right_count=cnt - lc,
+        is_cat=jnp.asarray(False),
+        cat_mask=jnp.zeros((gc.cat_width,), BOOL))
+
+
+def _select_with_forced(st_fidx, best, leaf_hist, leaf_sum_grad,
+                        leaf_sum_hess, leaf_count, forced: ForcedInfo,
+                        meta, params, gc: GrowConfig, ft):
+    """(l, cand, do, done, fidx') honoring the forced-split phase.
+
+    While fidx < n_forced the forced entry overrides leaf choice and
+    candidate; a failed forced split aborts the remaining forced list
+    (reference abort_last_forced_split) and growth continues normally.
+    """
+    l_best = jnp.argmax(best.gain).astype(I32)
+    cand_best = jax.tree.map(lambda a: a[l_best], best)
+    if gc.n_forced == 0:
+        do = cand_best.gain > 0.0
+        return l_best, cand_best, do, ~do, st_fidx
+    in_forced = st_fidx < gc.n_forced
+    fi = jnp.clip(st_fidx, 0, gc.n_forced - 1)
+    l = jnp.where(in_forced, forced.leaf[fi], l_best)
+    fc = _forced_candidate(
+        leaf_hist[l], leaf_sum_grad[l], leaf_sum_hess[l], leaf_count[l],
+        forced.feature[fi], forced.thr[fi], meta, params, gc, ft)
+    cand = jax.tree.map(
+        lambda a, b: jnp.where(in_forced, a, b), fc,
+        jax.tree.map(lambda a: a[l], best))
+    do = cand.gain > 0.0
+    done = jnp.where(in_forced, False, ~do)
+    fidx = jnp.where(in_forced,
+                     jnp.where(do, st_fidx + 1, gc.n_forced), st_fidx)
+    return l, cand, do, done, fidx
+
+
 def _split_keys(extras: GrowExtras, s):
     """Raw [2, 2]u32 child keys for split s (root uses tag 0; children use
     2s / 2s+1, disjoint because s >= 1)."""
@@ -619,7 +724,8 @@ def grow_tree(layout: DataLayout, grad: jnp.ndarray, hess: jnp.ndarray,
               bag_mask: jnp.ndarray, meta: FeatureMeta, params: SplitParams,
               feature_mask: jnp.ndarray, fix: FixInfo, gc: GrowConfig,
               axis_name=None, cat: CatLayout = None,
-              extras: GrowExtras = None) -> TreeArrays:
+              extras: GrowExtras = None,
+              forced: ForcedInfo = None) -> TreeArrays:
     """Grow one tree. grad/hess must already include bagging/GOSS weighting
     and be zero on padded/out-of-bag rows; bag_mask marks in-bag valid rows.
 
@@ -632,6 +738,8 @@ def grow_tree(layout: DataLayout, grad: jnp.ndarray, hess: jnp.ndarray,
         cat = empty_cat_layout(gc.cat_width)
     if extras is None:
         extras = default_extras(gc.num_features)
+    if forced is None:
+        forced = empty_forced()
     ft = acc_dtype(gc.use_dp)
     n = layout.bins.shape[0]
     L = gc.num_leaves
@@ -687,6 +795,7 @@ def grow_tree(layout: DataLayout, grad: jnp.ndarray, hess: jnp.ndarray,
     state = _LoopState(
         s=jnp.asarray(1, I32),
         done=jnp.asarray(False),
+        fidx=jnp.asarray(0, I32),
         row_leaf=jnp.zeros((n,), I32),
         leaf_hist=jnp.zeros((L, TB, 2), jnp.float32).at[0].set(root_hist),
         leaf_sum_grad=jnp.zeros((L,), ft).at[0].set(sum_grad),
@@ -717,11 +826,10 @@ def grow_tree(layout: DataLayout, grad: jnp.ndarray, hess: jnp.ndarray,
         return (~st.done) & (st.s < L)
 
     def body(st: _LoopState) -> _LoopState:
-        l = jnp.argmax(st.best.gain).astype(I32)   # first max = smallest leaf
-        gain = st.best.gain[l]
-        do = gain > 0.0
+        l, cand, do, done_new, fidx = _select_with_forced(
+            st.fidx, st.best, st.leaf_hist, st.leaf_sum_grad,
+            st.leaf_sum_hess, st.leaf_count, forced, meta, params, gc, ft)
         s = st.s
-        cand = jax.tree.map(lambda a: a[l], st.best)
         f = jnp.maximum(cand.feature, 0)
         g = layout.group_of[f]
         # per-row local bin of feature f (EFB fallback to most_freq)
@@ -815,7 +923,8 @@ def grow_tree(layout: DataLayout, grad: jnp.ndarray, hess: jnp.ndarray,
         tree = _record_split(st.tree, s - 1, do, l, cand, st.leaf_value[l],
                              st.leaf_count[l], s)
         return st._replace(
-            s=s + do.astype(I32), done=~do, row_leaf=row_leaf,
+            s=s + do.astype(I32), done=done_new, fidx=fidx,
+            row_leaf=row_leaf,
             leaf_hist=leaf_hist, leaf_sum_grad=leaf_sum_grad,
             leaf_sum_hess=leaf_sum_hess, leaf_count=leaf_count,
             leaf_value=leaf_value, leaf_depth=leaf_depth,
@@ -862,6 +971,7 @@ def grow_tree(layout: DataLayout, grad: jnp.ndarray, hess: jnp.ndarray,
 class _PartState(NamedTuple):
     s: jnp.ndarray
     done: jnp.ndarray
+    fidx: jnp.ndarray
     binsP: jnp.ndarray          # [N + PAD, G]  leaf-sorted bins
     gradP: jnp.ndarray          # [N + PAD] f32
     hessP: jnp.ndarray          # [N + PAD] f32
@@ -1017,7 +1127,8 @@ def grow_tree_partitioned(layout: DataLayout, grad: jnp.ndarray,
                           feature_mask: jnp.ndarray, fix: FixInfo,
                           gc: GrowConfig, gw_global=None, axis_name=None,
                           cat: CatLayout = None,
-                          extras: GrowExtras = None) -> TreeArrays:
+                          extras: GrowExtras = None,
+                          forced: ForcedInfo = None) -> TreeArrays:
     """Leaf-wise growth with O(rows-in-child) per-split work and no gathers.
 
     Same trees as grow_tree (up to f32 summation order); see the section
@@ -1029,6 +1140,8 @@ def grow_tree_partitioned(layout: DataLayout, grad: jnp.ndarray,
         cat = empty_cat_layout(gc.cat_width)
     if extras is None:
         extras = default_extras(gc.num_features)
+    if forced is None:
+        forced = empty_forced()
     ft = acc_dtype(gc.use_dp)
     n = layout.bins.shape[0]
     L = gc.num_leaves
@@ -1115,6 +1228,7 @@ def grow_tree_partitioned(layout: DataLayout, grad: jnp.ndarray,
     state = _PartState(
         s=jnp.asarray(1, I32),
         done=jnp.asarray(False),
+        fidx=jnp.asarray(0, I32),
         binsP=binsP0,
         gradP=gradP0,
         hessP=hessP0,
@@ -1150,11 +1264,10 @@ def grow_tree_partitioned(layout: DataLayout, grad: jnp.ndarray,
         return (~st.done) & (st.s < L)
 
     def body(st: _PartState) -> _PartState:
-        l = jnp.argmax(st.best.gain).astype(I32)
-        gain = st.best.gain[l]
-        do = gain > 0.0
+        l, cand, do, done_new, fidx = _select_with_forced(
+            st.fidx, st.best, st.leaf_hist, st.leaf_sum_grad,
+            st.leaf_sum_hess, st.leaf_count, forced, meta, params, gc, ft)
         s = st.s
-        cand = jax.tree.map(lambda a: a[l], st.best)
         s0 = st.leaf_start[l]
         n_l = jnp.where(do, st.leaf_nrows[l], 0)
         f = jnp.maximum(cand.feature, 0)
@@ -1362,7 +1475,7 @@ def grow_tree_partitioned(layout: DataLayout, grad: jnp.ndarray,
         tree = _record_split(st.tree, s - 1, do, l, cand, st.leaf_value[l],
                              st.leaf_count[l], s)
         return st._replace(
-            s=s + do.astype(I32), done=~do,
+            s=s + do.astype(I32), done=done_new, fidx=fidx,
             binsP=binsP, gradP=gradP, hessP=hessP, rbP=rbP,
             posL=posL, binsS=binsS, gradS=gradS, hessS=hessS, rbS=rbS,
             leaf_start=leaf_start, leaf_nrows=leaf_nrows,

@@ -21,9 +21,9 @@ import jax.numpy as jnp
 
 from ..config import Config
 from ..models.tree import Tree
-from ..ops.grow import (DataLayout, FixInfo, GrowConfig, GrowExtras,
-                        default_extras, empty_cat_layout, grow_tree,
-                        grow_tree_partitioned)
+from ..ops.grow import (DataLayout, FixInfo, ForcedInfo, GrowConfig,
+                        GrowExtras, default_extras, empty_cat_layout,
+                        empty_forced, grow_tree, grow_tree_partitioned)
 from ..ops.split import CatLayout, FeatureMeta, SplitParams
 from ..utils.log import Log
 
@@ -180,6 +180,54 @@ def build_cat_layout(dataset, cat_width: int) -> CatLayout:
                      num_bin=jnp.asarray(nbins))
 
 
+def _parse_forced_splits(config: Config, dataset):
+    """forcedsplits_filename JSON -> BFS-ordered (leaf, inner_feature,
+    threshold_bin) triples (SerialTreeLearner::ForceSplits,
+    src/treelearner/serial_tree_learner.cpp:411-521). The right child of
+    the k-th applied split receives leaf id k+1 — the same deterministic
+    numbering the device grower assigns, so leaf targets are precomputable
+    host-side. Thresholds convert value -> bin via BinMapper::ValueToBin
+    (dataset.h:597) and shift by -1 into the kernel's bins<=thr
+    convention."""
+    fname = str(config.forcedsplits_filename)
+    if not fname:
+        return None
+    import json as _json
+    from collections import deque
+    with open(fname) as fh:
+        spec = _json.load(fh)
+    if not isinstance(spec, dict) or "feature" not in spec:
+        return None
+    inner_of = {real: i for i, real in enumerate(dataset.used_features)}
+    out = []
+    q = deque([(spec, 0)])
+    max_splits = max(int(config.num_leaves) - 1, 0)
+    while q and len(out) < max_splits:
+        node, leaf = q.popleft()
+        real = int(node["feature"])
+        if real not in inner_of:
+            Log.fatal("forcedsplits_filename: split on unused feature %d"
+                      % real)
+        inner = inner_of[real]
+        if bool(dataset.is_categorical[inner]):
+            Log.fatal("forcedsplits_filename: categorical forced splits "
+                      "are not supported on device_type=tpu")
+        mapper = dataset.bin_mappers[real]
+        T = int(mapper.value_to_bin(
+            np.asarray([float(node["threshold"])]))[0])
+        out.append((leaf, inner, T - 1))
+        s = len(out)
+        left = node.get("left")
+        right = node.get("right")
+        if isinstance(left, dict) and "feature" in left \
+                and "threshold" in left:
+            q.append((left, leaf))
+        if isinstance(right, dict) and "feature" in right \
+                and "threshold" in right:
+            q.append((right, s))
+    return out or None
+
+
 class ColSampler:
     """feature_fraction by-tree sampling (col_sampler.hpp:17-160); the
     by-node sample runs inside the device grower (GrowConfig.bynode_k)."""
@@ -259,6 +307,15 @@ class SerialTreeLearner:
             use_cegb=_cegb_enabled(config),
             packed_4bit=bool(getattr(dataset, "device_packed", False)),
         )
+        forced_list = _parse_forced_splits(config, dataset)
+        if forced_list:
+            gc_kwargs["n_forced"] = len(forced_list)
+            self.forced = ForcedInfo(
+                leaf=jnp.asarray([x[0] for x in forced_list], jnp.int32),
+                feature=jnp.asarray([x[1] for x in forced_list], jnp.int32),
+                thr=jnp.asarray([x[2] for x in forced_list], jnp.int32))
+        else:
+            self.forced = empty_forced()
         self.grow_config = GrowConfig(
             scan_impl=resolve_scan_impl(config, gc_kwargs), **gc_kwargs)
         self._extras_base = _build_extras(config, dataset)
@@ -282,13 +339,13 @@ class SerialTreeLearner:
                 self.layout, grad, hess, bag_mask, self.meta, self.params,
                 fmask, self.fix, self.grow_config,
                 gw_global=self.gw_global, axis_name=self._axis_name,
-                cat=self.cat_layout, extras=extras)
+                cat=self.cat_layout, extras=extras, forced=self.forced)
         else:
             arrays, fu = grow_tree(
                 self.layout, grad, hess, bag_mask, self.meta,
                 self.params, fmask, self.fix, self.grow_config,
                 axis_name=self._axis_name, cat=self.cat_layout,
-                extras=extras)
+                extras=extras, forced=self.forced)
         self._feature_used_dev = fu
         return arrays
 
@@ -326,6 +383,7 @@ class SerialTreeLearner:
             return False
         widths = (ds.bin_end - ds.bin_start) if ds.num_features else None
         return (gc.scan_impl == "pallas"
+                and gc.n_forced == 0
                 and not gc.packed_4bit
                 and self.cat_layout.cat_feature.shape[0] == 0
                 and ds.num_features > 0
@@ -425,7 +483,7 @@ class SerialTreeLearner:
             # HIGGS-scale row counts
             @jax.jit
             def run(layout, score0, fu0, fmasks, keys, base_extras,
-                    shrink_t, meta, params, fix, gargs):
+                    shrink_t, meta, params, fix, gargs, forced):
                 bag = jnp.ones(n, bool)
 
                 def body(carry, per):
@@ -438,11 +496,11 @@ class SerialTreeLearner:
                     if use_part:
                         arrays, fu2 = grow_tree_partitioned(
                             layout, g, h, bag, meta, params, fmask, fix, gc,
-                            gw_global=gw, cat=cat, extras=ex)
+                            gw_global=gw, cat=cat, extras=ex, forced=forced)
                     else:
                         arrays, fu2 = grow_tree(
                             layout, g, h, bag, meta, params, fmask, fix, gc,
-                            cat=cat, extras=ex)
+                            cat=cat, extras=ex, forced=forced)
                     upd = arrays.leaf_value.astype(jnp.float64)[
                         arrays.row_leaf] * shrink_t
                     score2 = score + jnp.where(arrays.num_leaves > 1, upd,
@@ -461,7 +519,8 @@ class SerialTreeLearner:
                else base.feature_used)
         return fn(self.layout, score0, fu0, fmasks, keys, base,
                   jnp.asarray(shrink, jnp.float64),
-                  self.meta, self.params, self.fix, objective._grad_args())
+                  self.meta, self.params, self.fix, objective._grad_args(),
+                  self.forced)
 
     def train(self, grad: jnp.ndarray, hess: jnp.ndarray,
               bag_mask: jnp.ndarray) -> Tuple[Tree, jnp.ndarray]:
