@@ -125,12 +125,35 @@ class Dataset:
         if isinstance(self.data, (str, bytes)):
             return self._construct_from_path(str(self.data))
         cfg = params_to_config(self.params)
-        X, names, cat_idx = _data_to_2d(self.data, self.feature_name,
-                                        self.categorical_feature)
         ref_inner = None
         if self.reference is not None:
             self.reference.construct()
             ref_inner = self.reference._inner
+        if _SCIPY and _sp.issparse(self.data):
+            # streaming CSR ingest: never densifies the full matrix
+            # (dense-on-device is a TPU design choice; dense-on-host at
+            # ingest would need ~n*features*8 bytes)
+            cat_idx = (list(self.categorical_feature)
+                       if isinstance(self.categorical_feature, (list, tuple))
+                       else ())
+            self._inner = BinnedDataset.from_sparse(
+                self.data, cfg,
+                categorical_features=cat_idx,
+                label=self.label,
+                weight=self.weight,
+                group=self.group,
+                init_score=self.init_score,
+                feature_names=(list(self.feature_name)
+                               if isinstance(self.feature_name, (list, tuple))
+                               else None),
+                reference=ref_inner,
+            )
+            self._raw_X = None if self.free_raw_data else self.data
+            if self.free_raw_data:
+                self.data = None
+            return self
+        X, names, cat_idx = _data_to_2d(self.data, self.feature_name,
+                                        self.categorical_feature)
         self._inner = BinnedDataset.from_matrix(
             X, cfg,
             categorical_features=cat_idx,

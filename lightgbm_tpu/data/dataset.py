@@ -96,6 +96,18 @@ class Metadata:
         return (sums / np.diff(qb)).astype(np.float32)
 
 
+class SampleCols:
+    """Per-feature sampled (values, row-indices) — the reference's own
+    sample representation (DatasetLoader::CostructFromSampleData takes
+    sample_values/sample_indices per feature, src/io/dataset_loader.cpp:528)
+    — so sparse inputs sample without densifying."""
+
+    def __init__(self, values, rows, total):
+        self.values = values
+        self.rows = rows
+        self.total = total
+
+
 def _sample_data(X: np.ndarray, sample_cnt: int, seed: int) -> np.ndarray:
     n = X.shape[0]
     if n <= sample_cnt:
@@ -221,16 +233,22 @@ class BinnedDataset:
         (DatasetLoader::CostructFromSampleData, dataset_loader.cpp:528)."""
         ds = self
         nf = ds.num_total_features
-        total_sample = sample.shape[0]
+        total_sample = (sample.total if isinstance(sample, SampleCols)
+                        else sample.shape[0])
         filter_cnt = max(
             int(config.min_data_in_leaf * total_sample / max(n, 1)), 1)
 
         forced: Dict[int, List[float]] = _load_forced_bins(
             config.forcedbins_filename, nf)
 
+        def _col(f):
+            if isinstance(sample, SampleCols):
+                return sample.values[f]
+            return sample[:, f]
+
         ds.bin_mappers = []
         for f in range(nf):
-            col = sample[:, f]
+            col = _col(f)
             nonzero = col[(np.abs(col) > kZeroThreshold) | np.isnan(col)]
             m = BinMapper()
             m.find_bin(
@@ -254,10 +272,15 @@ class BinnedDataset:
         if config.enable_bundle and n_inner > 1:
             nz_masks = []
             for i, f in enumerate(ds.used_features):
-                col = sample[:, f]
                 mapper = inner_mappers[i]
-                bins = mapper.value_to_bin(col)
-                nz_masks.append(bins != mapper.most_freq_bin)
+                if isinstance(sample, SampleCols):
+                    bins = mapper.value_to_bin(sample.values[f])
+                    mask = np.zeros(total_sample, bool)
+                    mask[sample.rows[f][bins != mapper.most_freq_bin]] = True
+                    nz_masks.append(mask)
+                else:
+                    bins = mapper.value_to_bin(sample[:, f])
+                    nz_masks.append(bins != mapper.most_freq_bin)
             order = sorted(range(n_inner),
                            key=lambda i: -int(nz_masks[i].sum()))
             max_conflict = int(total_sample / 10000
@@ -270,6 +293,72 @@ class BinnedDataset:
             ds.groups = [[i] for i in range(n_inner)]
 
         ds._finish_layout(config)
+
+    @classmethod
+    def from_sparse(cls, X, config: Config,
+                    categorical_features: Sequence[int] = (),
+                    label=None, weight=None, group=None, init_score=None,
+                    feature_names: Optional[List[str]] = None,
+                    reference: Optional["BinnedDataset"] = None,
+                    ) -> "BinnedDataset":
+        """Streaming CSR ingest: sample -> bin mappers -> chunked binning,
+        never materializing the dense [n, features] matrix (the reference
+        streams sparse rows through Dataset::PushOneRow the same way,
+        src/io/dataset_loader.cpp:714-1004). Host memory is bounded by one
+        row chunk (~256 MB dense) + the binned output [n, groups]."""
+        import scipy.sparse as sp
+        X = X.tocsr()
+        X.sort_indices()
+        n, nf = X.shape
+        ds = cls()
+        ds.num_data = n
+        ds.num_total_features = nf
+        ds.feature_names = (feature_names
+                            or ["Column_%d" % i for i in range(nf)])
+        ds.metadata = Metadata(n)
+        if label is not None:
+            ds.metadata.set_label(label)
+        ds.metadata.set_weight(weight)
+        ds.metadata.set_query(group)
+        ds.metadata.set_init_score(init_score)
+
+        if reference is None:
+            cat_set = set(int(c) for c in categorical_features)
+            cnt = int(config.bin_construct_sample_cnt)
+            if n <= cnt:
+                samp = X
+                total = n
+            else:
+                rng = np.random.default_rng(config.data_random_seed)
+                idx = rng.choice(n, size=cnt, replace=False)
+                idx.sort()
+                samp = X[idx]
+                total = cnt
+            sc = samp.tocsc()
+            vals = [sc.data[sc.indptr[f]:sc.indptr[f + 1]].astype(np.float64)
+                    for f in range(nf)]
+            rows = [sc.indices[sc.indptr[f]:sc.indptr[f + 1]]
+                    for f in range(nf)]
+            with timer.scope("io::FindBinAndGroup"):
+                ds._construct_from_sample(SampleCols(vals, rows, total),
+                                          n, config, cat_set)
+        else:
+            ds.bin_mappers = reference.bin_mappers
+            ds.used_features = reference.used_features
+            ds.inner_of = reference.inner_of
+            ds.groups = reference.groups
+            ds._finish_layout_like(reference)
+
+        with timer.scope("io::PushSparse(binning)"):
+            G = len(ds.groups)
+            binned = np.zeros((n, G), dtype=ds._bin_dtype())
+            chunk = max(1024, int(2 ** 25 / max(nf, 1)))
+            for a in range(0, n, chunk):
+                b = min(a + chunk, n)
+                Xc = np.asarray(X[a:b].todense(), dtype=np.float64)
+                ds._bin_rows(Xc, binned[a:b])
+            ds.binned = binned
+        return ds
 
     @classmethod
     def from_matrix_with_mappers(cls, X, config: Config,
