@@ -313,6 +313,8 @@ def LGBM_DatasetSetFeatureNames(handle, feature_names, num_feature) -> int:
 
 
 def LGBM_DatasetGetFeatureNames(handle, out_strs, num_feature) -> int:
+    """v2.3.2 ABI parity: caller-allocated, unbounded buffers — see
+    LGBM_BoosterGetFeatureNames."""
     cd = _get(handle)
     names = cd.construct().get_feature_name()
     _write_out(num_feature, len(names), ctypes.c_int32)
@@ -580,6 +582,10 @@ def LGBM_BoosterGetEvalNames(handle, out_len, out_strs) -> int:
 
 
 def LGBM_BoosterGetFeatureNames(handle, out_len, out_strs) -> int:
+    """v2.3.2 ABI parity: out_strs must point at caller-allocated buffers
+    each large enough for the NUL-terminated name (the reference added
+    buffer_len bounds only in later releases); shorter buffers overflow
+    exactly as they do against the reference .so."""
     names = _get(handle).booster.feature_name()
     _write_out(out_len, len(names), ctypes.c_int32)
     ptrs = _view(out_strs, np.uint64, len(names))

@@ -286,7 +286,10 @@ class Tree:
                              for w in self.cat_threshold)
             buf.append("  static const unsigned int cat_threshold[] = {%s};"
                        % words)
-            buf.append("  long int_fval = 0;")
+            # long long: on LLP64 targets plain long is 32-bit and would
+            # truncate categories >= 2^31 differently from the
+            # Python predictor's int64 semantics
+            buf.append("  long long int_fval = 0;")
         buf.append("  double fval = 0.0;")
 
         def leaf(i):
@@ -308,7 +311,7 @@ class Tree:
                 nbits = (b1 - b0) * 32
                 mt = (dt >> 2) & 3
                 lines.append("%sint_fval = std::isnan(fval) ? 0 "
-                             ": (long)fval;" % pad)
+                             ": (long long)fval;" % pad)
                 nan_guard = ("!std::isnan(fval) && " if mt == 2 else "")
                 lines.append(
                     "%sif (%s(std::isnan(fval) || fval >= 0.0) && "

@@ -44,8 +44,11 @@ static inline int32_t value_to_bin(
     const double* bounds, const int32_t* lut, int64_t lut_size) {
   if (is_cat) {
     if (std::isnan(v) || !std::isfinite(v)) return num_bin - 1;
+    // range-check BEFORE the cast: float->int conversion of a value
+    // outside int64's range is UB in C++, while the numpy fallback's
+    // astype(int64) saturates and maps to num_bin - 1
+    if (!(v >= 0.0 && v < static_cast<double>(lut_size))) return num_bin - 1;
     int64_t iv = static_cast<int64_t>(v);  // toward zero, like numpy astype
-    if (iv < 0 || iv >= lut_size) return num_bin - 1;
     return lut[iv];
   }
   if (std::isnan(v)) {
