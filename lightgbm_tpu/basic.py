@@ -623,6 +623,18 @@ class Booster:
                 pred_contrib: bool = False, data_has_header: bool = False,
                 is_reshape: bool = True, start_iteration: int = 0, **kwargs):
         X, _, _ = _data_to_2d(data)
+        # reference LGBM_BoosterPredict* shape guard (predict_disable_
+        # shape_check): feature-count mismatch is fatal unless disabled
+        nf_model = self._booster.max_feature_idx + 1
+        if X.shape[1] != nf_model and not bool(kwargs.get(
+                "predict_disable_shape_check",
+                self.params.get("predict_disable_shape_check", False))):
+            raise LightGBMError(
+                "The number of features in data (%d) is not the same as "
+                "it was in training data (%d).\nYou can set "
+                "predict_disable_shape_check=true to discard this error, "
+                "but please be aware what you are doing." % (X.shape[1],
+                                                             nf_model))
         if num_iteration is None:
             num_iteration = (self.best_iteration
                              if self.best_iteration > 0 else -1)

@@ -249,6 +249,18 @@ class GBDT:
                 and type(self.tree_learner) is SerialTreeLearner):
             return 1
         remaining = self.planned_rounds - self._rounds_done + 1
+        # the v1 fused scan exists to amortize dispatch latency; when a
+        # single tree is already seconds of device work the batch buys
+        # nothing and a 16-iteration program runs long enough to trip the
+        # remote worker's watchdog (observed as a worker crash at
+        # MS-LTR scale). The persistent-payload path has its own driver
+        # and keeps batching at any size.
+        learner = self.tree_learner
+        persist = (getattr(learner, "can_persist_scan", None)
+                   and learner.can_persist_scan(self.objective))
+        if not persist and self.num_data * max(
+                self.train_data.num_features, 1) > 150_000_000:
+            return 1
         # fixed batch size: every distinct k compiles its own scan program,
         # so the tail runs as single iterations instead of a second compile
         K = 16
@@ -330,6 +342,11 @@ class GBDT:
                                   self.shrinkage_rate, init_scores[k]))
             self.models.append(None)
         self.iter += 1
+        # bound the async backlog: each pending tree pins its [N] row_leaf
+        # (and its dispatch chain) on device; at HIGGS/MS-LTR scale hundreds
+        # of unsynced single-iteration dispatches overrun the remote worker
+        if len(self._pending) >= 8:
+            self._materialize_pending()
         return False
 
     @timer.timed("boosting::MaterializePending(D2H+wait)")

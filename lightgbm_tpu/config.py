@@ -200,6 +200,11 @@ PARAMS: List[_P] = [
     _P("tpu_pack_impl", str, "sort"),        # sort | matmul (partition pack)
     _P("tpu_scan_impl", str, "auto"),        # auto | xla | pallas (split scan)
     _P("tpu_persist_scan", str, "auto"),     # auto | off (persistent-payload scan)
+    _P("feature_pre_filter", bool, True),
+    _P("force_col_wise", bool, False),       # CPU memory-layout hint; no-op
+    _P("force_row_wise", bool, False),       # on TPU (HBM layout is fixed)
+    _P("max_bin_by_feature", list, []),
+    _P("predict_disable_shape_check", bool, False),
     _P("tpu_4bit_packing", bool, True),      # nibble-pack <=16-bin groups in HBM
 ]
 

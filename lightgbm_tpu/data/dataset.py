@@ -246,14 +246,20 @@ class BinnedDataset:
                 return sample.values[f]
             return sample[:, f]
 
+        mbbf = list(config.max_bin_by_feature)
+        if mbbf and len(mbbf) != nf:
+            Log.fatal("max_bin_by_feature has %d entries for %d features"
+                      % (len(mbbf), nf))
         ds.bin_mappers = []
         for f in range(nf):
             col = _col(f)
             nonzero = col[(np.abs(col) > kZeroThreshold) | np.isnan(col)]
             m = BinMapper()
             m.find_bin(
-                nonzero, total_sample, config.max_bin, config.min_data_in_bin,
-                filter_cnt, pre_filter=True,
+                nonzero, total_sample,
+                int(mbbf[f]) if mbbf else config.max_bin,
+                config.min_data_in_bin,
+                filter_cnt, pre_filter=bool(config.feature_pre_filter),
                 bin_type=BinType.CATEGORICAL if f in cat_set else BinType.NUMERICAL,
                 use_missing=config.use_missing,
                 zero_as_missing=config.zero_as_missing,

@@ -145,6 +145,7 @@ def distributed_bin_mappers(
     from ..data.dataset import _load_forced_bins
     forced = _load_forced_bins(config.forcedbins_filename, nf)
 
+    mbbf = list(config.max_bin_by_feature)
     start, length = _feature_slice(rank, world, nf)
     states = []
     for f in range(start, start + length):
@@ -152,8 +153,10 @@ def distributed_bin_mappers(
         nonzero = col[(np.abs(col) > kZeroThreshold) | np.isnan(col)]
         m = BinMapper()
         m.find_bin(
-            nonzero, total_sample, config.max_bin, config.min_data_in_bin,
-            filter_cnt, pre_filter=True,
+            nonzero, total_sample,
+            int(mbbf[f]) if mbbf else config.max_bin,
+            config.min_data_in_bin,
+            filter_cnt, pre_filter=bool(config.feature_pre_filter),
             bin_type=(BinType.CATEGORICAL if f in cat_set
                       else BinType.NUMERICAL),
             use_missing=config.use_missing,
