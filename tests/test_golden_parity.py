@@ -117,6 +117,37 @@ def test_reference_model_text_interop():
     np.testing.assert_allclose(ours, ref, rtol=0, atol=1e-14)
 
 
+# Per-iteration training logloss of the reference binary on
+# binary_classification with the deterministic overrides (metric_freq=1,
+# is_provide_training_metric=true) — the use_dp/f64 CPU path must track
+# these within 0.1%: a gain-formula or count-rounding regression flips
+# this red while the loose final-metric gates above would absorb it.
+GOLDEN_PER_ITER = {1: 0.666147, 10: 0.539339, 50: 0.331962, 100: 0.20777}
+
+
+def test_per_iteration_training_parity():
+    exdir = os.path.join(EXAMPLES, "binary_classification")
+    cfg = Config.from_cli_args(["config=" + os.path.join(exdir, "train.conf")])
+    params = cfg.to_dict()
+    params.update({"feature_fraction": 1.0, "bagging_fraction": 1.0,
+                   "bagging_freq": 0, "verbosity": -1,
+                   "enable_bundle": False, "metric": "binary_logloss"})
+    for drop in ("data", "valid", "valid_data", "output_model", "task",
+                 "machine_list_filename", "config"):
+        params.pop(drop, None)
+    train = lgb.Dataset(os.path.join(exdir, cfg.data), params=dict(params))
+    evals = {}
+    lgb.train(params, train, num_boost_round=100, valid_sets=[train],
+              valid_names=["training"],
+              callbacks=[lgb.record_evaluation(evals)], verbose_eval=False)
+    series = evals["training"]["binary_logloss"]
+    for it, ref in GOLDEN_PER_ITER.items():
+        got = series[it - 1]
+        assert abs(got - ref) <= 1e-3 * abs(ref) + 1e-6, (
+            "iteration %d training logloss: ours=%.6f ref=%.6f "
+            "(>0.1%% divergence)" % (it, got, ref))
+
+
 @pytest.mark.parametrize("name", sorted(GOLDEN))
 def test_example_parity(name):
     ours = _train_example(name)
