@@ -449,7 +449,8 @@ def _eval_children(eval_leaf, leaf_hist, l, s, cand, left_cnt, right_cnt,
     return cand_l, cand_r
 
 
-def _make_eval_pair_fused(meta, params, feature_mask, cat, gc: GrowConfig):
+def _make_eval_pair_fused(meta, params, feature_mask, cat, gc: GrowConfig,
+                          axis_name=None, feat_nb=None, num_groups: int = 1):
     """Fused Pallas scan-pair evaluator (fast path; see ops/pallas_scan.py).
 
     Built once per tree: dense gather layout + direction masks precompute
@@ -457,21 +458,51 @@ def _make_eval_pair_fused(meta, params, feature_mask, cat, gc: GrowConfig):
     scalar assembly instead of the ~300-op XLA pair scan. Falls back never
     — the CALLER gates on gc.scan_impl (resolve_scan_impl checks every
     semantic knob this kernel does not implement).
+
+    Parallel modes (the reference's three learners):
+      * "data": hist arrives psum-reduced — plain kernel scan;
+      * "feature": the shard scans only its round-robin-owned features
+        (ownership folded into the layout masks) and the per-shard winners
+        merge via SyncUpGlobalBestSplit (all_gather + deterministic merge,
+        parallel_tree_learner.h:190);
+      * "voting": the kernel runs TWICE per child — a local scan with
+        1/S-scaled thresholds proposes top_k features, the global vote
+        picks 2k winners, only their bins psum, then the real scan runs
+        with win-masked validity (voting_parallel_tree_learner.cpp:153-344;
+        EFB-bundled datasets fall back to the XLA path — the fix-up runs
+        inside the voting eval there).
     """
     from .pallas_scan import ScanLayout, scan_pair
     F = gc.num_features
+    if gc.parallel_mode == "feature" and axis_name is not None:
+        shard = jax.lax.axis_index(axis_name)
+        owned = (jnp.arange(F, dtype=I32)
+                 % jax.lax.psum(1, axis_name)) == shard
+        feature_mask = feature_mask & owned
     layout = ScanLayout(meta, feature_mask, F, gc.scan_width, gc.total_bins)
     p32 = params.cast(jnp.float32)
     f32 = jnp.float32
     # CPU (tests) runs the kernel in interpreter mode — the equivalence
     # suite compares it against the XLA scan there
     interpret = jax.default_backend() not in ("tpu", "axon")
+    voting = gc.parallel_mode == "voting" and axis_name is not None
+
+    def _scan(gb, hb, scal, valid_r, valid_f):
+        return scan_pair(scal, gb, hb, layout.keep_r, layout.keep_f,
+                         valid_r, valid_f, layout.aux, interpret=interpret)
+
+    def _build_scal(sg, sh, cnt, md, mh):
+        l2 = p32.lambda_l2.astype(f32)
+        cf = cnt / sh
+        gain_shift = sg * sg / (sh + l2)
+        mgs = gain_shift + p32.min_gain_to_split.astype(f32)
+        return jnp.stack([
+            sg, sh, cnt, cf,
+            jnp.broadcast_to(md, (2,)), jnp.broadcast_to(mh, (2,)),
+            mgs, jnp.broadcast_to(l2, (2,))], axis=1)  # [2, 8]
 
     def eval_pair(leaf_hist, l, s, cand, left_cnt, right_cnt, depth_child):
         hist2 = leaf_hist[jnp.stack([l, s])]          # [2, TB, 2]
-        dense = hist2[:, layout.gidx, :]              # [2, Fp, Wp, 2]
-        gb = dense[..., 0]
-        hb = dense[..., 1]
         sg = jnp.stack([cand.left_sum_grad,
                         cand.right_sum_grad]).astype(f32)
         # the XLA scan's sum_hess_adj = sum_hess + 2*kEpsilon: NOT a no-op
@@ -479,19 +510,43 @@ def _make_eval_pair_fused(meta, params, feature_mask, cat, gc: GrowConfig):
         sh = jnp.stack([cand.left_sum_hess,
                         cand.right_sum_hess]).astype(f32) + f32(2e-15)
         cnt = jnp.stack([left_cnt, right_cnt]).astype(f32)
-        l2 = p32.lambda_l2.astype(f32)
-        cf = cnt / sh
-        gain_shift = sg * sg / (sh + l2)
-        mgs = gain_shift + p32.min_gain_to_split.astype(f32)
         md = p32.min_data_in_leaf.astype(f32)
         mh = p32.min_sum_hessian_in_leaf.astype(f32)
-        scal = jnp.stack([
-            sg, sh, cnt, cf,
-            jnp.broadcast_to(md, (2,)), jnp.broadcast_to(mh, (2,)),
-            mgs, jnp.broadcast_to(l2, (2,))], axis=1)  # [2, 8]
-        out = scan_pair(scal, gb, hb, layout.keep_r, layout.keep_f,
-                        layout.valid_r, layout.valid_f, layout.aux,
-                        interpret=interpret)
+        l2 = p32.lambda_l2.astype(f32)
+        valid_r, valid_f = layout.valid_r, layout.valid_f
+        if voting:
+            # ---- PV-tree: local scan -> vote -> selective psum ----------
+            S = jax.lax.psum(jnp.asarray(1.0, f32), axis_name)
+            ng = f32(max(num_groups, 1))
+            local_sg = jnp.sum(hist2[:, :, 0], axis=1) / ng        # [2]
+            local_sh = jnp.sum(hist2[:, :, 1], axis=1) / ng + f32(2e-15)
+            local_cnt = jnp.round(local_sh * cnt
+                                  / jnp.maximum(sh, f32(1e-12)))
+            dense_l = hist2[:, layout.gidx, :]
+            scal_l = _build_scal(local_sg, local_sh, local_cnt,
+                                 jnp.maximum(jnp.floor(md / S), 1.0),
+                                 mh / S)
+            out_l = _scan(dense_l[..., 0], dense_l[..., 1], scal_l,
+                          valid_r, valid_f)
+            hist_new = []
+            win_masks = []
+            for c in range(2):
+                hist_c, win = _voting_reduce_hist(
+                    hist2[c], out_l[c, 0, :F], meta, gc, axis_name,
+                    feat_nb, meta.is_categorical)
+                hist_new.append(hist_c)
+                win_masks.append(win)
+            hist2 = jnp.stack(hist_new)
+            winp = jnp.pad(jnp.stack(win_masks),
+                           ((0, 0), (0, layout.Fp - F)))    # [2, Fp]
+            valid_r = valid_r[None] * winp[:, :, None].astype(f32)
+            valid_f = valid_f[None] * winp[:, :, None].astype(f32)
+
+        dense = hist2[:, layout.gidx, :]              # [2, Fp, Wp, 2]
+        gb = dense[..., 0]
+        hb = dense[..., 1]
+        scal = _build_scal(sg, sh, cnt, md, mh)
+        out = _scan(gb, hb, scal, valid_r, valid_f)
         gains = out[:, 0, :]                          # [2, Fp]
         best_f = jnp.argmax(gains, axis=1)            # [2] first max
 
@@ -538,6 +593,9 @@ def _make_eval_pair_fused(meta, params, feature_mask, cat, gc: GrowConfig):
                 cat_pair = cat_pair._replace(gain=jnp.where(
                     depth_child < gc.max_depth, cat_pair.gain, neg))
             pair = merge_candidates(pair, cat_pair)
+        if gc.parallel_mode == "feature" and axis_name is not None:
+            # SyncUpGlobalBestSplit (parallel_tree_learner.h:190)
+            pair = _merge_cands_over_shards(pair, axis_name)
         cand_l = jax.tree.map(lambda a: a[0], pair)
         cand_r = jax.tree.map(lambda a: a[1], pair)
         return cand_l, cand_r
@@ -785,9 +843,10 @@ def grow_tree(layout: DataLayout, grad: jnp.ndarray, hess: jnp.ndarray,
                                 extras, feat_nb_e, axis_name=axis_name,
                                 fix=fix)
     eval_leaf.set_num_groups(layout.group_offset.shape[0])
-    eval_pair_fused = (_make_eval_pair_fused(meta, params, feature_mask,
-                                             cat, gc)
-                       if gc.scan_impl == "pallas" else None)
+    eval_pair_fused = (_make_eval_pair_fused(
+        meta, params, feature_mask, cat, gc, axis_name=axis_name,
+        feat_nb=feat_nb_e, num_groups=layout.group_offset.shape[0])
+        if gc.scan_impl == "pallas" else None)
     root_out = _leaf_output_unconstrained(
         sum_grad, sum_hess, pcast.lambda_l1, pcast.lambda_l2,
         pcast.max_delta_step)
@@ -1211,9 +1270,10 @@ def grow_tree_partitioned(layout: DataLayout, grad: jnp.ndarray,
                                 extras, feat_nb, axis_name=axis_name,
                                 fix=fix)
     eval_leaf.set_num_groups(layout.group_offset.shape[0])
-    eval_pair_fused = (_make_eval_pair_fused(meta, params, feature_mask,
-                                             cat, gc)
-                       if gc.scan_impl == "pallas" else None)
+    eval_pair_fused = (_make_eval_pair_fused(
+        meta, params, feature_mask, cat, gc, axis_name=axis_name,
+        feat_nb=feat_nb, num_groups=layout.group_offset.shape[0])
+        if gc.scan_impl == "pallas" else None)
     feature_used0 = extras.feature_used
 
     root_cand = eval_leaf(root_hist, sum_grad, sum_hess, root_count,

@@ -50,6 +50,7 @@ def _round_up(x: int, m: int) -> int:
 
 def _scan_kernel(scal_ref, gb_ref, hb_ref, keepr_ref, keepf_ref,
                  validr_ref, validf_ref, aux_ref, out_ref):
+    # validr/validf arrive as [1, F, W] child blocks
     """One grid step = one child.
 
     scal_ref:  [1, 1, 128] f32 (sum_grad, sum_hess, num_data, cnt_factor,
@@ -75,8 +76,8 @@ def _scan_kernel(scal_ref, gb_ref, hb_ref, keepr_ref, keepf_ref,
     hb = hb_ref[0]
     keep_r = keepr_ref[:]
     keep_f = keepf_ref[:]
-    valid_r0 = validr_ref[:]
-    valid_f0 = validf_ref[:]
+    valid_r0 = validr_ref[0]
+    valid_f0 = validf_ref[0]
     pen = aux_ref[0, :]
 
     cnt_b = jnp.floor(hb * cf + 0.5)
@@ -185,10 +186,16 @@ def scan_pair(scal, gb, hb, keep_r, keep_f, valid_r, valid_f, aux,
               interpret: bool = False):
     """Run the fused scan for both children.
 
-    scal: [2, 8] f32; gb/hb: [2, Fp, Wp] f32; masks: [Fp, Wp] f32;
-    aux: [8, Fp] f32 (row 0 = penalty). Returns [2, 8, Fp] f32.
+    scal: [2, 8] f32; gb/hb: [2, Fp, Wp] f32; valid masks: [Fp, Wp] f32
+    shared, or [2, Fp, Wp] per child (the voting-parallel win masks);
+    keep masks: [Fp, Wp] f32; aux: [8, Fp] f32 (row 0 = penalty).
+    Returns [2, 8, Fp] f32.
     """
     _, Fp, Wp = gb.shape
+    if valid_r.ndim == 2:
+        valid_r = jnp.broadcast_to(valid_r, (2, Fp, Wp))
+    if valid_f.ndim == 2:
+        valid_f = jnp.broadcast_to(valid_f, (2, Fp, Wp))
     scal = jnp.zeros((2, 1, 128), jnp.float32).at[:, 0, :8].set(scal)
     return pl.pallas_call(
         _scan_kernel,
@@ -199,8 +206,8 @@ def scan_pair(scal, gb, hb, keep_r, keep_f, valid_r, valid_f, aux,
             pl.BlockSpec((1, Fp, Wp), lambda c: (c, c * 0, c * 0)),
             pl.BlockSpec((Fp, Wp), lambda c: (c * 0, c * 0)),
             pl.BlockSpec((Fp, Wp), lambda c: (c * 0, c * 0)),
-            pl.BlockSpec((Fp, Wp), lambda c: (c * 0, c * 0)),
-            pl.BlockSpec((Fp, Wp), lambda c: (c * 0, c * 0)),
+            pl.BlockSpec((1, Fp, Wp), lambda c: (c, c * 0, c * 0)),
+            pl.BlockSpec((1, Fp, Wp), lambda c: (c, c * 0, c * 0)),
             pl.BlockSpec((8, Fp), lambda c: (c * 0, c * 0)),
         ],
         out_specs=pl.BlockSpec((1, 8, Fp), lambda c: (c, c * 0, c * 0)),

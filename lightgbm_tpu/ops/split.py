@@ -665,5 +665,11 @@ def merge_candidates(a: SplitCandidate, b: SplitCandidate) -> SplitCandidate:
                                   & (b.feature >= 0)
                                   & ((a.feature < 0)
                                      | (b.feature < a.feature)))
-    return jax.tree.map(
-        lambda x, y: jnp.where(b_wins, y, x), a, b)
+
+    def sel(x, y):
+        # leaves may carry trailing dims (cat_mask [..., CAT_W]) and the
+        # candidates may be batched (the fused pair scan merges [2]-shaped
+        # candidate pairs): align the predicate to each leaf's rank
+        w = b_wins.reshape(b_wins.shape + (1,) * (x.ndim - b_wins.ndim))
+        return jnp.where(w, y, x)
+    return jax.tree.map(sel, a, b)

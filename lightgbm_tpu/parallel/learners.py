@@ -153,10 +153,15 @@ class VotingParallelTreeLearner(DataParallelTreeLearner):
 
     def __init__(self, config, dataset, mesh: Mesh = None):
         super().__init__(config, dataset, mesh=mesh)
-        # the fused Pallas pair scan has no voting local-scan path
+        # the fused pair scan runs the PV-tree local-scan/vote/selective-
+        # psum flow; EFB-bundled datasets keep the XLA path (the voting
+        # histogram fix-up runs inside its eval)
+        scan = self.grow_config.scan_impl
+        if np.any(dataset.needs_fix):
+            scan = "xla"
         self.grow_config = self.grow_config._replace(
             parallel_mode="voting", top_k=int(config.top_k),
-            scan_impl="xla")
+            scan_impl=scan)
         self._sharded_grow = None
 
 
@@ -174,9 +179,9 @@ class FeatureParallelTreeLearner(SerialTreeLearner):
             int(config.tpu_num_devices))
         self.num_shards = self.mesh.devices.size
         self._axis_name = AXIS
-        # the fused Pallas pair scan has no per-shard feature ownership path
-        self.grow_config = self.grow_config._replace(parallel_mode="feature",
-                                                     scan_impl="xla")
+        # the fused pair scan folds per-shard feature ownership into its
+        # layout masks and merges winners via SyncUpGlobalBestSplit
+        self.grow_config = self.grow_config._replace(parallel_mode="feature")
         self._sharded_grow = None
 
     def _build(self):
