@@ -502,7 +502,8 @@ def _make_eval_pair_fused(meta, params, feature_mask, cat, gc: GrowConfig,
             mgs, jnp.broadcast_to(l2, (2,))], axis=1)  # [2, 8]
 
     def eval_pair(leaf_hist, l, s, cand, left_cnt, right_cnt, depth_child):
-        hist2 = leaf_hist[jnp.stack([l, s])]          # [2, TB, 2]
+        rows2 = jnp.stack([l, s])
+        hist2 = leaf_hist[rows2]                      # [2, TB, 2]
         sg = jnp.stack([cand.left_sum_grad,
                         cand.right_sum_grad]).astype(f32)
         # the XLA scan's sum_hess_adj = sum_hess + 2*kEpsilon: NOT a no-op
@@ -522,12 +523,12 @@ def _make_eval_pair_fused(meta, params, feature_mask, cat, gc: GrowConfig,
             local_sh = jnp.sum(hist2[:, :, 1], axis=1) / ng + f32(2e-15)
             local_cnt = jnp.round(local_sh * cnt
                                   / jnp.maximum(sh, f32(1e-12)))
-            dense_l = hist2[:, layout.gidx, :]
+            gb_l = leaf_hist[..., 0][rows2][:, layout.gidx]
+            hb_l = leaf_hist[..., 1][rows2][:, layout.gidx]
             scal_l = _build_scal(local_sg, local_sh, local_cnt,
                                  jnp.maximum(jnp.floor(md / S), 1.0),
                                  mh / S)
-            out_l = _scan(dense_l[..., 0], dense_l[..., 1], scal_l,
-                          valid_r, valid_f)
+            out_l = _scan(gb_l, hb_l, scal_l, valid_r, valid_f)
             hist_new = []
             win_masks = []
             for c in range(2):
@@ -542,9 +543,10 @@ def _make_eval_pair_fused(meta, params, feature_mask, cat, gc: GrowConfig,
             valid_r = valid_r[None] * winp[:, :, None].astype(f32)
             valid_f = valid_f[None] * winp[:, :, None].astype(f32)
 
-        dense = hist2[:, layout.gidx, :]              # [2, Fp, Wp, 2]
-        gb = dense[..., 0]
-        hb = dense[..., 1]
+        # channel planes sliced BEFORE the dense gather: a [..., 0] slice
+        # of the fused gather output miscompiles on TPU at large F
+        gb = leaf_hist[..., 0][rows2][:, layout.gidx]  # [2, Fp, Wp]
+        hb = leaf_hist[..., 1][rows2][:, layout.gidx]
         scal = _build_scal(sg, sh, cnt, md, mh)
         out = _scan(gb, hb, scal, valid_r, valid_f)
         gains = out[:, 0, :]                          # [2, Fp]

@@ -75,7 +75,7 @@ class PersistAssets(NamedTuple):
     geometry: tuple            # (WPA, NP, G, plan, nbw, n, C, CR) static
 
 
-def build_assets(dataset, labels: np.ndarray, C: int = 8192,
+def build_assets(dataset, labels: np.ndarray, C: int = 0,
                  CR: int = 16384) -> PersistAssets:
     """Host-side payload construction (once per dataset).
 
@@ -91,6 +91,10 @@ def build_assets(dataset, labels: np.ndarray, C: int = 8192,
     nbw = (Gs + 3) // 4
     WP = nbw + 5                 # + label, rid, grad, hess, score
     WPA = ((WP + 7) // 8) * 8
+    if C <= 0:
+        # split_pass VMEM scales with WPA (7 chunk-sized u32 buffers + the
+        # hist accumulator); stay under the 16MB scoped limit
+        C = 8192 if WPA <= 24 else (4096 if WPA <= 56 else 2048)
     NP = max(((n + 127) // 128 + 2) * 128 + C + 256,
              ((n + CR - 1) // CR) * CR)
     pay = np.zeros((WPA, NP), np.uint32)
@@ -163,13 +167,14 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
         rows: [2] i32 leaf-hist row ids; sgs/shs/cnts: [2] f32 sums.
         Returns a [2, 12] f32 best-candidate matrix.
         """
-        hist2 = leaf_hist[rows]                     # [2, TBp, 2]
-        dense = hist2.reshape(2, G, W, 2)
-        if layout.Fp > G:
-            dense = jnp.pad(dense, ((0, 0), (0, layout.Fp - G),
-                                    (0, 0), (0, 0)))
-        gb = dense[..., 0]
-        hb = dense[..., 1]
+        # channel planes sliced BEFORE the gather/reshape/pad: slicing
+        # [..., 0] from the fused gather+pad output miscompiles on TPU at
+        # large G (observed at G=137: channel 0 corrupt, channel 1 fine)
+        gflat = leaf_hist[..., 0]
+        hflat = leaf_hist[..., 1]
+        pad_f = ((0, 0), (0, layout.Fp - G), (0, 0))
+        gb = jnp.pad(gflat[rows].reshape(2, G, W), pad_f)
+        hb = jnp.pad(hflat[rows].reshape(2, G, W), pad_f)
         p32 = params.cast(F32)
         sg = sgs.astype(F32)
         sh = shs.astype(F32) + F32(2e-15)
@@ -398,6 +403,24 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
         return jnp.zeros((n,), F32).at[rid].set(
             score, mode="drop", unique_indices=True)
 
+    def fill_grad_row(pay, grad_fn, gargs):
+        """Row-order gradient mode for objectives whose gradients need
+        global row structure (lambdarank's query groups, xentropy weights):
+        scores scatter to row order, the objective's own grad_fn runs
+        there, and the results gather back through the rid row. Costs one
+        [n] scatter + one [NP] gather per tree — still payload-resident
+        everywhere else."""
+        score_rowo = finalize_scores(pay).astype(jnp.float64)
+        g, h = grad_fn(score_rowo, *gargs)
+        rid = pay[nbw + 1].astype(I32)
+        live = jnp.arange(NP, dtype=I32) < n
+        idx = jnp.minimum(rid, n - 1)
+        g = jnp.where(live, g.astype(F32)[idx], 0.0)
+        h = jnp.where(live, h.astype(F32)[idx], 0.0)
+        gh = jax.lax.bitcast_convert_type(jnp.stack([g, h]), U32)
+        return jax.lax.dynamic_update_slice(
+            pay, gh, (jnp.asarray(grad_row, I32), jnp.asarray(0, I32)))
+
     def set_scores(pay, score_pos):
         """Write a payload-order score vector into the score row."""
         return jax.lax.dynamic_update_slice(
@@ -422,27 +445,36 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
     gr.to_tree_arrays = to_tree_arrays
     gr.apply_scores = apply_scores
     gr.fill_grad = fill_grad
+    gr.fill_grad_row = fill_grad_row
     gr.finalize_scores = finalize_scores
     gr.set_scores = set_scores
     gr.init_carry = init_carry
     gr.NP = NP
     gr.n = n
     gr.nbw = nbw
+    gr._eval_pair = eval_pair          # debug/testing hooks
+    gr._root_hist = root_hist
+    gr._pad_meta = pad_meta
     return gr
 
 
-def make_scan_driver(gr, gc, k: int, grad_fn):
+def make_scan_driver(gr, gc, k: int, grad_fn, row_order: bool = False):
     """K fused boosting iterations over the persistent payload.
 
-    grad_fn(score_pos, label_pos) -> (grad, hess) is baked statically.
-    Returns fn(pay, score_pos, fmasks [k, F], params, shrink) ->
-    (pay', score_pos', stacked TreeArrays).
+    grad_fn is baked statically: payload mode takes (score_pos, label_pos);
+    row_order mode takes (score_row, *gargs) — the objective's standard
+    grad function (lambdarank etc.), fed by a per-tree scatter/gather
+    through the rid row. Returns fn(pay, fmasks [k, F], params, shrink,
+    gargs) -> (pay', stacked TreeArrays).
     """
 
     @functools.partial(jax.jit, donate_argnums=(0,))
-    def run(pay, fmasks, params, shrink):
+    def run(pay, fmasks, params, shrink, gargs):
         def body(pay, fmask):
-            pay = gr.fill_grad(pay, grad_fn)
+            if row_order:
+                pay = gr.fill_grad_row(pay, grad_fn, gargs)
+            else:
+                pay = gr.fill_grad(pay, grad_fn)
             pay, lstate, tree, nl, _root = gr.grow(pay, params, fmask)
             pay = gr.apply_scores(pay, lstate, nl, shrink)
             out = gr.to_tree_arrays(lstate, tree, nl)

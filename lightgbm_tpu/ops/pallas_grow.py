@@ -255,7 +255,8 @@ def make_split_pass(WPA: int, NP: int, G: int, plan, nbw: int,
             obuf[:WP_LIVE] = jnp.where(sel[None, :],
                                        pltpu.roll(src_l, dL, 1),
                                        rbuf[:WP_LIVE])
-            obuf[WP_LIVE:] = rbuf[WP_LIVE:]
+            if WP_LIVE < WPA:
+                obuf[WP_LIVE:] = rbuf[WP_LIVE:]
             cpw = pltpu.make_async_copy(
                 obuf, pay_out.at[:, pl.ds(al, E)], sem_w)
             cpw.start()
@@ -276,7 +277,8 @@ def make_split_pass(WPA: int, NP: int, G: int, plan, nbw: int,
             obuf[:WP_LIVE] = jnp.where(sel2[None, :],
                                        pltpu.roll(src_r, dR + nR_, 1),
                                        rbuf[:WP_LIVE])
-            obuf[WP_LIVE:] = rbuf[WP_LIVE:]
+            if WP_LIVE < WPA:
+                obuf[WP_LIVE:] = rbuf[WP_LIVE:]
             cpw2 = pltpu.make_async_copy(
                 obuf, pay_out.at[:, pl.ds(al2, E)], sem_w)
             cpw2.start()

@@ -394,7 +394,8 @@ class SerialTreeLearner:
                 and ds.num_data < (1 << 24)
                 and self._axis_name is None
                 and objective is not None
-                and objective.payload_grad_fn() is not None
+                and (objective.payload_grad_fn() is not None
+                     or getattr(objective, "supports_fused_scan", False))
                 and ds.metadata.weight is None)
 
     def _persist_cached(self, objective, k: int):
@@ -416,8 +417,14 @@ class SerialTreeLearner:
                 objective.static_fingerprint())
         driver = cache.get(dkey)
         if driver is None:
-            driver = make_scan_driver(gr, self.grow_config, k,
-                                      objective.payload_grad_fn())
+            pfn = objective.payload_grad_fn()
+            if pfn is not None:
+                driver = make_scan_driver(gr, self.grow_config, k, pfn)
+            else:
+                # row-order gradient mode (lambdarank query groups etc.)
+                driver = make_scan_driver(gr, self.grow_config, k,
+                                          objective.grad_fn(),
+                                          row_order=True)
             cache[dkey] = driver
         return assets, gr, driver
 
@@ -431,7 +438,8 @@ class SerialTreeLearner:
         if pay is None:
             pay = gr.init_carry(assets.pay0, jnp.asarray(score0))
         pay, stacked = driver(pay, jnp.asarray(fmasks), self.params,
-                              jnp.asarray(shrink, jnp.float64))
+                              jnp.asarray(shrink, jnp.float64),
+                              objective._grad_args())
         self._persist_carry = pay
         self._persist_gr = gr
         return stacked
