@@ -153,12 +153,14 @@ class VotingParallelTreeLearner(DataParallelTreeLearner):
 
     def __init__(self, config, dataset, mesh: Mesh = None):
         super().__init__(config, dataset, mesh=mesh)
-        # the fused pair scan runs the PV-tree local-scan/vote/selective-
-        # psum flow; EFB-bundled datasets keep the XLA path (the voting
-        # histogram fix-up runs inside its eval)
-        scan = self.grow_config.scan_impl
-        if np.any(dataset.needs_fix):
-            scan = "xla"
+        # the fused pair scan has an experimental PV-tree path
+        # (local scan/vote/selective psum in ops/grow._make_eval_pair_fused)
+        # but its vote ordering does not yet reproduce the XLA voting eval
+        # split-for-split, so voting stays on the XLA scan unless the user
+        # forces tpu_scan_impl=pallas explicitly
+        scan = ("xla" if str(config.tpu_scan_impl).lower() != "pallas"
+                or np.any(dataset.needs_fix)
+                else self.grow_config.scan_impl)
         self.grow_config = self.grow_config._replace(
             parallel_mode="voting", top_k=int(config.top_k),
             scan_impl=scan)
