@@ -129,7 +129,8 @@ class _PState(NamedTuple):
     s: jnp.ndarray
     done: jnp.ndarray
     pay: jnp.ndarray           # [WPA, NP] u32
-    leaf_hist: jnp.ndarray     # [L, TBp, 2] f32
+    gh: jnp.ndarray            # [L, TBp] f32 gradient histogram plane
+    hh: jnp.ndarray            # [L, TBp] f32 hessian histogram plane
     lstate: jnp.ndarray        # [L, 8] f32
     best: jnp.ndarray          # [L, 12] f32
     tree: jnp.ndarray          # [L, 8] f32
@@ -160,21 +161,19 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
         bin_start=jnp.arange(F, dtype=I32) * W,
         bin_end=jnp.arange(F, dtype=I32) * W + assets.nb)
 
-    def eval_pair(leaf_hist, rows, sgs, shs, cnts, depth_child, params,
+    def eval_pair(gh, hh, rows, sgs, shs, cnts, depth_child, params,
                   layout: ScanLayout):
-        """Best splits for two leaves from the padded hist tensor.
+        """Best splits for two leaves from the per-plane hist tensors
+        (gh/hh: [L, TBp] f32 — separate grad/hess planes so no strided
+        channel slices exist anywhere; a fused gather+pad+channel-slice
+        miscompiles on TPU at large G).
 
         rows: [2] i32 leaf-hist row ids; sgs/shs/cnts: [2] f32 sums.
         Returns a [2, 12] f32 best-candidate matrix.
         """
-        # channel planes sliced BEFORE the gather/reshape/pad: slicing
-        # [..., 0] from the fused gather+pad output miscompiles on TPU at
-        # large G (observed at G=137: channel 0 corrupt, channel 1 fine)
-        gflat = leaf_hist[..., 0]
-        hflat = leaf_hist[..., 1]
         pad_f = ((0, 0), (0, layout.Fp - G), (0, 0))
-        gb = jnp.pad(gflat[rows].reshape(2, G, W), pad_f)
-        hb = jnp.pad(hflat[rows].reshape(2, G, W), pad_f)
+        gb = jnp.pad(gh[rows].reshape(2, G, W), pad_f)
+        hb = jnp.pad(hh[rows].reshape(2, G, W), pad_f)
         p32 = params.cast(F32)
         sg = sgs.astype(F32)
         sh = shs.astype(F32) + F32(2e-15)
@@ -234,13 +233,15 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
         p32 = params.cast(F32)
         root_out = -sum_grad / (sum_hess + p32.lambda_l2.astype(F32))
 
-        leaf_hist = jnp.zeros((L, TBp, 2), F32).at[0].set(rhist)
+        gh0, hh0 = rhist
+        gh = jnp.zeros((L, TBp), F32).at[0].set(gh0)
+        hh = jnp.zeros((L, TBp), F32).at[0].set(hh0)
         lstate = jnp.zeros((L, 8), F32).at[0].set(
             jnp.asarray([0, 0, 0, 0, 0, 0, 0, 0], F32)
             .at[LS_SG].set(sum_grad).at[LS_SH].set(sum_hess)
             .at[LS_CNT].set(root_cnt).at[LS_VAL].set(root_out)
             .at[LS_NROWS].set(jnp.asarray(n, F32)))
-        pair0 = eval_pair(leaf_hist, jnp.asarray([0, 0], I32),
+        pair0 = eval_pair(gh, hh, jnp.asarray([0, 0], I32),
                           jnp.stack([sum_grad, sum_grad]),
                           jnp.stack([sum_hess, sum_hess]),
                           jnp.stack([root_cnt, root_cnt]),
@@ -251,7 +252,8 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
             s=jnp.asarray(1, I32),
             done=jnp.asarray(False),
             pay=pay,
-            leaf_hist=leaf_hist,
+            gh=gh,
+            hh=hh,
             lstate=lstate,
             best=best,
             tree=jnp.zeros((L, 8), F32),
@@ -288,18 +290,26 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
             left_cnt = n_left
             right_cnt = n_l - left_cnt
 
-            parent_hist = st.leaf_hist[l]
-            hist_larger = parent_hist - hist_sm
-            hist_left = jnp.where(smaller_is_left, hist_sm, hist_larger)
-            hist_right = jnp.where(smaller_is_left, hist_larger, hist_sm)
-            val_l, val_r = jax.lax.optimization_barrier(
-                (jnp.where(do, hist_left, parent_hist),
-                 jnp.where(do, hist_right, jnp.zeros_like(hist_right))))
-            leaf_hist = st.leaf_hist.at[l].set(val_l).at[s].set(val_r)
+            sm_g, sm_h = hist_sm
+            par_g = st.gh[l]
+            par_h = st.hh[l]
+            big_g = par_g - sm_g
+            big_h = par_h - sm_h
+            left_g = jnp.where(smaller_is_left, sm_g, big_g)
+            left_h = jnp.where(smaller_is_left, sm_h, big_h)
+            right_g = jnp.where(smaller_is_left, big_g, sm_g)
+            right_h = jnp.where(smaller_is_left, big_h, sm_h)
+            vgl, vgr, vhl, vhr = jax.lax.optimization_barrier(
+                (jnp.where(do, left_g, par_g),
+                 jnp.where(do, right_g, jnp.zeros_like(right_g)),
+                 jnp.where(do, left_h, par_h),
+                 jnp.where(do, right_h, jnp.zeros_like(right_h))))
+            gh = st.gh.at[l].set(vgl).at[s].set(vgr)
+            hh = st.hh.at[l].set(vhl).at[s].set(vhr)
 
             depth_child = ls[LS_DEPTH] + 1.0
             pair = eval_pair(
-                leaf_hist, jnp.stack([l, s]),
+                gh, hh, jnp.stack([l, s]),
                 jnp.stack([bl[BC_LSG], bl[BC_RSG]]),
                 jnp.stack([bl[BC_LSH], bl[BC_RSH]]),
                 jnp.stack([left_cnt, right_cnt]).astype(F32),
@@ -336,7 +346,7 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
                 jnp.where(do, rec, st.tree[s - 1]))
             return st._replace(
                 s=s + do.astype(I32), done=~do, pay=pay,
-                leaf_hist=leaf_hist, lstate=lstate, best=best, tree=tree)
+                gh=gh, hh=hh, lstate=lstate, best=best, tree=tree)
 
         final = jax.lax.while_loop(cond, body, state)
         return final.pay, final.lstate, final.tree, final.s, root_out

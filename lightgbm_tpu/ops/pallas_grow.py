@@ -377,7 +377,10 @@ def make_split_pass(WPA: int, NP: int, G: int, plan, nbw: int,
         # Mosaic recurses/lowers to unsupported i64
         with jax.enable_x64(False):
             pay2, hist, cnt = _call(pay, scalars, grid)
-        return pay2, hist.reshape(G * 256, 2), cnt[0]
+        # separate grad/hess planes: downstream keeps per-plane [L, TBp]
+        # histograms (no strided channel slices on the hot path)
+        return pay2, (hist[..., 0].reshape(G * 256),
+                      hist[..., 1].reshape(G * 256)), cnt[0]
 
     def _call(pay, scalars, grid):
         return pl.pallas_call(
@@ -464,7 +467,8 @@ def make_root_hist(WPA: int, NP: int, G: int, plan, nbw: int, n: int,
     def root_hist(pay):
         with jax.enable_x64(False):
             hist, sums = _call(pay)
-        return hist.reshape(G * 256, 2), sums
+        return (hist[..., 0].reshape(G * 256),
+                hist[..., 1].reshape(G * 256)), sums
 
     def _call(pay):
         return pl.pallas_call(
