@@ -90,9 +90,11 @@ def test_cegb_lazy_raises():
                   lgb.Dataset(X, y), 1, verbose_eval=False)
 
 
-def test_forcedsplits_raises():
+def test_forcedsplits_missing_file_raises():
+    # forced splits are implemented (tests/test_forced_splits.py); a
+    # nonexistent spec file must still fail loudly, not silently no-op
     X, y = _data(n=300)
-    with pytest.raises(LightGBMError):
+    with pytest.raises((LightGBMError, OSError)):
         lgb.train({"objective": "regression", "verbosity": -1,
                    "forcedsplits_filename": "foo.json"},
                   lgb.Dataset(X, y), 1, verbose_eval=False)
