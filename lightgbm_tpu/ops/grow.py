@@ -631,8 +631,10 @@ def _hist_chunk_contract(bv, vc, W, hist_dtype):
 class ForcedInfo(NamedTuple):
     """forcedsplits_filename JSON flattened to application order (BFS).
 
-    thr holds the kernel-convention threshold (bins <= thr go left) =
-    reference threshold bin T - 1 (the reference sends bins >= T right,
+    thr holds the kernel-convention threshold (bins <= thr go left), which
+    is the reference threshold bin T = ValueToBin(value) unchanged: the
+    reference partition sends bin <= T left and records RealThreshold(T)
+    (DenseBin::Split, src/io/dense_bin.hpp:112;
     GatherInfoForThresholdNumerical, feature_histogram.hpp:488-571).
     """
     leaf: jnp.ndarray       # [K] i32 leaf the forced split applies to
@@ -664,8 +666,12 @@ def _forced_candidate(hist, sum_grad, sum_hess, cnt, f, thr, meta,
     nb = meta.bin_end[f] - start
     mt = meta.missing_type[f]
     db = meta.default_bin[f]
+    # pad W trailing zero rows: a feature narrower than scan_width near the
+    # end of the histogram would otherwise make dynamic_slice clamp `start`
+    # and silently misalign the window with the local-bin iota below
+    hist_p = jnp.pad(hist, ((0, W), (0, 0)))
     win = jax.lax.dynamic_slice(
-        hist, (start, jnp.asarray(0, I32)), (W, 2)).astype(ft)
+        hist_p, (start, jnp.asarray(0, I32)), (W, 2)).astype(ft)
     w = jnp.arange(W, dtype=I32)
     T = thr + 1
     right = (w >= jnp.maximum(T, 1)) & (w < nb)

@@ -86,8 +86,19 @@ def train_multihost(config: Config, X_local: np.ndarray,
 
     # ---- distributed binning -----------------------------------------
     cnt = int(config.bin_construct_sample_cnt)
-    sample = (sample_override if sample_override is not None
-              else X_local[:min(len(X_local), cnt)])
+    if sample_override is not None:
+        sample = sample_override
+    else:
+        # random sample over the local rows (dataset_loader.cpp:762-823
+        # samples across the whole shard); taking the file head instead
+        # biases the bin boundaries on ordered (time/label-sorted) data
+        rng = np.random.default_rng(int(config.data_random_seed))
+        k = min(len(X_local), cnt)
+        if k < len(X_local):
+            idx = np.sort(rng.choice(len(X_local), size=k, replace=False))
+            sample = X_local[idx]
+        else:
+            sample = X_local
     mappers = distributed_bin_mappers(
         np.ascontiguousarray(sample, np.float64), len(X_local), config,
         categorical_features=categorical_features,
@@ -200,5 +211,18 @@ def train_multihost(config: Config, X_local: np.ndarray,
             tree.shrink(float(shrink))
             if it == 0 and abs(init0) > 1e-15:
                 tree.add_bias(init0)
-        trees.append(tree)
+            trees.append(tree)
+        else:
+            # no-split stop semantics (gbdt._materialize_pending /
+            # _truncate_if_stopped): a 1-leaf first tree keeps the
+            # boost_from_average constant as its output; any later 1-leaf
+            # tree stops training with the iteration popped
+            if it == 0:
+                if tree.leaf_value[0] == 0.0:
+                    tree.leaf_value[0] = init0
+                trees.append(tree)
+            else:
+                Log.warning("Stopped training because there are no more "
+                            "leaves that meet the split requirements")
+                break
     return trees, mappers, ds, score

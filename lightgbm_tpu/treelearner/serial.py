@@ -187,8 +187,9 @@ def _parse_forced_splits(config: Config, dataset):
     the k-th applied split receives leaf id k+1 — the same deterministic
     numbering the device grower assigns, so leaf targets are precomputable
     host-side. Thresholds convert value -> bin via BinMapper::ValueToBin
-    (dataset.h:597) and shift by -1 into the kernel's bins<=thr
-    convention."""
+    (dataset.h:597); the kernel's bins<=thr-left convention matches the
+    reference partition (DenseBin::Split sends bin <= ValueToBin(v) left,
+    src/io/dense_bin.hpp:112), so T is stored as-is."""
     fname = str(config.forcedsplits_filename)
     if not fname:
         return None
@@ -197,6 +198,9 @@ def _parse_forced_splits(config: Config, dataset):
     with open(fname) as fh:
         spec = _json.load(fh)
     if not isinstance(spec, dict) or "feature" not in spec:
+        Log.warning("forcedsplits_filename %s has no usable root node "
+                    "(expected an object with a 'feature' key); no splits "
+                    "will be forced" % fname)
         return None
     inner_of = {real: i for i, real in enumerate(dataset.used_features)}
     out = []
@@ -215,7 +219,7 @@ def _parse_forced_splits(config: Config, dataset):
         mapper = dataset.bin_mappers[real]
         T = int(mapper.value_to_bin(
             np.asarray([float(node["threshold"])]))[0])
-        out.append((leaf, inner, T - 1))
+        out.append((leaf, inner, T))
         s = len(out)
         left = node.get("left")
         right = node.get("right")
@@ -225,6 +229,9 @@ def _parse_forced_splits(config: Config, dataset):
         if isinstance(right, dict) and "feature" in right \
                 and "threshold" in right:
             q.append((right, s))
+    if q:
+        Log.warning("forced splits dropped: the specification holds more "
+                    "than num_leaves - 1 = %d splits" % max_splits)
     return out or None
 
 

@@ -80,6 +80,38 @@ def test_forced_predictions_consistent(tmp_path):
     assert np.mean((y - pred) ** 2) < base
 
 
+def test_forced_threshold_bin_goes_left(tmp_path):
+    """The bin containing the forced threshold partitions LEFT and the saved
+    model records the real threshold (DenseBin::Split sends
+    bin <= ValueToBin(v) left; regression pin for an off-by-one that sent
+    it right with a one-bin-low saved threshold)."""
+    rng = np.random.default_rng(0)
+    x = rng.choice([0.0, 1.0, 2.0], size=3000, p=[0.4, 0.35, 0.25])
+    X = x[:, None]
+    y = (x >= 1.0).astype(float) + rng.normal(size=3000) * 0.05
+    fname = os.path.join(str(tmp_path), "forced.json")
+    with open(fname, "w") as fh:
+        json.dump({"feature": 0, "threshold": 1.5}, fh)
+    params = {"objective": "regression", "num_leaves": 2,
+              "verbosity": -1, "min_data_in_leaf": 5,
+              "learning_rate": 1.0,
+              "forcedsplits_filename": fname}
+    bst = lgb.train(params, lgb.Dataset(X, y), 1, verbose_eval=False)
+    model = bst.dump_model()
+    if isinstance(model, str):
+        model = json.loads(model)
+    t0 = model["tree_info"][0]["tree_structure"]
+    assert t0["split_feature"] == 0
+    assert abs(t0["threshold"] - 1.5) < 1e-9
+    n_left_expected = int(np.sum(x <= 1.0))
+    assert t0["left_child"]["leaf_count"] == n_left_expected
+    assert t0["right_child"]["leaf_count"] == 3000 - n_left_expected
+    # prediction agrees with the partition
+    pred = bst.predict(np.array([[0.0], [1.0], [2.0]]))
+    assert abs(pred[0] - pred[1]) < 1e-9
+    assert abs(pred[1] - pred[2]) > 0.1
+
+
 def test_no_force_file_unchanged():
     X, y = _data()
     params = {"objective": "regression", "num_leaves": 8,
