@@ -1,15 +1,22 @@
 """Training and cross-validation entry points.
 
-TPU-native rebuild of python-package/lightgbm/engine.py: `train` (:18) with
-the same callback orchestration (:198-268) and `cv` (:375) with
-stratified/group folds (:299). The per-round work — gradients, tree growth,
-score updates — runs as jitted device programs behind Booster.update.
+TPU-native rebuild of the reference python-package surface: `train`
+(python-package/lightgbm/engine.py:18) and `cv` (:375) with the same
+observable contract — callback staging/timing via CallbackEnv, alias
+precedence for round counts and early stopping, train-set evaluation when
+the train set appears among the valid sets, `best_score`/`best_iteration`
+population, and stratified/group fold construction. The implementation is
+organized around a CallbackRegistry (staged, order-sorted dispatch) and an
+EvalPlan (which datasets get evaluated each round, and under what names)
+rather than the reference's inline loops; the per-round work itself —
+gradients, tree growth, score updates — runs as jitted device programs
+behind Booster.update.
 """
 from __future__ import annotations
 
 import collections
 import copy
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -17,11 +24,143 @@ from . import callback
 from .basic import Booster, Dataset
 from .utils.log import LightGBMError, Log
 
-_EARLY_STOP_ALIASES = ("early_stopping_round", "early_stopping_rounds",
-                       "early_stopping", "n_iter_no_change")
-_NUM_BOOST_ROUND_ALIASES = (
+_ROUND_COUNT_KEYS = (
     "num_iterations", "num_iteration", "n_iter", "num_tree", "num_trees",
     "num_round", "num_rounds", "num_boost_round", "n_estimators")
+_STOP_ROUND_KEYS = ("early_stopping_round", "early_stopping_rounds",
+                    "early_stopping", "n_iter_no_change")
+
+
+def _alias_override(params: Dict[str, Any], keys, fallback):
+    """Pop the first matching alias out of `params`; params win over the
+    keyword argument (reference alias precedence, engine.py:119-155)."""
+    for key in keys:
+        if key in params:
+            Log.warning("Found `%s` in params. Will use it instead of "
+                        "argument" % key)
+            return int(params.pop(key))
+    return fallback
+
+
+class _CallbackRegistry:
+    """Staged callback dispatch.
+
+    Callbacks carry an `order` (implicit ones set their own; user-supplied
+    ones default to negative offsets so they fire ahead of implicit ones)
+    and a `before_iteration` flag selecting the stage. Dispatch is a stable
+    sort by order within each stage.
+    """
+
+    def __init__(self, user_callbacks=None):
+        self._pre: List = []
+        self._post: List = []
+        user_callbacks = list(user_callbacks or ())
+        for offset, cb in enumerate(user_callbacks):
+            cb.__dict__.setdefault("order", offset - len(user_callbacks))
+        # identical objects registered twice fire once
+        for cb in dict.fromkeys(user_callbacks):
+            self.add(cb)
+
+    def add(self, cb) -> None:
+        stage = (self._pre if getattr(cb, "before_iteration", False)
+                 else self._post)
+        stage.append(cb)
+
+    def seal(self) -> None:
+        self._pre.sort(key=lambda cb: getattr(cb, "order", 0))
+        self._post.sort(key=lambda cb: getattr(cb, "order", 0))
+
+    @property
+    def has_pre_stage(self) -> bool:
+        return bool(self._pre)
+
+    def fire_pre(self, env: "callback.CallbackEnv") -> None:
+        for cb in self._pre:
+            cb(env)
+
+    def fire_post(self, env: "callback.CallbackEnv") -> None:
+        """May raise callback.EarlyStopException."""
+        for cb in self._post:
+            cb(env)
+
+
+class _EvalPlan(collections.namedtuple(
+        "_EvalPlan", ["eval_train", "train_name", "attached"])):
+    """Which datasets each round evaluates: the train set itself (when the
+    caller listed it among valid_sets) plus the attached held-out sets."""
+
+    @classmethod
+    def build(cls, train_set: Dataset, valid_sets, valid_names):
+        if valid_sets is None:
+            return cls(False, "training", [])
+        if isinstance(valid_sets, Dataset):
+            valid_sets = [valid_sets]
+        if isinstance(valid_names, str):
+            valid_names = [valid_names]
+        names = list(valid_names) if valid_names is not None else []
+        eval_train = False
+        train_name = "training"
+        attached: List[Tuple[Dataset, str]] = []
+        for pos, ds in enumerate(valid_sets):
+            label = names[pos] if pos < len(names) else "valid_%d" % pos
+            if ds is train_set:
+                eval_train = True
+                if pos < len(names):
+                    train_name = label
+            else:
+                if not isinstance(ds, Dataset):
+                    raise TypeError("Training only accepts Dataset object")
+                attached.append((ds, label))
+        return cls(eval_train, train_name, attached)
+
+    def attach(self, booster: Booster, params: Dict[str, Any],
+               train_set: Dataset) -> None:
+        if self.eval_train:
+            booster.set_train_data_name(self.train_name)
+        for ds, label in self.attached:
+            ds._update_params(params).set_reference(train_set)
+            booster.add_valid(ds, label)
+
+    def evaluate(self, booster: Booster, feval) -> List:
+        out: List = []
+        if self.eval_train:
+            out.extend(booster.eval_train(feval))
+        out.extend(booster.eval_valid(feval))
+        return out
+
+    @property
+    def active(self) -> bool:
+        return self.eval_train or bool(self.attached)
+
+
+def _load_init_model(init_model) -> Optional[str]:
+    if init_model is None:
+        return None
+    if isinstance(init_model, Booster):
+        return init_model.model_to_string(num_iteration=-1)
+    with open(init_model) as fh:
+        return fh.read()
+
+
+def _graft_init_model(booster: Booster, model_str: str,
+                      train_set: Dataset) -> int:
+    """Continued training (reference engine.py:159-165 feeds an
+    _InnerPredictor whose cached scores seed the new booster): prepend the
+    init model's trees and push their binned-walk predictions into the
+    fresh score updater."""
+    stump = Booster(model_str=model_str)
+    inner = booster._booster
+    ntpi = inner.num_tree_per_iteration
+    for pos, tree in enumerate(stump._booster.models):
+        # loaded trees carry only real-valued thresholds; bind them to the
+        # new dataset's bins before the binned walk
+        tree.bind_to_dataset(train_set._inner)
+        inner.train_score.add_score_np(
+            tree.predict_binned(train_set._inner), pos % ntpi)
+    inner.models = stump._booster.models + inner.models
+    inner.num_init_iteration = stump.current_iteration
+    inner.iter = 0
+    return stump.current_iteration
 
 
 def train(params: Dict[str, Any], train_set: Dataset,
@@ -35,174 +174,87 @@ def train(params: Dict[str, Any], train_set: Dataset,
           verbose_eval=True, learning_rates=None,
           keep_training_booster: bool = False, callbacks=None) -> Booster:
     """Train a booster (reference engine.py:18-290)."""
+    if not isinstance(train_set, Dataset):
+        raise TypeError("Training only accepts Dataset object")
     params = copy.deepcopy(params)
-    # resolve aliases the way the reference does (engine.py:119-155)
-    for alias in _NUM_BOOST_ROUND_ALIASES:
-        if alias in params:
-            num_boost_round = int(params.pop(alias))
-            Log.warning("Found `%s` in params. Will use it instead of "
-                        "argument" % alias)
-            break
-    for alias in _EARLY_STOP_ALIASES:
-        if alias in params:
-            early_stopping_rounds = int(params.pop(alias))
-            Log.warning("Found `%s` in params. Will use it instead of "
-                        "argument" % alias)
-            break
-    first_metric_only = params.get("first_metric_only", False)
-
+    num_boost_round = _alias_override(params, _ROUND_COUNT_KEYS,
+                                      num_boost_round)
+    early_stopping_rounds = _alias_override(params, _STOP_ROUND_KEYS,
+                                            early_stopping_rounds)
     if num_boost_round <= 0:
         raise ValueError("num_boost_round should be greater than zero.")
     if fobj is not None:
         params["objective"] = "none"
 
-    init_booster_str = None
-    init_iteration = 0
-    if isinstance(init_model, str):
-        with open(init_model) as f:
-            init_booster_str = f.read()
-    elif isinstance(init_model, Booster):
-        init_booster_str = init_model.model_to_string(num_iteration=-1)
-    if not isinstance(train_set, Dataset):
-        raise TypeError("Training only accepts Dataset object")
-
     train_set._update_params(params) \
              .set_feature_name(feature_name) \
              .set_categorical_feature(categorical_feature)
+    plan = _EvalPlan.build(train_set, valid_sets, valid_names)
 
-    is_valid_contain_train = False
-    train_data_name = "training"
-    reduced_valid_sets = []
-    name_valid_sets = []
-    if valid_sets is not None:
-        if isinstance(valid_sets, Dataset):
-            valid_sets = [valid_sets]
-        if isinstance(valid_names, str):
-            valid_names = [valid_names]
-        for i, valid_data in enumerate(valid_sets):
-            if valid_data is train_set:
-                is_valid_contain_train = True
-                if valid_names is not None:
-                    train_data_name = valid_names[i]
-                continue
-            if not isinstance(valid_data, Dataset):
-                raise TypeError("Training only accepts Dataset object")
-            reduced_valid_sets.append(
-                valid_data._update_params(params).set_reference(train_set))
-            if valid_names is not None and len(valid_names) > i:
-                name_valid_sets.append(valid_names[i])
-            else:
-                name_valid_sets.append("valid_" + str(i))
-
-    if callbacks is None:
-        callbacks = set()
-    else:
-        for i, cb in enumerate(callbacks):
-            cb.__dict__.setdefault("order", i - len(callbacks))
-        callbacks = set(callbacks)
-
+    registry = _CallbackRegistry(callbacks)
     if verbose_eval is True:
-        callbacks.add(callback.print_evaluation())
+        registry.add(callback.print_evaluation())
     elif isinstance(verbose_eval, int) and not isinstance(verbose_eval, bool):
-        callbacks.add(callback.print_evaluation(verbose_eval))
+        registry.add(callback.print_evaluation(verbose_eval))
     if early_stopping_rounds is not None and early_stopping_rounds > 0:
-        callbacks.add(callback.early_stopping(
-            early_stopping_rounds, first_metric_only,
+        registry.add(callback.early_stopping(
+            early_stopping_rounds, params.get("first_metric_only", False),
             verbose=bool(verbose_eval)))
     if learning_rates is not None:
-        callbacks.add(callback.reset_parameter(learning_rate=learning_rates))
+        registry.add(callback.reset_parameter(learning_rate=learning_rates))
     if evals_result is not None:
-        callbacks.add(callback.record_evaluation(evals_result))
-
-    callbacks_before_iter = {cb for cb in callbacks
-                             if getattr(cb, "before_iteration", False)}
-    callbacks_after_iter = callbacks - callbacks_before_iter
-    callbacks_before_iter = sorted(callbacks_before_iter,
-                                   key=lambda cb: getattr(cb, "order", 0))
-    callbacks_after_iter = sorted(callbacks_after_iter,
-                                  key=lambda cb: getattr(cb, "order", 0))
+        registry.add(callback.record_evaluation(evals_result))
+    registry.seal()
 
     booster = Booster(params=params, train_set=train_set)
-    if init_booster_str is not None:
-        # continued training: seed scores with the init model's predictions
-        init_b = Booster(model_str=init_booster_str)
-        init_iteration = init_b.current_iteration
-        _seed_scores_from_model(booster, init_b, train_set,
-                                reduced_valid_sets)
-        booster._booster.models = init_b._booster.models + \
-            booster._booster.models
-        booster._booster.num_init_iteration = init_iteration
-        booster._booster.iter = 0
-    if is_valid_contain_train:
-        booster.set_train_data_name(train_data_name)
-    for valid_set, name_valid_set in zip(reduced_valid_sets, name_valid_sets):
-        booster.add_valid(valid_set, name_valid_set)
+    model_str = _load_init_model(init_model)
+    first_round = 0
+    if model_str is not None:
+        first_round = _graft_init_model(booster, model_str, train_set)
+    plan.attach(booster, params, train_set)
     booster.best_iteration = 0
     # with no per-iteration host work (no before-iter callbacks, no eval
     # sets, no custom objective), the booster may fuse iterations into one
     # jitted multi-tree scan (one device dispatch per K trees)
     inner = getattr(booster, "_booster", None)
     if inner is not None:
-        inner.allow_batch = (not callbacks_before_iter
-                             and valid_sets is None and fobj is None)
+        inner.allow_batch = (not registry.has_pre_stage
+                             and not plan.active and fobj is None)
         inner.planned_rounds = num_boost_round
+    last_round = first_round + num_boost_round
 
-    evaluation_result_list: List = []
-    for i in range(init_iteration, init_iteration + num_boost_round):
-        for cb in callbacks_before_iter:
-            cb(callback.CallbackEnv(model=booster, params=params, iteration=i,
-                                    begin_iteration=init_iteration,
-                                    end_iteration=init_iteration
-                                    + num_boost_round,
-                                    evaluation_result_list=None))
+    def env_for(round_no: int, evals) -> callback.CallbackEnv:
+        return callback.CallbackEnv(
+            model=booster, params=params, iteration=round_no,
+            begin_iteration=first_round, end_iteration=last_round,
+            evaluation_result_list=evals)
+
+    final_evals: List = []
+    for round_no in range(first_round, last_round):
+        registry.fire_pre(env_for(round_no, None))
         booster.update(fobj=fobj)
-
-        evaluation_result_list = []
-        if valid_sets is not None:
-            if is_valid_contain_train:
-                evaluation_result_list.extend(booster.eval_train(feval))
-            evaluation_result_list.extend(booster.eval_valid(feval))
+        final_evals = plan.evaluate(booster, feval) if plan.active else []
         try:
-            for cb in callbacks_after_iter:
-                cb(callback.CallbackEnv(model=booster, params=params,
-                                        iteration=i,
-                                        begin_iteration=init_iteration,
-                                        end_iteration=init_iteration
-                                        + num_boost_round,
-                                        evaluation_result_list=
-                                        evaluation_result_list))
-        except callback.EarlyStopException as e:
-            booster.best_iteration = e.best_iteration + 1
-            evaluation_result_list = e.best_score
+            registry.fire_post(env_for(round_no, final_evals))
+        except callback.EarlyStopException as stop:
+            booster.best_iteration = stop.best_iteration + 1
+            final_evals = stop.best_score
             break
+
     booster.best_score = collections.defaultdict(collections.OrderedDict)
-    for item in evaluation_result_list:
-        dataset_name, eval_name, score = item[0], item[1], item[2]
-        booster.best_score[dataset_name][eval_name] = score
+    for entry in final_evals:
+        booster.best_score[entry[0]][entry[1]] = entry[2]
     return booster
 
 
-def _seed_scores_from_model(booster: Booster, init_b: Booster,
-                            train_set: Dataset, valid_sets) -> None:
-    """Continued training: add the init model's cached predictions to the
-    fresh booster's score updaters (reference seeds via _InnerPredictor,
-    engine.py:159-165 + boosting handler init)."""
-    inner = booster._booster
-    ntpi = inner.num_tree_per_iteration
-    for i, tree in enumerate(init_b._booster.models):
-        # loaded trees carry only real-valued thresholds; bind them to the
-        # new dataset's bins before the binned walk
-        tree.bind_to_dataset(train_set._inner)
-        inner.train_score.add_score_np(
-            tree.predict_binned(train_set._inner), i % ntpi)
-
-
 # ---------------------------------------------------------------------------
-# cross-validation (engine.py:293-610)
+# cross-validation (reference engine.py:293-610)
 # ---------------------------------------------------------------------------
 
 class CVBooster:
-    """Ensemble of per-fold boosters (reference _CVBooster, engine.py:296)."""
+    """Ensemble of per-fold boosters (reference _CVBooster, engine.py:296):
+    attribute access fans out to every fold and returns the list of
+    results."""
 
     def __init__(self):
         self.boosters: List[Booster] = []
@@ -212,81 +264,12 @@ class CVBooster:
         self.boosters.append(booster)
 
     def __getattr__(self, name):
-        def handler_function(*args, **kwargs):
+        def fan_out(*args, **kwargs):
             return [getattr(b, name)(*args, **kwargs) for b in self.boosters]
-        return handler_function
+        return fan_out
 
 
-def _make_n_folds(full_data: Dataset, folds, nfold: int, params: Dict,
-                  seed: int, fpreproc=None, stratified=False, shuffle=True,
-                  eval_train_metric=False):
-    num_data = full_data.num_data()
-    if folds is not None:
-        if not hasattr(folds, "__iter__") and not hasattr(folds, "split"):
-            raise AttributeError(
-                "folds should be a generator or iterator of (train_idx, "
-                "test_idx) tuples or scikit-learn splitter object")
-        if hasattr(folds, "split"):
-            group_info = full_data.get_group()
-            if group_info is not None:
-                group_info = np.asarray(group_info, dtype=np.int64)
-                flattened_group = np.repeat(
-                    range(len(group_info)), repeats=group_info)
-            else:
-                flattened_group = np.zeros(num_data, dtype=np.int64)
-            folds = folds.split(X=np.zeros(num_data),
-                                y=full_data.get_label(),
-                                groups=flattened_group)
-    else:
-        if any(params.get(alias, "") in ("lambdarank", "rank_xendcg")
-               for alias in ("objective", "application", "app")):
-            if not _SKLEARN_INSTALLED():
-                raise LightGBMError(
-                    "scikit-learn is required for ranking cv")
-            from sklearn.model_selection import GroupKFold
-            group_info = np.asarray(full_data.get_group(), dtype=np.int64)
-            flattened_group = np.repeat(
-                range(len(group_info)), repeats=group_info)
-            group_kfold = GroupKFold(n_splits=nfold)
-            folds = group_kfold.split(X=np.zeros(num_data),
-                                      groups=flattened_group)
-        elif stratified:
-            if not _SKLEARN_INSTALLED():
-                raise LightGBMError(
-                    "scikit-learn is required for stratified cv")
-            from sklearn.model_selection import StratifiedKFold
-            skf = StratifiedKFold(n_splits=nfold, shuffle=shuffle,
-                                  random_state=seed)
-            folds = skf.split(X=np.zeros(num_data), y=full_data.get_label())
-        else:
-            if shuffle:
-                randidx = np.random.RandomState(seed).permutation(num_data)
-            else:
-                randidx = np.arange(num_data)
-            kstep = int(num_data / nfold)
-            test_id = [randidx[i:i + kstep] for i in range(0, num_data, kstep)]
-            train_id = [np.concatenate([test_id[i] for i in range(nfold)
-                                        if k != i]) for k in range(nfold)]
-            folds = zip(train_id, test_id)
-
-    ret = CVBooster()
-    for train_idx, test_idx in folds:
-        train_subset = full_data.subset(sorted(train_idx))
-        valid_subset = full_data.subset(sorted(test_idx))
-        if fpreproc is not None:
-            train_subset, valid_subset, tparam = fpreproc(
-                train_subset, valid_subset, params.copy())
-        else:
-            tparam = params
-        cvbooster = Booster(tparam, train_subset)
-        if eval_train_metric:
-            cvbooster.add_valid(train_subset, "train")
-        cvbooster.add_valid(valid_subset, "valid")
-        ret.append(cvbooster)
-    return ret
-
-
-def _SKLEARN_INSTALLED() -> bool:
+def _sklearn_available() -> bool:
     try:
         import sklearn  # noqa: F401
         return True
@@ -294,21 +277,94 @@ def _SKLEARN_INSTALLED() -> bool:
         return False
 
 
-def _agg_cv_result(raw_results, eval_train_metric=False):
-    """Aggregate per-fold eval results (engine.py:354-372)."""
-    cvmap = collections.OrderedDict()
-    metric_type = {}
-    for one_result in raw_results:
-        for one_line in one_result:
-            if eval_train_metric:
-                key = "%s %s" % (one_line[0], one_line[1])
-            else:
-                key = "valid %s" % one_line[1]
-            metric_type[key] = one_line[3]
-            cvmap.setdefault(key, [])
-            cvmap[key].append(one_line[2])
-    return [("cv_agg", k, float(np.mean(v)), metric_type[k], float(np.std(v)))
-            for k, v in cvmap.items()]
+def _query_memberships(full_data: Dataset) -> np.ndarray:
+    """Row -> query id from the dataset's group boundaries (for group-aware
+    fold splitting)."""
+    sizes = np.asarray(full_data.get_group(), dtype=np.int64)
+    return np.repeat(np.arange(len(sizes)), sizes)
+
+
+def _fold_indices(full_data: Dataset, folds, nfold: int,
+                  params: Dict[str, Any], seed: int, stratified: bool,
+                  shuffle: bool):
+    """Yield (train_idx, test_idx) pairs.
+
+    Explicit `folds` win (an iterable of index pairs or an sklearn-style
+    splitter). Otherwise: ranking objectives split whole queries
+    (GroupKFold), stratified classification uses StratifiedKFold, and the
+    default is an (optionally shuffled) nfold partition of the row range.
+    """
+    n = full_data.num_data()
+    if folds is not None:
+        if hasattr(folds, "split"):
+            sizes = full_data.get_group()
+            groups = (_query_memberships(full_data) if sizes is not None
+                      else np.zeros(n, dtype=np.int64))
+            return folds.split(X=np.zeros(n), y=full_data.get_label(),
+                               groups=groups)
+        if not hasattr(folds, "__iter__"):
+            raise AttributeError(
+                "folds should be a generator or iterator of (train_idx, "
+                "test_idx) tuples or scikit-learn splitter object")
+        return folds
+
+    objective = next((params[k] for k in ("objective", "application", "app")
+                      if k in params), "")
+    if objective in ("lambdarank", "rank_xendcg"):
+        if not _sklearn_available():
+            raise LightGBMError("scikit-learn is required for ranking cv")
+        from sklearn.model_selection import GroupKFold
+        return GroupKFold(n_splits=nfold).split(
+            X=np.zeros(n), groups=_query_memberships(full_data))
+    if stratified:
+        if not _sklearn_available():
+            raise LightGBMError("scikit-learn is required for stratified cv")
+        from sklearn.model_selection import StratifiedKFold
+        return StratifiedKFold(n_splits=nfold, shuffle=shuffle,
+                               random_state=seed).split(
+            X=np.zeros(n), y=full_data.get_label())
+    order = (np.random.RandomState(seed).permutation(n) if shuffle
+             else np.arange(n))
+    held_out = np.array_split(order, nfold)
+    return ((np.concatenate(held_out[:k] + held_out[k + 1:]), held_out[k])
+            for k in range(nfold))
+
+
+def _build_fold_boosters(full_data: Dataset, folds, nfold: int,
+                         params: Dict[str, Any], seed: int, fpreproc,
+                         stratified: bool, shuffle: bool,
+                         eval_train_metric: bool) -> CVBooster:
+    ensemble = CVBooster()
+    for train_idx, test_idx in _fold_indices(full_data, folds, nfold, params,
+                                             seed, stratified, shuffle):
+        fit_part = full_data.subset(sorted(train_idx))
+        held_part = full_data.subset(sorted(test_idx))
+        fold_params = params
+        if fpreproc is not None:
+            fit_part, held_part, fold_params = fpreproc(
+                fit_part, held_part, params.copy())
+        member = Booster(fold_params, fit_part)
+        if eval_train_metric:
+            member.add_valid(fit_part, "train")
+        member.add_valid(held_part, "valid")
+        ensemble.append(member)
+    return ensemble
+
+
+def _pool_fold_evals(per_fold: List[List], eval_train_metric: bool):
+    """Mean/std across folds for each (dataset, metric) series
+    (reference engine.py:354-372): returns entries shaped like a booster
+    eval record plus the cross-fold standard deviation."""
+    series = collections.OrderedDict()
+    higher_better = {}
+    for fold_entries in per_fold:
+        for ds_name, metric_name, value, is_higher in fold_entries:
+            key = ("%s %s" % (ds_name, metric_name) if eval_train_metric
+                   else "valid %s" % metric_name)
+            higher_better[key] = is_higher
+            series.setdefault(key, []).append(value)
+    return [("cv_agg", key, float(np.mean(vals)), higher_better[key],
+             float(np.std(vals))) for key, vals in series.items()]
 
 
 def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
@@ -323,19 +379,10 @@ def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
     if not isinstance(train_set, Dataset):
         raise TypeError("Training only accepts Dataset object")
     params = copy.deepcopy(params)
-    for alias in _NUM_BOOST_ROUND_ALIASES:
-        if alias in params:
-            Log.warning("Found `%s` in params. Will use it instead of "
-                        "argument" % alias)
-            num_boost_round = int(params.pop(alias))
-            break
-    for alias in _EARLY_STOP_ALIASES:
-        if alias in params:
-            Log.warning("Found `%s` in params. Will use it instead of "
-                        "argument" % alias)
-            early_stopping_rounds = int(params.pop(alias))
-            break
-    first_metric_only = params.get("first_metric_only", False)
+    num_boost_round = _alias_override(params, _ROUND_COUNT_KEYS,
+                                      num_boost_round)
+    early_stopping_rounds = _alias_override(params, _STOP_ROUND_KEYS,
+                                            early_stopping_rounds)
     if num_boost_round <= 0:
         raise ValueError("num_boost_round should be greater than zero.")
     if fobj is not None:
@@ -350,64 +397,50 @@ def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
         # cv needs subsetting: keep the raw matrix
         train_set.free_raw_data = False
 
-    results = collections.defaultdict(list)
-    cvfolds = _make_n_folds(train_set, folds=folds, nfold=nfold,
-                            params=params, seed=seed, fpreproc=fpreproc,
-                            stratified=stratified, shuffle=shuffle,
-                            eval_train_metric=eval_train_metric)
+    ensemble = _build_fold_boosters(train_set, folds, nfold, params, seed,
+                                    fpreproc, stratified, shuffle,
+                                    eval_train_metric)
 
-    if callbacks is None:
-        callbacks = set()
-    else:
-        for i, cb in enumerate(callbacks):
-            cb.__dict__.setdefault("order", i - len(callbacks))
-        callbacks = set(callbacks)
+    registry = _CallbackRegistry(callbacks)
     if early_stopping_rounds is not None and early_stopping_rounds > 0:
-        callbacks.add(callback.early_stopping(
-            early_stopping_rounds, first_metric_only, verbose=False))
+        registry.add(callback.early_stopping(
+            early_stopping_rounds, params.get("first_metric_only", False),
+            verbose=False))
     if verbose_eval is True:
-        callbacks.add(callback.print_evaluation(show_stdv=show_stdv))
+        registry.add(callback.print_evaluation(show_stdv=show_stdv))
     elif isinstance(verbose_eval, int) and not isinstance(verbose_eval, bool):
-        callbacks.add(callback.print_evaluation(verbose_eval, show_stdv))
+        registry.add(callback.print_evaluation(verbose_eval, show_stdv))
+    registry.seal()
 
-    callbacks_before_iter = {cb for cb in callbacks
-                             if getattr(cb, "before_iteration", False)}
-    callbacks_after_iter = callbacks - callbacks_before_iter
-    callbacks_before_iter = sorted(callbacks_before_iter,
-                                   key=lambda cb: getattr(cb, "order", 0))
-    callbacks_after_iter = sorted(callbacks_after_iter,
-                                  key=lambda cb: getattr(cb, "order", 0))
+    def env_for(round_no: int, evals) -> callback.CallbackEnv:
+        return callback.CallbackEnv(
+            model=ensemble, params=params, iteration=round_no,
+            begin_iteration=0, end_iteration=num_boost_round,
+            evaluation_result_list=evals)
 
-    for i in range(num_boost_round):
-        for cb in callbacks_before_iter:
-            cb(callback.CallbackEnv(model=cvfolds, params=params, iteration=i,
-                                    begin_iteration=0,
-                                    end_iteration=num_boost_round,
-                                    evaluation_result_list=None))
-        for b in cvfolds.boosters:
-            b.update(fobj=fobj)
-        raw = []
-        for b in cvfolds.boosters:
-            one = []
+    history = collections.defaultdict(list)
+    for round_no in range(num_boost_round):
+        registry.fire_pre(env_for(round_no, None))
+        per_fold = []
+        for member in ensemble.boosters:
+            member.update(fobj=fobj)
+        for member in ensemble.boosters:
+            entries: List = []
             if eval_train_metric:
-                one.extend(b.eval_train(feval))
-            one.extend(b.eval_valid(feval))
-            raw.append(one)
-        res = _agg_cv_result(raw, eval_train_metric)
-        for _, key, mean, _, std in res:
-            results[key + "-mean"].append(mean)
-            results[key + "-stdv"].append(std)
+                entries.extend(member.eval_train(feval))
+            entries.extend(member.eval_valid(feval))
+            per_fold.append(entries)
+        pooled = _pool_fold_evals(per_fold, eval_train_metric)
+        for _, key, mean, _, std in pooled:
+            history[key + "-mean"].append(mean)
+            history[key + "-stdv"].append(std)
         try:
-            for cb in callbacks_after_iter:
-                cb(callback.CallbackEnv(model=cvfolds, params=params,
-                                        iteration=i, begin_iteration=0,
-                                        end_iteration=num_boost_round,
-                                        evaluation_result_list=res))
-        except callback.EarlyStopException as e:
-            cvfolds.best_iteration = e.best_iteration + 1
-            for k in results:
-                results[k] = results[k][:cvfolds.best_iteration]
+            registry.fire_post(env_for(round_no, pooled))
+        except callback.EarlyStopException as stop:
+            ensemble.best_iteration = stop.best_iteration + 1
+            for key in history:
+                history[key] = history[key][:ensemble.best_iteration]
             break
     if return_cvbooster:
-        results["cvbooster"] = cvfolds
-    return dict(results)
+        history["cvbooster"] = ensemble
+    return dict(history)
