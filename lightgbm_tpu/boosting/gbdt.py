@@ -233,8 +233,17 @@ class GBDT:
     supports_batch = True   # DART/GOSS/RF need host work per iteration
 
     def _batch_size(self) -> int:
+        from ..parallel.learners import DataParallelTreeLearner
         from ..treelearner.serial import SerialTreeLearner
         cfg = self.config
+        learner = self.tree_learner
+        persist = bool(getattr(learner, "can_persist_scan", None)
+                       and learner.can_persist_scan(self.objective))
+        # the v1 fused scan is serial-only; the persist driver also runs
+        # sharded under the data-parallel learner (in-loop histogram psum)
+        learner_ok = (type(learner) is SerialTreeLearner
+                      or (persist
+                          and isinstance(learner, DataParallelTreeLearner)))
         if not (self.allow_batch and self.supports_batch
                 and (self.objective is None
                      or self.objective.supports_fused_scan)
@@ -246,7 +255,7 @@ class GBDT:
                 and not self.balanced_bagging
                 and self._bag_weight_dev is None
                 and self.train_data.num_features > 0
-                and type(self.tree_learner) is SerialTreeLearner):
+                and learner_ok):
             return 1
         remaining = self.planned_rounds - self._rounds_done + 1
         # the v1 fused scan exists to amortize dispatch latency; when a
@@ -255,9 +264,6 @@ class GBDT:
         # remote worker's watchdog (observed as a worker crash at
         # MS-LTR scale). The persistent-payload path has its own driver
         # and keeps batching at any size.
-        learner = self.tree_learner
-        persist = (getattr(learner, "can_persist_scan", None)
-                   and learner.can_persist_scan(self.objective))
         if not persist and self.num_data * max(
                 self.train_data.num_features, 1) > 150_000_000:
             return 1

@@ -199,7 +199,7 @@ PARAMS: List[_P] = [
     _P("tpu_hist_dtype", str, "auto"),       # auto | f32 | bf16x2
     _P("tpu_pack_impl", str, "sort"),        # sort | matmul (partition pack)
     _P("tpu_scan_impl", str, "auto"),        # auto | xla | pallas (split scan)
-    _P("tpu_persist_scan", str, "auto"),     # auto | off (persistent-payload scan)
+    _P("tpu_persist_scan", str, "auto"),     # auto | off | force (persistent-payload scan; force = XLA kernel emulation off-TPU)
     _P("feature_pre_filter", bool, True),
     _P("force_col_wise", bool, False),       # CPU memory-layout hint; no-op
     _P("force_row_wise", bool, False),       # on TPU (HBM layout is fixed)

@@ -54,6 +54,10 @@ U32 = jnp.uint32
 F32 = jnp.float32
 BOOL = jnp.bool_
 
+def _f32r(row):
+    return jax.lax.bitcast_convert_type(row, F32)
+
+
 # leaf-state matrix columns
 LS_SG, LS_SH, LS_CNT, LS_VAL, LS_DEPTH, LS_START, LS_NROWS, LS_PAD = range(8)
 # best-candidate matrix columns
@@ -75,20 +79,8 @@ class PersistAssets(NamedTuple):
     geometry: tuple            # (WPA, NP, G, plan, nbw, n, C, CR) static
 
 
-def build_assets(dataset, labels: np.ndarray, C: int = 0,
-                 CR: int = 16384) -> PersistAssets:
-    """Host-side payload construction (once per dataset).
-
-    dataset: BinnedDataset with groups == features, widths <= 256.
-    """
-    n = int(dataset.num_data)
-    G = len(dataset.groups)
-    binned = dataset.binned          # [n, Gs] narrow int storage
-    packed = getattr(dataset, "device_packed", False)
-    if packed:
-        raise NotImplementedError  # plan below assumes byte storage
-    Gs = binned.shape[1]
-    nbw = (Gs + 3) // 4
+def _payload_geometry(n: int, G: int, C: int, CR: int):
+    nbw = (G + 3) // 4
     WP = nbw + 5                 # + label, rid, grad, hess, score
     WPA = ((WP + 7) // 8) * 8
     if C <= 0:
@@ -97,12 +89,18 @@ def build_assets(dataset, labels: np.ndarray, C: int = 0,
         C = 8192 if WPA <= 24 else (4096 if WPA <= 56 else 2048)
     NP = max(((n + 127) // 128 + 2) * 128 + C + 256,
              ((n + CR - 1) // CR) * CR)
+    return nbw, WPA, C, NP
+
+
+def _pack_payload(binned: np.ndarray, labels: np.ndarray, n: int,
+                  WPA: int, NP: int, nbw: int):
+    """One shard's payload matrix from its binned rows + labels."""
+    G = binned.shape[1]
     pay = np.zeros((WPA, NP), np.uint32)
     plan = []
     col = binned.astype(np.uint32)
     for g in range(G):
-        sc = g
-        w, sh = sc // 4, (sc % 4) * 8
+        w, sh = g // 4, (g % 4) * 8
         np.bitwise_or(pay[w, :n], col[:, g] << np.uint32(sh),
                       out=pay[w, :n])
         plan.append((w, sh, 255))
@@ -110,10 +108,46 @@ def build_assets(dataset, labels: np.ndarray, C: int = 0,
         labels.astype(np.float32)).view(np.uint32)
     pay[nbw + 1, :n] = np.arange(n, dtype=np.uint32)
     pay[nbw + 1, n:] = n                     # sentinel: dropped at finalize
+    return pay, plan
+
+
+def build_assets(dataset, labels: np.ndarray, C: int = 0,
+                 CR: int = 16384, num_shards: int = 1) -> PersistAssets:
+    """Host-side payload construction (once per dataset).
+
+    dataset: BinnedDataset with groups == features, widths <= 256.
+    With num_shards > 1 the rows are cut into equal contiguous blocks
+    (num_data % num_shards == 0 required; the sharded fast-path gate checks
+    this) and pay0 holds the per-shard payloads concatenated on the lane
+    axis — shard k's payload at lanes [k*NP, (k+1)*NP), with SHARD-LOCAL
+    row ids (global row = k*n_shard + local rid). geometry describes ONE
+    shard, which is what the per-device program sees under shard_map.
+    """
+    n_total = int(dataset.num_data)
+    if n_total % num_shards:
+        raise ValueError("persist sharding needs equal row shards")
+    n = n_total // num_shards
+    binned = dataset.binned          # [n_total, G] narrow int storage
+    if getattr(dataset, "device_packed", False):
+        raise NotImplementedError  # packing plan assumes byte storage
+    G = binned.shape[1]
+    labels = np.asarray(labels)
+    nbw, WPA, C, NP = _payload_geometry(n, G, C, CR)
+    blocks = []
+    plan = None
+    for k in range(num_shards):
+        pay_k, plan = _pack_payload(binned[k * n:(k + 1) * n],
+                                    labels[k * n:(k + 1) * n], n, WPA, NP,
+                                    nbw)
+        blocks.append(pay_k)
+    pay = blocks[0] if num_shards == 1 else np.concatenate(blocks, axis=1)
     F = dataset.num_features
     sc = np.arange(F, dtype=np.int32)
+    # pay0 stays a HOST array: the sharded caller device_puts it with a
+    # per-shard layout (materializing the whole payload on one device
+    # first would spike that device's HBM by the full dataset size)
     return PersistAssets(
-        pay0=jnp.asarray(pay),
+        pay0=pay,
         dec_word=jnp.asarray(sc // 4),
         dec_shift=jnp.asarray((sc % 4) * 8),
         dec_mask=jnp.asarray(np.full(F, 255, np.int32)),
@@ -123,6 +157,80 @@ def build_assets(dataset, labels: np.ndarray, C: int = 0,
         db=jnp.asarray(dataset.default_bin.astype(np.int32)),
         geometry=(WPA, NP, G, tuple(plan), nbw, n, C, CR),
     )
+
+
+# ---------------------------------------------------------------------------
+# pure-XLA kernel emulation (CPU fallback + sharding tests)
+# ---------------------------------------------------------------------------
+
+def make_xla_split_pass(WPA: int, NP: int, G: int, plan, nbw: int):
+    """jnp reference implementation of the split_pass kernel contract:
+    same (pay', (gh, hh), n_left) outputs, with the partitioned segment in
+    stable original order (left rows first). Row order within a segment is
+    an implementation detail both impls are free over — histograms, counts
+    and segment CONTENTS are what the grower depends on. Histograms
+    accumulate in f64 so per-shard partial sums + psum match a whole-data
+    sum to f32 round-off (the sharding equivalence tests rely on this)."""
+    grad_row = nbw + 2
+
+    def split_pass(pay, scal):
+        n_l = scal[S_NL]
+        s0 = scal[S_S0]
+        lane = jnp.arange(NP, dtype=I32)
+        in_seg = (lane >= s0) & (lane < s0 + n_l)
+        word = jnp.take(pay, scal[S_WG], axis=0)
+        b = ((word >> scal[S_SH].astype(U32))
+             & scal[S_MASK].astype(U32)).astype(I32)
+        cmp_left = b <= scal[S_THR]
+        is_na = (scal[S_MT] == 2) & (b == scal[S_NB] - 1)
+        is_zero = (scal[S_MT] == 1) & (b == scal[S_DB])
+        gd = is_na | is_zero
+        go_left = jnp.where(gd, scal[S_DL] > 0, cmp_left)
+        gl = in_seg & go_left
+        gr = in_seg & ~go_left
+        nL = jnp.sum(gl, dtype=I32)
+        rank_l = jnp.cumsum(gl.astype(I32)) - 1
+        rank_r = jnp.cumsum(gr.astype(I32)) - 1
+        target = jnp.where(gl, s0 + rank_l,
+                           jnp.where(gr, s0 + nL + rank_r, lane))
+        pay2 = jnp.zeros_like(pay).at[:, target].set(pay,
+                                                     unique_indices=True)
+        hm = in_seg & (go_left == (scal[S_SMALL_L] > 0))
+        grad = jnp.where(hm, _f32r(pay[grad_row]), 0.0).astype(jnp.float64)
+        hess = jnp.where(hm, _f32r(pay[grad_row + 1]), 0.0) \
+            .astype(jnp.float64)
+        gh = jnp.zeros(G * 256, jnp.float64)
+        hh = jnp.zeros(G * 256, jnp.float64)
+        for g, (w, sh, mk) in enumerate(plan):
+            bg = ((pay[w] >> U32(sh)) & U32(mk)).astype(I32) + g * 256
+            gh = gh.at[bg].add(grad)
+            hh = hh.at[bg].add(hess)
+        return pay2, (gh.astype(F32), hh.astype(F32)), nL
+
+    return split_pass
+
+
+def make_xla_root_hist(WPA: int, NP: int, G: int, plan, nbw: int, n: int):
+    """jnp reference implementation of the root_hist kernel contract
+    (f64 accumulation, see make_xla_split_pass)."""
+    grad_row = nbw + 2
+
+    def root_hist(pay):
+        live = jnp.arange(NP, dtype=I32) < n
+        grad = jnp.where(live, _f32r(pay[grad_row]), 0.0) \
+            .astype(jnp.float64)
+        hess = jnp.where(live, _f32r(pay[grad_row + 1]), 0.0) \
+            .astype(jnp.float64)
+        gh = jnp.zeros(G * 256, jnp.float64)
+        hh = jnp.zeros(G * 256, jnp.float64)
+        for g, (w, sh, mk) in enumerate(plan):
+            bg = ((pay[w] >> U32(sh)) & U32(mk)).astype(I32) + g * 256
+            gh = gh.at[bg].add(grad)
+            hh = hh.at[bg].add(hess)
+        sums = jnp.stack([jnp.sum(grad), jnp.sum(hess)]).astype(F32)
+        return (gh.astype(F32), hh.astype(F32)), sums
+
+    return root_hist
 
 
 class _PState(NamedTuple):
@@ -137,22 +245,41 @@ class _PState(NamedTuple):
 
 
 def make_persist_grower(assets: PersistAssets, meta, gc,
-                        interpret: bool = False):
+                        interpret: bool = False, axis_name=None,
+                        kernel_impl: str = "pallas"):
     """Build grow/score/gradient closures for one dataset + grow config.
 
     gc: GrowConfig (num_leaves, max_depth, num_features, scan_width used).
     Returns an object with .grow(pay, params, fmask), .apply_scores,
     .fill_grad, .finalize_scores.
+
+    axis_name: when set, the grower body runs per-shard under shard_map
+    over that mesh axis with rows sharded — the data-parallel learner over
+    the persist path. Exactly like the v1 sharded grower (and the
+    reference's ReduceScatter at data_parallel_tree_learner.cpp:163-234),
+    only the per-split smaller-child histogram planes, the left counts and
+    the root sums cross devices: leaf STATISTICS (sums, counts, gains,
+    split choices) are global, while payload GEOMETRY (segment starts/
+    lengths) stays shard-local. Every shard then takes identical split
+    decisions from the identical global state — SPMD without divergence.
+
+    kernel_impl: "pallas" (TPU Mosaic kernels) or "xla" (the jnp reference
+    implementation — CPU fallback and what the 8-device CPU-mesh sharding
+    tests run).
     """
     WPA, NP, G, plan, nbw, n, C, CR = assets.geometry
     F = gc.num_features
     L = gc.num_leaves
     W = 256
     TBp = G * W
-    split_pass = make_split_pass(WPA, NP, G, plan, nbw, C=C,
-                                 interpret=interpret)
-    root_hist = make_root_hist(WPA, NP, G, plan, nbw, n, C=CR,
-                               interpret=interpret)
+    if kernel_impl == "xla":
+        split_pass = make_xla_split_pass(WPA, NP, G, plan, nbw)
+        root_hist = make_xla_root_hist(WPA, NP, G, plan, nbw, n)
+    else:
+        split_pass = make_split_pass(WPA, NP, G, plan, nbw, C=C,
+                                     interpret=interpret)
+        root_hist = make_root_hist(WPA, NP, G, plan, nbw, n, C=CR,
+                                   interpret=interpret)
     grad_row = nbw + 2
     score_row = nbw + 4
 
@@ -227,13 +354,19 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
         root_value)."""
         layout = ScanLayout(pad_meta, fmask, F, W, TBp)
         rhist, sums = root_hist(pay)
+        gh0, hh0 = rhist
+        if axis_name is not None:
+            # root Allreduce (data_parallel_tree_learner.cpp:120-145)
+            sums = jax.lax.psum(sums, axis_name)
+            gh0 = jax.lax.psum(gh0, axis_name)
+            hh0 = jax.lax.psum(hh0, axis_name)
+            root_cnt = jax.lax.psum(jnp.asarray(n, F32), axis_name)
+        else:
+            root_cnt = jnp.asarray(n, F32)
         sum_grad = sums[0]
         sum_hess = sums[1]
-        root_cnt = jnp.asarray(n, F32)
         p32 = params.cast(F32)
         root_out = -sum_grad / (sum_hess + p32.lambda_l2.astype(F32))
-
-        gh0, hh0 = rhist
         gh = jnp.zeros((L, TBp), F32).at[0].set(gh0)
         hh = jnp.zeros((L, TBp), F32).at[0].set(hh0)
         lstate = jnp.zeros((L, 8), F32).at[0].set(
@@ -287,10 +420,24 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
             scal = scal.at[S_DL].set(bl[BC_DL].astype(I32))
             scal = scal.at[S_SMALL_L].set(smaller_is_left.astype(I32))
             pay, hist_sm, n_left = split_pass(st.pay, scal)
-            left_cnt = n_left
-            right_cnt = n_l - left_cnt
-
             sm_g, sm_h = hist_sm
+            # n_l == 0 skips the kernel (zero grid steps) and leaves its
+            # histogram/count outputs undefined; mask before sums/psum
+            ran = n_l > 0
+            sm_g = jnp.where(ran, sm_g, 0.0)
+            sm_h = jnp.where(ran, sm_h, 0.0)
+            n_left = jnp.where(ran, n_left, 0)
+            n_right = n_l - n_left
+            if axis_name is not None:
+                # per-split histogram reduction + global left count
+                # (data_parallel_tree_learner.cpp:163-234); n_left/n_right
+                # stay shard-local for the payload segment geometry
+                sm_g = jax.lax.psum(sm_g, axis_name)
+                sm_h = jax.lax.psum(sm_h, axis_name)
+                left_cnt = jax.lax.psum(n_left, axis_name)
+            else:
+                left_cnt = n_left
+            right_cnt = jnp.where(do, ls[LS_CNT].astype(I32), 0) - left_cnt
             par_g = st.gh[l]
             par_h = st.hh[l]
             big_g = par_g - sm_g
@@ -323,14 +470,14 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
                 .at[LS_VAL].set(bl[BC_LOUT]) \
                 .at[LS_DEPTH].set(depth_child) \
                 .at[LS_START].set(s0.astype(F32)) \
-                .at[LS_NROWS].set(left_cnt.astype(F32))
+                .at[LS_NROWS].set(n_left.astype(F32))
             row_s = jnp.zeros((8,), F32) \
                 .at[LS_SG].set(bl[BC_RSG]).at[LS_SH].set(bl[BC_RSH]) \
                 .at[LS_CNT].set(right_cnt.astype(F32)) \
                 .at[LS_VAL].set(bl[BC_ROUT]) \
                 .at[LS_DEPTH].set(depth_child) \
-                .at[LS_START].set((s0 + left_cnt).astype(F32)) \
-                .at[LS_NROWS].set(right_cnt.astype(F32))
+                .at[LS_START].set((s0 + n_left).astype(F32)) \
+                .at[LS_NROWS].set(n_right.astype(F32))
             lstate = st.lstate.at[l].set(jnp.where(do, row_l, st.lstate[l])) \
                               .at[s].set(jnp.where(do, row_s, st.lstate[s]))
 
@@ -468,7 +615,8 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
     return gr
 
 
-def make_scan_driver(gr, gc, k: int, grad_fn, row_order: bool = False):
+def make_scan_driver(gr, gc, k: int, grad_fn, row_order: bool = False,
+                     wrap_jit: bool = True):
     """K fused boosting iterations over the persistent payload.
 
     grad_fn is baked statically: payload mode takes (score_pos, label_pos);
@@ -476,9 +624,12 @@ def make_scan_driver(gr, gc, k: int, grad_fn, row_order: bool = False):
     grad function (lambdarank etc.), fed by a per-tree scatter/gather
     through the rid row. Returns fn(pay, fmasks [k, F], params, shrink,
     gargs) -> (pay', stacked TreeArrays).
+
+    wrap_jit=False returns the untraced body for callers that wrap it
+    themselves (the sharded learner puts it under shard_map and jits with
+    payload donation outside).
     """
 
-    @functools.partial(jax.jit, donate_argnums=(0,))
     def run(pay, fmasks, params, shrink, gargs):
         def body(pay, fmask):
             if row_order:
@@ -492,4 +643,6 @@ def make_scan_driver(gr, gc, k: int, grad_fn, row_order: bool = False):
         payK, stacked = jax.lax.scan(body, pay, fmasks, length=k)
         return payK, stacked
 
+    if wrap_jit:
+        return jax.jit(run, donate_argnums=(0,))
     return run

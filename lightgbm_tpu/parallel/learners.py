@@ -130,6 +130,87 @@ class DataParallelTreeLearner(SerialTreeLearner):
         tree = Tree.from_grower(host, self.dataset)
         return tree, arrays.row_leaf
 
+    # -- sharded persistent-payload fast path ---------------------------
+    # The K-iteration persist scan (ops/grow_persist.py) under shard_map:
+    # per-shard payloads with shard-local row ids, histogram planes and
+    # left counts psum'd inside the grow loop (the ReduceScatter at
+    # data_parallel_tree_learner.cpp:163 fused into the per-split kernel
+    # step). The base-class driver methods (train_arrays_scan_persist /
+    # persist_finalize_scores) work unchanged against the wrapper this
+    # _persist_cached returns.
+
+    def _persist_axis_ok(self) -> bool:
+        return (self.grow_config.parallel_mode not in ("voting", "feature")
+                and self.dataset.num_data % self.num_shards == 0)
+
+    def _persist_rows_ok(self) -> bool:
+        # global counts (root_cnt, psum'd left counts) ride the f32 leaf
+        # state, so the 2^24 exact-int bound applies to TOTAL rows too
+        return self.dataset.num_data < (1 << 24)
+
+    def _persist_obj_ok(self, objective) -> bool:
+        # payload-order gradients only: row-order mode needs global row
+        # structure (lambdarank query groups) that crosses shards
+        return objective.payload_grad_fn() is not None
+
+    def _persist_cached(self, objective, k: int):
+        from ..ops.grow_persist import (build_assets, make_persist_grower,
+                                        make_scan_driver)
+        from jax.sharding import NamedSharding
+        cache = getattr(self.dataset, "_persist_cache", None)
+        if cache is None:
+            cache = self.dataset._persist_cache = {}
+        S = self.num_shards
+        mesh = self.mesh
+        pay_spec = P(None, AXIS)
+        akey = ("assets_sharded", S)
+        assets = cache.get(akey)
+        if assets is None:
+            assets = build_assets(self.dataset, self.dataset.metadata.label,
+                                  num_shards=S)
+            assets = assets._replace(pay0=jax.device_put(
+                assets.pay0, NamedSharding(mesh, pay_spec)))
+            cache[akey] = assets
+        kernel_impl, interpret = self._persist_kernel_mode()
+        gc = self.grow_config
+        gkey = ("grower_sharded", S, gc)
+        wrapper = cache.get(gkey)
+        if wrapper is None:
+            inner = make_persist_grower(assets, self.meta, gc,
+                                        interpret=interpret,
+                                        axis_name=AXIS,
+                                        kernel_impl=kernel_impl)
+
+            class _ShardedGrower:
+                pass
+
+            wrapper = _ShardedGrower()
+            wrapper.inner = inner
+            wrapper.init_carry = jax.jit(jax.shard_map(
+                inner.init_carry, mesh=mesh,
+                in_specs=(pay_spec, P(AXIS)), out_specs=pay_spec,
+                check_vma=False))
+            wrapper.finalize_scores = jax.jit(jax.shard_map(
+                inner.finalize_scores, mesh=mesh,
+                in_specs=(pay_spec,), out_specs=P(AXIS),
+                check_vma=False))
+            cache[gkey] = wrapper
+        dkey = ("driver_sharded", S, k, gc, objective.static_fingerprint())
+        driver = cache.get(dkey)
+        if driver is None:
+            raw = make_scan_driver(wrapper.inner, gc, k,
+                                   objective.payload_grad_fn(),
+                                   wrap_jit=False)
+            smapped = jax.shard_map(
+                raw, mesh=mesh,
+                in_specs=(pay_spec, P(), P(), P(), P()),
+                out_specs=(pay_spec, _tree_arrays_spec(gc,
+                                                       row_sharded=False)),
+                check_vma=False)
+            driver = jax.jit(smapped, donate_argnums=(0,))
+            cache[dkey] = driver
+        return assets, wrapper, driver
+
 
 def _tree_arrays_spec(gc: GrowConfig, row_sharded: bool = True):
     """A TreeArrays-shaped pytree of PartitionSpecs (replicated except

@@ -372,38 +372,65 @@ class SerialTreeLearner:
         return ex
 
     # -- persistent-payload fast path (ops/grow_persist.py) -------------
+    def _persist_axis_ok(self) -> bool:
+        """Overridden by DataParallelTreeLearner: the persist path runs
+        sharded there (psum of histogram planes inside the grow loop)."""
+        return self._axis_name is None
+
+    def _persist_rows_ok(self) -> bool:
+        """Payload row-id packing bound (per-payload; sharded learners
+        check their per-shard row count)."""
+        return self.dataset.num_data < (1 << 24)
+
+    def _persist_obj_ok(self, objective) -> bool:
+        return (objective.payload_grad_fn() is not None
+                or getattr(objective, "supports_fused_scan", False))
+
     def can_persist_scan(self, objective) -> bool:
         """True when the whole K-iteration scan can run on the persistent
         transposed payload (fused split kernel, no per-row gathers).
         Requirements beyond the Pallas-scan fast path: numerical features
         only, one feature per group (no EFB bundles), <= 256 bins, label-
-        only objective, unweighted, single device, n in [PARTITION_MIN_ROWS,
-        2^24)."""
+        only objective, unweighted, per-payload rows < 2^24. Single device
+        or the data-parallel learner (sharded persist). tpu_persist_scan=
+        force engages the XLA kernel emulation off-TPU (tests)."""
         import jax
         from ..ops.pallas_grow import HAS_PALLAS
         ds = self.dataset
         gc = self.grow_config
-        if not (HAS_PALLAS and jax.default_backend() in ("tpu", "axon")):
-            return False
         opt = str(getattr(self.config, "tpu_persist_scan", "auto")).lower()
         if opt in ("false", "0", "off"):
             return False
+        if opt != "force":
+            if not (HAS_PALLAS
+                    and jax.default_backend() in ("tpu", "axon")):
+                return False
+            if gc.scan_impl != "pallas":
+                return False
+            if ds.num_data < PARTITION_MIN_ROWS:
+                return False
         widths = (ds.bin_end - ds.bin_start) if ds.num_features else None
-        return (gc.scan_impl == "pallas"
-                and gc.n_forced == 0
+        return (gc.n_forced == 0
                 and not gc.packed_4bit
                 and self.cat_layout.cat_feature.shape[0] == 0
                 and ds.num_features > 0
                 and len(ds.groups) == ds.num_features
                 and not bool(np.any(ds.needs_fix))
                 and int(widths.max()) <= 256
-                and ds.num_data >= PARTITION_MIN_ROWS
-                and ds.num_data < (1 << 24)
-                and self._axis_name is None
+                and self._persist_rows_ok()
+                and self._persist_axis_ok()
                 and objective is not None
-                and (objective.payload_grad_fn() is not None
-                     or getattr(objective, "supports_fused_scan", False))
+                and self._persist_obj_ok(objective)
                 and ds.metadata.weight is None)
+
+    @staticmethod
+    def _persist_kernel_mode():
+        """(kernel_impl, interpret) by backend: Mosaic kernels on TPU, the
+        XLA emulation elsewhere (tpu_persist_scan=force paths/tests)."""
+        import jax
+        if jax.default_backend() in ("tpu", "axon"):
+            return "pallas", False
+        return "xla", True
 
     def _persist_cached(self, objective, k: int):
         from ..ops.grow_persist import (build_assets, make_persist_grower,
@@ -415,10 +442,13 @@ class SerialTreeLearner:
         if assets is None:
             assets = build_assets(self.dataset, self.dataset.metadata.label)
             cache["assets"] = assets
+        kernel_impl, interpret = self._persist_kernel_mode()
         gkey = ("grower", self.grow_config)
         gr = cache.get(gkey)
         if gr is None:
-            gr = make_persist_grower(assets, self.meta, self.grow_config)
+            gr = make_persist_grower(assets, self.meta, self.grow_config,
+                                     interpret=interpret,
+                                     kernel_impl=kernel_impl)
             cache[gkey] = gr
         dkey = ("driver", k, self.grow_config,
                 objective.static_fingerprint())
