@@ -230,7 +230,19 @@ class GBDT:
                 and self.train_data.num_features > 0
                 and all(self.class_need_train))
 
-    supports_batch = True   # DART/GOSS/RF need host work per iteration
+    supports_batch = True   # DART/RF need host work per iteration
+
+    def _persist_bag_spec(self):
+        """Static description of the device-side bag transform the persist
+        driver should run (ops/grow_persist.make_bag_transform); GOSS
+        overrides. ("none",) = no per-row sampling configured."""
+        cfg = self.config
+        if cfg.bagging_freq > 0 and self.balanced_bagging:
+            return ("bagging", 1.0, float(cfg.pos_bagging_fraction),
+                    float(cfg.neg_bagging_fraction))
+        if cfg.bagging_freq > 0 and cfg.bagging_fraction < 1.0:
+            return ("bagging", float(cfg.bagging_fraction), 1.0, 1.0)
+        return ("none",)
 
     def _batch_size(self) -> int:
         from ..parallel.learners import DataParallelTreeLearner
@@ -244,16 +256,25 @@ class GBDT:
         learner_ok = (type(learner) is SerialTreeLearner
                       or (persist
                           and isinstance(learner, DataParallelTreeLearner)))
+        bag_spec = self._persist_bag_spec()
+        if bag_spec[0] == "none":
+            # no sampling configured for the driver; any leftover host
+            # bagging state (reset_parameter re-bag, GOSS weights from a
+            # single-iteration fallback) forces the per-iteration path
+            bag_ok = (not (cfg.bagging_fraction < 1.0
+                           and cfg.bagging_freq > 0)
+                      and not self.balanced_bagging
+                      and not self.need_re_bagging
+                      and self._bag_weight_dev is None)
+        else:
+            # bagging/GOSS run INSIDE the persist driver as payload
+            # transforms (masks re-derived from row ids per window)
+            bag_ok = persist and learner.persist_bag_ok(bag_spec)
         if not (self.allow_batch and self.supports_batch
                 and (self.objective is None
                      or self.objective.supports_fused_scan)
                 and self.num_tree_per_iteration == 1
-                and not (cfg.bagging_fraction < 1.0 and cfg.bagging_freq > 0)
-                and not (cfg.pos_bagging_fraction < 1.0
-                         or cfg.neg_bagging_fraction < 1.0)
-                and not self.need_re_bagging
-                and not self.balanced_bagging
-                and self._bag_weight_dev is None
+                and bag_ok
                 and self.train_data.num_features > 0
                 and learner_ok):
             return 1
@@ -285,8 +306,13 @@ class GBDT:
             score0 = (self.train_score.score_device(0)
                       if getattr(learner, "_persist_carry", None) is None
                       else None)
+            bag_spec = self._persist_bag_spec()
+            wkeys, iters = self._persist_bag_keys(bag_spec, k)
+            if bag_spec[0] != "none":
+                self._persist_bag_active = True
             stacked = learner.train_arrays_scan_persist(
-                self.objective, score0, fmasks, self.shrinkage_rate, k)
+                self.objective, score0, fmasks, wkeys, iters,
+                self.shrinkage_rate, k, bag_spec)
             # scores live payload-ordered on the learner until synced
             self._persist_scores_dirty = True
         else:
@@ -305,6 +331,25 @@ class GBDT:
         self._batch_credit = k - 1
         return False
 
+    def _persist_bag_keys(self, bag_spec, k: int):
+        """Per-iteration window keys + iteration indices for the persist
+        driver's bag transform. Bagging folds the bagging_seed key at the
+        WINDOW index (it // bagging_freq), so every iteration inside a
+        window redraws the identical per-row mask — the reference's cached
+        bag (gbdt.cpp:210-244) without a mask row in the payload."""
+        import jax
+        start = self.iter
+        iters = np.arange(start, start + k, dtype=np.int32)
+        if bag_spec[0] == "none":
+            return np.zeros((k, 2), np.uint32), iters
+        freq = max(int(self.config.bagging_freq), 1)
+        base = jax.random.PRNGKey(int(self.config.bagging_seed))
+        windows = (iters // freq if bag_spec[0] == "bagging" else iters)
+        wkeys = np.stack([
+            np.asarray(jax.random.key_data(jax.random.fold_in(base, int(w))))
+            for w in windows]).astype(np.uint32)
+        return wkeys, iters
+
     def _sync_persist_scores(self) -> None:
         """Write the persistent-payload carry's scores back into the
         row-ordered score buffer (one device scatter; keeps the carry)."""
@@ -322,6 +367,12 @@ class GBDT:
         k = self._batch_size()
         if k > 1:
             return self._train_multi_iter_fast(k)
+        if getattr(self, "_persist_bag_active", False):
+            # device bagging already ran in a fused batch: the tail
+            # iterations must keep drawing the same hash-keyed window bags
+            # (a host redraw mid-window would break the cached-bag
+            # contract, gbdt.cpp:210-244) — run them as k=1 batches
+            return self._train_multi_iter_fast(1)
         self._sync_persist_scores()
         ntpi = self.num_tree_per_iteration
         init_scores = [self.boost_from_average(k, True) for k in range(ntpi)]

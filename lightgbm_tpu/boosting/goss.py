@@ -20,8 +20,17 @@ from .gbdt import GBDT
 
 class GOSS(GBDT):
 
-    supports_batch = False  # per-iteration host work (drop/sample RNG)
+    # batching engages only through the persist driver's device-side GOSS
+    # transform (_persist_bag_spec below; _batch_size requires
+    # persist_bag_ok for a non-"none" spec) — the v1 scan path still runs
+    # the per-iteration host sampling in bagging()
+    supports_batch = True
     sub_model_name = "goss"
+
+    def _persist_bag_spec(self):
+        cfg = self.config
+        return ("goss", float(cfg.top_rate), float(cfg.other_rate),
+                int(1.0 / float(cfg.learning_rate)))
 
     def init(self, config, train_data, objective, training_metrics=()):
         super().init(config, train_data, objective, training_metrics)

@@ -30,8 +30,10 @@ row-ordered score/gradient plumbing around it:
 Numerics: f32 accumulation everywhere (the reference GPU learner's
 gpu_use_dp=false trade); trees match the v1 f32 grower up to f32 summation
 order. Gated by treelearner.serial.can_persist_scan — anything outside the
-fast path (categoricals, EFB bundles, bagging, weights, monotone, f64)
-takes the v1 path.
+fast path (categoricals, EFB bundles, weights, monotone, f64) takes the
+v1 path. Bagging and GOSS run INSIDE the scan as payload transforms
+(make_bag_transform), and the whole driver also runs sharded under
+shard_map (make_persist_grower's axis_name) with in-loop histogram psum.
 """
 from __future__ import annotations
 
@@ -93,8 +95,12 @@ def _payload_geometry(n: int, G: int, C: int, CR: int):
 
 
 def _pack_payload(binned: np.ndarray, labels: np.ndarray, n: int,
-                  WPA: int, NP: int, nbw: int):
-    """One shard's payload matrix from its binned rows + labels."""
+                  WPA: int, NP: int, nbw: int, rid_offset: int,
+                  rid_sentinel: int):
+    """One shard's payload matrix from its binned rows + labels. Row ids
+    are GLOBAL (shard offset baked in): the bag transforms hash them, so
+    draws must agree between serial and sharded runs; finalize_scores
+    subtracts the shard offset back out."""
     G = binned.shape[1]
     pay = np.zeros((WPA, NP), np.uint32)
     plan = []
@@ -106,8 +112,8 @@ def _pack_payload(binned: np.ndarray, labels: np.ndarray, n: int,
         plan.append((w, sh, 255))
     pay[nbw, :n] = np.ascontiguousarray(
         labels.astype(np.float32)).view(np.uint32)
-    pay[nbw + 1, :n] = np.arange(n, dtype=np.uint32)
-    pay[nbw + 1, n:] = n                     # sentinel: dropped at finalize
+    pay[nbw + 1, :n] = rid_offset + np.arange(n, dtype=np.uint32)
+    pay[nbw + 1, n:] = rid_sentinel          # dropped at finalize
     return pay, plan
 
 
@@ -119,9 +125,11 @@ def build_assets(dataset, labels: np.ndarray, C: int = 0,
     With num_shards > 1 the rows are cut into equal contiguous blocks
     (num_data % num_shards == 0 required; the sharded fast-path gate checks
     this) and pay0 holds the per-shard payloads concatenated on the lane
-    axis — shard k's payload at lanes [k*NP, (k+1)*NP), with SHARD-LOCAL
-    row ids (global row = k*n_shard + local rid). geometry describes ONE
-    shard, which is what the per-device program sees under shard_map.
+    axis — shard k's payload at lanes [k*NP, (k+1)*NP). Row ids are GLOBAL
+    everywhere (the bag transforms hash them, so draws must agree between
+    serial and sharded runs); finalize_scores subtracts the shard offset.
+    geometry describes ONE shard, which is what the per-device program
+    sees under shard_map.
     """
     n_total = int(dataset.num_data)
     if n_total % num_shards:
@@ -138,7 +146,8 @@ def build_assets(dataset, labels: np.ndarray, C: int = 0,
     for k in range(num_shards):
         pay_k, plan = _pack_payload(binned[k * n:(k + 1) * n],
                                     labels[k * n:(k + 1) * n], n, WPA, NP,
-                                    nbw)
+                                    nbw, rid_offset=k * n,
+                                    rid_sentinel=n_total)
         blocks.append(pay_k)
     pay = blocks[0] if num_shards == 1 else np.concatenate(blocks, axis=1)
     F = dataset.num_features
@@ -244,14 +253,119 @@ class _PState(NamedTuple):
     tree: jnp.ndarray          # [L, 8] f32
 
 
+# ---------------------------------------------------------------------------
+# device-side bagging / GOSS (payload transforms)
+# ---------------------------------------------------------------------------
+
+def _hash_uniform(rid, wkey):
+    """Stateless per-row uniform in [0, 1) from (row id, window key): a
+    murmur3-style integer finalizer. Rows permute across iterations but the
+    row id rides the payload, so the same window key reproduces the same
+    per-ROW draw regardless of position — bagging_freq windows behave like
+    the reference's cached bag (gbdt.cpp:210-244) without a mask row."""
+    x = rid.astype(U32) ^ wkey[0]
+    x = x * U32(0x85EB_CA6B)
+    x = x ^ (x >> 13)
+    x = (x + wkey[1]) * U32(0xC2B2_AE35)
+    x = x ^ (x >> 16)
+    return x.astype(F32) * F32(1.0 / 4294967296.0)
+
+
+def make_bag_transform(bag_spec, geometry):
+    """Payload transform applied after the gradient fill: scales/zeroes the
+    grad+hess rows per row and returns the in-bag count.
+
+    bag_spec (static):
+      ("none",)
+      ("bagging", fraction, pos_fraction, neg_fraction)    — per-row
+        bernoulli at the window key (balanced bagging splits by the label
+        row, gbdt.cpp:210-244 / ResetBaggingConfig)
+      ("goss", top_rate, other_rate, skip_iters)           — rows with
+        |g*h| above the top_rate threshold kept; the rest kept with
+        probability other_rate/(1-top_rate) and amplified by
+        (1-top_rate)/other_rate (goss.hpp:75-124; bernoulli where the
+        reference samples exactly other_k — same expectation). Sampling
+        starts after skip_iters (goss.hpp:126-131).
+
+    Returns fn(pay, wkey [2]u32, it i32) -> (pay', bag_cnt f32 local).
+    """
+    WPA, NP, G, plan, nbw, n, C, CR = geometry
+    grad_row = nbw + 2
+    mode = bag_spec[0]
+
+    def none_fn(pay, wkey, it):
+        return pay, jnp.asarray(n, F32)
+
+    if mode == "none":
+        return none_fn
+
+    def apply_w(pay, w):
+        g = _f32r(pay[grad_row]) * w
+        h = _f32r(pay[grad_row + 1]) * w
+        gh = jax.lax.bitcast_convert_type(jnp.stack([g, h]), U32)
+        pay = jax.lax.dynamic_update_slice(
+            pay, gh, (jnp.asarray(grad_row, I32), jnp.asarray(0, I32)))
+        return pay, jnp.sum((w > 0).astype(F32))
+
+    if mode == "bagging":
+        _, fraction, pos_f, neg_f = bag_spec
+        balanced = pos_f < 1.0 or neg_f < 1.0
+
+        def bag_fn(pay, wkey, it):
+            live = jnp.arange(NP, dtype=I32) < n
+            u = _hash_uniform(pay[nbw + 1], wkey)
+            if balanced:
+                pos = _f32r(pay[nbw]) > 0
+                keep = jnp.where(pos, u < F32(pos_f), u < F32(neg_f))
+            else:
+                keep = u < F32(fraction)
+            w = (keep & live).astype(F32)
+            return apply_w(pay, w)
+
+        return bag_fn
+
+    if mode == "goss":
+        _, top_rate, other_rate, skip_iters = bag_spec
+        top_k = max(1, int(n * top_rate))
+        p_rest = min(1.0, (n * other_rate) / max(n - top_k, 1))
+        amp = (n - top_k) / max(n * other_rate, 1.0)
+
+        def goss_fn(pay, wkey, it):
+            live = jnp.arange(NP, dtype=I32) < n
+            g = _f32r(pay[grad_row])
+            h = _f32r(pay[grad_row + 1])
+            s = jnp.where(live, jnp.abs(g * h), -jnp.inf)
+            thr = jnp.sort(s)[NP - top_k]
+            big = s >= thr
+            u = _hash_uniform(pay[nbw + 1], wkey)
+            w = jnp.where(big, F32(1.0),
+                          jnp.where(u < F32(p_rest), F32(amp), F32(0.0)))
+            w = jnp.where(live, w, F32(0.0))
+            w = jnp.where(it < skip_iters, live.astype(F32), w)
+            return apply_w(pay, w)
+
+        return goss_fn
+
+    raise ValueError("unknown bag mode %r" % (mode,))
+
+
 def make_persist_grower(assets: PersistAssets, meta, gc,
                         interpret: bool = False, axis_name=None,
-                        kernel_impl: str = "pallas"):
+                        kernel_impl: str = "pallas",
+                        stat_from_scan: bool = False):
     """Build grow/score/gradient closures for one dataset + grow config.
 
     gc: GrowConfig (num_leaves, max_depth, num_features, scan_width used).
     Returns an object with .grow(pay, params, fmask), .apply_scores,
     .fill_grad, .finalize_scores.
+
+    stat_from_scan: leaf counts come from the scan's hessian-derived
+    rounding (the reference's cnt_factor recovery,
+    feature_histogram.hpp:772-790) instead of the kernel's exact
+    partition counts. Required under bagging/GOSS, where out-of-bag rows
+    still ride the payload segments and the geometric counts no longer
+    equal the statistical ones; grow() then takes the exact in-bag root
+    count from the bag transform.
 
     axis_name: when set, the grower body runs per-shard under shard_map
     over that mesh axis with rows sharded — the data-parallel learner over
@@ -349,20 +463,21 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
             jnp.floor(lc + 0.5), jnp.floor(rc + 0.5),
             lo, ro], axis=1)                        # [2, 12]
 
-    def grow(pay, params: SplitParams, fmask):
+    def grow(pay, params: SplitParams, fmask, bag_cnt=None):
         """Grow one tree in place; returns (pay', lstate, tree, num_leaves,
-        root_value)."""
+        root_value). bag_cnt: shard-local in-bag row count from the bag
+        transform (None = every live row in bag)."""
         layout = ScanLayout(pad_meta, fmask, F, W, TBp)
         rhist, sums = root_hist(pay)
         gh0, hh0 = rhist
+        root_cnt = (jnp.asarray(n, F32) if bag_cnt is None
+                    else bag_cnt.astype(F32))
         if axis_name is not None:
             # root Allreduce (data_parallel_tree_learner.cpp:120-145)
             sums = jax.lax.psum(sums, axis_name)
             gh0 = jax.lax.psum(gh0, axis_name)
             hh0 = jax.lax.psum(hh0, axis_name)
-            root_cnt = jax.lax.psum(jnp.asarray(n, F32), axis_name)
-        else:
-            root_cnt = jnp.asarray(n, F32)
+            root_cnt = jax.lax.psum(root_cnt, axis_name)
         sum_grad = sums[0]
         sum_hess = sums[1]
         p32 = params.cast(F32)
@@ -429,15 +544,21 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
             n_left = jnp.where(ran, n_left, 0)
             n_right = n_l - n_left
             if axis_name is not None:
-                # per-split histogram reduction + global left count
+                # per-split histogram reduction
                 # (data_parallel_tree_learner.cpp:163-234); n_left/n_right
                 # stay shard-local for the payload segment geometry
                 sm_g = jax.lax.psum(sm_g, axis_name)
                 sm_h = jax.lax.psum(sm_h, axis_name)
-                left_cnt = jax.lax.psum(n_left, axis_name)
+            if stat_from_scan:
+                # bagged: geometric segment counts include out-of-bag rows;
+                # the scan's hessian-derived counts are the statistics
+                left_cnt = bl[BC_LCNT].astype(I32)
+                right_cnt = bl[BC_RCNT].astype(I32)
             else:
-                left_cnt = n_left
-            right_cnt = jnp.where(do, ls[LS_CNT].astype(I32), 0) - left_cnt
+                left_cnt = (jax.lax.psum(n_left, axis_name)
+                            if axis_name is not None else n_left)
+                right_cnt = (jnp.where(do, ls[LS_CNT].astype(I32), 0)
+                             - left_cnt)
             par_g = st.gh[l]
             par_h = st.hh[l]
             big_g = par_g - sm_g
@@ -554,8 +675,13 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
             pay, gh, (jnp.asarray(grad_row, I32), jnp.asarray(0, I32)))
 
     def finalize_scores(pay):
-        """Payload-order scores -> row order (one scatter per batch)."""
+        """Payload-order scores -> row order (one scatter per batch).
+        Row ids are global; sharded runs subtract the shard offset (dead
+        lanes carry the total-row sentinel and always land out of range).
+        """
         rid = pay[nbw + 1].astype(I32)
+        if axis_name is not None:
+            rid = rid - jax.lax.axis_index(axis_name).astype(I32) * n
         score = jax.lax.bitcast_convert_type(pay[score_row], F32)
         return jnp.zeros((n,), F32).at[rid].set(
             score, mode="drop", unique_indices=True)
@@ -616,31 +742,41 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
 
 
 def make_scan_driver(gr, gc, k: int, grad_fn, row_order: bool = False,
-                     wrap_jit: bool = True):
+                     wrap_jit: bool = True, bag_fn=None):
     """K fused boosting iterations over the persistent payload.
 
     grad_fn is baked statically: payload mode takes (score_pos, label_pos);
     row_order mode takes (score_row, *gargs) — the objective's standard
     grad function (lambdarank etc.), fed by a per-tree scatter/gather
-    through the rid row. Returns fn(pay, fmasks [k, F], params, shrink,
-    gargs) -> (pay', stacked TreeArrays).
+    through the rid row. Returns fn(pay, fmasks [k, F], wkeys [k, 2]u32,
+    iters [k]i32, params, shrink, gargs) -> (pay', stacked TreeArrays).
+
+    bag_fn: optional make_bag_transform closure run between the gradient
+    fill and the grow (bagging masks / GOSS weights applied to the payload
+    grad rows; its in-bag count feeds the root statistics).
 
     wrap_jit=False returns the untraced body for callers that wrap it
     themselves (the sharded learner puts it under shard_map and jits with
     payload donation outside).
     """
 
-    def run(pay, fmasks, params, shrink, gargs):
-        def body(pay, fmask):
+    def run(pay, fmasks, wkeys, iters, params, shrink, gargs):
+        def body(pay, per):
+            fmask, wkey, it = per
             if row_order:
                 pay = gr.fill_grad_row(pay, grad_fn, gargs)
             else:
                 pay = gr.fill_grad(pay, grad_fn)
-            pay, lstate, tree, nl, _root = gr.grow(pay, params, fmask)
+            bag_cnt = None
+            if bag_fn is not None:
+                pay, bag_cnt = bag_fn(pay, wkey, it)
+            pay, lstate, tree, nl, _root = gr.grow(pay, params, fmask,
+                                                   bag_cnt=bag_cnt)
             pay = gr.apply_scores(pay, lstate, nl, shrink)
             out = gr.to_tree_arrays(lstate, tree, nl)
             return pay, out
-        payK, stacked = jax.lax.scan(body, pay, fmasks, length=k)
+        payK, stacked = jax.lax.scan(body, pay, (fmasks, wkeys, iters),
+                                     length=k)
         return payK, stacked
 
     if wrap_jit:

@@ -153,8 +153,14 @@ class DataParallelTreeLearner(SerialTreeLearner):
         # structure (lambdarank query groups) that crosses shards
         return objective.payload_grad_fn() is not None
 
-    def _persist_cached(self, objective, k: int):
-        from ..ops.grow_persist import (build_assets, make_persist_grower,
+    def persist_bag_ok(self, bag_spec) -> bool:
+        # bagging draws are row-local; the GOSS threshold is a global
+        # order statistic (needs a cross-shard quantile) — not yet sharded
+        return bag_spec[0] in ("none", "bagging")
+
+    def _persist_cached(self, objective, k: int, bag_spec=("none",)):
+        from ..ops.grow_persist import (build_assets, make_bag_transform,
+                                        make_persist_grower,
                                         make_scan_driver)
         from jax.sharding import NamedSharding
         cache = getattr(self.dataset, "_persist_cache", None)
@@ -172,14 +178,16 @@ class DataParallelTreeLearner(SerialTreeLearner):
                 assets.pay0, NamedSharding(mesh, pay_spec)))
             cache[akey] = assets
         kernel_impl, interpret = self._persist_kernel_mode()
+        stat_from_scan = bag_spec[0] != "none"
         gc = self.grow_config
-        gkey = ("grower_sharded", S, gc)
+        gkey = ("grower_sharded", S, gc, stat_from_scan)
         wrapper = cache.get(gkey)
         if wrapper is None:
             inner = make_persist_grower(assets, self.meta, gc,
                                         interpret=interpret,
                                         axis_name=AXIS,
-                                        kernel_impl=kernel_impl)
+                                        kernel_impl=kernel_impl,
+                                        stat_from_scan=stat_from_scan)
 
             class _ShardedGrower:
                 pass
@@ -195,15 +203,18 @@ class DataParallelTreeLearner(SerialTreeLearner):
                 in_specs=(pay_spec,), out_specs=P(AXIS),
                 check_vma=False))
             cache[gkey] = wrapper
-        dkey = ("driver_sharded", S, k, gc, objective.static_fingerprint())
+        dkey = ("driver_sharded", S, k, gc, objective.static_fingerprint(),
+                bag_spec)
         driver = cache.get(dkey)
         if driver is None:
+            bag_fn = (make_bag_transform(bag_spec, assets.geometry)
+                      if stat_from_scan else None)
             raw = make_scan_driver(wrapper.inner, gc, k,
                                    objective.payload_grad_fn(),
-                                   wrap_jit=False)
+                                   wrap_jit=False, bag_fn=bag_fn)
             smapped = jax.shard_map(
                 raw, mesh=mesh,
-                in_specs=(pay_spec, P(), P(), P(), P()),
+                in_specs=(pay_spec, P(), P(), P(), P(), P(), P()),
                 out_specs=(pay_spec, _tree_arrays_spec(gc,
                                                        row_sharded=False)),
                 check_vma=False)

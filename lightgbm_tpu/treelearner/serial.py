@@ -386,6 +386,11 @@ class SerialTreeLearner:
         return (objective.payload_grad_fn() is not None
                 or getattr(objective, "supports_fused_scan", False))
 
+    def persist_bag_ok(self, bag_spec) -> bool:
+        """Which device-side bag transforms this learner's persist path
+        supports (single-payload: all of them)."""
+        return bag_spec[0] in ("none", "bagging", "goss")
+
     def can_persist_scan(self, objective) -> bool:
         """True when the whole K-iteration scan can run on the persistent
         transposed payload (fused split kernel, no per-row gathers).
@@ -432,8 +437,9 @@ class SerialTreeLearner:
             return "pallas", False
         return "xla", True
 
-    def _persist_cached(self, objective, k: int):
-        from ..ops.grow_persist import (build_assets, make_persist_grower,
+    def _persist_cached(self, objective, k: int, bag_spec=("none",)):
+        from ..ops.grow_persist import (build_assets, make_bag_transform,
+                                        make_persist_grower,
                                         make_scan_driver)
         cache = getattr(self.dataset, "_persist_cache", None)
         if cache is None:
@@ -443,38 +449,46 @@ class SerialTreeLearner:
             assets = build_assets(self.dataset, self.dataset.metadata.label)
             cache["assets"] = assets
         kernel_impl, interpret = self._persist_kernel_mode()
-        gkey = ("grower", self.grow_config)
+        stat_from_scan = bag_spec[0] != "none"
+        gkey = ("grower", self.grow_config, stat_from_scan)
         gr = cache.get(gkey)
         if gr is None:
             gr = make_persist_grower(assets, self.meta, self.grow_config,
                                      interpret=interpret,
-                                     kernel_impl=kernel_impl)
+                                     kernel_impl=kernel_impl,
+                                     stat_from_scan=stat_from_scan)
             cache[gkey] = gr
         dkey = ("driver", k, self.grow_config,
-                objective.static_fingerprint())
+                objective.static_fingerprint(), bag_spec)
         driver = cache.get(dkey)
         if driver is None:
+            bag_fn = (make_bag_transform(bag_spec, assets.geometry)
+                      if stat_from_scan else None)
             pfn = objective.payload_grad_fn()
             if pfn is not None:
-                driver = make_scan_driver(gr, self.grow_config, k, pfn)
+                driver = make_scan_driver(gr, self.grow_config, k, pfn,
+                                          bag_fn=bag_fn)
             else:
                 # row-order gradient mode (lambdarank query groups etc.)
                 driver = make_scan_driver(gr, self.grow_config, k,
                                           objective.grad_fn(),
-                                          row_order=True)
+                                          row_order=True, bag_fn=bag_fn)
             cache[dkey] = driver
         return assets, gr, driver
 
-    def train_arrays_scan_persist(self, objective, score0, fmasks,
-                                  shrink: float, k: int):
+    def train_arrays_scan_persist(self, objective, score0, fmasks, wkeys,
+                                  iters, shrink: float, k: int,
+                                  bag_spec=("none",)):
         """K iterations on the persistent payload. Keeps (pay, score_pos)
         as a device carry on this learner; scores return to row order only
         in persist_finalize_scores()."""
-        assets, gr, driver = self._persist_cached(objective, k)
+        assets, gr, driver = self._persist_cached(objective, k, bag_spec)
         pay = getattr(self, "_persist_carry", None)
         if pay is None:
             pay = gr.init_carry(assets.pay0, jnp.asarray(score0))
-        pay, stacked = driver(pay, jnp.asarray(fmasks), self.params,
+        pay, stacked = driver(pay, jnp.asarray(fmasks),
+                              jnp.asarray(wkeys, jnp.uint32),
+                              jnp.asarray(iters, jnp.int32), self.params,
                               jnp.asarray(shrink, jnp.float64),
                               objective._grad_args())
         self._persist_carry = pay

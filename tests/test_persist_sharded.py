@@ -32,10 +32,12 @@ def _data(seed=7):
     return X, y
 
 
-def _train(X, y, learner):
+def _train(X, y, learner, extra=None):
     params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
               "min_data_in_leaf": 10, "max_bin": 63, "learning_rate": 0.2,
               "tpu_persist_scan": "force", "tree_learner": learner}
+    if extra:
+        params.update(extra)
     bst = lgb.train(params, lgb.Dataset(X, y), ROUNDS, verbose_eval=False)
     tl = bst._booster.tree_learner
     assert getattr(tl, "_persist_carry", None) is not None, \
@@ -97,6 +99,61 @@ def test_persist_matches_v1_grower():
     s_v1, v_v1 = _tree_tuples(bst_v1)
     assert s_p == s_v1
     np.testing.assert_allclose(v_p, v_v1, rtol=1e-3, atol=1e-5)
+
+
+def _root_counts(bst):
+    model = bst.dump_model()
+    if isinstance(model, str):
+        model = json.loads(model)
+    out = []
+    for t in model["tree_info"]:
+        node = t["tree_structure"]
+        out.append(node.get("internal_count", node.get("leaf_count", 0)))
+    return np.asarray(out)
+
+
+BAG = {"bagging_fraction": 0.8, "bagging_freq": 5}
+
+
+def test_persist_bagging_counts_and_quality():
+    """Device-side bagging on the persist path: root counts track the
+    bagging fraction (exact in-bag count feeds the root statistics) and
+    the model still learns."""
+    X, y = _data(seed=31)
+    bst = _train(X, y, "serial", extra=BAG)
+    rc = _root_counts(bst)
+    assert np.all(np.abs(rc / N - 0.8) < 0.05), rc / N
+    acc = ((bst.predict(X) > 0.5) == y).mean()
+    assert acc > 0.85, acc
+
+
+def test_persist_bagging_sharded_matches_serial():
+    """Bag masks hash GLOBAL row ids, so the sharded persist run redraws
+    the identical bag and reproduces the serial persist trees."""
+    X, y = _data(seed=37)
+    bst_serial = _train(X, y, "serial", extra=BAG)
+    bst_sharded = _train(X, y, "data", extra=BAG)
+    s1, v1 = _tree_tuples(bst_serial)
+    s2, v2 = _tree_tuples(bst_sharded)
+    assert s1 == s2
+    np.testing.assert_allclose(v1, v2, rtol=2e-5, atol=2e-6)
+
+
+def test_persist_goss():
+    """Device-side GOSS: warmup iterations keep every row
+    (goss.hpp:126-131), sampled iterations keep ~(top_rate+other_rate) with
+    the amplification preserving learning quality."""
+    X, y = _data(seed=41)
+    # learning_rate 0.2 -> 5 warmup iterations of the 16
+    bst = _train(X, y, "serial",
+                 extra={"boosting": "goss", "top_rate": 0.2,
+                        "other_rate": 0.1})
+    rc = _root_counts(bst)
+    assert np.all(rc[:5] == N), rc[:5]
+    frac = rc[5:] / N
+    assert np.all(np.abs(frac - 0.3) < 0.05), frac
+    acc = ((bst.predict(X) > 0.5) == y).mean()
+    assert acc > 0.85, acc
 
 
 def test_persist_sharded_scores_row_ordered():
