@@ -273,7 +273,11 @@ class GBDT:
         if not (self.allow_batch and self.supports_batch
                 and (self.objective is None
                      or self.objective.supports_fused_scan)
-                and self.num_tree_per_iteration == 1
+                # K trees/iteration (multiclass) batch only through the
+                # persist driver's per-class snapshot loop; GOSS needs the
+                # cross-class |g*h| sum it doesn't compute yet
+                and (self.num_tree_per_iteration == 1
+                     or (persist and bag_spec[0] != "goss"))
                 and bag_ok
                 and self.train_data.num_features > 0
                 and learner_ok):
@@ -298,14 +302,22 @@ class GBDT:
         """K fused iterations (one device dispatch); see
         SerialTreeLearner.train_arrays_scan / train_arrays_scan_persist."""
         learner = self.tree_learner
-        init0 = self.boost_from_average(0, True)   # no-op past iteration 0
+        ntpi = self.num_tree_per_iteration
+        # no-ops past iteration 0
+        init0s = tuple(self.boost_from_average(c, True)
+                       for c in range(ntpi))
         fmasks = jnp.asarray(
-            np.stack([learner.col_sampler.sample() for _ in range(k)]))
+            np.stack([learner.col_sampler.sample()
+                      for _ in range(k * ntpi)]))
+        if ntpi > 1:
+            fmasks = fmasks.reshape(k, ntpi, -1)
         if getattr(learner, "can_persist_scan", None) \
                 and learner.can_persist_scan(self.objective):
-            score0 = (self.train_score.score_device(0)
-                      if getattr(learner, "_persist_carry", None) is None
-                      else None)
+            if getattr(learner, "_persist_carry", None) is None:
+                score0 = (self.train_score.score_device(0) if ntpi == 1
+                          else self.train_score.score_matrix())
+            else:
+                score0 = None
             bag_spec = self._persist_bag_spec()
             wkeys, iters = self._persist_bag_keys(bag_spec, k)
             if bag_spec[0] != "none":
@@ -325,8 +337,8 @@ class GBDT:
             self.train_score._score[0] = scoreK
         start = len(self.models)
         self._pending_batches.append((start, stacked, self.shrinkage_rate,
-                                      init0))
-        self.models.extend([None] * k)
+                                      init0s))
+        self.models.extend([None] * (k * ntpi))
         self.iter += k
         self._batch_credit = k - 1
         return False
@@ -357,7 +369,11 @@ class GBDT:
             return
         sc = self.tree_learner.persist_finalize_scores()
         if sc is not None:
-            self.train_score._score[0] = sc
+            if sc.ndim == 2:    # multiclass: [K, N] class-major
+                for c in range(sc.shape[0]):
+                    self.train_score._score[c] = sc[c]
+            else:
+                self.train_score._score[0] = sc
         self._persist_scores_dirty = False
 
     def _train_one_iter_fast(self) -> bool:
@@ -447,22 +463,26 @@ class GBDT:
             return jax.tree.unflatten(treedef, out)
 
         # batch-scan entries are already stacked on device: one transfer
-        for start, stacked, shrink, init0 in self._pending_batches:
+        ntpi = self.num_tree_per_iteration
+        for start, stacked, shrink, init0s in self._pending_batches:
+            if not isinstance(init0s, tuple):
+                init0s = (init0s,)
             host_b = get_packed(stacked)
             kb = int(host_b.num_leaves.shape[0])
             for i in range(kb):
+                cls = i % ntpi
                 ha = jax.tree.map(lambda a, i=i: a[i], host_b)
                 tree = Tree.from_grower(ha, self.train_data)
                 if tree.num_leaves > 1:
                     tree.shrink(shrink)
-                    if i == 0 and abs(init0) > K_EPSILON:
-                        tree.add_bias(init0)
+                    if i < ntpi and abs(init0s[cls]) > K_EPSILON:
+                        tree.add_bias(init0s[cls])
                 else:
                     tree = Tree(1)
-                    if start + i == 0:
+                    if start + i < ntpi:
                         # reference keeps the iteration-0 constant tree at
                         # the boosted-from-average output (gbdt.cpp:396-411)
-                        tree.leaf_value[0] = init0
+                        tree.leaf_value[0] = init0s[cls]
                 self.models[start + i] = tree
         self._pending_batches = []
         if not self._pending:

@@ -89,6 +89,13 @@ class ObjectiveFunction:
         host inputs) — those configurations take the v1 path."""
         return None
 
+    def payload_grad_fn_multi(self):
+        """K-tree-per-iteration analog of payload_grad_fn: pure
+        (scores [K, NP], label, cls) -> (grad, hess) for class `cls`,
+        where `scores` is the payload's per-class score block (snapshot
+        at iteration start). None when unsupported."""
+        return None
+
     def _grad_args(self):
         """Device arrays bound as extra args of the jitted grad function."""
         import jax.numpy as jnp

@@ -70,6 +70,25 @@ class MulticlassSoftmax(ObjectiveFunction):
         weight = jnp.asarray(self.weight) if self.weight is not None else None
         return (jnp.asarray(self.label_int), weight)
 
+    def payload_grad_fn_multi(self):
+        """Per-class softmax grads from the payload score block
+        (multiclass_objective.hpp:84-126). The label row carries the raw
+        class index as f32. The softmax normalization is recomputed per
+        class (O(K^2 N) per iteration instead of O(K N)): the payload
+        permutes between class trees, so a shared denominator would need
+        its own payload row — not worth one until profiles say the exp/sum
+        shows up next to the split kernels."""
+        if self.weight is not None:
+            return None
+
+        def fn(scores, label, cls):
+            m = jnp.max(scores, axis=0)
+            e = jnp.exp(scores - m)
+            p = e[cls] / jnp.sum(e, axis=0)
+            onehot = (label.astype(jnp.int32) == cls).astype(p.dtype)
+            return p - onehot, 2.0 * p * (1.0 - p)
+        return fn
+
     def boost_from_score(self, class_id):
         return float(np.log(max(K_EPSILON, self.class_init_probs[class_id])))
 
@@ -122,6 +141,20 @@ class MulticlassOVA(ObjectiveFunction):
             gs.append(g)
             hs.append(h)
         return jnp.stack(gs), jnp.stack(hs)
+
+    def payload_grad_fn_multi(self):
+        """Per-class one-vs-all binary grads (multiclass_objective.hpp:180+);
+        class k's positives are payload-label == k."""
+        if self.weight is not None:
+            return None
+        if not all(b.need_train for b in self.binary_losses):
+            return None
+        fns = [b.grad_fn() for b in self.binary_losses]
+
+        def fn(scores, label, cls):
+            return fns[cls](scores[cls], label.astype(jnp.int32) == cls,
+                            None)
+        return fn
 
     def boost_from_score(self, class_id):
         return self.binary_losses[class_id].boost_from_score(0)

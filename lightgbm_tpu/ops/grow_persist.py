@@ -78,12 +78,21 @@ class PersistAssets(NamedTuple):
     nb: jnp.ndarray            # [F] i32 per-feature bin count
     mt: jnp.ndarray            # [F] i32 missing type
     db: jnp.ndarray            # [F] i32 default bin
-    geometry: tuple            # (WPA, NP, G, plan, nbw, n, C, CR) static
+    geometry: tuple            # (WPA, NP, G, plan, nbw, n, C, CR, K) static
 
 
-def _payload_geometry(n: int, G: int, C: int, CR: int):
+def _payload_geometry(n: int, G: int, C: int, CR: int,
+                      num_scores: int = 1):
+    """Payload rows: bins words | label | rid | grad | hess | score*K
+    [| snapshot*K when K > 1]. Multiclass (K = num_class trees per
+    iteration) carries one score row per class plus an iteration-start
+    snapshot block: the reference computes all K classes' gradients from
+    the PRE-iteration scores (GBDT::Boosting once per TrainOneIter,
+    src/boosting/gbdt.cpp:152,338-420), so per-class softmax grads read
+    the snapshot while per-class score updates land in the live rows."""
     nbw = (G + 3) // 4
-    WP = nbw + 5                 # + label, rid, grad, hess, score
+    K = num_scores
+    WP = nbw + 4 + K + (K if K > 1 else 0)
     WPA = ((WP + 7) // 8) * 8
     if C <= 0:
         # split_pass VMEM scales with WPA (7 chunk-sized u32 buffers + the
@@ -118,7 +127,8 @@ def _pack_payload(binned: np.ndarray, labels: np.ndarray, n: int,
 
 
 def build_assets(dataset, labels: np.ndarray, C: int = 0,
-                 CR: int = 16384, num_shards: int = 1) -> PersistAssets:
+                 CR: int = 16384, num_shards: int = 1,
+                 num_scores: int = 1) -> PersistAssets:
     """Host-side payload construction (once per dataset).
 
     dataset: BinnedDataset with groups == features, widths <= 256.
@@ -140,7 +150,7 @@ def build_assets(dataset, labels: np.ndarray, C: int = 0,
         raise NotImplementedError  # packing plan assumes byte storage
     G = binned.shape[1]
     labels = np.asarray(labels)
-    nbw, WPA, C, NP = _payload_geometry(n, G, C, CR)
+    nbw, WPA, C, NP = _payload_geometry(n, G, C, CR, num_scores)
     blocks = []
     plan = None
     for k in range(num_shards):
@@ -164,7 +174,8 @@ def build_assets(dataset, labels: np.ndarray, C: int = 0,
                        .astype(np.int32)),
         mt=jnp.asarray(dataset.missing_type_arr.astype(np.int32)),
         db=jnp.asarray(dataset.default_bin.astype(np.int32)),
-        geometry=(WPA, NP, G, tuple(plan), nbw, n, C, CR),
+        geometry=(WPA, NP, G, tuple(plan), nbw, n, C, CR,
+                  num_scores),
     )
 
 
@@ -289,7 +300,7 @@ def make_bag_transform(bag_spec, geometry):
 
     Returns fn(pay, wkey [2]u32, it i32) -> (pay', bag_cnt f32 local).
     """
-    WPA, NP, G, plan, nbw, n, C, CR = geometry
+    WPA, NP, G, plan, nbw, n, C, CR = geometry[:8]
     grad_row = nbw + 2
     mode = bag_spec[0]
 
@@ -381,7 +392,8 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
     implementation — CPU fallback and what the 8-device CPU-mesh sharding
     tests run).
     """
-    WPA, NP, G, plan, nbw, n, C, CR = assets.geometry
+    WPA, NP, G, plan, nbw, n, C, CR = assets.geometry[:8]
+    K = assets.geometry[8] if len(assets.geometry) > 8 else 1
     F = gc.num_features
     L = gc.num_leaves
     W = 256
@@ -395,7 +407,8 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
         root_hist = make_root_hist(WPA, NP, G, plan, nbw, n, C=CR,
                                    interpret=interpret)
     grad_row = nbw + 2
-    score_row = nbw + 4
+    score_row = nbw + 4            # class k's score row = score_row + k
+    snap_row = nbw + 4 + K         # class k's snapshot row (K > 1 only)
 
     # padded meta for the dense scan: feature f's window at flat f*W
     pad_meta = meta._replace(
@@ -641,9 +654,11 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
             row_leaf=jnp.zeros((0,), I32),
         )
 
-    def apply_scores(pay, lstate, num_leaves, shrink):
-        """score-row += shrink * leaf_value[leaf_of_position] via segment
-        deltas: leaves partition positions into contiguous runs."""
+    def apply_scores(pay, lstate, num_leaves, shrink, cls=0):
+        """score-row of class `cls` += shrink * leaf_value[leaf_of_position]
+        via segment deltas: leaves partition positions into contiguous
+        runs."""
+        row = score_row + cls
         starts = lstate[:, LS_START]
         nrows = lstate[:, LS_NROWS]
         vals = lstate[:, LS_VAL] * shrink.astype(F32)
@@ -657,16 +672,13 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
         pos = jnp.where(live_o, starts[order].astype(I32), NP)
         upd = jnp.zeros((NP,), F32).at[pos].add(delta, mode="drop")
         cum = jnp.cumsum(upd)
-        sc = jax.lax.bitcast_convert_type(pay[score_row], F32)
+        sc = jax.lax.bitcast_convert_type(pay[row], F32)
         sc = sc + jnp.where(num_leaves > 1, cum, 0.0)
         return jax.lax.dynamic_update_slice(
             pay, jax.lax.bitcast_convert_type(sc[None, :], U32),
-            (jnp.asarray(score_row, I32), jnp.asarray(0, I32)))
+            (jnp.asarray(row, I32), jnp.asarray(0, I32)))
 
-    def fill_grad(pay, payload_grad_fn):
-        label = jax.lax.bitcast_convert_type(pay[nbw], F32)
-        score = jax.lax.bitcast_convert_type(pay[score_row], F32)
-        g, h = payload_grad_fn(score, label)
+    def _write_grads(pay, g, h):
         live = jnp.arange(NP, dtype=I32) < n
         g = jnp.where(live, g.astype(F32), 0.0)
         h = jnp.where(live, h.astype(F32), 0.0)
@@ -674,17 +686,43 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
         return jax.lax.dynamic_update_slice(
             pay, gh, (jnp.asarray(grad_row, I32), jnp.asarray(0, I32)))
 
+    def fill_grad(pay, payload_grad_fn):
+        label = jax.lax.bitcast_convert_type(pay[nbw], F32)
+        score = jax.lax.bitcast_convert_type(pay[score_row], F32)
+        g, h = payload_grad_fn(score, label)
+        return _write_grads(pay, g, h)
+
+    def snapshot_scores(pay):
+        """Copy the live score rows into the snapshot block (iteration
+        start): all K class gradients read pre-iteration scores."""
+        return jax.lax.dynamic_update_slice(
+            pay, pay[score_row:score_row + K],
+            (jnp.asarray(snap_row, I32), jnp.asarray(0, I32)))
+
+    def fill_grad_multi(pay, payload_grad_fn_multi, cls):
+        """Class `cls` gradients from the snapshot score block."""
+        label = jax.lax.bitcast_convert_type(pay[nbw], F32)
+        scores = jax.lax.bitcast_convert_type(
+            pay[snap_row:snap_row + K], F32)            # [K, NP]
+        g, h = payload_grad_fn_multi(scores, label, cls)
+        return _write_grads(pay, g, h)
+
     def finalize_scores(pay):
-        """Payload-order scores -> row order (one scatter per batch).
-        Row ids are global; sharded runs subtract the shard offset (dead
-        lanes carry the total-row sentinel and always land out of range).
-        """
+        """Payload-order scores -> row order (one scatter per batch);
+        [n] for one class, [K, n] for multiclass. Row ids are global;
+        sharded runs subtract the shard offset (dead lanes carry the
+        total-row sentinel and always land out of range)."""
         rid = pay[nbw + 1].astype(I32)
         if axis_name is not None:
             rid = rid - jax.lax.axis_index(axis_name).astype(I32) * n
-        score = jax.lax.bitcast_convert_type(pay[score_row], F32)
-        return jnp.zeros((n,), F32).at[rid].set(
-            score, mode="drop", unique_indices=True)
+        if K == 1:
+            score = jax.lax.bitcast_convert_type(pay[score_row], F32)
+            return jnp.zeros((n,), F32).at[rid].set(
+                score, mode="drop", unique_indices=True)
+        scores = jax.lax.bitcast_convert_type(
+            pay[score_row:score_row + K], F32)
+        return jnp.zeros((K, n), F32).at[:, rid].set(
+            scores, mode="drop", unique_indices=True)
 
     def fill_grad_row(pay, grad_fn, gargs):
         """Row-order gradient mode for objectives whose gradients need
@@ -705,19 +743,22 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
             pay, gh, (jnp.asarray(grad_row, I32), jnp.asarray(0, I32)))
 
     def set_scores(pay, score_pos):
-        """Write a payload-order score vector into the score row."""
+        """Write payload-order score rows ([NP] or [K, NP])."""
+        sc = score_pos.astype(F32)
+        if sc.ndim == 1:
+            sc = sc[None, :]
         return jax.lax.dynamic_update_slice(
-            pay, jax.lax.bitcast_convert_type(
-                score_pos.astype(F32)[None, :], U32),
+            pay, jax.lax.bitcast_convert_type(sc, U32),
             (jnp.asarray(score_row, I32), jnp.asarray(0, I32)))
 
     @jax.jit
     def init_carry(pay, score0_row):
         """Fresh carry from the pristine payload + a row-ordered score
-        vector ([n], any float dtype). One fused device program — the
-        eager op chain costs seconds of dispatch latency under remote
-        TPU."""
-        sc = jnp.zeros((NP,), F32).at[:n].set(score0_row.astype(F32))
+        vector ([n] or [K, n], any float dtype). One fused device program
+        — the eager op chain costs seconds of dispatch latency under
+        remote TPU."""
+        s0 = score0_row.astype(F32).reshape(K, n)
+        sc = jnp.zeros((K, NP), F32).at[:, :n].set(s0)
         return set_scores(pay, sc)
 
     class _Grower:
@@ -729,12 +770,15 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
     gr.apply_scores = apply_scores
     gr.fill_grad = fill_grad
     gr.fill_grad_row = fill_grad_row
+    gr.fill_grad_multi = fill_grad_multi
+    gr.snapshot_scores = snapshot_scores
     gr.finalize_scores = finalize_scores
     gr.set_scores = set_scores
     gr.init_carry = init_carry
     gr.NP = NP
     gr.n = n
     gr.nbw = nbw
+    gr.K = K
     gr._eval_pair = eval_pair          # debug/testing hooks
     gr._root_hist = root_hist
     gr._pad_meta = pad_meta
@@ -760,9 +804,30 @@ def make_scan_driver(gr, gc, k: int, grad_fn, row_order: bool = False,
     payload donation outside).
     """
 
+    K = getattr(gr, "K", 1)
+
     def run(pay, fmasks, wkeys, iters, params, shrink, gargs):
         def body(pay, per):
             fmask, wkey, it = per
+            if K > 1:
+                # one iteration = K class trees from one score snapshot
+                # (GBDT::TrainOneIter, gbdt.cpp:338-420: gradients for
+                # every class come from the pre-iteration scores)
+                pay = gr.snapshot_scores(pay)
+                outs = []
+                for cls in range(K):
+                    pay = gr.fill_grad_multi(pay, grad_fn, cls)
+                    bag_cnt = None
+                    if bag_fn is not None:
+                        # same window key for every class: one bag per
+                        # iteration, as in the reference
+                        pay, bag_cnt = bag_fn(pay, wkey, it)
+                    pay, lstate, tree, nl, _root = gr.grow(
+                        pay, params, fmask[cls], bag_cnt=bag_cnt)
+                    pay = gr.apply_scores(pay, lstate, nl, shrink, cls)
+                    outs.append(gr.to_tree_arrays(lstate, tree, nl))
+                out = jax.tree.map(lambda *xs: jnp.stack(xs), *outs)
+                return pay, out
             if row_order:
                 pay = gr.fill_grad_row(pay, grad_fn, gargs)
             else:
@@ -777,6 +842,12 @@ def make_scan_driver(gr, gc, k: int, grad_fn, row_order: bool = False,
             return pay, out
         payK, stacked = jax.lax.scan(body, pay, (fmasks, wkeys, iters),
                                      length=k)
+        if K > 1:
+            # [k, K, ...] -> [k*K, ...]: trees in (iteration, class) order,
+            # the model list layout the booster materializes
+            stacked = jax.tree.map(
+                lambda a: a.reshape((a.shape[0] * a.shape[1],)
+                                    + a.shape[2:]), stacked)
         return payK, stacked
 
     if wrap_jit:

@@ -132,12 +132,13 @@ class DataParallelTreeLearner(SerialTreeLearner):
 
     # -- sharded persistent-payload fast path ---------------------------
     # The K-iteration persist scan (ops/grow_persist.py) under shard_map:
-    # per-shard payloads with shard-local row ids, histogram planes and
-    # left counts psum'd inside the grow loop (the ReduceScatter at
-    # data_parallel_tree_learner.cpp:163 fused into the per-split kernel
-    # step). The base-class driver methods (train_arrays_scan_persist /
-    # persist_finalize_scores) work unchanged against the wrapper this
-    # _persist_cached returns.
+    # per-shard payloads carrying GLOBAL row ids (bag draws must agree
+    # with serial runs; finalize subtracts the shard offset), histogram
+    # planes and left counts psum'd inside the grow loop (the
+    # ReduceScatter at data_parallel_tree_learner.cpp:163 fused into the
+    # per-split kernel step). The base-class driver methods
+    # (train_arrays_scan_persist / persist_finalize_scores) work
+    # unchanged against the wrapper this _persist_cached returns.
 
     def _persist_axis_ok(self) -> bool:
         return (self.grow_config.parallel_mode not in ("voting", "feature")

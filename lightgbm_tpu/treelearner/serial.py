@@ -383,6 +383,8 @@ class SerialTreeLearner:
         return self.dataset.num_data < (1 << 24)
 
     def _persist_obj_ok(self, objective) -> bool:
+        if getattr(objective, "num_model_per_iteration", 1) > 1:
+            return objective.payload_grad_fn_multi() is not None
         return (objective.payload_grad_fn() is not None
                 or getattr(objective, "supports_fused_scan", False))
 
@@ -444,13 +446,16 @@ class SerialTreeLearner:
         cache = getattr(self.dataset, "_persist_cache", None)
         if cache is None:
             cache = self.dataset._persist_cache = {}
-        assets = cache.get("assets")
+        K = getattr(objective, "num_model_per_iteration", 1)
+        akey = ("assets", K)
+        assets = cache.get(akey)
         if assets is None:
-            assets = build_assets(self.dataset, self.dataset.metadata.label)
-            cache["assets"] = assets
+            assets = build_assets(self.dataset, self.dataset.metadata.label,
+                                  num_scores=K)
+            cache[akey] = assets
         kernel_impl, interpret = self._persist_kernel_mode()
         stat_from_scan = bag_spec[0] != "none"
-        gkey = ("grower", self.grow_config, stat_from_scan)
+        gkey = ("grower", K, self.grow_config, stat_from_scan)
         gr = cache.get(gkey)
         if gr is None:
             gr = make_persist_grower(assets, self.meta, self.grow_config,
@@ -458,21 +463,26 @@ class SerialTreeLearner:
                                      kernel_impl=kernel_impl,
                                      stat_from_scan=stat_from_scan)
             cache[gkey] = gr
-        dkey = ("driver", k, self.grow_config,
+        dkey = ("driver", K, k, self.grow_config,
                 objective.static_fingerprint(), bag_spec)
         driver = cache.get(dkey)
         if driver is None:
             bag_fn = (make_bag_transform(bag_spec, assets.geometry)
                       if stat_from_scan else None)
-            pfn = objective.payload_grad_fn()
-            if pfn is not None:
-                driver = make_scan_driver(gr, self.grow_config, k, pfn,
+            if K > 1:
+                driver = make_scan_driver(gr, self.grow_config, k,
+                                          objective.payload_grad_fn_multi(),
                                           bag_fn=bag_fn)
             else:
-                # row-order gradient mode (lambdarank query groups etc.)
-                driver = make_scan_driver(gr, self.grow_config, k,
-                                          objective.grad_fn(),
-                                          row_order=True, bag_fn=bag_fn)
+                pfn = objective.payload_grad_fn()
+                if pfn is not None:
+                    driver = make_scan_driver(gr, self.grow_config, k, pfn,
+                                              bag_fn=bag_fn)
+                else:
+                    # row-order gradient mode (lambdarank query groups)
+                    driver = make_scan_driver(gr, self.grow_config, k,
+                                              objective.grad_fn(),
+                                              row_order=True, bag_fn=bag_fn)
             cache[dkey] = driver
         return assets, gr, driver
 
