@@ -105,9 +105,21 @@ class Application:
         loaded = load_text_file(cfg.data, cfg)
         idx = shard_rows(loaded.X.shape[0], rank, int(cfg.num_machines),
                          bool(cfg.pre_partition))
+        Xv = yv = None
+        if cfg.valid:
+            # each rank evaluates its shard of the first valid set; metric
+            # values aggregate count-weighted across ranks (SURVEY §2.6
+            # pre-partitioned parallel eval)
+            vloaded = load_text_file(cfg.valid[0], cfg)
+            vidx = shard_rows(vloaded.X.shape[0], rank,
+                              int(cfg.num_machines),
+                              bool(cfg.pre_partition))
+            Xv, yv = vloaded.X[vidx], vloaded.label[vidx]
+        wl = loaded.weight[idx] if loaded.weight is not None else None
         trees, mappers, ds, _score = train_multihost(
             cfg, loaded.X[idx], loaded.label[idx],
-            num_rounds=int(cfg.num_iterations))
+            num_rounds=int(cfg.num_iterations),
+            weight_local=wl, X_valid=Xv, y_valid=yv)
         if jax.process_index() == 0:
             from .boosting.gbdt import GBDT
             from .objectives import create_objective

@@ -74,7 +74,7 @@ class LambdarankNDCG(RankingObjective):
         if self.sigmoid <= 0.0:
             Log.fatal("Sigmoid param %f should be greater than zero"
                       % self.sigmoid)
-        self._chunk = 256   # queries per lax.map step
+        self._chunk = 0     # queries per lax.map step; 0 = size by memory
 
     def init(self, metadata, num_data):
         super().init(metadata, num_data)
@@ -87,12 +87,36 @@ class LambdarankNDCG(RankingObjective):
             inv[q] = 1.0 / m if m > 0.0 else 0.0
         self.inverse_max_dcgs = inv
         self._qidx, self._qvalid = _pack_queries(qb)
+        # row -> padded position (q*P + offset): the padded [Q, P] lambdas
+        # return to row order with one gather (TPU scatters serialize;
+        # queries are contiguous row ranges so this map is static)
+        P = self._qidx.shape[1]
+        counts = np.diff(qb)
+        qid = np.repeat(np.arange(self.num_queries, dtype=np.int64), counts)
+        self._inv_pos = (qid * P + (np.arange(self.num_data, dtype=np.int64)
+                                    - qb[qid])).astype(np.int32)
+        if self._chunk <= 0:
+            # budget the [chunk, P, P] pairwise intermediates to ~256MB:
+            # tiny chunks turn lax.map into hundreds of sequential
+            # dispatch-bound steps (a 256-query chunk at P=73 is 5MB of
+            # work per step — measured 10x slower than 2 big steps)
+            P = max(int(self._qidx.shape[1]), 1)
+            self._chunk = max(256, min(self.num_queries,
+                                       (256 << 20) // (P * P * 4)))
 
     def grad_fn(self):
         sigmoid = self.sigmoid
         norm = self.norm
         num_data = self.num_data
         chunk = self._chunk
+        # f64 on TPU is emulated op-by-op; the pairwise tensors dominate
+        # this objective, so compute them in f32 on accelerators (the
+        # reference itself trades exactness here with its 1M-entry sigmoid
+        # table, rank_objective.hpp:237-257). CPU keeps f64 for the
+        # reference-parity suite.
+        import jax as _jax
+        ct = (jnp.float64 if _jax.default_backend() == "cpu"
+              else jnp.float32)
 
         def one_query(scores_q, labels_q, valid_q, inv_max_dcg, gains_q,
                       disc_from_rank):
@@ -145,12 +169,14 @@ class LambdarankNDCG(RankingObjective):
             return lambdas, hess
 
         def fn(score, label, weight, qidx, qvalid, inv_max_dcgs, label_gain,
-               discounts):
+               discounts, inv_pos):
             Q, P = qidx.shape
             safe_idx = jnp.maximum(qidx, 0)
-            s_q = score[safe_idx]                       # [Q, P]
+            s_q = score[safe_idx].astype(ct)            # [Q, P]
             l_q = label[safe_idx]
-            gains_q = label_gain[l_q.astype(jnp.int32)]
+            gains_q = label_gain[l_q.astype(jnp.int32)].astype(ct)
+            inv_max_dcgs = inv_max_dcgs.astype(ct)
+            discounts = discounts.astype(ct)
 
             def chunk_fn(args):
                 sq, lq, vq, inv, gq = args
@@ -167,16 +193,10 @@ class LambdarankNDCG(RankingObjective):
             resh = lambda x: x.reshape((nchunks, chunk) + x.shape[1:])
             lam_c, hes_c = jax.lax.map(
                 chunk_fn, (resh(sq), resh(lq), resh(vq), resh(inv), resh(gq)))
-            lam = lam_c.reshape(-1, P)[:Q]
-            hes = hes_c.reshape(-1, P)[:Q]
-
-            # scatter back to the flat row axis
-            flat_idx = safe_idx.reshape(-1)
-            ok = qvalid.reshape(-1)
-            g = jnp.zeros((num_data,), lam.dtype).at[flat_idx].add(
-                jnp.where(ok, lam.reshape(-1), 0.0))
-            h = jnp.zeros((num_data,), hes.dtype).at[flat_idx].add(
-                jnp.where(ok, hes.reshape(-1), 0.0))
+            # padded [Q, P] -> flat rows with one gather (each row occupies
+            # exactly one padded position)
+            g = lam_c.reshape(-1)[inv_pos]
+            h = hes_c.reshape(-1)[inv_pos]
             if weight is not None:
                 g = g * weight
                 h = h * weight
@@ -190,7 +210,8 @@ class LambdarankNDCG(RankingObjective):
         return (jnp.asarray(self.label), weight, jnp.asarray(self._qidx),
                 jnp.asarray(self._qvalid), jnp.asarray(self.inverse_max_dcgs),
                 jnp.asarray(self.label_gain),
-                jnp.asarray(_DISCOUNT_CACHE[:P]))
+                jnp.asarray(_DISCOUNT_CACHE[:P]),
+                jnp.asarray(self._inv_pos))
 
     def to_string(self):
         return self.name

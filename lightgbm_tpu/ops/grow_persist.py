@@ -96,8 +96,11 @@ def _payload_geometry(n: int, G: int, C: int, CR: int,
     WPA = ((WP + 7) // 8) * 8
     if C <= 0:
         # split_pass VMEM scales with WPA (7 chunk-sized u32 buffers + the
-        # hist accumulator); stay under the 16MB scoped limit
-        C = 8192 if WPA <= 24 else (4096 if WPA <= 56 else 2048)
+        # hist accumulator + compaction temporaries). The kernel raises the
+        # Mosaic scoped-VMEM limit to its footprint (v5e carries 128MB),
+        # so chunks are sized for DMA-latency amortization, not the 16MB
+        # default: small chunks cost ~5 serialized DMA latencies each
+        C = 16384 if WPA <= 56 else 8192
     NP = max(((n + 127) // 128 + 2) * 128 + C + 256,
              ((n + CR - 1) // CR) * CR)
     return nbw, WPA, C, NP
@@ -402,8 +405,10 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
         split_pass = make_xla_split_pass(WPA, NP, G, plan, nbw)
         root_hist = make_xla_root_hist(WPA, NP, G, plan, nbw, n)
     else:
+        # every score/snapshot row must ride the partition
+        wp_live = nbw + 4 + K + (K if K > 1 else 0)
         split_pass = make_split_pass(WPA, NP, G, plan, nbw, C=C,
-                                     interpret=interpret)
+                                     interpret=interpret, wp_live=wp_live)
         root_hist = make_root_hist(WPA, NP, G, plan, nbw, n, C=CR,
                                    interpret=interpret)
     grad_row = nbw + 2

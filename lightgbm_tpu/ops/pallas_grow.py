@@ -160,8 +160,13 @@ def _hist_accum(hist_ref, bins_g, grad, hess, G: int):
     """hist_ref[g] += radix-16 one-hot MXU contraction of one chunk.
 
     bins_g: [G, E] i32; grad/hess: [E] f32 already masked to valid rows.
-    hist_ref: [G, 16, 16, 2] f32 VMEM ref. grad/hess ride as bf16 hi+lo
-    pairs so the contraction is exact to f32 (ops/pallas_histogram docs).
+    hist_ref: [G, 16, 64] f32 VMEM ref holding RAW accumulator columns
+    v*16+lo for v in (grad_hi, hess_hi, grad_lo, hess_lo) — the bf16 hi/lo
+    pairs that make the contraction exact to f32 (ops/pallas_histogram
+    docs). The 4 value columns ride ONE [64, E] rhs so each group costs one
+    [16,E]x[E,64] MXU issue instead of four [16,E]x[E,16]: same FLOPs, 4x
+    the N-utilization. Callers unpack hi/lo planes OUTSIDE the kernel
+    (_unpack_hist).
     """
     E = bins_g.shape[1]
     n16 = jax.lax.broadcasted_iota(I32, (16, E), 0)
@@ -175,13 +180,23 @@ def _hist_accum(hist_ref, bins_g, grad, hess, G: int):
         b = bins_g[g, :]
         oh_hi = (n16 == (b >> 4)[None, :]).astype(jnp.bfloat16)   # [16, E]
         oh_lo = (n16 == (b & 15)[None, :]).astype(jnp.bfloat16)
-        hs = []
-        for v in range(4):
-            bv = oh_lo * vt[v][None, :]
-            hs.append(jax.lax.dot_general(
-                oh_hi, bv, dn, preferred_element_type=F32))        # [16, 16]
-        hist_ref[g] = hist_ref[g] + jnp.stack(
-            [hs[0] + hs[2], hs[1] + hs[3]], axis=-1)
+        # 64-sublane one-hots can't be built directly (i1 relayout at 64
+        # rows breaks Mosaic); concatenating four known-good [16, E]
+        # scaled one-hots gives the same [64, E] rhs
+        bv = jnp.concatenate([oh_lo * v[None, :] for v in vt], axis=0)
+        hist_ref[g] = hist_ref[g] + jax.lax.dot_general(
+            oh_hi, bv, dn, preferred_element_type=F32)            # [16, 64]
+
+
+def _unpack_hist(hist):
+    """[G, 16, 64] raw accumulator -> ([G*256] grad, [G*256] hess) f32
+    planes (hi*16+lo bin order); runs OUTSIDE the kernel where XLA
+    reshapes freely."""
+    G = hist.shape[0]
+    h4 = hist.reshape(G, 16, 4, 16)
+    gh = (h4[:, :, 0] + h4[:, :, 2]).reshape(G * 256)
+    hh = (h4[:, :, 1] + h4[:, :, 3]).reshape(G * 256)
+    return gh, hh
 
 
 def _f32r(row):
@@ -200,18 +215,26 @@ def _align128(ptr):
 
 def make_split_pass(WPA: int, NP: int, G: int, plan, nbw: int,
                     C: int = 8192, interpret: bool = False,
+                    wp_live: int = 0,
                     _skip_hist: bool = False, _skip_pack: bool = False):
     """Build the fused per-split kernel for one payload geometry.
 
     plan: tuple of (word_row, shift, mask) per group; rows nbw..nbw+3 are
     label/rowid/grad/hess (nbw = WP - 4).
 
+    wp_live: how many leading payload rows carry per-row state that must
+    PERMUTE with the partition (bins + label/rid/grad/hess + all score and
+    snapshot rows — everything multiclass adds); defaults to the
+    single-score layout nbw + 5. Rows past wp_live are padding and pass
+    through untouched.
+
     Returns fn(pay, scalars_i32) -> (pay', hist [G*256, 2] f32, n_left).
     """
     assert WPA % 8 == 0, "payload row count must be padded to 8"
     E = C + 128
     grad_row = nbw + 2
-    WP_LIVE = nbw + 5          # payload rows incl. the score row
+    WP_LIVE = wp_live or (nbw + 5)
+    assert WP_LIVE <= WPA
 
     def kernel(ns, pay_in, pay_out, hist_ref, cnt_ref,
                wbuf, obuf, rbuf, slots, st, sem_r, sem_w, sem_rmw):
@@ -368,6 +391,16 @@ def make_split_pass(WPA: int, NP: int, G: int, plan, nbw: int,
         def _fin():
             cnt_ref[0] = st[6]
 
+    # the default 16MB scoped-VMEM limit forces small chunks whose cost is
+    # pure DMA latency (~5 serialized DMAs per chunk); v5e cores carry
+    # 128MB of VMEM, so size the limit to the kernel's actual footprint
+    # (buffers + Mosaic temporaries scale with E) and let C grow instead
+    E_ = C + 128
+    _vmem_req = min(96 << 20,
+                    7 * WPA * E_ * 4 + G * 16 * 64 * 4 + (20 << 20)
+                    + 3 * WPA * E_ * 4)
+    _cparams = pltpu.CompilerParams(vmem_limit_bytes=int(_vmem_req))
+
     @jax.jit
     def split_pass(pay, scalars):
         do_run = scalars[S_NL] > 0
@@ -379,8 +412,7 @@ def make_split_pass(WPA: int, NP: int, G: int, plan, nbw: int,
             pay2, hist, cnt = _call(pay, scalars, grid)
         # separate grad/hess planes: downstream keeps per-plane [L, TBp]
         # histograms (no strided channel slices on the hot path)
-        return pay2, (hist[..., 0].reshape(G * 256),
-                      hist[..., 1].reshape(G * 256)), cnt[0]
+        return pay2, _unpack_hist(hist), cnt[0]
 
     def _call(pay, scalars, grid):
         return pl.pallas_call(
@@ -391,8 +423,8 @@ def make_split_pass(WPA: int, NP: int, G: int, plan, nbw: int,
                 in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
                 out_specs=[
                     pl.BlockSpec(memory_space=pltpu.ANY),
-                    pl.BlockSpec((G, 16, 16, 2),
-                                 lambda i, s: (i * 0, i * 0, i * 0, i * 0)),
+                    pl.BlockSpec((G, 16, 64),
+                                 lambda i, s: (i * 0, i * 0, i * 0)),
                     pl.BlockSpec((1,), lambda i, s: (i * 0,),
                                  memory_space=pltpu.SMEM),
                 ],
@@ -409,10 +441,11 @@ def make_split_pass(WPA: int, NP: int, G: int, plan, nbw: int,
             ),
             out_shape=[
                 jax.ShapeDtypeStruct((WPA, NP), U32),
-                jax.ShapeDtypeStruct((G, 16, 16, 2), F32),
+                jax.ShapeDtypeStruct((G, 16, 64), F32),
                 jax.ShapeDtypeStruct((1,), I32),
             ],
             input_output_aliases={1: 0},
+            compiler_params=_cparams,
             interpret=interpret,
         )(scalars, pay)
 
@@ -467,8 +500,7 @@ def make_root_hist(WPA: int, NP: int, G: int, plan, nbw: int, n: int,
     def root_hist(pay):
         with jax.enable_x64(False):
             hist, sums = _call(pay)
-        return (hist[..., 0].reshape(G * 256),
-                hist[..., 1].reshape(G * 256)), sums
+        return _unpack_hist(hist), sums
 
     def _call(pay):
         return pl.pallas_call(
@@ -476,13 +508,13 @@ def make_root_hist(WPA: int, NP: int, G: int, plan, nbw: int, n: int,
             grid=(nch,),
             in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
             out_specs=[
-                pl.BlockSpec((G, 16, 16, 2),
-                             lambda i: (i * 0, i * 0, i * 0, i * 0)),
+                pl.BlockSpec((G, 16, 64),
+                             lambda i: (i * 0, i * 0, i * 0)),
                 pl.BlockSpec((2,), lambda i: (i * 0,),
                              memory_space=pltpu.SMEM),
             ],
             out_shape=[
-                jax.ShapeDtypeStruct((G, 16, 16, 2), F32),
+                jax.ShapeDtypeStruct((G, 16, 64), F32),
                 jax.ShapeDtypeStruct((2,), F32),
             ],
             scratch_shapes=[

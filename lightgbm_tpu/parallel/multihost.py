@@ -13,11 +13,14 @@ Flow per process (one per machine, mirroring the reference's rank flow):
                                      feature slices + allgather
   4. local BinnedDataset             from_matrix_with_mappers (EFB off so
                                      every rank derives an identical layout)
-  5. sharded boosting                the data-parallel grower under
+  5. sharded boosting                K-iteration fused lax.scan under
                                      shard_map over a GLOBAL mesh spanning
                                      every process's devices; histograms
                                      psum over ICI/DCN
-                                     (data_parallel_tree_learner.cpp:163)
+                                     (data_parallel_tree_learner.cpp:163),
+                                     ONE host transfer of K stacked trees
+                                     per batch instead of a per-tree
+                                     device_get
 
 Scores, gradients and row ids stay row-sharded on the devices that own the
 rows — only histograms, split candidates and the finished split records
@@ -25,8 +28,19 @@ cross hosts, exactly the reference's communication pattern. Every process
 materializes the identical model (deterministic merge), so rank 0 saving
 the model matches the reference CLI behavior.
 
-Scope: built-in label-only objectives (binary, regression L2), no bagging
-and no in-loop metrics — the configurations outside this fail loudly.
+Objective dispatch is generic: the local objective's grad_fn consumes its
+own _grad_args(), each row-aligned device argument sharded over the mesh
+(weights included). Bagging draws per-row bernoulli masks from a stateless
+hash of the GLOBAL row id at the bagging window key (the same draw the
+persist fast path uses), so every rank agrees on the bag without
+communication. Validation shards evaluate locally and the metric
+aggregates as a count-weighted mean across ranks (the reference's
+pre-partitioned parallel eval, SURVEY §2.6), driving reference-semantics
+early stopping identically on every rank.
+
+Out of scope (loud failures below): K-trees-per-iteration objectives
+(multiclass) and query-structured objectives (ranking) — their gradient
+inputs are not row-shardable yet.
 """
 from __future__ import annotations
 
@@ -68,21 +82,63 @@ def _global_array(mesh: Mesh, local_np: np.ndarray):
     return jax.make_array_from_process_local_data(sharding, local_np)
 
 
+def _allreduce_mean_host(values: np.ndarray, weights: np.ndarray):
+    """Count-weighted mean across processes via host allgather (used for
+    metric aggregation over unequal validation shards; zero-weight ranks
+    contribute nothing but still participate in the collective)."""
+    from jax.experimental import multihost_utils
+    v = multihost_utils.process_allgather(
+        np.asarray(values, np.float64).reshape(1, -1)).reshape(
+        jax.process_count(), -1)
+    w = multihost_utils.process_allgather(
+        np.asarray(weights, np.float64).reshape(1, -1)).reshape(
+        jax.process_count(), -1)
+    tot = np.sum(w, axis=0)
+    return np.sum(v * w, axis=0) / np.where(tot > 0, tot, 1.0)
+
+
+class _EarlyStop:
+    """Reference early-stopping semantics (GBDT::EvalAndCheckEarlyStopping,
+    gbdt.cpp:440-543): stop when the first metric fails to improve for
+    early_stopping_round consecutive evaluations."""
+
+    def __init__(self, rounds: int, higher_better: bool):
+        self.rounds = rounds
+        self.higher = higher_better
+        self.best = -np.inf if higher_better else np.inf
+        self.best_iter = 0
+
+    def update(self, value: float, it: int) -> bool:
+        """Patience counts ITERATIONS (not evaluations): evaluations here
+        happen once per k-iteration batch."""
+        improved = (value > self.best) if self.higher else (value < self.best)
+        if improved:
+            self.best, self.best_iter = value, it
+            return False
+        return self.rounds > 0 and it - self.best_iter >= self.rounds
+
+
 def train_multihost(config: Config, X_local: np.ndarray,
                     y_local: np.ndarray, num_rounds: int,
                     categorical_features=(), process_id: Optional[int] = None,
-                    sample_override: Optional[np.ndarray] = None):
+                    sample_override: Optional[np.ndarray] = None,
+                    weight_local: Optional[np.ndarray] = None,
+                    X_valid: Optional[np.ndarray] = None,
+                    y_valid: Optional[np.ndarray] = None):
     """Distributed training entry; returns the (identical-on-every-rank)
-    list of host Trees plus the shared BinMappers for model IO."""
+    list of host Trees plus the shared BinMappers for model IO.
+
+    X_valid/y_valid: this rank's shard of a validation set; with
+    valid data and early_stopping_round > 0 the loop stops when the
+    aggregated first metric stalls.
+    """
     from ..data.dataset import BinnedDataset
     from ..objectives import create_objective
+    from ..ops.grow_persist import _hash_uniform
     from ..treelearner.serial import PARTITION_MIN_ROWS
 
     rank = init_network(config, process_id)
     world = max(int(config.num_machines), 1)
-
-    if float(config.bagging_fraction) < 1.0 and config.bagging_freq > 0:
-        Log.fatal("bagging is not supported with num_machines > 1 yet")
 
     # ---- distributed binning -----------------------------------------
     cnt = int(config.bin_construct_sample_cnt)
@@ -104,12 +160,15 @@ def train_multihost(config: Config, X_local: np.ndarray,
         categorical_features=categorical_features,
         rank=rank, world=world)
     ds = BinnedDataset.from_matrix_with_mappers(
-        X_local, config, mappers, label=y_local)
+        X_local, config, mappers, label=y_local, weight=weight_local)
 
     objective = create_objective(config.objective, config)
     if objective is None:
         Log.fatal("num_machines > 1 needs a built-in objective")
     objective.init(ds.metadata, ds.num_data)
+    if getattr(objective, "num_model_per_iteration", 1) > 1:
+        Log.fatal("multiclass objectives are not supported with "
+                  "num_machines > 1 yet")
 
     # ---- global mesh + row-sharded device state ----------------------
     from ..treelearner.serial import SerialTreeLearner
@@ -126,31 +185,49 @@ def train_multihost(config: Config, X_local: np.ndarray,
     pad_to = ((per_proc + local_dev - 1) // local_dev) * local_dev
     pad = pad_to - n_local
 
-    bins_l = np.ascontiguousarray(ds.binned)
-    if pad:
-        bins_l = np.pad(bins_l, ((0, pad), (0, 0)))
-    label_l = np.pad(np.asarray(ds.metadata.label, np.float64), (0, pad))
-    valid_l = np.pad(np.ones(n_local, bool), (0, pad))
+    def padded(a, fill=0.0):
+        a = np.asarray(a)
+        if not pad:
+            return a
+        widths = ((0, pad),) + ((0, 0),) * (a.ndim - 1)
+        return np.pad(a, widths, constant_values=fill)
 
-    bins_g = _global_array(mesh, bins_l)
-    label_g = _global_array(mesh, label_l)
-    valid_g = _global_array(mesh, valid_l)
-    n_global_pad = bins_g.shape[0]
+    bins_g = _global_array(mesh, padded(np.ascontiguousarray(ds.binned)))
+    valid_g = _global_array(mesh, padded(np.ones(n_local, bool)))
+    # GLOBAL row ids drive the bagging hash — every rank draws the same
+    # per-row bernoulli without communication (gbdt.cpp:210-244 semantics)
+    gidx_l = shard_rows(int(counts.sum()), rank, world,
+                        bool(config.pre_partition))[:n_local]
+    gidx_g = _global_array(mesh, padded(gidx_l.astype(np.uint32)))
+
+    # the objective's device args, row-sharded where row-aligned
+    grad_fn = objective.grad_fn()
+    gargs_local = objective._grad_args()
+    gargs_g = []
+    for a in gargs_local:
+        if a is None:
+            gargs_g.append(None)
+        elif a.ndim >= 1 and a.shape[0] == n_local:
+            gargs_g.append(_global_array(mesh, padded(np.asarray(a))))
+        else:
+            Log.fatal("objective %s has gradient inputs that are not "
+                      "row-shardable; not supported with num_machines > 1"
+                      % config.objective)
 
     gc = learner.grow_config
-    n_shard = n_global_pad // S
+    n_shard = pad_to * jax.process_count() // S
     use_part = n_shard >= PARTITION_MIN_ROWS
     meta, params, fix = learner.meta, learner.params, learner.fix
     cat = learner.cat_layout
     gw_global = learner.gw_global
     layout_rest = tuple(learner.layout)[1:]
-    grad_fn = objective.grad_fn()
-    gargs_fn = objective._grad_args  # label-only objectives: rebuild from
-    #                                  the sharded label (weights excluded)
-    if ds.metadata.weight is not None:
-        Log.fatal("weights are not supported with num_machines > 1 yet")
+    base_extras = learner._extras_base
 
     from ..ops.grow import DataLayout, grow_tree, grow_tree_partitioned
+
+    bag_frac = (float(config.bagging_fraction)
+                if (config.bagging_freq > 0
+                    and config.bagging_fraction < 1.0) else 1.0)
 
     def _grow(bins, grad, hess, bag, fmask, extras):
         layout = DataLayout(bins, *layout_rest)
@@ -161,68 +238,144 @@ def train_multihost(config: Config, X_local: np.ndarray,
         return grow_tree(layout, grad, hess, bag, meta, params, fmask,
                          fix, gc, axis_name=AXIS, cat=cat, extras=extras)
 
-    grow_sharded = jax.jit(jax.shard_map(
-        _grow, mesh=mesh,
-        in_specs=(P(AXIS, None), P(AXIS), P(AXIS), P(AXIS), P(), P()),
-        out_specs=(_tree_arrays_spec(gc, row_sharded=True), P()),
-        check_vma=False))
+    def _batch(k: int):
+        """jitted K-iteration boosting scan under shard_map: gradients ->
+        bag mask -> sharded grow (psum inside) -> on-device score update;
+        K stacked tree records come back replicated, ONE transfer."""
 
-    @jax.jit
-    def grads(score, label, valid):
-        if type(objective).__name__ == "BinaryLogloss":
-            g, h = grad_fn(score, label > 0, None)
-        else:
-            g, h = grad_fn(score, label, None)
-        z = jnp.zeros_like(g)
-        return jnp.where(valid, g, z).astype(jnp.float32), \
-            jnp.where(valid, h, z).astype(jnp.float32)
+        def body_fn(bins, gidx, valid, gargs, score0, fu0, fmasks, wkeys,
+                    keys):
+            def body(carry, per):
+                score, fu = carry
+                fmask, wkey, key = per
+                g, h = grad_fn(score, *gargs)
+                if bag_frac < 1.0:
+                    u = _hash_uniform(gidx, wkey)
+                    bag = valid & (u < jnp.float32(bag_frac))
+                else:
+                    bag = valid
+                m = bag.astype(jnp.float32)
+                g = g.astype(jnp.float32) * m
+                h = h.astype(jnp.float32) * m
+                ex = base_extras._replace(key=key, feature_used=fu)
+                arrays, fu2 = _grow(bins, g, h, bag, fmask, ex)
+                upd = arrays.leaf_value.astype(jnp.float64)[
+                    arrays.row_leaf] * jnp.float64(config.learning_rate)
+                score2 = score + jnp.where(arrays.num_leaves > 1, upd, 0.0)
+                out = arrays._replace(row_leaf=jnp.zeros((0,), jnp.int32))
+                return (score2, fu2), out
 
-    @jax.jit
-    def upd_score(score, leaf_value, row_leaf, shrink, nl):
-        add = leaf_value.astype(jnp.float64)[row_leaf] * shrink
-        return score + jnp.where(nl > 1, add, 0.0)
+            (scoreK, fuK), stacked = jax.lax.scan(
+                body, (score0, fu0), (fmasks, wkeys, keys), length=k)
+            return scoreK, fuK, stacked
 
-    shrink = jnp.asarray(float(config.learning_rate), jnp.float64)
-    init0 = objective.boost_from_score(0) if config.boost_from_average else 0.0
+        spec_gargs = tuple(P(AXIS) if a is not None else P()
+                           for a in gargs_g)
+        return jax.jit(jax.shard_map(
+            body_fn, mesh=mesh,
+            in_specs=(P(AXIS, None), P(AXIS), P(AXIS), spec_gargs, P(AXIS),
+                      P(), P(), P(), P()),
+            out_specs=(P(AXIS), P(), _tree_arrays_spec(gc,
+                                                       row_sharded=False)),
+            check_vma=False))
+
+    # ---- init score (BoostFromAverage; GlobalSyncUpByMean) -----------
+    init0 = (objective.boost_from_score(0)
+             if config.boost_from_average else 0.0)
     if world > 1:
-        # Network::GlobalSyncUpByMean on the init score (gbdt.cpp:308)
+        # Network::GlobalSyncUpByMean (gbdt.cpp:308): UNWEIGHTED mean over
+        # machines — reference parity on unequal shards
         from jax.experimental import multihost_utils
         init0 = float(np.mean(multihost_utils.process_allgather(
             np.asarray([init0], np.float64))))
-    zero_sharding = NamedSharding(mesh, P(AXIS))
     score = jax.device_put(
-        jnp.full((n_global_pad,), float(init0), jnp.float64), zero_sharding)
+        jnp.full((pad_to * jax.process_count(),), float(init0),
+                 jnp.float64), NamedSharding(mesh, P(AXIS)))
 
+    # ---- validation + metrics ----------------------------------------
+    # metrics are constructed whenever valid data was PASSED (even when
+    # this rank's shard came up empty): the per-batch metric aggregation
+    # is a collective, and every rank must participate — empty shards
+    # contribute weight 0
+    from ..metrics import create_metric
+    metrics = []
+    Xv = None
+    if X_valid is not None and y_valid is not None:
+        names = list(config.metric) or [""]
+        m = create_metric(names[0] or str(config.objective), config)
+        if m is not None:
+            class _VMeta:
+                label = np.asarray(y_valid, np.float64)
+                weight = None
+                query_boundaries = None
+                num_queries = 0
+                init_score = None
+            m.init(_VMeta(), len(y_valid))
+            metrics.append(m)
+            Xv = np.ascontiguousarray(X_valid, np.float64)
+    es = (_EarlyStop(int(config.early_stopping_round),
+                     metrics[0].factor_to_bigger_better > 0)
+          if metrics and int(config.early_stopping_round) > 0 else None)
+    vscore = (np.zeros(len(y_valid), np.float64) + init0
+              if metrics else None)
+
+    # ---- batched boosting loop ---------------------------------------
+    shrink = float(config.learning_rate)
+    base_key = jax.random.PRNGKey(int(config.bagging_seed))
+    freq = max(int(config.bagging_freq), 1)
     trees: List[Tree] = []
-    fu = None
-    for it in range(num_rounds):
-        g, h = grads(score, label_g, valid_g)
-        fmask = jnp.asarray(learner.col_sampler.sample())
-        extras = learner._next_extras()
-        if fu is not None:
-            extras = extras._replace(feature_used=fu)
-        arrays, fu = grow_sharded(bins_g, g, h, valid_g, fmask, extras)
-        score = upd_score(score, arrays.leaf_value, arrays.row_leaf, shrink,
-                          arrays.num_leaves)
-        host = jax.device_get(jax.tree.map(
-            lambda a: a, arrays._replace(row_leaf=np.zeros(0, np.int32))))
-        tree = Tree.from_grower(host, ds)
-        if tree.num_leaves > 1:
-            tree.shrink(float(shrink))
-            if it == 0 and abs(init0) > 1e-15:
-                tree.add_bias(init0)
-            trees.append(tree)
-        else:
-            # no-split stop semantics (gbdt._materialize_pending /
-            # _truncate_if_stopped): a 1-leaf first tree keeps the
-            # boost_from_average constant as its output; any later 1-leaf
-            # tree stops training with the iteration popped
-            if it == 0:
+    fu = base_extras.feature_used
+    runners = {}
+    it = 0
+    stopped = False
+    while it < num_rounds and not stopped:
+        k = min(8 if metrics else 16, num_rounds - it)
+        if k not in runners:
+            runners[k] = _batch(k)
+        fmasks = jnp.asarray(
+            np.stack([learner.col_sampler.sample() for _ in range(k)]))
+        wkeys = jnp.asarray(np.stack([
+            np.asarray(jax.random.key_data(jax.random.fold_in(
+                base_key, (it + i) // freq))) for i in range(k)]),
+            jnp.uint32)
+        keys = jnp.stack([learner._next_extras().key for _ in range(k)])
+        score, fu, stacked = runners[k](
+            bins_g, gidx_g, valid_g, tuple(gargs_g), score, fu, fmasks,
+            wkeys, keys)
+        host = jax.device_get(stacked)          # ONE transfer per batch
+        for i in range(k):
+            ha = jax.tree.map(lambda a, i=i: a[i], host)
+            tree = Tree.from_grower(ha, ds)
+            if tree.num_leaves > 1:
+                tree.shrink(shrink)
+                if it + i == 0 and abs(init0) > 1e-15:
+                    tree.add_bias(init0)
+                trees.append(tree)
+            elif it + i == 0:
+                # no-split first tree keeps the boost_from_average
+                # constant (gbdt.cpp:396-411)
                 if tree.leaf_value[0] == 0.0:
                     tree.leaf_value[0] = init0
                 trees.append(tree)
             else:
                 Log.warning("Stopped training because there are no more "
                             "leaves that meet the split requirements")
+                stopped = True
                 break
+            if vscore is not None and len(vscore):
+                vscore += tree.predict(Xv)
+        it += k
+        if metrics and not stopped:
+            local = (float(metrics[0].eval(vscore, objective)[0])
+                     if len(vscore) else 0.0)
+            agg = float(_allreduce_mean_host([local],
+                                             [float(len(vscore))])[0])
+            if rank == 0:
+                Log.info("[%d] valid %s : %g"
+                         % (it, metrics[0].names[0], agg))
+            if es is not None and es.update(agg, it):
+                Log.info("Early stopping at iteration %d, best %g at %d"
+                         % (it, es.best, es.best_iter))
+                trees = trees[:max(es.best_iter, 1)]
+                stopped = True
     return trees, mappers, ds, score

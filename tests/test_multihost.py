@@ -39,10 +39,17 @@ y = (X[:, 0] - 0.7 * X[:, 3] + rng.normal(size=n) * 0.3 > 0).astype(float)
 cfg = Config({"objective": "binary", "num_leaves": 15, "verbosity": -1,
               "num_machines": 2,
               "machines": "127.0.0.1:%%s,127.0.0.1:0" %% port,
-              "min_data_in_leaf": 5, "tree_learner": "data"})
+              "min_data_in_leaf": 5, "tree_learner": "data",
+              "bagging_fraction": 0.8, "bagging_freq": 2,
+              "metric": "binary_logloss", "early_stopping_round": 50})
 idx = shard_rows(n, rank, 2, False)
+w = np.ones(n)
+Xv = rng.normal(size=(400, nf))
+yv = (Xv[:, 0] - 0.7 * Xv[:, 3] > 0).astype(float)
+vidx = shard_rows(400, rank, 2, False)
 trees, mappers, ds, score = train_multihost(
-    cfg, X[idx], y[idx], num_rounds=4, process_id=rank)
+    cfg, X[idx], y[idx], num_rounds=12, process_id=rank,
+    weight_local=w[idx], X_valid=Xv[vidx], y_valid=yv[vidx])
 digest = [[int(t.num_leaves),
            [int(f) for f in t.split_feature[:t.num_leaves - 1]],
            [round(float(v), 6) for v in t.threshold[:t.num_leaves - 1]],
