@@ -622,6 +622,22 @@ class Booster:
                 raw_score: bool = False, pred_leaf: bool = False,
                 pred_contrib: bool = False, data_has_header: bool = False,
                 is_reshape: bool = True, start_iteration: int = 0, **kwargs):
+        if _SCIPY and _sp.issparse(data):
+            # stream CSR row blocks through the dense predictor instead of
+            # densifying the whole matrix (reference PredictForCSR,
+            # src/c_api.cpp, walks rows sparsely); each block densifies to
+            # ~32MB so predict memory stays bounded regardless of n
+            csr = data.tocsr()
+            step = max(1, (32 << 20) // max(int(csr.shape[1]) * 8, 1))
+            if csr.shape[0] > step:
+                outs = [self.predict(
+                    np.asarray(csr[i:i + step].todense(), dtype=np.float64),
+                    num_iteration=num_iteration, raw_score=raw_score,
+                    pred_leaf=pred_leaf, pred_contrib=pred_contrib,
+                    data_has_header=data_has_header, is_reshape=is_reshape,
+                    start_iteration=start_iteration, **kwargs)
+                    for i in range(0, int(csr.shape[0]), step)]
+                return np.concatenate(outs, axis=0)
         X, _, _ = _data_to_2d(data)
         # reference LGBM_BoosterPredict* shape guard (predict_disable_
         # shape_check): feature-count mismatch is fatal unless disabled

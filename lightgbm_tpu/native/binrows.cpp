@@ -17,31 +17,91 @@
 //     LATER sub-features overwrite earlier ones on conflict.
 #include <cmath>
 #include <cstdint>
+#include <cstdlib>
 
 #if defined(_OPENMP)
 #include <omp.h>
 #endif
 
+// Uniform-grid accelerator for the per-feature boundary search: LUT cell j
+// holds lower_bound(bounds, b0 + j*step), so a value's true bin index is
+// bracketed by [LUT[j]-1, LUT[j+1]+1] (the -1/+1 absorb float round-off in
+// the cell computation) and the binary search runs over a handful of
+// entries instead of the full boundary array. Quantile-built boundaries
+// spread ~255 entries over the value span, so with 8x as many LUT cells a
+// typical bracket holds 0-2 boundaries; the dependent-load compare chain
+// of the full search (~175 cycles/cell measured on this host) collapses
+// to one multiply + one LUT load + a couple of compares.
+static const int32_t kLutCells = 2048;
+
+struct FeatLut {
+  double b0;
+  double inv_step;
+  int32_t idx[kLutCells + 1];
+  int32_t usable;   // 0 when the span is degenerate (single finite bound)
+};
+
 extern "C" {
 
-// searchsorted(bounds, v, side=left): first i with bounds[i] >= v
+// searchsorted(bounds, v, side=left): first i with bounds[i] >= v.
+// Branchless: bin boundaries make the comparison direction
+// data-dependent and unpredictable, so the classic branching search
+// pays ~8 mispredicts per cell (measured ~200 cycles/cell); conditional
+// moves bring it to the pure compare-chain cost.
 static inline int32_t lower_bound_idx(const double* bounds, int32_t n,
                                       double v) {
-  int32_t lo = 0, hi = n;
-  while (lo < hi) {
-    int32_t mid = (lo + hi) >> 1;
-    if (bounds[mid] < v) {
-      lo = mid + 1;
-    } else {
-      hi = mid;
-    }
+  const double* base = bounds;
+  int32_t len = n;
+  while (len > 1) {
+    int32_t half = len >> 1;
+    base = (base[half - 1] < v) ? base + half : base;  // cmov
+    len -= half;
   }
-  return lo;
+  int32_t idx = static_cast<int32_t>(base - bounds);
+  return idx + (len == 1 && idx < n && base[0] < v ? 1 : 0);
+}
+
+static void build_feat_lut(FeatLut* fl, const double* bounds,
+                           int32_t n_search) {
+  fl->usable = 0;
+  if (n_search < 4) return;
+  // span the finite boundary range; the trailing bound is typically +inf
+  int32_t last = n_search - 1;
+  while (last > 0 && !std::isfinite(bounds[last])) --last;
+  double b0 = bounds[0], b1 = bounds[last];
+  if (!(std::isfinite(b0) && std::isfinite(b1) && b1 > b0)) return;
+  double step = (b1 - b0) / kLutCells;
+  if (!(step > 0.0)) return;
+  fl->b0 = b0;
+  fl->inv_step = 1.0 / step;
+  for (int32_t j = 0; j <= kLutCells; ++j) {
+    fl->idx[j] = lower_bound_idx(bounds, n_search, b0 + j * step);
+  }
+  fl->usable = 1;
+}
+
+static inline int32_t lut_lower_bound(const FeatLut* fl,
+                                      const double* bounds,
+                                      int32_t n_search, double v) {
+  double jf = (v - fl->b0) * fl->inv_step;
+  if (!(jf >= 0.0)) return v <= bounds[0] ? 0 : lower_bound_idx(
+      bounds, n_search, v);
+  if (jf >= kLutCells) {
+    // past the last finite bound: a short search over the tail
+    int32_t lo = fl->idx[kLutCells] > 0 ? fl->idx[kLutCells] - 1 : 0;
+    return lo + lower_bound_idx(bounds + lo, n_search - lo, v);
+  }
+  int32_t j = static_cast<int32_t>(jf);
+  int32_t lo = fl->idx[j] > 0 ? fl->idx[j] - 1 : 0;
+  int32_t hi = fl->idx[j + 1] + 1;   // +-1 absorb float round-off
+  if (hi > n_search) hi = n_search;
+  return lo + lower_bound_idx(bounds + lo, hi - lo, v);
 }
 
 static inline int32_t value_to_bin(
     double v, int32_t num_bin, int32_t missing_type, int32_t is_cat,
-    const double* bounds, const int32_t* lut, int64_t lut_size) {
+    const double* bounds, const int32_t* lut, int64_t lut_size,
+    const FeatLut* fl) {
   if (is_cat) {
     if (std::isnan(v) || !std::isfinite(v)) return num_bin - 1;
     // range-check BEFORE the cast: float->int conversion of a value
@@ -56,7 +116,9 @@ static inline int32_t value_to_bin(
     v = 0.0;
   }
   int32_t n_search = num_bin - (missing_type == 2 ? 1 : 0);
-  int32_t idx = lower_bound_idx(bounds, n_search, v);
+  int32_t idx = (fl != nullptr && fl->usable)
+      ? lut_lower_bound(fl, bounds, n_search, v)
+      : lower_bound_idx(bounds, n_search, v);
   return idx < n_search - 1 ? idx : n_search - 1;
 }
 
@@ -72,6 +134,24 @@ void bin_rows(const double* X, int64_t n, int64_t stride, int32_t G,
   uint16_t* out16 = static_cast<uint16_t*>(out);
   int32_t* out32 = static_cast<int32_t*>(out);
 
+  int32_t K = group_ptr[G];
+  // LUT construction costs ~2k searches per feature: only worth it when
+  // the row count amortizes it, and degrade to the plain search when the
+  // allocation fails (wide one-hot matrices can make K huge)
+  FeatLut* fluts = nullptr;
+  if (n >= 4096) {
+    fluts = static_cast<FeatLut*>(malloc(sizeof(FeatLut) * K));
+  }
+  if (fluts != nullptr) {
+    for (int32_t k = 0; k < K; ++k) {
+      fluts[k].usable = 0;
+      if (!feat_iscat[k]) {
+        int32_t n_search = feat_numbin[k] - (feat_missing[k] == 2 ? 1 : 0);
+        build_feat_lut(&fluts[k], bounds + bounds_ptr[k], n_search);
+      }
+    }
+  }
+
 #if defined(_OPENMP)
 #pragma omp parallel for schedule(static)
 #endif
@@ -85,7 +165,8 @@ void bin_rows(const double* X, int64_t n, int64_t stride, int32_t G,
         val = value_to_bin(row[feat_col[k]], feat_numbin[k],
                            feat_missing[k], feat_iscat[k],
                            bounds + bounds_ptr[k], lut + lut_ptr[k],
-                           lut_ptr[k + 1] - lut_ptr[k]);
+                           lut_ptr[k + 1] - lut_ptr[k],
+                           fluts ? &fluts[k] : nullptr);
       } else {
         val = 0;  // group-local sentinel (default) bin
         int64_t local = 1;
@@ -94,7 +175,8 @@ void bin_rows(const double* X, int64_t n, int64_t stride, int32_t G,
                                    feat_missing[k], feat_iscat[k],
                                    bounds + bounds_ptr[k],
                                    lut + lut_ptr[k],
-                                   lut_ptr[k + 1] - lut_ptr[k]);
+                                   lut_ptr[k + 1] - lut_ptr[k],
+                                   fluts ? &fluts[k] : nullptr);
           if (b != feat_mostfreq[k]) {
             val = local + b;
           }
@@ -111,6 +193,7 @@ void bin_rows(const double* X, int64_t n, int64_t stride, int32_t G,
       }
     }
   }
+  free(fluts);
 }
 
 int32_t binrows_num_threads() {

@@ -60,6 +60,9 @@ def _f32r(row):
     return jax.lax.bitcast_convert_type(row, F32)
 
 
+# payload row count up to which f32 leaf state holds exact integer counts
+EXACT_F32_ROWS = 1 << 24
+
 # leaf-state matrix columns
 LS_SG, LS_SH, LS_CNT, LS_VAL, LS_DEPTH, LS_START, LS_NROWS, LS_PAD = range(8)
 # best-candidate matrix columns
@@ -366,7 +369,8 @@ def make_bag_transform(bag_spec, geometry):
 def make_persist_grower(assets: PersistAssets, meta, gc,
                         interpret: bool = False, axis_name=None,
                         kernel_impl: str = "pallas",
-                        stat_from_scan: bool = False):
+                        stat_from_scan: bool = False,
+                        state_dtype=None):
     """Build grow/score/gradient closures for one dataset + grow config.
 
     gc: GrowConfig (num_leaves, max_depth, num_features, scan_width used).
@@ -401,6 +405,17 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
     L = gc.num_leaves
     W = 256
     TBp = G * W
+    # the leaf-state/tree-record matrices carry exact integer counts and
+    # payload positions; f32 is integer-exact only to 2^24, so larger
+    # payloads switch them to f64 (tiny [L, 8] matrices — the cost is
+    # noise even with emulated f64 on TPU). Sharded callers pass the
+    # GLOBAL row count's choice via state_dtype. The SCAN's hessian-
+    # derived count recovery stays f32 (estimate-grade by design, the
+    # reference's cnt_factor trade): above 2^24 rows its min_data gating
+    # and the bagged stat counts carry ~1e-7 relative rounding on the
+    # largest leaves.
+    ST = state_dtype if state_dtype is not None else (
+        F32 if n < EXACT_F32_ROWS else jnp.float64)
     if kernel_impl == "xla":
         split_pass = make_xla_split_pass(WPA, NP, G, plan, nbw)
         root_hist = make_xla_root_hist(WPA, NP, G, plan, nbw, n)
@@ -488,8 +503,8 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
         layout = ScanLayout(pad_meta, fmask, F, W, TBp)
         rhist, sums = root_hist(pay)
         gh0, hh0 = rhist
-        root_cnt = (jnp.asarray(n, F32) if bag_cnt is None
-                    else bag_cnt.astype(F32))
+        root_cnt = (jnp.asarray(n, ST) if bag_cnt is None
+                    else bag_cnt.astype(ST))
         if axis_name is not None:
             # root Allreduce (data_parallel_tree_learner.cpp:120-145)
             sums = jax.lax.psum(sums, axis_name)
@@ -502,11 +517,12 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
         root_out = -sum_grad / (sum_hess + p32.lambda_l2.astype(F32))
         gh = jnp.zeros((L, TBp), F32).at[0].set(gh0)
         hh = jnp.zeros((L, TBp), F32).at[0].set(hh0)
-        lstate = jnp.zeros((L, 8), F32).at[0].set(
-            jnp.asarray([0, 0, 0, 0, 0, 0, 0, 0], F32)
-            .at[LS_SG].set(sum_grad).at[LS_SH].set(sum_hess)
-            .at[LS_CNT].set(root_cnt).at[LS_VAL].set(root_out)
-            .at[LS_NROWS].set(jnp.asarray(n, F32)))
+        lstate = jnp.zeros((L, 8), ST).at[0].set(
+            jnp.asarray([0, 0, 0, 0, 0, 0, 0, 0], ST)
+            .at[LS_SG].set(sum_grad.astype(ST))
+            .at[LS_SH].set(sum_hess.astype(ST))
+            .at[LS_CNT].set(root_cnt).at[LS_VAL].set(root_out.astype(ST))
+            .at[LS_NROWS].set(jnp.asarray(n, ST)))
         pair0 = eval_pair(gh, hh, jnp.asarray([0, 0], I32),
                           jnp.stack([sum_grad, sum_grad]),
                           jnp.stack([sum_hess, sum_hess]),
@@ -522,7 +538,7 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
             hh=hh,
             lstate=lstate,
             best=best,
-            tree=jnp.zeros((L, 8), F32),
+            tree=jnp.zeros((L, 8), ST),
         )
 
         def cond(st: _PState):
@@ -593,7 +609,7 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
             gh = st.gh.at[l].set(vgl).at[s].set(vgr)
             hh = st.hh.at[l].set(vhl).at[s].set(vhr)
 
-            depth_child = ls[LS_DEPTH] + 1.0
+            depth_child = (ls[LS_DEPTH] + 1.0).astype(ST)
             pair = eval_pair(
                 gh, hh, jnp.stack([l, s]),
                 jnp.stack([bl[BC_LSG], bl[BC_RSG]]),
@@ -603,29 +619,31 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
             best = st.best.at[l].set(jnp.where(do, pair[0], st.best[l])) \
                           .at[s].set(jnp.where(do, pair[1], st.best[s]))
 
-            row_l = jnp.zeros((8,), F32) \
-                .at[LS_SG].set(bl[BC_LSG]).at[LS_SH].set(bl[BC_LSH]) \
-                .at[LS_CNT].set(left_cnt.astype(F32)) \
-                .at[LS_VAL].set(bl[BC_LOUT]) \
+            row_l = jnp.zeros((8,), ST) \
+                .at[LS_SG].set(bl[BC_LSG].astype(ST)) \
+                .at[LS_SH].set(bl[BC_LSH].astype(ST)) \
+                .at[LS_CNT].set(left_cnt.astype(ST)) \
+                .at[LS_VAL].set(bl[BC_LOUT].astype(ST)) \
                 .at[LS_DEPTH].set(depth_child) \
-                .at[LS_START].set(s0.astype(F32)) \
-                .at[LS_NROWS].set(n_left.astype(F32))
-            row_s = jnp.zeros((8,), F32) \
-                .at[LS_SG].set(bl[BC_RSG]).at[LS_SH].set(bl[BC_RSH]) \
-                .at[LS_CNT].set(right_cnt.astype(F32)) \
-                .at[LS_VAL].set(bl[BC_ROUT]) \
+                .at[LS_START].set(s0.astype(ST)) \
+                .at[LS_NROWS].set(n_left.astype(ST))
+            row_s = jnp.zeros((8,), ST) \
+                .at[LS_SG].set(bl[BC_RSG].astype(ST)) \
+                .at[LS_SH].set(bl[BC_RSH].astype(ST)) \
+                .at[LS_CNT].set(right_cnt.astype(ST)) \
+                .at[LS_VAL].set(bl[BC_ROUT].astype(ST)) \
                 .at[LS_DEPTH].set(depth_child) \
-                .at[LS_START].set((s0 + n_left).astype(F32)) \
-                .at[LS_NROWS].set(n_right.astype(F32))
+                .at[LS_START].set((s0 + n_left).astype(ST)) \
+                .at[LS_NROWS].set(n_right.astype(ST))
             lstate = st.lstate.at[l].set(jnp.where(do, row_l, st.lstate[l])) \
                               .at[s].set(jnp.where(do, row_s, st.lstate[s]))
 
-            rec = jnp.zeros((8,), F32) \
-                .at[TR_LEAF].set(l.astype(F32)) \
-                .at[TR_FEAT].set(bl[BC_FEAT]) \
-                .at[TR_THR].set(bl[BC_THR]) \
-                .at[TR_DL].set(bl[BC_DL]) \
-                .at[TR_GAIN].set(bl[BC_GAIN]) \
+            rec = jnp.zeros((8,), ST) \
+                .at[TR_LEAF].set(l.astype(ST)) \
+                .at[TR_FEAT].set(bl[BC_FEAT].astype(ST)) \
+                .at[TR_THR].set(bl[BC_THR].astype(ST)) \
+                .at[TR_DL].set(bl[BC_DL].astype(ST)) \
+                .at[TR_GAIN].set(bl[BC_GAIN].astype(ST)) \
                 .at[TR_IVAL].set(ls[LS_VAL]) \
                 .at[TR_ICNT].set(ls[LS_CNT])
             tree = st.tree.at[s - 1].set(
@@ -666,7 +684,7 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
         row = score_row + cls
         starts = lstate[:, LS_START]
         nrows = lstate[:, LS_NROWS]
-        vals = lstate[:, LS_VAL] * shrink.astype(F32)
+        vals = (lstate[:, LS_VAL] * shrink.astype(ST)).astype(F32)
         live = (nrows > 0) & (jnp.arange(L, dtype=I32) < num_leaves)
         key = jnp.where(live, starts, jnp.inf)
         order = jnp.argsort(key)

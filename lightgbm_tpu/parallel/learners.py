@@ -145,9 +145,9 @@ class DataParallelTreeLearner(SerialTreeLearner):
                 and self.dataset.num_data % self.num_shards == 0)
 
     def _persist_rows_ok(self) -> bool:
-        # global counts (root_cnt, psum'd left counts) ride the f32 leaf
-        # state, so the 2^24 exact-int bound applies to TOTAL rows too
-        return self.dataset.num_data < (1 << 24)
+        # 32-bit row ids / lane pointers bound the TOTAL rows; counts
+        # above 2^24 ride f64 leaf state (state_dtype below)
+        return self.dataset.num_data < (1 << 31) - (1 << 16)
 
     def _persist_obj_ok(self, objective) -> bool:
         # payload-order gradients only: row-order mode needs global row
@@ -160,7 +160,8 @@ class DataParallelTreeLearner(SerialTreeLearner):
         return bag_spec[0] in ("none", "bagging")
 
     def _persist_cached(self, objective, k: int, bag_spec=("none",)):
-        from ..ops.grow_persist import (build_assets, make_bag_transform,
+        from ..ops.grow_persist import (EXACT_F32_ROWS, build_assets,
+                                        make_bag_transform,
                                         make_persist_grower,
                                         make_scan_driver)
         from jax.sharding import NamedSharding
@@ -184,11 +185,14 @@ class DataParallelTreeLearner(SerialTreeLearner):
         gkey = ("grower_sharded", S, gc, stat_from_scan)
         wrapper = cache.get(gkey)
         if wrapper is None:
-            inner = make_persist_grower(assets, self.meta, gc,
-                                        interpret=interpret,
-                                        axis_name=AXIS,
-                                        kernel_impl=kernel_impl,
-                                        stat_from_scan=stat_from_scan)
+            inner = make_persist_grower(
+                assets, self.meta, gc, interpret=interpret, axis_name=AXIS,
+                kernel_impl=kernel_impl, stat_from_scan=stat_from_scan,
+                # GLOBAL counts live in the leaf state: pick exactness by
+                # the total row count, not the per-shard one
+                state_dtype=(jnp.float32
+                             if self.dataset.num_data < EXACT_F32_ROWS
+                             else jnp.float64))
 
             class _ShardedGrower:
                 pass

@@ -378,9 +378,9 @@ class SerialTreeLearner:
         return self._axis_name is None
 
     def _persist_rows_ok(self) -> bool:
-        """Payload row-id packing bound (per-payload; sharded learners
-        check their per-shard row count)."""
-        return self.dataset.num_data < (1 << 24)
+        """Row-count bound for one payload: lane pointers and row ids are
+        32-bit (counts above 2^24 ride f64 leaf state automatically)."""
+        return self.dataset.num_data < (1 << 31) - (1 << 16)
 
     def _persist_obj_ok(self, objective) -> bool:
         if getattr(objective, "num_model_per_iteration", 1) > 1:

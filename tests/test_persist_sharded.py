@@ -211,3 +211,25 @@ def test_persist_sharded_scores_row_ordered():
     # order is the point here: a misplaced shard/rid would be off by O(1);
     # the payload carries scores in f32, predict sums trees in f64
     np.testing.assert_allclose(staged, pred_raw, rtol=1e-4, atol=1e-5)
+
+
+def test_persist_f64_state_matches_f32(monkeypatch):
+    """Above EXACT_F32_ROWS the persist leaf state switches to f64 for
+    exact counts (the 2^24 cap lift); at small n the two dtypes must
+    agree (same trees, counts exact either way)."""
+    import lightgbm_tpu.ops.grow_persist as GP
+    X, y = _data(seed=61)
+    base = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+            "min_data_in_leaf": 10, "max_bin": 63,
+            "tpu_persist_scan": "force"}
+    bst32 = lgb.train(dict(base), lgb.Dataset(X, y), ROUNDS,
+                      verbose_eval=False)
+    monkeypatch.setattr(GP, "EXACT_F32_ROWS", 1024)   # force f64 state
+    bst64 = lgb.train(dict(base), lgb.Dataset(X, y), ROUNDS,
+                      verbose_eval=False)
+    assert getattr(bst64._booster.tree_learner, "_persist_carry",
+                   None) is not None
+    s32, v32 = _tree_tuples(bst32)
+    s64, v64 = _tree_tuples(bst64)
+    assert s32 == s64
+    np.testing.assert_allclose(v32, v64, rtol=1e-5, atol=1e-7)

@@ -77,3 +77,25 @@ def test_sparse_reference_alignment():
     dv.construct()
     assert dv._inner.total_bins == ds._inner.total_bins
     assert dv._inner.groups == ds._inner.groups
+
+
+def test_sparse_predict_chunked_matches_dense():
+    """Booster.predict on scipy CSR streams row blocks (no whole-matrix
+    densify; reference PredictForCSR analog) and matches dense predict."""
+    sp = pytest.importorskip("scipy.sparse")
+    rng = np.random.default_rng(5)
+    n, f = 70_000, 400
+    X = sp.random(n, f, density=0.01, format="csr", random_state=3,
+                  data_rvs=lambda k: rng.normal(size=k))
+    y = (np.asarray(X[:, 0].todense()).ravel()
+         + np.asarray(X[:, 3].todense()).ravel() > 0.01).astype(float)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbosity": -1, "max_bin": 63},
+                    lgb.Dataset(X, y), 5, verbose_eval=False)
+    # chunking engages: 32MB / (400*8B) ~ 10k rows per block < n
+    p_sparse = bst.predict(X)
+    p_dense = bst.predict(np.asarray(X[:20_000].todense(), np.float64))
+    assert p_sparse.shape == (n,)
+    np.testing.assert_allclose(p_sparse[:20_000], p_dense, rtol=1e-12)
+    c = bst.predict(X[:15_000], pred_contrib=True)
+    assert c.shape == (15_000, f + 1)
