@@ -143,6 +143,11 @@ void bin_rows(const double* X, int64_t n, int64_t stride, int32_t G,
     fluts = static_cast<FeatLut*>(malloc(sizeof(FeatLut) * K));
   }
   if (fluts != nullptr) {
+    // per-feature builds are independent; wide one-hot matrices make K
+    // large enough that a serial build would rival the binning itself
+#if defined(_OPENMP)
+#pragma omp parallel for schedule(static)
+#endif
     for (int32_t k = 0; k < K; ++k) {
       fluts[k].usable = 0;
       if (!feat_iscat[k]) {

@@ -79,6 +79,8 @@ class GrowConfig(NamedTuple):
     extra_trees: bool = False   # USE_RAND: one random threshold per feature
     bynode_k: int = 0           # >0: feature_fraction_bynode sample size
     use_cegb: bool = False      # CEGB split/coupled gain penalties
+    use_cegb_lazy: bool = False  # CEGB per-row lazy feature penalty
+    #                            # (masked grower only; [N, F] bookkeeping)
     parallel_mode: str = "data"  # "data" | "feature" | "voting" (see
     #                            # parallel/learners.py for the mapping to
     #                            # the reference's three learners)
@@ -95,6 +97,9 @@ class GrowExtras(NamedTuple):
     cegb_coupled: jnp.ndarray   # [F] f64 per-feature coupled penalty
     cegb_split_pen: jnp.ndarray  # scalar f64 penalty_split
     cegb_tradeoff: jnp.ndarray   # scalar f64
+    cegb_lazy: jnp.ndarray       # [F] f64 per-feature lazy (on-demand)
+    #                            # penalty charged per row that has not yet
+    #                            # seen the feature used on its path
     feature_used: jnp.ndarray    # [F] bool: features already split on in
     #                            # EARLIER trees (CEGB coupled penalty is
     #                            # charged once per model, not per tree —
@@ -108,6 +113,7 @@ def default_extras(num_features: int) -> GrowExtras:
         cegb_coupled=jnp.zeros((max(num_features, 1),), F64),
         cegb_split_pen=jnp.asarray(0.0, F64),
         cegb_tradeoff=jnp.asarray(1.0, F64),
+        cegb_lazy=jnp.zeros((max(num_features, 1),), F64),
         feature_used=jnp.zeros((max(num_features, 1),), jnp.bool_))
 
 
@@ -186,6 +192,9 @@ class _LoopState(NamedTuple):
     leaf_cmin: jnp.ndarray      # [L] ft monotone lower bound
     leaf_cmax: jnp.ndarray      # [L] ft monotone upper bound
     feature_used: jnp.ndarray   # [F] bool (CEGB coupled-penalty bookkeeping)
+    row_feat_used: jnp.ndarray  # [N, F] bool CEGB lazy bookkeeping
+    #                           # (feature_used_in_data_ bitset analog;
+    #                           # [0, 0] when gc.use_cegb_lazy is off)
     best: SplitCandidate        # [L] pytree of per-leaf best splits
     tree: TreeArrays
 
@@ -342,7 +351,8 @@ def _make_eval_leaf(meta, params, feature_mask, cat, gc: GrowConfig,
     """
     F = gc.num_features
 
-    def eval_leaf(hist, sg, sh, cnt, depth, cmin, cmax, key, feature_used):
+    def eval_leaf(hist, sg, sh, cnt, depth, cmin, cmax, key, feature_used,
+                  lazy_unused=None):
         fmask = feature_mask
         win_mask = None
         if gc.parallel_mode == "voting" and axis_name is not None:
@@ -401,6 +411,15 @@ def _make_eval_leaf(meta, params, feature_mask, cat, gc: GrowConfig,
                 * (extras.cegb_split_pen.astype(ft_) * cnt.astype(ft_)
                    + jnp.where(feature_used, 0.0,
                                extras.cegb_coupled.astype(ft_))))
+            if gc.use_cegb_lazy and lazy_unused is not None:
+                # on-demand data-acquisition cost: penalty_lazy[f] per
+                # in-leaf row whose path never used feature f
+                # (CalculateOndemandCosts,
+                # cost_effective_gradient_boosting.hpp:94-114)
+                gain_penalty = gain_penalty + (
+                    extras.cegb_tradeoff.astype(ft_)
+                    * extras.cegb_lazy.astype(ft_)
+                    * lazy_unused.astype(ft_))
         cand = find_best_split_numerical(
             hist, sg, sh, cnt, meta, params, cmin, cmax, fmask,
             num_features=F, use_mc=gc.use_mc, max_w=gc.scan_width,
@@ -432,7 +451,7 @@ def _make_eval_leaf(meta, params, feature_mask, cat, gc: GrowConfig,
 
 def _eval_children(eval_leaf, leaf_hist, l, s, cand, left_cnt, right_cnt,
                    depth_child, l_cmin, l_cmax, r_cmin, r_cmax, keys,
-                   feature_used):
+                   feature_used, lazy_pair=None):
     """Evaluate both children in ONE vectorized scan pass (vmap over a
     [2, TB, 2] stack) — halves the per-split fixed cost of the dense scan."""
     pair_hist = jnp.stack([leaf_hist[l], leaf_hist[s]])
@@ -441,9 +460,15 @@ def _eval_children(eval_leaf, leaf_hist, l, s, cand, left_cnt, right_cnt,
     cnts = jnp.stack([left_cnt, right_cnt])
     cmins = jnp.stack([l_cmin, r_cmin])
     cmaxs = jnp.stack([l_cmax, r_cmax])
-    pair = jax.vmap(eval_leaf, in_axes=(0, 0, 0, 0, None, 0, 0, 0, None))(
-        pair_hist, sgs, shs, cnts, depth_child, cmins, cmaxs, keys,
-        feature_used)
+    if lazy_pair is None:
+        pair = jax.vmap(eval_leaf, in_axes=(0, 0, 0, 0, None, 0, 0, 0, None))(
+            pair_hist, sgs, shs, cnts, depth_child, cmins, cmaxs, keys,
+            feature_used)
+    else:
+        pair = jax.vmap(eval_leaf,
+                        in_axes=(0, 0, 0, 0, None, 0, 0, 0, None, 0))(
+            pair_hist, sgs, shs, cnts, depth_child, cmins, cmaxs, keys,
+            feature_used, lazy_pair)
     cand_l = jax.tree.map(lambda a: a[0], pair)
     cand_r = jax.tree.map(lambda a: a[1], pair)
     return cand_l, cand_r
@@ -791,7 +816,8 @@ def grow_tree(layout: DataLayout, grad: jnp.ndarray, hess: jnp.ndarray,
               feature_mask: jnp.ndarray, fix: FixInfo, gc: GrowConfig,
               axis_name=None, cat: CatLayout = None,
               extras: GrowExtras = None,
-              forced: ForcedInfo = None) -> TreeArrays:
+              forced: ForcedInfo = None,
+              row_feat_used=None) -> TreeArrays:
     """Grow one tree. grad/hess must already include bagging/GOSS weighting
     and be zero on padded/out-of-bag rows; bag_mask marks in-bag valid rows.
 
@@ -799,6 +825,12 @@ def grow_tree(layout: DataLayout, grad: jnp.ndarray, hess: jnp.ndarray,
     histograms / counts are psum-reduced — this IS the data-parallel learner
     (reference src/treelearner/data_parallel_tree_learner.cpp) expressed as
     sharding + one collective.
+
+    When gc.use_cegb_lazy is set, `row_feat_used` carries the [N, F] bool
+    per-row feature-acquisition bitset across trees (the reference's
+    feature_used_in_data_, cost_effective_gradient_boosting.hpp:47) and the
+    return value grows a third element with its updated state. Lazy CEGB is
+    single-device masked-grower only (gated in treelearner/serial.py).
     """
     if cat is None:
         cat = empty_cat_layout(gc.cat_width)
@@ -814,8 +846,11 @@ def grow_tree(layout: DataLayout, grad: jnp.ndarray, hess: jnp.ndarray,
     if F == 0 or TB == 0:
         # no usable features: a single-leaf tree (reference warns and trains
         # constant trees when all features are trivial)
-        return _single_leaf_tree(n, L, gc.cat_width, grad, hess, bag_mask,
-                                 params, axis_name, ft), extras.feature_used
+        one = _single_leaf_tree(n, L, gc.cat_width, grad, hess, bag_mask,
+                                params, axis_name, ft)
+        if gc.use_cegb_lazy:
+            return one, extras.feature_used, row_feat_used
+        return one, extras.feature_used
 
     grad = grad.astype(jnp.float32)
     hess = hess.astype(jnp.float32)
@@ -859,6 +894,21 @@ def grow_tree(layout: DataLayout, grad: jnp.ndarray, hess: jnp.ndarray,
         sum_grad, sum_hess, pcast.lambda_l1, pcast.lambda_l2,
         pcast.max_delta_step)
 
+    if gc.use_cegb_lazy:
+        assert eval_pair_fused is None, \
+            "CEGB excludes the fused Pallas pair scan (resolve_scan_impl)"
+        rfu0 = (row_feat_used if row_feat_used is not None
+                else jnp.zeros((n, F), jnp.bool_))
+    else:
+        rfu0 = jnp.zeros((0, 0), jnp.bool_)
+
+    def _lazy_unused(mask, rfu):
+        # per-feature count of rows in `mask` whose acquisition bit is
+        # still unset: one [N]x[N,F] matvec (counts exact in f32 — lazy
+        # CEGB rides the masked grower, bounded well under 2^24 rows)
+        return jnp.matmul(mask.astype(jnp.float32),
+                          (~rfu).astype(jnp.float32))
+
     state = _LoopState(
         s=jnp.asarray(1, I32),
         done=jnp.asarray(False),
@@ -873,6 +923,7 @@ def grow_tree(layout: DataLayout, grad: jnp.ndarray, hess: jnp.ndarray,
         leaf_cmin=jnp.full((L,), -jnp.inf, ft),
         leaf_cmax=jnp.full((L,), jnp.inf, ft),
         feature_used=extras.feature_used,
+        row_feat_used=rfu0,
         best=jax.tree.map(
             lambda x: jnp.broadcast_to(x, (L,) + x.shape),
             _root_candidate_dummy(gc.cat_width, ft)),
@@ -880,10 +931,11 @@ def grow_tree(layout: DataLayout, grad: jnp.ndarray, hess: jnp.ndarray,
     )
 
     # root best split
+    root_lazy = (_lazy_unused(bag_mask, rfu0) if gc.use_cegb_lazy else None)
     root_cand = eval_leaf(root_hist, sum_grad, sum_hess, root_count,
                           jnp.asarray(0, I32), state.leaf_cmin[0],
                           state.leaf_cmax[0], _root_key(extras),
-                          state.feature_used)
+                          state.feature_used, root_lazy)
     state = state._replace(
         best=jax.tree.map(lambda a, v: a.at[0].set(v), state.best, root_cand))
 
@@ -970,6 +1022,19 @@ def grow_tree(layout: DataLayout, grad: jnp.ndarray, hess: jnp.ndarray,
         if gc.use_cegb:
             feature_used = feature_used.at[f].set(feature_used[f] | do)
 
+        row_feat_used = st.row_feat_used
+        lazy_pair = None
+        if gc.use_cegb_lazy:
+            # the split leaf's rows acquire feature f BEFORE the children
+            # are evaluated (UpdateLeafBestSplits marks, then the children's
+            # FindBestSplits see the updated bitset)
+            row_feat_used = row_feat_used.at[:, f].set(
+                row_feat_used[:, f] | (in_bag & do))
+            nrfu = (~row_feat_used).astype(jnp.float32)
+            lazy_pair = jnp.stack([
+                jnp.matmul((in_bag & go_left).astype(jnp.float32), nrfu),
+                jnp.matmul((in_bag & ~go_left).astype(jnp.float32), nrfu)])
+
         # evaluate children FROM THE UPDATED BUFFER: slicing leaf_hist (not
         # the hist_left/right expressions) ends the old buffer's liveness at
         # the update, letting XLA do the dynamic-update-slice in place
@@ -981,7 +1046,7 @@ def grow_tree(layout: DataLayout, grad: jnp.ndarray, hess: jnp.ndarray,
             cand_l, cand_r = _eval_children(
                 eval_leaf, leaf_hist, l, s, cand, left_cnt, right_cnt,
                 depth_child, l_cmin, l_cmax, r_cmin, r_cmax,
-                _split_keys(extras, s), feature_used)
+                _split_keys(extras, s), feature_used, lazy_pair=lazy_pair)
         best = jax.tree.map(
             lambda a, vl, vr: a.at[l].set(jnp.where(do, vl, a[l]))
                                .at[s].set(jnp.where(do, vr, a[s])),
@@ -996,16 +1061,20 @@ def grow_tree(layout: DataLayout, grad: jnp.ndarray, hess: jnp.ndarray,
             leaf_sum_hess=leaf_sum_hess, leaf_count=leaf_count,
             leaf_value=leaf_value, leaf_depth=leaf_depth,
             leaf_cmin=leaf_cmin, leaf_cmax=leaf_cmax,
-            feature_used=feature_used, best=best, tree=tree)
+            feature_used=feature_used, row_feat_used=row_feat_used,
+            best=best, tree=tree)
 
     final = jax.lax.while_loop(cond, body, state)
-    return final.tree._replace(
+    out = final.tree._replace(
         num_leaves=final.s,
         leaf_value=final.leaf_value,
         leaf_count=final.leaf_count,
         leaf_weight=final.leaf_sum_hess,
         row_leaf=final.row_leaf,
-    ), final.feature_used
+    )
+    if gc.use_cegb_lazy:
+        return out, final.feature_used, final.row_feat_used
+    return out, final.feature_used
 
 
 # ---------------------------------------------------------------------------

@@ -265,9 +265,10 @@ class _PState(NamedTuple):
     pay: jnp.ndarray           # [WPA, NP] u32
     gh: jnp.ndarray            # [L, TBp] f32 gradient histogram plane
     hh: jnp.ndarray            # [L, TBp] f32 hessian histogram plane
-    lstate: jnp.ndarray        # [L, 8] f32
-    best: jnp.ndarray          # [L, 12] f32
-    tree: jnp.ndarray          # [L, 8] f32
+    lstate: jnp.ndarray        # [L, 8] ST (f32; f64 when counts can pass
+    #                          # 2^24 — EXACT_F32_ROWS / state_dtype)
+    best: jnp.ndarray          # [L, 12] ST
+    tree: jnp.ndarray          # [L, 8] ST
 
 
 # ---------------------------------------------------------------------------

@@ -328,6 +328,10 @@ class FeatureParallelTreeLearner(SerialTreeLearner):
 
 
 def create_parallel_learner(learner_type: str, config, dataset):
+    if list(config.cegb_penalty_feature_lazy):
+        # the [N, F] acquisition bitset lives in the masked grower's
+        # full-N row space; sharded rows would need a gathered bitset
+        Log.fatal("cegb_penalty_feature_lazy requires tree_learner=serial")
     if learner_type == "data":
         return DataParallelTreeLearner(config, dataset)
     if learner_type == "voting":

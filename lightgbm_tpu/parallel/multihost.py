@@ -169,6 +169,9 @@ def train_multihost(config: Config, X_local: np.ndarray,
     if getattr(objective, "num_model_per_iteration", 1) > 1:
         Log.fatal("multiclass objectives are not supported with "
                   "num_machines > 1 yet")
+    if list(config.cegb_penalty_feature_lazy):
+        Log.fatal("cegb_penalty_feature_lazy is not supported with "
+                  "num_machines > 1 (per-row bitset needs unsharded rows)")
 
     # ---- global mesh + row-sharded device state ----------------------
     from ..treelearner.serial import SerialTreeLearner

@@ -34,14 +34,17 @@ PARTITION_MIN_ROWS = 65536
 
 def _cegb_enabled(config: Config) -> bool:
     """CostEfficientGradientBoosting::IsEnable
-    (cost_effective_gradient_boosting.hpp:25-31); the per-row lazy feature
-    penalty needs [rows, features] bookkeeping we do not keep on device."""
-    if list(config.cegb_penalty_feature_lazy):
-        Log.fatal("cegb_penalty_feature_lazy is not supported on "
-                  "device_type=tpu (per-row feature bookkeeping); use "
-                  "cegb_penalty_feature_coupled / cegb_penalty_split")
+    (cost_effective_gradient_boosting.hpp:25-31)."""
     return bool(float(config.cegb_penalty_split) > 0.0
-                or list(config.cegb_penalty_feature_coupled))
+                or list(config.cegb_penalty_feature_coupled)
+                or list(config.cegb_penalty_feature_lazy))
+
+
+def _cegb_lazy_enabled(config: Config) -> bool:
+    """The per-row on-demand penalty keeps a [N, F] device bitset
+    (feature_used_in_data_, cost_effective_gradient_boosting.hpp:47) —
+    masked-grower, single-device only."""
+    return bool(list(config.cegb_penalty_feature_lazy))
 
 
 def _build_extras(config: Config, dataset) -> GrowExtras:
@@ -56,6 +59,14 @@ def _build_extras(config: Config, dataset) -> GrowExtras:
                       "size as feature number.")
         for inner, real in enumerate(dataset.used_features):
             coupled[inner] = pen[real]
+    lazy = np.zeros(F, dtype=np.float64)
+    pen_lazy = list(config.cegb_penalty_feature_lazy)
+    if pen_lazy:
+        if len(pen_lazy) != dataset.num_total_features:
+            Log.fatal("cegb_penalty_feature_lazy should be the same "
+                      "size as feature number.")
+        for inner, real in enumerate(dataset.used_features):
+            lazy[inner] = pen_lazy[real]
     seed = int(config.extra_seed)
     key = jax.random.key_data(jax.random.PRNGKey(seed))
     ex = default_extras(dataset.num_features)
@@ -64,7 +75,8 @@ def _build_extras(config: Config, dataset) -> GrowExtras:
         cegb_coupled=jnp.asarray(coupled),
         cegb_split_pen=jnp.asarray(float(config.cegb_penalty_split),
                                    jnp.float64),
-        cegb_tradeoff=jnp.asarray(float(config.cegb_tradeoff), jnp.float64))
+        cegb_tradeoff=jnp.asarray(float(config.cegb_tradeoff), jnp.float64),
+        cegb_lazy=jnp.asarray(lazy))
 
 
 def resolve_hist_impl(config: Config) -> str:
@@ -312,6 +324,7 @@ class SerialTreeLearner:
                              * min(float(config.feature_fraction), 1.0)))))
                       if float(config.feature_fraction_bynode) < 1.0 else 0),
             use_cegb=_cegb_enabled(config),
+            use_cegb_lazy=_cegb_lazy_enabled(config),
             packed_4bit=bool(getattr(dataset, "device_packed", False)),
         )
         forced_list = _parse_forced_splits(config, dataset)
@@ -328,9 +341,18 @@ class SerialTreeLearner:
         self._extras_base = _build_extras(config, dataset)
         self._tree_counter = 0
         self._feature_used_dev = None
+        self._row_feat_used_dev = None   # CEGB lazy [N, F] bitset carry
         self.col_sampler = ColSampler(config, dataset.num_features)
         self.cat_layout = build_cat_layout(dataset, cat_width)
-        self.use_partitioned = dataset.num_data >= PARTITION_MIN_ROWS
+        # lazy CEGB keeps its per-row bitset in the masked grower's full-N
+        # row space; the payload-sorted grower has no stable row residency.
+        # Its unused-row counts accumulate in an f32 matmul — exact only
+        # below 2^24 rows, so the row count is gated loudly.
+        if self.grow_config.use_cegb_lazy and dataset.num_data >= (1 << 24):
+            Log.fatal("cegb_penalty_feature_lazy supports up to 2^24 rows "
+                      "(per-row acquisition counts are f32-exact)")
+        self.use_partitioned = (dataset.num_data >= PARTITION_MIN_ROWS
+                                and not self.grow_config.use_cegb_lazy)
         self.gw_global = build_gw_global(dataset)
         self._axis_name = None   # set by parallel learners
 
@@ -347,6 +369,14 @@ class SerialTreeLearner:
                 fmask, self.fix, self.grow_config,
                 gw_global=self.gw_global, axis_name=self._axis_name,
                 cat=self.cat_layout, extras=extras, forced=self.forced)
+        elif self.grow_config.use_cegb_lazy:
+            arrays, fu, rfu = grow_tree(
+                self.layout, grad, hess, bag_mask, self.meta,
+                self.params, fmask, self.fix, self.grow_config,
+                axis_name=self._axis_name, cat=self.cat_layout,
+                extras=extras, forced=self.forced,
+                row_feat_used=self._row_feat_used_dev)
+            self._row_feat_used_dev = rfu
         else:
             arrays, fu = grow_tree(
                 self.layout, grad, hess, bag_mask, self.meta,
@@ -418,6 +448,7 @@ class SerialTreeLearner:
                 return False
         widths = (ds.bin_end - ds.bin_start) if ds.num_features else None
         return (gc.n_forced == 0
+                and not gc.use_cegb_lazy
                 and not gc.packed_4bit
                 and self.cat_layout.cat_feature.shape[0] == 0
                 and ds.num_features > 0
@@ -551,21 +582,27 @@ class SerialTreeLearner:
             # every compile and overflows the remote-compile transport at
             # HIGGS-scale row counts
             @jax.jit
-            def run(layout, score0, fu0, fmasks, keys, base_extras,
+            def run(layout, score0, fu0, rfu0, fmasks, keys, base_extras,
                     shrink_t, meta, params, fix, gargs, forced):
                 bag = jnp.ones(n, bool)
 
                 def body(carry, per):
-                    score, fu = carry
+                    score, fu, rfu = carry
                     fmask, kk = per
                     g, h = grad_fn(score, *gargs)
                     ex = base_extras._replace(key=kk, feature_used=fu)
                     g = g.astype(jnp.float32)
                     h = h.astype(jnp.float32)
+                    rfu2 = rfu
                     if use_part:
                         arrays, fu2 = grow_tree_partitioned(
                             layout, g, h, bag, meta, params, fmask, fix, gc,
                             gw_global=gw, cat=cat, extras=ex, forced=forced)
+                    elif gc.use_cegb_lazy:
+                        arrays, fu2, rfu2 = grow_tree(
+                            layout, g, h, bag, meta, params, fmask, fix, gc,
+                            cat=cat, extras=ex, forced=forced,
+                            row_feat_used=rfu)
                     else:
                         arrays, fu2 = grow_tree(
                             layout, g, h, bag, meta, params, fmask, fix, gc,
@@ -576,20 +613,31 @@ class SerialTreeLearner:
                                                0.0)
                     out = arrays._replace(
                         row_leaf=jnp.zeros((0,), jnp.int32))
-                    return (score2, fu2), out
+                    return (score2, fu2, rfu2), out
 
-                (scoreK, fuK), stacked = jax.lax.scan(
-                    body, (score0, fu0), (fmasks, keys), length=k)
-                return scoreK, fuK, stacked
+                (scoreK, fuK, rfuK), stacked = jax.lax.scan(
+                    body, (score0, fu0, rfu0), (fmasks, keys), length=k)
+                return scoreK, fuK, rfuK, stacked
             cache[cache_key] = run
             fn = run
         base = self._extras_base
         fu0 = (self._feature_used_dev if self._feature_used_dev is not None
                else base.feature_used)
-        return fn(self.layout, score0, fu0, fmasks, keys, base,
-                  jnp.asarray(shrink, jnp.float64),
-                  self.meta, self.params, self.fix, objective._grad_args(),
-                  self.forced)
+        if self.grow_config.use_cegb_lazy:
+            rfu0 = (self._row_feat_used_dev
+                    if self._row_feat_used_dev is not None
+                    else jnp.zeros((self.layout.bins.shape[0],
+                                    self.dataset.num_features), jnp.bool_))
+        else:
+            rfu0 = jnp.zeros((0, 0), jnp.bool_)
+        scoreK, fuK, rfuK, stacked = fn(
+            self.layout, score0, fu0, rfu0, fmasks, keys, base,
+            jnp.asarray(shrink, jnp.float64),
+            self.meta, self.params, self.fix, objective._grad_args(),
+            self.forced)
+        if self.grow_config.use_cegb_lazy:
+            self._row_feat_used_dev = rfuK
+        return scoreK, fuK, stacked
 
     def train(self, grad: jnp.ndarray, hess: jnp.ndarray,
               bag_mask: jnp.ndarray) -> Tuple[Tree, jnp.ndarray]:

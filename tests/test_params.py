@@ -82,10 +82,74 @@ def test_cegb_coupled_penalty_limits_features():
     assert len(used1) <= len(used0)
 
 
-def test_cegb_lazy_raises():
+def _cegb_lazy_data(tmp_path):
+    """Deterministic binary problem round-tripped through CSV the way the
+    reference golden below was generated (values %.9g-rounded)."""
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(2000, 8))
+    y = (X[:, 0] + 0.5 * X[:, 1]
+         + 0.2 * rng.normal(size=2000) > 0).astype(float)
+    path = str(tmp_path / "cegb_train.csv")
+    np.savetxt(path, np.column_stack([y, X]), delimiter=",", fmt="%.9g")
+    return path
+
+
+# Per-iteration training logloss of the REAL LightGBM binary (built from
+# /root/reference) on the dataset above with the params below — pins the
+# lazy on-demand penalty semantics (CalculateOndemandCosts + the
+# feature_used_in_data_ bitset, cost_effective_gradient_boosting.hpp:47-114).
+CEGB_LAZY_GOLDEN = [
+    0.616674, 0.553374, 0.501297, 0.456948, 0.418483, 0.385537, 0.357251,
+    0.332104, 0.309686, 0.289829, 0.272757, 0.256913, 0.24323, 0.230598,
+    0.219254, 0.209052, 0.199477, 0.191094, 0.18345, 0.17599]
+
+
+def test_cegb_lazy_reference_parity(tmp_path):
+    path = _cegb_lazy_data(tmp_path)
+    params = {"objective": "binary", "num_leaves": 15,
+              "min_data_in_leaf": 20, "learning_rate": 0.1,
+              "metric": "binary_logloss", "verbosity": -1,
+              "label_column": 0, "header": False,
+              "cegb_penalty_feature_lazy": [0.001] * 8,
+              "cegb_tradeoff": 1.0}
+    ds = lgb.Dataset(path, params=dict(params))
+    evals = {}
+    lgb.train(params, ds, num_boost_round=20, valid_sets=[ds],
+              valid_names=["training"],
+              callbacks=[lgb.record_evaluation(evals)], verbose_eval=False)
+    ours = evals["training"]["binary_logloss"]
+    for it, (got, ref) in enumerate(zip(ours, CEGB_LAZY_GOLDEN), 1):
+        assert abs(got - ref) <= 1e-3 * abs(ref) + 1e-6, (
+            "iteration %d: ours=%.6f ref=%.6f" % (it, got, ref))
+
+
+def test_cegb_lazy_zero_matches_coupled_zero():
+    # a zero lazy penalty vector must reproduce the zero-coupled CEGB
+    # model exactly (identical gain path, bitset contributes nothing)
+    X, y = _data(f=8)
+    base = {"objective": "regression", "num_leaves": 31, "verbosity": -1}
+    bz = lgb.train({**base, "cegb_penalty_feature_lazy": [0.0] * 8},
+                   lgb.Dataset(X, y), 5, verbose_eval=False)
+    bc = lgb.train({**base, "cegb_penalty_feature_coupled": [0.0] * 8},
+                   lgb.Dataset(X, y), 5, verbose_eval=False)
+    np.testing.assert_allclose(bz.predict(X), bc.predict(X), atol=1e-12)
+
+
+def test_cegb_lazy_heavy_penalty_suppresses_splits():
+    # a per-row acquisition cost far above any gain: no split clears it
+    X, y = _data(n=500, f=8)
+    b = lgb.train({"objective": "regression", "verbosity": -1,
+                   "num_leaves": 15,
+                   "cegb_penalty_feature_lazy": [1e6] * 8},
+                  lgb.Dataset(X, y), 3, verbose_eval=False)
+    assert all(t.num_leaves == 1 for t in _trees_of(b))
+
+
+def test_cegb_lazy_parallel_raises():
     X, y = _data(n=300)
     with pytest.raises(LightGBMError):
         lgb.train({"objective": "regression", "verbosity": -1,
+                   "tree_learner": "data",
                    "cegb_penalty_feature_lazy": [1.0] * 8},
                   lgb.Dataset(X, y), 1, verbose_eval=False)
 
