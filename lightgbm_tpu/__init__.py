@@ -18,9 +18,31 @@ import jax as _jax
 _jax.config.update("jax_enable_x64", True)
 
 # persistent XLA compile cache: tree-grower programs are re-jitted per
-# (total_bins, num_features, num_leaves) signature; cache them across runs
-_cache_dir = _os.environ.get("LIGHTGBM_TPU_CACHE",
-                             _os.path.expanduser("~/.cache/lightgbm_tpu_xla"))
+# (total_bins, num_features, num_leaves) signature; cache them across runs.
+# The directory is suffixed with a host CPU fingerprint — XLA:CPU AOT
+# results encode the compile machine's ISA features, and loading (or
+# appending to) a cache written on a different host warns at best and
+# segfaults the cache writer at worst.
+
+
+def _host_tag() -> str:
+    import hashlib
+    try:
+        with open("/proc/cpuinfo") as fh:
+            for line in fh:
+                if line.startswith("flags"):
+                    return hashlib.sha256(
+                        line.encode()).hexdigest()[:8]
+    except OSError:
+        pass
+    import platform
+    return hashlib.sha256(
+        (platform.machine() + platform.processor()).encode()).hexdigest()[:8]
+
+
+_cache_dir = _os.environ.get(
+    "LIGHTGBM_TPU_CACHE",
+    _os.path.expanduser("~/.cache/lightgbm_tpu_xla-" + _host_tag()))
 try:
     _jax.config.update("jax_compilation_cache_dir", _cache_dir)
     _jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

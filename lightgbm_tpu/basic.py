@@ -488,6 +488,17 @@ class Booster:
         return out
 
     # ------------------------------------------------------------------
+    def reset_parameter(self, params: dict) -> "Booster":
+        """Change training-control parameters of the Booster (reference
+        Booster.reset_parameter, python-package basic.py /
+        LGBM_BoosterResetParameter): routes through GBDT.reset_config,
+        which warns on structurally-fixed keys."""
+        if params:
+            self._booster.reset_config(params)
+            self.params.update(params)
+        return self
+
+    # ------------------------------------------------------------------
     def refit(self, data, label, decay_rate: float = 0.9,
               **kwargs) -> "Booster":
         """Refit the existing model's leaf values to new data

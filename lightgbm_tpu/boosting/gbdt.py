@@ -106,24 +106,12 @@ class GBDT:
             self.class_need_train = [
                 objective.class_need_train(k)
                 for k in range(self.num_tree_per_iteration)]
-        # bagging state
+        # bagging state; the plan itself is derived in
+        # _refresh_bagging_config (the ResetBaggingConfig analog shared
+        # with reset_config)
         self._bag_mask_dev = jnp.ones(n, dtype=bool)
         self._bag_weight_dev = None   # GOSS amplification weights
-        self.bag_data_cnt = n
-        self.balanced_bagging = False
-        self._bagging_rng = np.random.default_rng(config.bagging_seed)
-        self.need_re_bagging = False
-        if (config.bagging_fraction < 1.0 and config.bagging_freq > 0):
-            self.bag_data_cnt = max(1, int(config.bagging_fraction * n))
-            self.need_re_bagging = True
-        if (config.pos_bagging_fraction < 1.0
-                or config.neg_bagging_fraction < 1.0):
-            if config.bagging_freq <= 0:
-                Log.warning("pos/neg bagging needs bagging_freq > 0")
-            else:
-                self.balanced_bagging = True
-                self.bag_data_cnt = 0  # computed at bagging time
-                self.need_re_bagging = True
+        self._refresh_bagging_config()
         self._grad_rows = None
         self._pending = []
 
@@ -213,6 +201,95 @@ class GBDT:
         Log.debug("Re-bagging, using %d data to train" % self.bag_data_cnt)
         self._bag_mask_dev = jnp.asarray(mask)
         self._bag_weight_dev = None
+
+    # -- ResetConfig ---------------------------------------------------
+    # training-control params GBDT::ResetConfig accepts mid-training
+    # (gbdt.cpp:704-760 + SerialTreeLearner::ResetConfig). Everything
+    # else — objective, metric, num_class, binning/layout params — shapes
+    # state built at construction and is rejected with a warning.
+    _RESET_SPLIT = frozenset({
+        "lambda_l1", "lambda_l2", "min_data_in_leaf",
+        "min_sum_hessian_in_leaf", "min_gain_to_split", "max_delta_step",
+        "num_leaves", "max_depth", "extra_trees", "feature_fraction",
+        "feature_fraction_bynode", "cat_smooth", "cat_l2",
+        "max_cat_threshold", "min_data_per_group", "max_cat_to_onehot"})
+    _RESET_BAG = frozenset({
+        "bagging_fraction", "bagging_freq", "pos_bagging_fraction",
+        "neg_bagging_fraction", "bagging_seed"})
+
+    def reset_config(self, updates: dict) -> None:
+        """GBDT::ResetConfig (gbdt.cpp:704): apply new training-control
+        parameters between iterations. Unsupported keys warn and are
+        skipped (loudly, never silently misapplied)."""
+        from ..config import _BY_NAME, alias_transform
+        updates = alias_transform(dict(updates))
+        cfg = self.config
+        touched_split = touched_bag = False
+        rejected = []
+        for k, v in updates.items():
+            p = _BY_NAME.get(k)
+            if p is None:
+                rejected.append(k)
+                continue
+            v = cfg._coerce(p, v)
+            if k == "learning_rate":
+                cfg.learning_rate = v
+                self.shrinkage_rate = float(v)
+            elif k in self._RESET_SPLIT:
+                setattr(cfg, k, v)
+                touched_split = True
+            elif k in self._RESET_BAG:
+                setattr(cfg, k, v)
+                touched_bag = True
+            else:
+                rejected.append(k)
+        if rejected:
+            Log.warning("reset_config: parameter(s) %s cannot change "
+                        "during training; ignored"
+                        % ", ".join(sorted(rejected)))
+        if touched_split:
+            # pending async trees were grown under the old static knobs;
+            # materialize them while their shapes still agree
+            self._materialize_pending()
+        if touched_split and hasattr(self.tree_learner, "refresh_config"):
+            gc_changed = self.tree_learner.refresh_config(cfg)
+            if gc_changed and getattr(self.tree_learner, "_persist_carry",
+                                      None) is not None:
+                # static grower knobs re-key the compiled persist program;
+                # sync the payload-ordered scores back to the row-ordered
+                # buffer and re-enter the persist path fresh next batch
+                self._sync_persist_scores()
+                self.tree_learner._persist_carry = None
+        if touched_bag:
+            self._refresh_bagging_config()
+
+    def _refresh_bagging_config(self) -> None:
+        """GBDT::ResetBaggingConfig (gbdt.cpp:762-800): recompute the bag
+        plan from the updated config and force a redraw next iteration."""
+        cfg = self.config
+        n = self.num_data
+        self._bagging_rng = np.random.default_rng(cfg.bagging_seed)
+        self.balanced_bagging = False
+        self.bag_data_cnt = n
+        bag_on = False
+        if cfg.bagging_fraction < 1.0 and cfg.bagging_freq > 0:
+            self.bag_data_cnt = max(1, int(cfg.bagging_fraction * n))
+            bag_on = True
+        if (cfg.pos_bagging_fraction < 1.0
+                or cfg.neg_bagging_fraction < 1.0):
+            if cfg.bagging_freq <= 0:
+                Log.warning("pos/neg bagging needs bagging_freq > 0")
+            else:
+                self.balanced_bagging = True
+                self.bag_data_cnt = 0
+                bag_on = True
+        if bag_on:
+            self.need_re_bagging = True
+        else:
+            # bagging turned off: all rows back in the bag immediately
+            self.need_re_bagging = False
+            self._bag_mask_dev = jnp.ones(n, dtype=bool)
+            self._bag_weight_dev = None
 
     # ------------------------------------------------------------------
     def _fast_path_ok(self) -> bool:

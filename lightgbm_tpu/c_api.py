@@ -483,22 +483,15 @@ def LGBM_BoosterResetTrainingData(handle, train_data) -> int:
 
 
 def LGBM_BoosterResetParameter(handle, parameters) -> int:
-    """GBDT::ResetConfig (gbdt.cpp:704): learning-rate/bagging-class
-    updates take effect immediately; structural knobs (num_leaves,
-    max_bin, ...) are compiled into the device program and need a new
-    booster."""
+    """GBDT::ResetConfig (gbdt.cpp:704): training-control updates
+    (learning rate, regularization, sampling, bagging, tree shape) take
+    effect at the next iteration — static grower knobs recompile the
+    device program; structurally-fixed keys (objective, max_bin, ...)
+    warn and are skipped."""
     cb = _get(handle)
     params = _params_dict(parameters)
     cb.booster.params.update(params)
-    new_cfg = params_to_config(cb.booster.params)
-    inner = cb.booster._booster
-    structural = ("num_leaves", "max_bin", "max_depth", "tree_learner")
-    if any(k in params for k in structural):
-        Log.warning("LGBM_BoosterResetParameter: %s are fixed after booster "
-                    "creation on device_type=tpu"
-                    % ", ".join(k for k in structural if k in params))
-    inner.config = new_cfg
-    inner.shrinkage_rate = float(new_cfg.learning_rate)
+    cb.booster._booster.reset_config(params)
     return 0
 
 

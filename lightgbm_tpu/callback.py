@@ -103,14 +103,16 @@ class _ParamScheduler:
     """Re-applies parameters on a schedule before each iteration.
 
     Values may be lists (indexed by iteration) or callables(iteration).
-    On this backend only learning_rate can change mid-training without a
-    relearn; anything else warns.
+    Training-control parameters route through GBDT.reset_config (the
+    ResetConfig analog, gbdt.cpp:704); structurally-fixed ones (objective,
+    metric, binning) warn and are skipped.
     """
 
     def __init__(self, schedule: Dict):
         self.order = 10
         self.before_iteration = True
         self.schedule = schedule
+        self._prev = None   # last applied values (reset only on change)
 
     def _value_at(self, key, spec, env: CallbackEnv):
         step = env.iteration - env.begin_iteration
@@ -126,16 +128,16 @@ class _ParamScheduler:
                    for k, v in self.schedule.items()}
         if not updates:
             return
-        if "learning_rate" in updates:
-            inner = getattr(env.model, "_booster", None)
-            if inner is not None:
-                lr = float(updates["learning_rate"])
-                inner.shrinkage_rate = lr
-                inner.config.learning_rate = lr
-        rest = [k for k in updates if k != "learning_rate"]
-        if rest:
-            Log.warning("reset_parameter: only learning_rate is resettable "
-                        "on device_type=tpu (got %s)" % ", ".join(sorted(rest)))
+        # apply only on CHANGE (reference _reset_parameter_callback
+        # compares against the previous iteration's values) — re-applying
+        # an unchanged bagging config every iteration would reseed the
+        # bag RNG into drawing the identical mask each time
+        if updates == self._prev:
+            return
+        self._prev = updates
+        inner = getattr(env.model, "_booster", None)
+        if inner is not None:
+            inner.reset_config(updates)
         env.params.update(updates)
 
 

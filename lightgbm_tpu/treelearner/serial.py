@@ -47,6 +47,29 @@ def _cegb_lazy_enabled(config: Config) -> bool:
     return bool(list(config.cegb_penalty_feature_lazy))
 
 
+def _config_grow_kwargs(config: Config, num_features: int) -> dict:
+    """Static GrowConfig knobs derived purely from Config — one source of
+    truth shared by SerialTreeLearner.__init__ and refresh_config, so a
+    new config-derived knob cannot be added to one site and silently
+    missed by the other."""
+    return dict(
+        num_leaves=int(config.num_leaves),
+        max_depth=int(config.max_depth),
+        use_l1=float(config.lambda_l1) > 0.0,
+        use_mds=float(config.max_delta_step) > 0.0,
+        extra_trees=bool(config.extra_trees),
+        # by-node sample scales off the by-TREE sampled feature count
+        # (ColSampler::GetByNode, col_sampler.hpp:90-140)
+        bynode_k=(int(math.ceil(
+            float(config.feature_fraction_bynode)
+            * max(1, int(num_features
+                         * min(float(config.feature_fraction), 1.0)))))
+                  if float(config.feature_fraction_bynode) < 1.0 else 0),
+        use_cegb=_cegb_enabled(config),
+        use_cegb_lazy=_cegb_lazy_enabled(config),
+    )
+
+
 def _build_extras(config: Config, dataset) -> GrowExtras:
     import jax
     import jax.numpy as jnp
@@ -300,11 +323,9 @@ class SerialTreeLearner:
             hist_dtype = ("f32" if jax.default_backend() == "cpu"
                           else "bf16x2")
         gc_kwargs = dict(
-            num_leaves=int(config.num_leaves),
             total_bins=int(dataset.total_bins),
             num_features=int(dataset.num_features),
             use_mc=use_mc,
-            max_depth=int(config.max_depth),
             rows_per_chunk=rows_per_chunk,
             cat_width=cat_width,
             hist_impl=resolve_hist_impl(config),
@@ -312,20 +333,9 @@ class SerialTreeLearner:
             use_dp=resolve_use_dp(config),
             window_chunk=window_chunk,
             hist_dtype=hist_dtype,
-            use_l1=float(config.lambda_l1) > 0.0,
-            use_mds=float(config.max_delta_step) > 0.0,
             pack_impl=str(config.tpu_pack_impl).lower(),
-            extra_trees=bool(config.extra_trees),
-            # by-node sample scales off the by-TREE sampled feature count
-            # (ColSampler::GetByNode, col_sampler.hpp:90-140)
-            bynode_k=(int(math.ceil(
-                float(config.feature_fraction_bynode)
-                * max(1, int(dataset.num_features
-                             * min(float(config.feature_fraction), 1.0)))))
-                      if float(config.feature_fraction_bynode) < 1.0 else 0),
-            use_cegb=_cegb_enabled(config),
-            use_cegb_lazy=_cegb_lazy_enabled(config),
             packed_4bit=bool(getattr(dataset, "device_packed", False)),
+            **_config_grow_kwargs(config, dataset.num_features),
         )
         forced_list = _parse_forced_splits(config, dataset)
         if forced_list:
@@ -355,6 +365,26 @@ class SerialTreeLearner:
                                 and not self.grow_config.use_cegb_lazy)
         self.gw_global = build_gw_global(dataset)
         self._axis_name = None   # set by parallel learners
+
+    def refresh_config(self, config: Config) -> bool:
+        """SerialTreeLearner::ResetConfig
+        (src/treelearner/serial_tree_learner.cpp:124-160): re-derive the
+        split params and the static grower knobs from an updated Config.
+        Gain/regularization params flow as traced arguments, so most
+        changes take effect without recompiling; flipping a static flag
+        (use_l1, num_leaves, ...) re-keys the jit caches and compiles the
+        new program on next use. Returns True when the static GrowConfig
+        changed (callers must then drop any persistent-payload carry)."""
+        self.config = config
+        self.params = SplitParams.from_config(config)
+        self.col_sampler.fraction = float(config.feature_fraction)
+        kwargs = self.grow_config._asdict()
+        kwargs.update(_config_grow_kwargs(config, self.dataset.num_features))
+        kwargs["scan_impl"] = resolve_scan_impl(config, kwargs)
+        new_gc = GrowConfig(**kwargs)
+        changed = new_gc != self.grow_config
+        self.grow_config = new_gc
+        return changed
 
     def train_arrays(self, grad: jnp.ndarray, hess: jnp.ndarray,
                      bag_mask: jnp.ndarray):

@@ -238,3 +238,98 @@ def test_bagging_not_silently_dropped():
         assert t.internal_count[0] < 0.7 * X.shape[0]
     for t in t_full[1:]:
         assert t.internal_count[0] == X.shape[0]
+
+
+# -- reset_parameter / ResetConfig (gbdt.cpp:704) -------------------------
+
+def test_reset_parameter_learning_rate_schedule():
+    X, y = _data()
+    ds = lgb.Dataset(X, y)
+    base = {"objective": "regression", "num_leaves": 15, "verbosity": -1,
+            "learning_rate": 0.3}
+    # decaying schedule vs constant: both must train, schedules differ
+    b0 = lgb.train(dict(base), ds, 10, verbose_eval=False)
+    b1 = lgb.train(dict(base), lgb.Dataset(X, y), 10, verbose_eval=False,
+                   callbacks=[lgb.reset_parameter(
+                       learning_rate=[0.3 * (0.9 ** i) for i in range(10)])])
+    assert np.abs(b0.predict(X) - b1.predict(X)).max() > 1e-8
+
+
+def test_reset_parameter_num_leaves_schedule():
+    # static grower knob: later trees must respect the smaller cap
+    X, y = _data()
+    b = lgb.train({"objective": "regression", "num_leaves": 31,
+                   "verbosity": -1}, lgb.Dataset(X, y), 6,
+                  verbose_eval=False,
+                  callbacks=[lgb.reset_parameter(
+                      num_leaves=[31, 31, 31, 4, 4, 4])])
+    trees = _trees_of(b)
+    assert max(t.num_leaves for t in trees[:3]) > 4
+    assert all(t.num_leaves <= 4 for t in trees[3:])
+
+
+def test_reset_parameter_bagging_schedule():
+    # bagging switched ON mid-training: later trees see fewer in-bag rows
+    X, y = _data(n=2000)
+    b = lgb.train({"objective": "regression", "num_leaves": 15,
+                   "verbosity": -1, "bagging_seed": 7},
+                  lgb.Dataset(X, y), 6, verbose_eval=False,
+                  callbacks=[lgb.reset_parameter(
+                      bagging_fraction=[1.0, 1.0, 1.0, 0.5, 0.5, 0.5],
+                      bagging_freq=[0, 0, 0, 1, 1, 1])])
+    trees = _trees_of(b)
+    counts = [int(t.leaf_count[:t.num_leaves].sum()) for t in trees]
+    assert counts[0] == 2000 and counts[1] == 2000 and counts[2] == 2000
+    assert all(800 < c < 1200 for c in counts[3:])
+
+
+def test_reset_parameter_bagging_masks_differ_across_iterations():
+    # a CONSTANT bagging schedule must not reseed the bag RNG every
+    # iteration (that would redraw the identical mask each time)
+    X, y = _data(n=2000)
+    masks = []
+
+    class _Spy:
+        order = 99
+        before_iteration = False
+
+        def __call__(self, env):
+            masks.append(np.asarray(env.model._booster._bag_mask_dev))
+
+    lgb.train({"objective": "regression", "num_leaves": 15,
+               "verbosity": -1}, lgb.Dataset(X, y), 4, verbose_eval=False,
+              callbacks=[lgb.reset_parameter(bagging_fraction=[0.5] * 4,
+                                             bagging_freq=[1] * 4),
+                         _Spy()])
+    assert len(masks) == 4
+    assert any(not np.array_equal(masks[0], m) for m in masks[1:])
+
+
+def test_reset_parameter_constant_schedule_is_noop():
+    # scheduling the param at its constant value must not change the model
+    X, y = _data()
+    base = {"objective": "regression", "num_leaves": 15, "verbosity": -1,
+            "lambda_l2": 0.5}
+    b0 = lgb.train(dict(base), lgb.Dataset(X, y), 8, verbose_eval=False)
+    b1 = lgb.train(dict(base), lgb.Dataset(X, y), 8, verbose_eval=False,
+                   callbacks=[lgb.reset_parameter(lambda_l2=[0.5] * 8)])
+    np.testing.assert_allclose(b0.predict(X), b1.predict(X), atol=1e-12)
+
+
+def test_reset_parameter_fixed_key_warns_not_crashes():
+    X, y = _data()
+    b = lgb.train({"objective": "regression", "num_leaves": 15,
+                   "verbosity": -1}, lgb.Dataset(X, y), 3,
+                  verbose_eval=False,
+                  callbacks=[lgb.reset_parameter(max_bin=[64, 64, 64])])
+    assert len(_trees_of(b)) == 3   # trained through, key ignored loudly
+
+
+def test_booster_reset_parameter_api():
+    X, y = _data()
+    b = lgb.train({"objective": "regression", "num_leaves": 15,
+                   "verbosity": -1}, lgb.Dataset(X, y), 3,
+                  verbose_eval=False)
+    b._booster  # Booster facade wraps the inner GBDT
+    b.reset_parameter({"learning_rate": 0.01})
+    assert b._booster.shrinkage_rate == 0.01
