@@ -247,6 +247,12 @@ class GBDT:
             Log.warning("reset_config: parameter(s) %s cannot change "
                         "during training; ignored"
                         % ", ".join(sorted(rejected)))
+        if getattr(self, "train_data", None) is None:
+            # model loaded from string/file: no learner or bagging state
+            # to refresh — config + shrinkage updates above are all that
+            # can apply (matches LGBM_BoosterResetParameter on a
+            # prediction-only booster)
+            return
         if touched_split:
             # pending async trees were grown under the old static knobs;
             # materialize them while their shapes still agree

@@ -128,17 +128,21 @@ class _ParamScheduler:
                    for k, v in self.schedule.items()}
         if not updates:
             return
-        # apply only on CHANGE (reference _reset_parameter_callback
-        # compares against the previous iteration's values) — re-applying
-        # an unchanged bagging config every iteration would reseed the
-        # bag RNG into drawing the identical mask each time
-        if updates == self._prev:
-            return
+        # apply only the keys whose value CHANGED since the previous
+        # iteration (reference _reset_parameter_callback compares per
+        # entry) — re-applying an unchanged bagging config would reseed
+        # the bag RNG into drawing the identical mask each time, even
+        # when some OTHER key (a learning-rate decay) changes every step
+        prev = self._prev or {}
+        changed = {k: v for k, v in updates.items()
+                   if k not in prev or prev[k] != v}
         self._prev = updates
+        if not changed:
+            return
         inner = getattr(env.model, "_booster", None)
         if inner is not None:
-            inner.reset_config(updates)
-        env.params.update(updates)
+            inner.reset_config(changed)
+        env.params.update(changed)
 
 
 def reset_parameter(**kwargs) -> Callable:

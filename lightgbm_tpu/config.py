@@ -206,6 +206,9 @@ PARAMS: List[_P] = [
     _P("max_bin_by_feature", list, []),
     _P("predict_disable_shape_check", bool, False),
     _P("tpu_4bit_packing", bool, True),      # nibble-pack <=16-bin groups in HBM
+    _P("tpu_multival", str, "auto"),         # auto | force | off: ELL row-
+    #                                        # sparse device layout (the
+    #                                        # MultiValBin/SparseBin analog)
 ]
 
 _BY_NAME: Dict[str, _P] = {p.name: p for p in PARAMS}

@@ -180,6 +180,13 @@ class BinnedDataset:
         self.penalty: Optional[np.ndarray] = None
         self.needs_fix: Optional[np.ndarray] = None   # bundled features
         self.total_bins: int = 0
+        # multi-value (ELL row-sparse) storage, the MultiValBin/SparseBin
+        # analog — populated instead of `binned` when the dense [N, G]
+        # matrix would dwarf the per-row non-default entries
+        # (ref src/io/multi_val_sparse_bin.hpp, sparse_bin.hpp)
+        self.is_multival: bool = False
+        self.ell_grp: Optional[np.ndarray] = None     # [N, K] group ids
+        self.ell_bin: Optional[np.ndarray] = None     # [N, K] local bins
 
     # ------------------------------------------------------------------
     @classmethod
@@ -357,14 +364,54 @@ class BinnedDataset:
 
         with timer.scope("io::PushSparse(binning)"):
             G = len(ds.groups)
-            binned = np.zeros((n, G), dtype=ds._bin_dtype())
             chunk = max(1024, int(2 ** 25 / max(nf, 1)))
-            for a in range(0, n, chunk):
-                b = min(a + chunk, n)
-                Xc = np.asarray(X[a:b].todense(), dtype=np.float64)
-                ds._bin_rows(Xc, binned[a:b])
-            ds.binned = binned
+            if ds._choose_multival(config, X):
+                # stream into the multi-value layout: host memory is
+                # bounded by one dense chunk + the non-default entries
+                # (the dense [n, G] matrix is never materialized)
+                gd = ds.group_default_bins()
+                buf = np.zeros((chunk, G), dtype=ds._bin_dtype())
+                coo = []
+                for a in range(0, n, chunk):
+                    b = min(a + chunk, n)
+                    Xc = np.asarray(X[a:b].todense(), dtype=np.float64)
+                    ds._bin_rows(Xc, buf[:b - a])
+                    coo.append(ds._dense_chunk_to_coo(buf[:b - a], a, gd))
+                ds._assemble_ell(
+                    coo, n,
+                    force=str(getattr(config, "tpu_multival",
+                                      "auto")).lower() == "force")
+            else:
+                binned = np.zeros((n, G), dtype=ds._bin_dtype())
+                for a in range(0, n, chunk):
+                    b = min(a + chunk, n)
+                    Xc = np.asarray(X[a:b].todense(), dtype=np.float64)
+                    ds._bin_rows(Xc, binned[a:b])
+                ds.binned = binned
         return ds
+
+    def _choose_multival(self, config: Config, X=None) -> bool:
+        """Pick the multi-value (ELL) device layout when the dense [N, G]
+        matrix would dwarf the per-row non-default entries — the
+        reference's MultiValBin decision re-derived for static-shape HBM
+        storage (Dataset::TestMultiThreadingMethod / sparse_threshold,
+        src/io/dataset.cpp:350-430)."""
+        mode = str(getattr(config, "tpu_multival", "auto")).lower()
+        if mode in ("off", "false", "0"):
+            return False
+        if mode == "force":
+            return True
+        if X is None:
+            return False
+        G = len(self.groups)
+        if G < 64:
+            return False
+        e_row = X.nnz / max(1, X.shape[0])
+        dense_bytes = G * np.dtype(self._bin_dtype()).itemsize
+        grp_dt, bin_dt = self._ell_dtypes()
+        ell_bytes = ((e_row + 1.0)
+                     * (np.dtype(grp_dt).itemsize + np.dtype(bin_dt).itemsize))
+        return dense_bytes > 4.0 * ell_bytes
 
     @classmethod
     def from_matrix_with_mappers(cls, X, config: Config,
@@ -708,6 +755,7 @@ class BinnedDataset:
         # compiled programs are shaped by the old layout
         if hasattr(self, "_scan_cache"):
             self._scan_cache = {}
+        self._group_default_cache = None
 
     # ------------------------------------------------------------------
     @property
@@ -735,7 +783,6 @@ class BinnedDataset:
         meta = self.metadata
         arrays = {
             "magic": np.frombuffer(self.BINARY_MAGIC.encode(), np.uint8),
-            "binned": self.binned,
             "group_offset": self.group_offset,
             "group_of": self.group_of,
             "bin_start": self.bin_start,
@@ -757,6 +804,11 @@ class BinnedDataset:
                 "mappers": [m.to_state() for m in self.bin_mappers],
             }).encode(), np.uint8),
         }
+        if self.is_multival:
+            arrays["ell_grp"] = self.ell_grp
+            arrays["ell_bin"] = self.ell_bin
+        else:
+            arrays["binned"] = self.binned
         if meta is not None:
             for k in ("label", "weight", "query_boundaries", "init_score"):
                 v = getattr(meta, k)
@@ -807,7 +859,12 @@ class BinnedDataset:
             if magic != cls.BINARY_MAGIC:
                 Log.fatal("%s is not a lightgbm_tpu binary dataset" % path)
             struct = json.loads(bytes(z["structure"]).decode())
-            ds.binned = z["binned"]
+            if "ell_grp" in z.files:
+                ds.ell_grp = z["ell_grp"]
+                ds.ell_bin = z["ell_bin"]
+                ds.is_multival = True
+            else:
+                ds.binned = z["binned"]
             ds.group_offset = z["group_offset"]
             ds.group_of = z["group_of"]
             ds.bin_start = z["bin_start"]
@@ -828,7 +885,8 @@ class BinnedDataset:
         ds.feature_names = list(struct["feature_names"])
         ds.bin_mappers = [BinMapper.from_state(d) for d in struct["mappers"]]
         ds.inner_of = {f: i for i, f in enumerate(ds.used_features)}
-        ds.num_data = int(ds.binned.shape[0])
+        ds.num_data = int((ds.ell_grp if ds.is_multival
+                           else ds.binned).shape[0])
         ds.metadata = Metadata(ds.num_data)
         for k, v in meta_arrays.items():
             setattr(ds.metadata, k, v)
@@ -838,10 +896,19 @@ class BinnedDataset:
 
     # ------------------------------------------------------------------
     def fix_info(self):
-        """FixInfo arrays for bundled features (ops.split.fix_histogram)."""
+        """FixInfo arrays for features whose histogram omits a bin and
+        needs reconstruction from leaf totals (ops.split.fix_histogram).
+        Dense layout: only EFB-bundled features (their most_freq rows sit
+        in the group sentinel). Multi-value layout: EVERY feature — each
+        group's default bin is not materialized (the reference's
+        multi-val histograms have the same contract,
+        src/io/dataset.cpp:1198 + FixHistogram:1410)."""
         import jax.numpy as jnp
         from ..ops.grow import FixInfo
-        idx = np.nonzero(self.needs_fix)[0]
+        if self.is_multival:
+            idx = np.arange(self.num_features)
+        else:
+            idx = np.nonzero(self.needs_fix)[0]
         return FixInfo(
             mf_global=jnp.asarray((self.bin_start[idx]
                                    + self.most_freq_bin[idx]).astype(np.int32)),
@@ -849,13 +916,120 @@ class BinnedDataset:
             end=jnp.asarray(self.bin_end[idx]),
         )
 
+    # -- multi-value (ELL row-sparse) layout ---------------------------
+    def group_default_bins(self) -> np.ndarray:
+        """[G] bin omitted from multi-value storage per group: the single
+        feature's most_freq bin, or the 0 sentinel for EFB bundles.
+        Cached — Tree.predict_leaf_binned asks once per leaf level."""
+        cached = getattr(self, "_group_default_cache", None)
+        if cached is not None and len(cached) == len(self.groups):
+            return cached
+        G = len(self.groups)
+        out = np.zeros(G, dtype=np.int32)
+        for g, feats in enumerate(self.groups):
+            if len(feats) == 1:
+                out[g] = int(self.most_freq_bin[feats[0]])
+        self._group_default_cache = out
+        return out
+
+    def _ell_dtypes(self):
+        G = len(self.groups)
+        widths = np.diff(np.append(self.group_offset, self.total_bins))
+        grp_dt = np.uint16 if G < 0xFFFF else np.int32
+        bin_dt = (np.uint8 if (len(widths) == 0 or widths.max() <= 0xFF)
+                  else (np.uint16 if widths.max() <= 0xFFFF else np.int32))
+        return grp_dt, bin_dt
+
+    def _assemble_ell(self, coo_chunks, n: int, force: bool = False) -> bool:
+        """COO chunk list [(row_global, grp, bin)] -> padded [N, K] ELL
+        arrays (pad entry: grp = G); chunks must cover disjoint contiguous
+        row ranges (both callers chunk by rows). Sets is_multival and
+        returns True — unless the padded width K (set by the DENSEST row,
+        not the mean the chooser estimated from) would make ELL as large
+        as the dense matrix, in which case it densifies instead and
+        returns False. `force` (tpu_multival=force) skips that guard."""
+        G = len(self.groups)
+        grp_dt, bin_dt = self._ell_dtypes()
+        counts = np.zeros(n, dtype=np.int64)
+        for rows, _, _ in coo_chunks:
+            np.add.at(counts, rows, 1)
+        K = max(1, int(counts.max()) if n else 1)
+        entry_bytes = np.dtype(grp_dt).itemsize + np.dtype(bin_dt).itemsize
+        if (not force
+                and K * entry_bytes
+                >= G * np.dtype(self._bin_dtype()).itemsize):
+            Log.warning("multi-value layout abandoned: one row holds %d "
+                        "non-default entries, padding every row that wide "
+                        "would exceed the dense [N, %d] matrix" % (K, G))
+            self._densify_from_coo(coo_chunks, n)
+            return False
+        self.ell_grp = np.full((n, K), G, dtype=grp_dt)
+        self.ell_bin = np.zeros((n, K), dtype=bin_dt)
+        for rows, grp, bn in coo_chunks:
+            # entries arrive row-sorted; each entry's slot is its
+            # occurrence index within its row
+            first = np.ones(len(rows), dtype=bool)
+            first[1:] = rows[1:] != rows[:-1]
+            pos = np.arange(len(rows)) - np.maximum.accumulate(
+                np.where(first, np.arange(len(rows)), 0))
+            self.ell_grp[rows, pos] = grp.astype(grp_dt)
+            self.ell_bin[rows, pos] = bn.astype(bin_dt)
+        self.is_multival = True
+        self.binned = None
+        return True
+
+    def _densify_from_coo(self, coo_chunks, n: int) -> None:
+        """Rebuild the dense [N, G] matrix from non-default COO entries
+        plus per-group defaults (the _assemble_ell fallback)."""
+        gd = self.group_default_bins()
+        binned = np.tile(gd.astype(self._bin_dtype()), (n, 1))
+        for rows, grp, bn in coo_chunks:
+            binned[rows, grp] = bn.astype(self._bin_dtype())
+        self.binned = binned
+        self.is_multival = False
+
+    def _dense_chunk_to_coo(self, binned_chunk: np.ndarray, row0: int,
+                            group_default: np.ndarray):
+        """Non-default entries of one dense binned chunk as row-sorted
+        (global row, group, bin) COO arrays."""
+        rr, gg = np.nonzero(binned_chunk != group_default[None, :])
+        return (rr.astype(np.int64) + row0, gg.astype(np.int32),
+                binned_chunk[rr, gg].astype(np.int32))
+
+    def to_multival(self) -> None:
+        """Convert a dense-binned dataset to the multi-value layout in
+        place (tpu_multival=force; tests and post-hoc conversion)."""
+        if self.is_multival or self.binned is None:
+            return
+        gd = self.group_default_bins()
+        chunks = []
+        step = max(1, int(2 ** 24 / max(1, len(self.groups))))
+        for a in range(0, self.num_data, step):
+            chunks.append(self._dense_chunk_to_coo(
+                self.binned[a:a + step], a, gd))
+        self._assemble_ell(chunks, self.num_data, force=True)
+
+    def host_group_bins(self, rows: np.ndarray, g: np.ndarray) -> np.ndarray:
+        """Per-row group-local bin for (row, group) pairs from either
+        layout — the host-side analog of ops.grow._multival_col, used by
+        Tree.predict_leaf_binned."""
+        if not self.is_multival:
+            return self.binned[rows, g].astype(np.int64)
+        eg = self.ell_grp[rows].astype(np.int64)         # [R, K]
+        eb = self.ell_bin[rows].astype(np.int64)
+        match = eg == np.asarray(g)[:, None]
+        found = match.any(axis=1)
+        raw = np.where(match, eb, 0).sum(axis=1)
+        gd = self.group_default_bins()
+        return np.where(found, raw, gd[np.asarray(g)])
+
     def device_pack_plan(self, config: Config):
         """Nibble-packing plan for HBM storage (the Dense4bitsBin analog,
         src/io/dense_nbits_bin.hpp): pairs of logical groups whose width
         fits 4 bits share one storage byte. Returns None when packing is
         off or fewer than 2 groups qualify; else (storage_of [G_l],
         shift [G_l], n_storage, unpack_mask [G_l])."""
-        if not bool(config.tpu_4bit_packing):
+        if not bool(config.tpu_4bit_packing) or self.binned is None:
             return None
         G = len(self.groups)
         widths = np.diff(np.append(self.group_offset, self.total_bins))
@@ -898,6 +1072,34 @@ class BinnedDataset:
             owner[self.bin_start[i]:self.bin_end[i]] = i
         feat_id = np.where(owner < 0, 0, owner).astype(np.int32)
 
+        if (not self.is_multival and self.binned is not None
+                and str(getattr(config, "tpu_multival", "auto")).lower()
+                == "force"):
+            self.to_multival()
+        if self.is_multival:
+            self.device_packed = False
+            layout = DataLayout(
+                # placeholder dense matrix: the multival grower never
+                # reads it, but downstream sharding specs expect 2D
+                bins=jnp.zeros((self.num_data, 1), jnp.uint8),
+                group_offset=jnp.asarray(self.group_offset),
+                group_of=jnp.asarray(self.group_of),
+                most_freq_bin=jnp.asarray(self.most_freq_bin),
+                ell_grp=jnp.asarray(self.ell_grp),
+                ell_bin=jnp.asarray(self.ell_bin),
+                group_default=jnp.asarray(self.group_default_bins()),
+            )
+            meta = FeatureMeta(
+                feat_id=jnp.asarray(feat_id),
+                bin_start=jnp.asarray(self.bin_start),
+                bin_end=jnp.asarray(self.bin_end),
+                missing_type=jnp.asarray(self.missing_type_arr),
+                default_bin=jnp.asarray(self.default_bin),
+                monotone=jnp.asarray(self.monotone),
+                is_categorical=jnp.asarray(self.is_categorical),
+                penalty=jnp.asarray(self.penalty),
+            )
+            return layout, meta
         plan = self.device_pack_plan(config)
         self.device_packed = plan is not None
         if plan is not None:

@@ -426,14 +426,15 @@ class Tree:
         ds = dataset
         node = np.zeros(n, dtype=np.int32)
         active = np.ones(n, dtype=bool)
-        binned = ds.binned
+        rows_all = np.arange(n)
         for _ in range(self.num_leaves):
             if not active.any():
                 break
             nd = node[active]
             f = self.split_feature_inner[nd]
             g = ds.group_of[f]
-            col = binned[active, g].astype(np.int64) + ds.group_offset[g]
+            col = (ds.host_group_bins(rows_all[active], g)
+                   + ds.group_offset[g])
             in_range = (col >= ds.bin_start[f]) & (col < ds.bin_end[f])
             local_bin = np.where(in_range, col - ds.bin_start[f],
                                  ds.most_freq_bin[f])

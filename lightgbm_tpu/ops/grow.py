@@ -89,6 +89,7 @@ class GrowConfig(NamedTuple):
     #                            # (fast path only; resolve_scan_impl gates)
     packed_4bit: bool = False    # layout.bins nibble-packs <=16-bin groups
     n_forced: int = 0            # forcedsplits_filename node count
+    multival: bool = False       # layout is ELL row-sparse (masked grower)
 
 
 class GrowExtras(NamedTuple):
@@ -141,6 +142,17 @@ class DataLayout(NamedTuple):
     unpack_col: jnp.ndarray = None    # [G_logical] i32 storage column
     unpack_shift: jnp.ndarray = None  # [G_logical] i32 shift (0 or 4)
     unpack_mask: jnp.ndarray = None   # [G_logical] i32 (15 packed, else wide)
+    # multi-value (ELL) row-sparse storage — the MultiValBin/SparseBin
+    # analog (ref src/io/multi_val_sparse_bin.hpp, sparse_bin.hpp): when
+    # gc.multival is set, `bins` is an empty placeholder and each row
+    # stores up to K (group, local bin) pairs for the groups whose bin
+    # differs from that group's default; every feature's default-bin mass
+    # is reconstructed from leaf totals by ops.split.fix_histogram.
+    ell_grp: jnp.ndarray = None       # [N, K] i32 logical group (G = pad)
+    ell_bin: jnp.ndarray = None       # [N, K] i32 group-local bin
+    group_default: jnp.ndarray = None  # [G] i32 omitted bin per group (the
+    #                                  # single feature's most_freq, or the
+    #                                  # 0 sentinel for EFB bundles)
 
 
 def _logical_bins(bw, layout: DataLayout, packed: bool):
@@ -200,16 +212,39 @@ class _LoopState(NamedTuple):
 
 
 def _hist_masked(layout: DataLayout, grad, hess, mask, total_bins,
-                 rows_per_chunk, packed: bool, axis_name=None):
+                 rows_per_chunk, packed: bool, axis_name=None,
+                 multival: bool = False):
     from .histogram import build_histogram
     m = mask.astype(grad.dtype)
-    idx = (_logical_bins(layout.bins, layout, packed)
-           + layout.group_offset[None, :])
-    h = build_histogram(idx, grad * m, hess * m, total_bins=total_bins,
-                        rows_per_chunk=rows_per_chunk)
+    if multival:
+        # row-sparse scatter (ConstructHistogramsMultiVal analog,
+        # src/io/dataset.cpp:1198): K entries per row, padding entries
+        # land in a scratch bin that is sliced away
+        g = layout.ell_grp
+        pad = g >= layout.group_offset.shape[0]
+        gsafe = jnp.where(pad, 0, g)
+        idx = jnp.where(pad, total_bins,
+                        layout.group_offset[gsafe] + layout.ell_bin)
+        h = build_histogram(idx, grad * m, hess * m,
+                            total_bins=total_bins + 1,
+                            rows_per_chunk=rows_per_chunk)[:total_bins]
+    else:
+        idx = (_logical_bins(layout.bins, layout, packed)
+               + layout.group_offset[None, :])
+        h = build_histogram(idx, grad * m, hess * m, total_bins=total_bins,
+                            rows_per_chunk=rows_per_chunk)
     if axis_name is not None:
         h = jax.lax.psum(h, axis_name)
     return h
+
+
+def _multival_col(layout: DataLayout, g):
+    """One logical group's [rows] local-bin column from the ELL storage:
+    rows without an entry for group g sit at the group's default bin."""
+    match = layout.ell_grp == g
+    found = jnp.any(match, axis=1)
+    raw = jnp.sum(jnp.where(match, layout.ell_bin, 0), axis=1)
+    return jnp.where(found, raw, layout.group_default[g]).astype(I32)
 
 
 def _root_candidate_dummy(cat_width: int, ft) -> SplitCandidate:
@@ -839,7 +874,7 @@ def grow_tree(layout: DataLayout, grad: jnp.ndarray, hess: jnp.ndarray,
     if forced is None:
         forced = empty_forced()
     ft = acc_dtype(gc.use_dp)
-    n = layout.bins.shape[0]
+    n = (layout.ell_grp if gc.multival else layout.bins).shape[0]
     L = gc.num_leaves
     TB = gc.total_bins
     F = gc.num_features
@@ -871,7 +906,7 @@ def grow_tree(layout: DataLayout, grad: jnp.ndarray, hess: jnp.ndarray,
     # ---- root ----------------------------------------------------------
     root_hist = hist_psum(_hist_masked(
         layout, grad, hess, bag_mask, TB, gc.rows_per_chunk,
-        gc.packed_4bit, None))
+        gc.packed_4bit, None, multival=gc.multival))
     sum_grad = psum(jnp.sum(grad, dtype=ft))
     sum_hess = psum(jnp.sum(hess, dtype=ft))
     root_count = psum(jnp.sum(bag_mask, dtype=I32))
@@ -952,8 +987,11 @@ def grow_tree(layout: DataLayout, grad: jnp.ndarray, hess: jnp.ndarray,
         f = jnp.maximum(cand.feature, 0)
         g = layout.group_of[f]
         # per-row local bin of feature f (EFB fallback to most_freq)
-        col = (_logical_col(layout.bins, g, layout, gc.packed_4bit)
-               + layout.group_offset[g])
+        if gc.multival:
+            col = _multival_col(layout, g) + layout.group_offset[g]
+        else:
+            col = (_logical_col(layout.bins, g, layout, gc.packed_4bit)
+                   + layout.group_offset[g])
         in_range = (col >= meta.bin_start[f]) & (col < meta.bin_end[f])
         local_bin = col - meta.bin_start[f]
         go_left = _go_left_decision(
@@ -972,7 +1010,7 @@ def grow_tree(layout: DataLayout, grad: jnp.ndarray, hess: jnp.ndarray,
         smaller_mask = in_leaf & (go_left == smaller_is_left)
         hist_smaller = hist_psum(_hist_masked(
             layout, grad, hess, smaller_mask, TB, gc.rows_per_chunk,
-            gc.packed_4bit, None))
+            gc.packed_4bit, None, multival=gc.multival))
         sm_sum_grad = jnp.where(smaller_is_left, cand.left_sum_grad,
                                 cand.right_sum_grad)
         sm_sum_hess = jnp.where(smaller_is_left, cand.left_sum_hess,

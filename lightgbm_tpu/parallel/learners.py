@@ -332,6 +332,11 @@ def create_parallel_learner(learner_type: str, config, dataset):
         # the [N, F] acquisition bitset lives in the masked grower's
         # full-N row space; sharded rows would need a gathered bitset
         Log.fatal("cegb_penalty_feature_lazy requires tree_learner=serial")
+    if (getattr(dataset, "is_multival", False)
+            or str(getattr(config, "tpu_multival", "auto")).lower()
+            == "force"):
+        Log.fatal("the multi-value (ELL) layout is not sharded yet; use "
+                  "tree_learner=serial or tpu_multival=off")
     if learner_type == "data":
         return DataParallelTreeLearner(config, dataset)
     if learner_type == "voting":

@@ -172,6 +172,10 @@ def train_multihost(config: Config, X_local: np.ndarray,
     if list(config.cegb_penalty_feature_lazy):
         Log.fatal("cegb_penalty_feature_lazy is not supported with "
                   "num_machines > 1 (per-row bitset needs unsharded rows)")
+    if str(config.tpu_multival).lower() == "force" \
+            or getattr(ds, "is_multival", False):
+        Log.fatal("the multi-value (ELL) layout is not supported with "
+                  "num_machines > 1 yet; use tpu_multival=off")
 
     # ---- global mesh + row-sharded device state ----------------------
     from ..treelearner.serial import SerialTreeLearner

@@ -144,7 +144,13 @@ def resolve_scan_impl(config: Config, gc_kwargs: dict) -> str:
     import jax
     from ..ops.pallas_scan import HAS_PALLAS
     backend = jax.default_backend()
-    ok = (HAS_PALLAS and backend in ("tpu", "axon")
+    # the fused kernel stages ~12 [Fp, Wp] f32 blocks in VMEM at once;
+    # wide-feature datasets (Fp*Wp beyond ~256k lanes ~= 12MB) overflow
+    # the 16MB scoped-vmem budget and must use the XLA scan
+    Fp = -(-max(gc_kwargs["num_features"], 8) // 8) * 8
+    Wp = -(-max(gc_kwargs["scan_width"], 128) // 128) * 128
+    vmem_ok = Fp * Wp <= 256 * 1024
+    ok = (HAS_PALLAS and backend in ("tpu", "axon") and vmem_ok
           and not gc_kwargs["use_dp"] and not gc_kwargs["use_mc"]
           and not gc_kwargs["use_l1"] and not gc_kwargs["use_mds"]
           and not gc_kwargs["extra_trees"] and gc_kwargs["bynode_k"] == 0
@@ -335,6 +341,7 @@ class SerialTreeLearner:
             hist_dtype=hist_dtype,
             pack_impl=str(config.tpu_pack_impl).lower(),
             packed_4bit=bool(getattr(dataset, "device_packed", False)),
+            multival=bool(getattr(dataset, "is_multival", False)),
             **_config_grow_kwargs(config, dataset.num_features),
         )
         forced_list = _parse_forced_splits(config, dataset)
@@ -361,8 +368,12 @@ class SerialTreeLearner:
         if self.grow_config.use_cegb_lazy and dataset.num_data >= (1 << 24):
             Log.fatal("cegb_penalty_feature_lazy supports up to 2^24 rows "
                       "(per-row acquisition counts are f32-exact)")
+        # the payload-sorted grower gathers dense [N, G] windows; the
+        # multi-value layout stays on the masked grower (row-sparse
+        # scatter histograms, the MultiValBin serial path)
         self.use_partitioned = (dataset.num_data >= PARTITION_MIN_ROWS
-                                and not self.grow_config.use_cegb_lazy)
+                                and not self.grow_config.use_cegb_lazy
+                                and not self.grow_config.multival)
         self.gw_global = build_gw_global(dataset)
         self._axis_name = None   # set by parallel learners
 
@@ -479,6 +490,7 @@ class SerialTreeLearner:
         widths = (ds.bin_end - ds.bin_start) if ds.num_features else None
         return (gc.n_forced == 0
                 and not gc.use_cegb_lazy
+                and not gc.multival
                 and not gc.packed_4bit
                 and self.cat_layout.cat_feature.shape[0] == 0
                 and ds.num_features > 0

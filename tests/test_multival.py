@@ -1,0 +1,178 @@
+"""Multi-value (ELL row-sparse) device layout.
+
+The TPU analog of the reference's MultiValBin / SparseBin
+(src/io/multi_val_sparse_bin.hpp, sparse_bin.hpp): per-row (group, bin)
+pairs for non-default bins, histograms as row-sparse scatter with every
+feature's default-bin mass reconstructed by FixHistogram. Chosen
+automatically for wide-sparse CSR ingest; forceable for testing via
+tpu_multival=force.
+
+Equality with the dense layout is to summation-order noise (~1e-6): the
+ELL histogram accumulates in a different order and rebuilds most-freq
+bins from leaf totals, exactly as the reference's multi-val path does.
+"""
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.data.dataset import BinnedDataset
+from lightgbm_tpu.utils.log import LightGBMError
+
+
+def _dense_data(n=3000, f=10, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    X[rng.random((n, f)) < 0.3] = 0.0
+    X[rng.random((n, f)) < 0.05] = np.nan
+    y = (X[:, 0] + 0.5 * np.nan_to_num(X[:, 1]) > 0.3).astype(float)
+    return X, y
+
+
+def _wide_sparse(n=4000, f=300, seed=1):
+    """One-hot-ish wide matrix: ~8 active features per row."""
+    rng = np.random.default_rng(seed)
+    rows = np.repeat(np.arange(n), 8)
+    cols = rng.integers(0, f, size=8 * n)
+    vals = rng.normal(loc=1.0, size=8 * n)
+    X = sp.csr_matrix((vals, (rows, cols)), shape=(n, f))
+    beta = rng.normal(size=f) * (rng.random(f) < 0.2)
+    y = (np.asarray(X @ beta).ravel() > 0).astype(float)
+    return X, y
+
+
+def test_forced_multival_matches_dense():
+    X, y = _dense_data()
+    base = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+            "min_data_in_leaf": 20}
+    b0 = lgb.train(dict(base), lgb.Dataset(X, y), 10, verbose_eval=False)
+    p1 = dict(base, tpu_multival="force")
+    ds1 = lgb.Dataset(X, y, params=p1)
+    b1 = lgb.train(p1, ds1, 10, verbose_eval=False)
+    assert ds1._inner.is_multival
+    assert ds1._inner.binned is None
+    np.testing.assert_allclose(b0.predict(X), b1.predict(X), atol=1e-4)
+
+
+def test_forced_multival_matches_dense_regression_bundles():
+    # EFB-bundled one-hot blocks + continuous features: sentinel groups
+    # and single-feature groups both omit their default bins
+    rng = np.random.default_rng(2)
+    n = 2500
+    onehot = np.zeros((n, 12))
+    onehot[np.arange(n), rng.integers(0, 12, n)] = 1.0
+    Xc = rng.normal(size=(n, 4))
+    X = np.column_stack([Xc, onehot])
+    y = Xc[:, 0] + onehot[:, 3] * 2.0 + 0.05 * rng.normal(size=n)
+    base = {"objective": "regression", "num_leaves": 31, "verbosity": -1}
+    b0 = lgb.train(dict(base), lgb.Dataset(X, y), 10, verbose_eval=False)
+    p1 = dict(base, tpu_multival="force")
+    b1 = lgb.train(p1, lgb.Dataset(X, y, params=p1), 10, verbose_eval=False)
+    np.testing.assert_allclose(b0.predict(X), b1.predict(X), atol=1e-4)
+
+
+def test_forced_multival_categorical():
+    rng = np.random.default_rng(3)
+    n = 2000
+    Xc = rng.normal(size=(n, 3))
+    cat = rng.integers(0, 7, size=n).astype(float)
+    X = np.column_stack([Xc, cat])
+    y = Xc[:, 0] + (cat == 3) * 1.5 + 0.05 * rng.normal(size=n)
+    base = {"objective": "regression", "num_leaves": 15, "verbosity": -1,
+            "categorical_feature": [3]}
+    b0 = lgb.train(dict(base), lgb.Dataset(
+        X, y, categorical_feature=[3]), 10, verbose_eval=False)
+    p1 = dict(base, tpu_multival="force")
+    b1 = lgb.train(p1, lgb.Dataset(X, y, categorical_feature=[3],
+                                   params=p1), 10, verbose_eval=False)
+    np.testing.assert_allclose(b0.predict(X), b1.predict(X), atol=1e-4)
+
+
+def test_sparse_auto_picks_multival_and_trains():
+    X, y = _wide_sparse()
+    ds = lgb.Dataset(X, y)
+    b = lgb.train({"objective": "binary", "num_leaves": 31,
+                   "verbosity": -1}, ds, 20, verbose_eval=False)
+    inner = ds._inner
+    assert inner.is_multival, "wide-sparse ingest should choose ELL"
+    assert inner.binned is None, "dense [N, G] must never materialize"
+    # ELL width is bounded by the true max active features per row
+    assert inner.ell_grp.shape[1] <= 16
+    pred = b.predict(np.asarray(X.todense()))
+    acc = ((pred > 0.5) == y).mean()
+    assert acc > 0.8
+
+
+def test_sparse_multival_matches_sparse_dense_layout():
+    # same CSR data, layouts forced both ways: same quality to noise
+    X, y = _wide_sparse(n=2500, f=120)
+    b0 = lgb.train({"objective": "binary", "num_leaves": 15,
+                    "verbosity": -1, "tpu_multival": "off"},
+                   lgb.Dataset(X, y, params={"tpu_multival": "off"}),
+                   10, verbose_eval=False)
+    b1 = lgb.train({"objective": "binary", "num_leaves": 15,
+                    "verbosity": -1, "tpu_multival": "force"},
+                   lgb.Dataset(X, y, params={"tpu_multival": "force"}),
+                   10, verbose_eval=False)
+    Xd = np.asarray(X.todense())
+    np.testing.assert_allclose(b0.predict(Xd), b1.predict(Xd), atol=1e-4)
+
+
+def test_multival_binary_cache_roundtrip(tmp_path):
+    X, y = _wide_sparse(n=1500, f=100)
+    params = {"tpu_multival": "force"}
+    ds = lgb.Dataset(X, y, params=params)
+    ds.construct()
+    path = str(tmp_path / "mv.bin")
+    ds._inner.save_binary(path)
+    ds2 = BinnedDataset.from_binary(path)
+    assert ds2.is_multival
+    np.testing.assert_array_equal(ds._inner.ell_grp, ds2.ell_grp)
+    np.testing.assert_array_equal(ds._inner.ell_bin, ds2.ell_bin)
+
+
+def test_multival_continued_training_binned_walk():
+    # init_model continuation exercises Tree.predict_leaf_binned over the
+    # ELL host arrays (host_group_bins)
+    X, y = _dense_data(n=1500)
+    p = {"objective": "regression", "num_leaves": 15, "verbosity": -1,
+         "tpu_multival": "force"}
+    ds = lgb.Dataset(X, y, params=p)
+    b0 = lgb.train(dict(p), ds, 5, verbose_eval=False)
+    b1 = lgb.train(dict(p), lgb.Dataset(X, y, params=p), 5,
+                   verbose_eval=False, init_model=b0)
+    r2 = 1 - np.var(y - b1.predict(X)) / np.var(y)
+    assert r2 > 0.5
+
+
+def test_multival_parallel_learner_raises():
+    X, y = _dense_data(n=1000)
+    p = {"objective": "regression", "verbosity": -1,
+         "tree_learner": "data", "tpu_multival": "force"}
+    with pytest.raises(LightGBMError):
+        lgb.train(p, lgb.Dataset(X, y, params=p), 1, verbose_eval=False)
+
+
+def test_multival_dense_row_falls_back_to_dense():
+    # mean nnz/row is low but ONE row is fully dense: padding every row
+    # to K=G would dwarf the dense matrix, so assembly must densify
+    rng = np.random.default_rng(5)
+    n, f = 3000, 150
+    rows = np.repeat(np.arange(n), 4)
+    cols = rng.integers(0, f, size=4 * n)
+    X = sp.lil_matrix((n, f))
+    X[rows, cols] = 1.0
+    X[0, :] = np.arange(1, f + 1, dtype=float)   # one dense row
+    X = X.tocsr()
+    y = (np.asarray(X[:, 0].todense()).ravel() > 0).astype(float)
+    p = {"tpu_multival": "auto", "min_data_in_leaf": 5}
+    ds = lgb.Dataset(X, y, params=p)
+    ds.construct()
+    inner = ds._inner
+    assert not inner.is_multival
+    assert inner.binned is not None
+    # and the densified matrix is identical to direct dense binning
+    ds2 = lgb.Dataset(np.asarray(X.todense()), y,
+                      params={"tpu_multival": "off", "min_data_in_leaf": 5})
+    ds2.construct()
+    np.testing.assert_array_equal(inner.binned, ds2._inner.binned)

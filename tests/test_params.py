@@ -333,3 +333,38 @@ def test_booster_reset_parameter_api():
     b._booster  # Booster facade wraps the inner GBDT
     b.reset_parameter({"learning_rate": 0.01})
     assert b._booster.shrinkage_rate == 0.01
+
+
+def test_reset_parameter_mixed_schedule_bagging_still_varies():
+    # a changing lr + CONSTANT bagging keys: the constant keys must not
+    # be re-applied (re-seeding the bag RNG) just because lr changed
+    X, y = _data(n=2000)
+    masks = []
+
+    class _Spy:
+        order = 99
+        before_iteration = False
+
+        def __call__(self, env):
+            masks.append(np.asarray(env.model._booster._bag_mask_dev))
+
+    lgb.train({"objective": "regression", "num_leaves": 15,
+               "verbosity": -1}, lgb.Dataset(X, y), 4, verbose_eval=False,
+              callbacks=[lgb.reset_parameter(
+                  learning_rate=[0.3 * 0.9 ** i for i in range(4)],
+                  bagging_fraction=[0.5] * 4, bagging_freq=[1] * 4),
+                  _Spy()])
+    assert any(not np.array_equal(masks[0], m) for m in masks[1:])
+
+
+def test_reset_parameter_on_loaded_model():
+    # prediction-only booster (no training state): config-level updates
+    # apply, nothing crashes (LGBM_BoosterResetParameter contract)
+    X, y = _data(n=500)
+    b = lgb.train({"objective": "regression", "num_leaves": 15,
+                   "verbosity": -1}, lgb.Dataset(X, y), 3,
+                  verbose_eval=False)
+    loaded = lgb.Booster(model_str=b.model_to_string())
+    loaded.reset_parameter({"learning_rate": 0.05, "bagging_fraction": 0.5})
+    assert loaded._booster.shrinkage_rate == 0.05
+    np.testing.assert_allclose(loaded.predict(X), b.predict(X), atol=1e-12)
