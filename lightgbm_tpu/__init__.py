@@ -43,11 +43,20 @@ def _host_tag() -> str:
 _cache_dir = _os.environ.get(
     "LIGHTGBM_TPU_CACHE",
     _os.path.expanduser("~/.cache/lightgbm_tpu_xla-" + _host_tag()))
-try:
-    _jax.config.update("jax_compilation_cache_dir", _cache_dir)
-    _jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-except Exception:  # pragma: no cover - older jax
-    pass
+# CPU runs skip the persistent cache entirely: XLA:CPU AOT executable
+# serialization can segfault when the runtime host's ISA differs from the
+# client build's target features, and CPU compiles are cheap. The cache
+# exists for the slow remote-TPU compiles. The EFFECTIVE platform decides:
+# test harnesses force cpu via jax.config.update before importing this
+# package while the env var still names the accelerator plugin.
+_plat = (getattr(_jax.config, "jax_platforms", None)
+         or _os.environ.get("JAX_PLATFORMS", "") or "").strip().lower()
+if not _plat.startswith("cpu"):
+    try:
+        _jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        _jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:  # pragma: no cover - older jax
+        pass
 
 from .utils.log import LightGBMError, Log  # noqa: E402
 from .config import Config  # noqa: E402

@@ -1089,17 +1089,7 @@ class BinnedDataset:
                 ell_bin=jnp.asarray(self.ell_bin),
                 group_default=jnp.asarray(self.group_default_bins()),
             )
-            meta = FeatureMeta(
-                feat_id=jnp.asarray(feat_id),
-                bin_start=jnp.asarray(self.bin_start),
-                bin_end=jnp.asarray(self.bin_end),
-                missing_type=jnp.asarray(self.missing_type_arr),
-                default_bin=jnp.asarray(self.default_bin),
-                monotone=jnp.asarray(self.monotone),
-                is_categorical=jnp.asarray(self.is_categorical),
-                penalty=jnp.asarray(self.penalty),
-            )
-            return layout, meta
+            return layout, self._feature_meta(feat_id)
         plan = self.device_pack_plan(config)
         self.device_packed = plan is not None
         if plan is not None:
@@ -1128,7 +1118,12 @@ class BinnedDataset:
                 group_of=jnp.asarray(self.group_of),
                 most_freq_bin=jnp.asarray(self.most_freq_bin),
             )
-        meta = FeatureMeta(
+        return layout, self._feature_meta(feat_id)
+
+    def _feature_meta(self, feat_id):
+        import jax.numpy as jnp
+        from ..ops.split import FeatureMeta
+        return FeatureMeta(
             feat_id=jnp.asarray(feat_id),
             bin_start=jnp.asarray(self.bin_start),
             bin_end=jnp.asarray(self.bin_end),
@@ -1138,7 +1133,6 @@ class BinnedDataset:
             is_categorical=jnp.asarray(self.is_categorical),
             penalty=jnp.asarray(self.penalty),
         )
-        return layout, meta
 
 
 def _load_forced_bins(filename: str, num_features: int) -> Dict[int, List[float]]:

@@ -163,6 +163,102 @@ def _graft_init_model(booster: Booster, model_str: str,
     return stump.current_iteration
 
 
+def _distributed_raw(ds, cfg):
+    """(X, label, weight) host arrays of a not-yet-constructed Dataset for
+    per-rank sharding; file-backed Datasets load through the text reader."""
+    import numpy as np
+    from .utils.log import LightGBMError
+    if isinstance(ds.data, (str, bytes)):
+        from .main import load_text_file
+        loaded = load_text_file(str(ds.data), cfg)
+        return loaded.X, loaded.label, loaded.weight
+    if ds.data is None:
+        raise LightGBMError(
+            "num_machines > 1 needs the raw data to shard rows; pass the "
+            "matrix/file to Dataset (free_raw_data has no effect here)")
+    if hasattr(ds.data, "tocsr"):
+        raise LightGBMError(
+            "num_machines > 1 does not accept scipy sparse input yet: "
+            "each rank shards dense rows (parallel/multihost.py); pass a "
+            "dense matrix or a data file")
+    X = np.asarray(ds.data, dtype=np.float64)
+    y = None if ds.label is None else np.asarray(ds.label, dtype=np.float64)
+    w = None if ds.weight is None else np.asarray(ds.weight,
+                                                 dtype=np.float64)
+    return X, y, w
+
+
+def _train_distributed(params, train_set, num_boost_round, valid_sets,
+                       fobj=None, feval=None, init_model=None,
+                       early_stopping_rounds=None, callbacks=None):
+    """num_machines > 1 from the Python API — the reference reaches this
+    through params (machines/local_listen_port -> Network::Init inside
+    Booster, basic.py set_network / network.cpp); here every participating
+    process runs the same program, ranks wire up via jax.distributed, and
+    training shards rows over the global mesh (parallel/multihost.py).
+    Returns a prediction-ready Booster holding the full model on every
+    rank. Custom objectives and callbacks are not supported."""
+    from .basic import Booster, params_to_config
+    from .boosting.gbdt import GBDT
+    from .objectives import create_objective
+    from .parallel.multihost import (init_network, shard_rows,
+                                     train_multihost)
+    from .utils.log import LightGBMError, Log
+    if fobj is not None:
+        raise LightGBMError("custom objectives are not supported with "
+                            "num_machines > 1")
+    if feval is not None:
+        raise LightGBMError("custom eval functions are not supported with "
+                            "num_machines > 1 (metrics aggregate "
+                            "count-weighted across ranks)")
+    if init_model is not None:
+        raise LightGBMError("continued training (init_model) is not "
+                            "supported with num_machines > 1 yet")
+    if callbacks:
+        Log.warning("callbacks are ignored with num_machines > 1")
+    cfg = params_to_config(params)
+    if early_stopping_rounds:
+        cfg.early_stopping_round = int(early_stopping_rounds)
+    rank = init_network(cfg)
+    X, y, w = _distributed_raw(train_set, cfg)
+    idx = shard_rows(len(X), rank, int(cfg.num_machines),
+                     bool(cfg.pre_partition))
+    Xv = yv = None
+    if valid_sets:
+        vset = next((v for v in valid_sets if v is not train_set), None)
+        if vset is not None:
+            Xv_all, yv_all, _ = _distributed_raw(vset, cfg)
+            if yv_all is None:
+                raise LightGBMError("the validation Dataset needs a label "
+                                    "with num_machines > 1")
+            vidx = shard_rows(len(Xv_all), rank, int(cfg.num_machines),
+                              bool(cfg.pre_partition))
+            Xv, yv = Xv_all[vidx], yv_all[vidx]
+    trees, _mappers, ds, _score = train_multihost(
+        cfg, X[idx], None if y is None else y[idx],
+        num_rounds=int(num_boost_round),
+        weight_local=None if w is None else w[idx],
+        X_valid=Xv, y_valid=yv)
+    # serialization-only GBDT: populate just the fields
+    # save_model_to_string reads (a full init would rebuild a tree
+    # learner + device score state per rank only to be discarded)
+    inner = GBDT()
+    obj = create_objective(cfg.objective, cfg)
+    obj.init(ds.metadata, ds.num_data)
+    inner.config = cfg
+    inner.objective = obj
+    inner.num_class = int(cfg.num_class)
+    inner.num_tree_per_iteration = getattr(obj, "num_model_per_iteration", 1)
+    inner.max_feature_idx = ds.num_total_features - 1
+    inner.feature_names = list(ds.feature_names)
+    inner.feature_infos = [GBDT._feature_info(m) for m in ds.bin_mappers]
+    inner.monotone_constraints = list(cfg.monotone_constraints)
+    inner.models = trees
+    inner.iter = len(trees)
+    return Booster(model_str=inner.save_model_to_string(),
+                   params=dict(params))
+
+
 def train(params: Dict[str, Any], train_set: Dataset,
           num_boost_round: int = 100,
           valid_sets: Optional[List[Dataset]] = None,
@@ -183,6 +279,13 @@ def train(params: Dict[str, Any], train_set: Dataset,
                                             early_stopping_rounds)
     if num_boost_round <= 0:
         raise ValueError("num_boost_round should be greater than zero.")
+    from .basic import params_to_config
+    if int(params_to_config(params).num_machines) > 1:
+        return _train_distributed(params, train_set, num_boost_round,
+                                  valid_sets, fobj=fobj, feval=feval,
+                                  init_model=init_model,
+                                  early_stopping_rounds=early_stopping_rounds,
+                                  callbacks=callbacks)
     if fobj is not None:
         params["objective"] = "none"
 

@@ -13,3 +13,8 @@ os.environ["XLA_FLAGS"] = (
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+# no persistent compile cache on CPU: XLA:CPU AOT executable serialization
+# segfaults when the runtime host's ISA differs from the client build's
+# target features (jax compilation_cache.put_executable_and_time); the
+# cache only pays off for the slow remote-TPU compiles anyway
+jax.config.update("jax_compilation_cache_dir", None)

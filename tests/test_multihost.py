@@ -132,3 +132,72 @@ def test_two_process_training(tmp_path):
         for st in json.loads(blobs[r].decode()):
             mappers.append(BinMapper.from_state(st))
     assert [m.num_bin for m in mappers] == r0["nbins"]
+
+
+PY_API_WORKER = r"""
+import json, os, sys
+import numpy as np
+sys.path.insert(0, %(repo)r)
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 2)
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+rank = int(sys.argv[1])
+port = sys.argv[2]
+out = sys.argv[3]
+os.environ["JAX_PROCESS_ID"] = str(rank)
+
+import lightgbm_tpu as lgb
+
+rng = np.random.default_rng(11)
+n, nf = 2400, 6
+X = rng.normal(size=(n, nf))
+y = (X[:, 1] + 0.5 * X[:, 4] + rng.normal(size=n) * 0.3 > 0).astype(float)
+
+params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+          "num_machines": 2,
+          "machines": "127.0.0.1:%%s,127.0.0.1:0" %% port,
+          "min_data_in_leaf": 5, "tree_learner": "data"}
+bst = lgb.train(params, lgb.Dataset(X, y), num_boost_round=8,
+                verbose_eval=False)
+pred = bst.predict(X[:200])
+with open(out, "w") as fh:
+    json.dump({"rank": rank,
+               "pred": [round(float(p), 8) for p in pred],
+               "model_hash": hash(bst.model_to_string()) %% (2**31)}, fh)
+"""
+
+
+@pytest.mark.slow
+def test_python_api_distributed_train(tmp_path):
+    """lgb.train(params with num_machines=2) from two processes — the
+    Python-API distributed entry (reference: network params on Booster,
+    basic.py set_network) — returns the identical full model on every
+    rank."""
+    port = _free_port()
+    script = tmp_path / "pyapi_worker.py"
+    script.write_text(PY_API_WORKER % {"repo": REPO})
+    outs = [str(tmp_path / f"api_rank{r}.json") for r in range(2)]
+    procs = []
+    for r in range(2):
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env.pop("JAX_PLATFORMS", None)
+        procs.append(subprocess.Popen(
+            [sys.executable, str(script), str(r), str(port), outs[r]],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+    for p in procs:
+        try:
+            _, err = p.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("python-api multihost worker timed out")
+        assert p.returncode == 0, err.decode()[-2000:]
+    r0 = json.load(open(outs[0]))
+    r1 = json.load(open(outs[1]))
+    assert r0["pred"] == r1["pred"]
+    # the model learned something nontrivial
+    assert np.std(r0["pred"]) > 0.05
