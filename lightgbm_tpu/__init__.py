@@ -51,7 +51,9 @@ _cache_dir = _os.environ.get(
 # package while the env var still names the accelerator plugin.
 _plat = (getattr(_jax.config, "jax_platforms", None)
          or _os.environ.get("JAX_PLATFORMS", "") or "").strip().lower()
-if not _plat.startswith("cpu"):
+# only enable when an accelerator platform is EXPLICITLY configured: an
+# unset platform usually resolves to cpu, where the cache is the hazard
+if _plat and not _plat.startswith("cpu"):
     try:
         _jax.config.update("jax_compilation_cache_dir", _cache_dir)
         _jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

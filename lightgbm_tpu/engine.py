@@ -163,15 +163,17 @@ def _graft_init_model(booster: Booster, model_str: str,
     return stump.current_iteration
 
 
-def _distributed_raw(ds, cfg):
-    """(X, label, weight) host arrays of a not-yet-constructed Dataset for
-    per-rank sharding; file-backed Datasets load through the text reader."""
+def _distributed_raw(ds, cfg, categorical_feature="auto"):
+    """(X, label, weight, cat_indices) host arrays of a not-yet-
+    constructed Dataset for per-rank sharding; file-backed Datasets load
+    through the text reader, matrices through the same pandas/categorical
+    coercion the single-host path uses (basic._data_to_2d)."""
     import numpy as np
     from .utils.log import LightGBMError
     if isinstance(ds.data, (str, bytes)):
         from .main import load_text_file
         loaded = load_text_file(str(ds.data), cfg)
-        return loaded.X, loaded.label, loaded.weight
+        return loaded.X, loaded.label, loaded.weight, []
     if ds.data is None:
         raise LightGBMError(
             "num_machines > 1 needs the raw data to shard rows; pass the "
@@ -181,16 +183,20 @@ def _distributed_raw(ds, cfg):
             "num_machines > 1 does not accept scipy sparse input yet: "
             "each rank shards dense rows (parallel/multihost.py); pass a "
             "dense matrix or a data file")
-    X = np.asarray(ds.data, dtype=np.float64)
+    from .basic import _data_to_2d
+    X, _names, cat_idx = _data_to_2d(ds.data, ds.feature_name,
+                                     categorical_feature)
     y = None if ds.label is None else np.asarray(ds.label, dtype=np.float64)
     w = None if ds.weight is None else np.asarray(ds.weight,
                                                  dtype=np.float64)
-    return X, y, w
+    return X, y, w, cat_idx
 
 
 def _train_distributed(params, train_set, num_boost_round, valid_sets,
                        fobj=None, feval=None, init_model=None,
-                       early_stopping_rounds=None, callbacks=None):
+                       early_stopping_rounds=None, callbacks=None,
+                       categorical_feature="auto", learning_rates=None,
+                       keep_training_booster=False):
     """num_machines > 1 from the Python API — the reference reaches this
     through params (machines/local_listen_port -> Network::Init inside
     Booster, basic.py set_network / network.cpp); here every participating
@@ -216,18 +222,49 @@ def _train_distributed(params, train_set, num_boost_round, valid_sets,
                             "supported with num_machines > 1 yet")
     if callbacks:
         Log.warning("callbacks are ignored with num_machines > 1")
-    cfg = params_to_config(params)
+    if learning_rates is not None:
+        raise LightGBMError("learning_rates schedules are not supported "
+                            "with num_machines > 1; set learning_rate")
+    if keep_training_booster:
+        Log.warning("keep_training_booster is ignored with "
+                    "num_machines > 1 (the returned Booster is "
+                    "prediction-ready on every rank)")
+    # same params precedence as the single-host path: Dataset-level
+    # params (max_bin, binning knobs) overlaid by train() params
+    merged = dict(getattr(train_set, "params", None) or {})
+    merged.update(params)
+    cfg = params_to_config(merged)
     if early_stopping_rounds:
         cfg.early_stopping_round = int(early_stopping_rounds)
+    # categorical features: the kwarg wins, else the Dataset's own
+    cat = categorical_feature
+    if cat == "auto":
+        cat = getattr(train_set, "categorical_feature", "auto")
     rank = init_network(cfg)
-    X, y, w = _distributed_raw(train_set, cfg)
+    X, y, w, cat_idx = _distributed_raw(train_set, cfg,
+                                        "auto" if cat == "auto" else cat)
+    if cat not in ("auto", None):
+        if any(isinstance(c, str) for c in cat):
+            raise LightGBMError("categorical_feature by NAME needs a "
+                                "DataFrame; pass column indices with "
+                                "num_machines > 1")
+        cat_idx = sorted(set(int(c) for c in cat) | set(cat_idx))
     idx = shard_rows(len(X), rank, int(cfg.num_machines),
                      bool(cfg.pre_partition))
     Xv = yv = None
     if valid_sets:
-        vset = next((v for v in valid_sets if v is not train_set), None)
+        others = [v for v in valid_sets if v is not train_set]
+        if len(others) < len(valid_sets):
+            Log.warning("train-set metrics are not reported with "
+                        "num_machines > 1; the train entry of valid_sets "
+                        "is ignored")
+        if len(others) > 1:
+            Log.warning("num_machines > 1 evaluates only the FIRST "
+                        "validation set; %d more ignored"
+                        % (len(others) - 1))
+        vset = others[0] if others else None
         if vset is not None:
-            Xv_all, yv_all, _ = _distributed_raw(vset, cfg)
+            Xv_all, yv_all, _, _ = _distributed_raw(vset, cfg)
             if yv_all is None:
                 raise LightGBMError("the validation Dataset needs a label "
                                     "with num_machines > 1")
@@ -237,6 +274,7 @@ def _train_distributed(params, train_set, num_boost_round, valid_sets,
     trees, _mappers, ds, _score = train_multihost(
         cfg, X[idx], None if y is None else y[idx],
         num_rounds=int(num_boost_round),
+        categorical_features=tuple(cat_idx),
         weight_local=None if w is None else w[idx],
         X_valid=Xv, y_valid=yv)
     # serialization-only GBDT: populate just the fields
@@ -281,11 +319,18 @@ def train(params: Dict[str, Any], train_set: Dataset,
         raise ValueError("num_boost_round should be greater than zero.")
     from .basic import params_to_config
     if int(params_to_config(params).num_machines) > 1:
+        if evals_result is not None:
+            from .utils.log import Log
+            Log.warning("evals_result is not populated with "
+                        "num_machines > 1")
         return _train_distributed(params, train_set, num_boost_round,
                                   valid_sets, fobj=fobj, feval=feval,
                                   init_model=init_model,
                                   early_stopping_rounds=early_stopping_rounds,
-                                  callbacks=callbacks)
+                                  callbacks=callbacks,
+                                  categorical_feature=categorical_feature,
+                                  learning_rates=learning_rates,
+                                  keep_training_booster=keep_training_booster)
     if fobj is not None:
         params["objective"] = "none"
 

@@ -255,8 +255,12 @@ class VotingParallelTreeLearner(DataParallelTreeLearner):
         # but its vote ordering does not yet reproduce the XLA voting eval
         # split-for-split, so voting stays on the XLA scan unless the user
         # forces tpu_scan_impl=pallas explicitly
-        scan = ("xla" if str(config.tpu_scan_impl).lower() != "pallas"
-                or np.any(dataset.needs_fix)
+        forced_pallas = str(config.tpu_scan_impl).lower() == "pallas"
+        if forced_pallas and np.any(dataset.needs_fix):
+            Log.warning("tpu_scan_impl=pallas: the fused voting scan does "
+                        "not implement the EFB histogram fix-up; using the "
+                        "XLA voting eval for this bundled dataset")
+        scan = ("xla" if not forced_pallas or np.any(dataset.needs_fix)
                 else self.grow_config.scan_impl)
         self.grow_config = self.grow_config._replace(
             parallel_mode="voting", top_k=int(config.top_k),

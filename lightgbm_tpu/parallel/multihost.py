@@ -166,9 +166,11 @@ def train_multihost(config: Config, X_local: np.ndarray,
     if objective is None:
         Log.fatal("num_machines > 1 needs a built-in objective")
     objective.init(ds.metadata, ds.num_data)
-    if getattr(objective, "num_model_per_iteration", 1) > 1:
-        Log.fatal("multiclass objectives are not supported with "
-                  "num_machines > 1 yet")
+    # K trees per iteration (multiclass): gradients are a [K, N] matrix
+    # row-shardable along N; each iteration grows K class trees from the
+    # iteration-start scores (GBDT::TrainOneIter computes gradients once,
+    # then trains per class — gbdt.cpp:372-411)
+    K = int(getattr(objective, "num_model_per_iteration", 1))
     if list(config.cegb_penalty_feature_lazy):
         Log.fatal("cegb_penalty_feature_lazy is not supported with "
                   "num_machines > 1 (per-row bitset needs unsharded rows)")
@@ -255,22 +257,48 @@ def train_multihost(config: Config, X_local: np.ndarray,
             def body(carry, per):
                 score, fu = carry
                 fmask, wkey, key = per
-                g, h = grad_fn(score, *gargs)
                 if bag_frac < 1.0:
                     u = _hash_uniform(gidx, wkey)
                     bag = valid & (u < jnp.float32(bag_frac))
                 else:
                     bag = valid
                 m = bag.astype(jnp.float32)
-                g = g.astype(jnp.float32) * m
-                h = h.astype(jnp.float32) * m
-                ex = base_extras._replace(key=key, feature_used=fu)
-                arrays, fu2 = _grow(bins, g, h, bag, fmask, ex)
-                upd = arrays.leaf_value.astype(jnp.float64)[
-                    arrays.row_leaf] * jnp.float64(config.learning_rate)
-                score2 = score + jnp.where(arrays.num_leaves > 1, upd, 0.0)
-                out = arrays._replace(row_leaf=jnp.zeros((0,), jnp.int32))
-                return (score2, fu2), out
+                shrink_t = jnp.float64(config.learning_rate)
+                if K == 1:
+                    g, h = grad_fn(score, *gargs)
+                    g = g.astype(jnp.float32) * m
+                    h = h.astype(jnp.float32) * m
+                    ex = base_extras._replace(key=key, feature_used=fu)
+                    arrays, fu2 = _grow(bins, g, h, bag, fmask, ex)
+                    upd = arrays.leaf_value.astype(jnp.float64)[
+                        arrays.row_leaf] * shrink_t
+                    score2 = score + jnp.where(arrays.num_leaves > 1,
+                                               upd, 0.0)
+                    out = arrays._replace(
+                        row_leaf=jnp.zeros((0,), jnp.int32))
+                    return (score2, fu2), out
+                # multiclass: one [K, N] gradient pass at the iteration
+                # start, then K class trees (static unroll)
+                G, H = grad_fn(score, *gargs)
+                outs = []
+                score2 = score
+                fu2 = fu
+                for c in range(K):
+                    g = G[c].astype(jnp.float32) * m
+                    h = H[c].astype(jnp.float32) * m
+                    ex = base_extras._replace(
+                        key=jax.random.key_data(jax.random.fold_in(
+                            jax.random.wrap_key_data(key), c)),
+                        feature_used=fu2)
+                    arrays, fu2 = _grow(bins, g, h, bag, fmask[c], ex)
+                    upd = arrays.leaf_value.astype(jnp.float64)[
+                        arrays.row_leaf] * shrink_t
+                    score2 = score2.at[c].add(
+                        jnp.where(arrays.num_leaves > 1, upd, 0.0))
+                    outs.append(arrays._replace(
+                        row_leaf=jnp.zeros((0,), jnp.int32)))
+                stacked_c = jax.tree.map(lambda *xs: jnp.stack(xs), *outs)
+                return (score2, fu2), stacked_c
 
             (scoreK, fuK), stacked = jax.lax.scan(
                 body, (score0, fu0), (fmasks, wkeys, keys), length=k)
@@ -278,26 +306,37 @@ def train_multihost(config: Config, X_local: np.ndarray,
 
         spec_gargs = tuple(P(AXIS) if a is not None else P()
                            for a in gargs_g)
+        score_spec = P(AXIS) if K == 1 else P(None, AXIS)
         return jax.jit(jax.shard_map(
             body_fn, mesh=mesh,
-            in_specs=(P(AXIS, None), P(AXIS), P(AXIS), spec_gargs, P(AXIS),
-                      P(), P(), P(), P()),
-            out_specs=(P(AXIS), P(), _tree_arrays_spec(gc,
-                                                       row_sharded=False)),
+            in_specs=(P(AXIS, None), P(AXIS), P(AXIS), spec_gargs,
+                      score_spec, P(), P(), P(), P()),
+            out_specs=(score_spec, P(), _tree_arrays_spec(gc,
+                                                          row_sharded=False)),
             check_vma=False))
 
     # ---- init score (BoostFromAverage; GlobalSyncUpByMean) -----------
-    init0 = (objective.boost_from_score(0)
-             if config.boost_from_average else 0.0)
+    init0s = [(objective.boost_from_score(c)
+               if config.boost_from_average else 0.0) for c in range(K)]
     if world > 1:
         # Network::GlobalSyncUpByMean (gbdt.cpp:308): UNWEIGHTED mean over
         # machines — reference parity on unequal shards
         from jax.experimental import multihost_utils
-        init0 = float(np.mean(multihost_utils.process_allgather(
-            np.asarray([init0], np.float64))))
-    score = jax.device_put(
-        jnp.full((pad_to * jax.process_count(),), float(init0),
-                 jnp.float64), NamedSharding(mesh, P(AXIS)))
+        init0s = [float(v) for v in np.mean(
+            multihost_utils.process_allgather(
+                np.asarray(init0s, np.float64)).reshape(world, -1),
+            axis=0)]
+    init0 = init0s[0]
+    n_glob = pad_to * jax.process_count()
+    if K == 1:
+        score = jax.device_put(
+            jnp.full((n_glob,), float(init0), jnp.float64),
+            NamedSharding(mesh, P(AXIS)))
+    else:
+        score = jax.device_put(
+            jnp.broadcast_to(jnp.asarray(init0s, jnp.float64)[:, None],
+                             (K, n_glob)),
+            NamedSharding(mesh, P(None, AXIS)))
 
     # ---- validation + metrics ----------------------------------------
     # metrics are constructed whenever valid data was PASSED (even when
@@ -323,8 +362,12 @@ def train_multihost(config: Config, X_local: np.ndarray,
     es = (_EarlyStop(int(config.early_stopping_round),
                      metrics[0].factor_to_bigger_better > 0)
           if metrics and int(config.early_stopping_round) > 0 else None)
-    vscore = (np.zeros(len(y_valid), np.float64) + init0
-              if metrics else None)
+    vscore = None
+    if metrics:
+        vscore = (np.zeros(len(y_valid), np.float64) + init0 if K == 1
+                  else np.broadcast_to(
+                      np.asarray(init0s)[:, None],
+                      (K, len(y_valid))).astype(np.float64).copy())
 
     # ---- batched boosting loop ---------------------------------------
     shrink = float(config.learning_rate)
@@ -340,7 +383,10 @@ def train_multihost(config: Config, X_local: np.ndarray,
         if k not in runners:
             runners[k] = _batch(k)
         fmasks = jnp.asarray(
-            np.stack([learner.col_sampler.sample() for _ in range(k)]))
+            np.stack([learner.col_sampler.sample()
+                      for _ in range(k * K)]))
+        if K > 1:
+            fmasks = fmasks.reshape(k, K, -1)
         wkeys = jnp.asarray(np.stack([
             np.asarray(jax.random.key_data(jax.random.fold_in(
                 base_key, (it + i) // freq))) for i in range(k)]),
@@ -351,38 +397,49 @@ def train_multihost(config: Config, X_local: np.ndarray,
             wkeys, keys)
         host = jax.device_get(stacked)          # ONE transfer per batch
         for i in range(k):
-            ha = jax.tree.map(lambda a, i=i: a[i], host)
-            tree = Tree.from_grower(ha, ds)
-            if tree.num_leaves > 1:
-                tree.shrink(shrink)
-                if it + i == 0 and abs(init0) > 1e-15:
-                    tree.add_bias(init0)
-                trees.append(tree)
-            elif it + i == 0:
-                # no-split first tree keeps the boost_from_average
-                # constant (gbdt.cpp:396-411)
-                if tree.leaf_value[0] == 0.0:
-                    tree.leaf_value[0] = init0
-                trees.append(tree)
-            else:
+            class_trees = []
+            for c in range(K):
+                ha = jax.tree.map(
+                    (lambda a, i=i: a[i]) if K == 1
+                    else (lambda a, i=i, c=c: a[i][c]), host)
+                tree = Tree.from_grower(ha, ds)
+                if tree.num_leaves > 1:
+                    tree.shrink(shrink)
+                    if it + i == 0 and abs(init0s[c]) > 1e-15:
+                        tree.add_bias(init0s[c])
+                elif it + i == 0 and tree.leaf_value[0] == 0.0:
+                    # no-split first tree keeps the boost_from_average
+                    # constant (gbdt.cpp:396-411)
+                    tree.leaf_value[0] = init0s[c]
+                class_trees.append(tree)
+            if (it + i > 0
+                    and all(t.num_leaves <= 1 for t in class_trees)):
+                # the model stops only when NO class can split
+                # (gbdt.cpp:425-435)
                 Log.warning("Stopped training because there are no more "
                             "leaves that meet the split requirements")
                 stopped = True
                 break
-            if vscore is not None and len(vscore):
-                vscore += tree.predict(Xv)
+            trees.extend(class_trees)
+            if vscore is not None and vscore.size:
+                if K == 1:
+                    vscore += class_trees[0].predict(Xv)
+                else:
+                    for c in range(K):
+                        vscore[c] += class_trees[c].predict(Xv)
         it += k
         if metrics and not stopped:
-            local = (float(metrics[0].eval(vscore, objective)[0])
-                     if len(vscore) else 0.0)
-            agg = float(_allreduce_mean_host([local],
-                                             [float(len(vscore))])[0])
+            nv = (len(y_valid) if y_valid is not None else 0)
+            local = (float(metrics[0].eval(vscore.reshape(-1),
+                                           objective)[0])
+                     if nv else 0.0)
+            agg = float(_allreduce_mean_host([local], [float(nv)])[0])
             if rank == 0:
                 Log.info("[%d] valid %s : %g"
                          % (it, metrics[0].names[0], agg))
             if es is not None and es.update(agg, it):
                 Log.info("Early stopping at iteration %d, best %g at %d"
                          % (it, es.best, es.best_iter))
-                trees = trees[:max(es.best_iter, 1)]
+                trees = trees[:max(es.best_iter, 1) * K]
                 stopped = True
     return trees, mappers, ds, score

@@ -201,3 +201,72 @@ def test_python_api_distributed_train(tmp_path):
     assert r0["pred"] == r1["pred"]
     # the model learned something nontrivial
     assert np.std(r0["pred"]) > 0.05
+
+
+MC_WORKER = r"""
+import json, os, sys
+import numpy as np
+sys.path.insert(0, %(repo)r)
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 2)
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+rank = int(sys.argv[1])
+port = sys.argv[2]
+out = sys.argv[3]
+os.environ["JAX_PROCESS_ID"] = str(rank)
+
+import lightgbm_tpu as lgb
+
+rng = np.random.default_rng(21)
+n, nf = 2400, 6
+X = rng.normal(size=(n, nf))
+logits = np.stack([X[:, 0], X[:, 1] - 0.5 * X[:, 2], -X[:, 0] + X[:, 3]])
+y = np.argmax(logits + rng.normal(size=(3, n)) * 0.3, axis=0).astype(float)
+
+params = {"objective": "multiclass", "num_class": 3, "num_leaves": 15,
+          "verbosity": -1, "num_machines": 2,
+          "machines": "127.0.0.1:%%s,127.0.0.1:0" %% port,
+          "min_data_in_leaf": 5, "tree_learner": "data"}
+bst = lgb.train(params, lgb.Dataset(X, y), num_boost_round=10,
+                verbose_eval=False)
+pred = bst.predict(X[:300])
+acc = float((np.argmax(pred, axis=1) == y[:300]).mean())
+with open(out, "w") as fh:
+    json.dump({"rank": rank, "acc": acc,
+               "pred": [round(float(p), 8) for p in pred.ravel()[:600]]},
+              fh)
+"""
+
+
+@pytest.mark.slow
+def test_python_api_distributed_multiclass(tmp_path):
+    """Multiclass (K trees per iteration) over two jax.distributed
+    processes: one [K, N] gradient pass per iteration, K sharded class
+    trees, identical model on every rank (gbdt.cpp:372-435 contract)."""
+    port = _free_port()
+    script = tmp_path / "mc_worker.py"
+    script.write_text(MC_WORKER % {"repo": REPO})
+    outs = [str(tmp_path / f"mc_rank{r}.json") for r in range(2)]
+    procs = []
+    for r in range(2):
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env.pop("JAX_PLATFORMS", None)
+        procs.append(subprocess.Popen(
+            [sys.executable, str(script), str(r), str(port), outs[r]],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+    for p in procs:
+        try:
+            _, err = p.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("multiclass multihost worker timed out")
+        assert p.returncode == 0, err.decode()[-2000:]
+    r0 = json.load(open(outs[0]))
+    r1 = json.load(open(outs[1]))
+    assert r0["pred"] == r1["pred"]
+    assert r0["acc"] > 0.8, r0["acc"]
