@@ -173,7 +173,7 @@ def _distributed_raw(ds, cfg, categorical_feature="auto"):
     if isinstance(ds.data, (str, bytes)):
         from .main import load_text_file
         loaded = load_text_file(str(ds.data), cfg)
-        return loaded.X, loaded.label, loaded.weight, []
+        return loaded.X, loaded.label, loaded.weight, [], loaded.group
     if ds.data is None:
         raise LightGBMError(
             "num_machines > 1 needs the raw data to shard rows; pass the "
@@ -189,7 +189,7 @@ def _distributed_raw(ds, cfg, categorical_feature="auto"):
     y = None if ds.label is None else np.asarray(ds.label, dtype=np.float64)
     w = None if ds.weight is None else np.asarray(ds.weight,
                                                  dtype=np.float64)
-    return X, y, w, cat_idx
+    return X, y, w, cat_idx, ds.group
 
 
 def _train_distributed(params, train_set, num_boost_round, valid_sets,
@@ -241,17 +241,27 @@ def _train_distributed(params, train_set, num_boost_round, valid_sets,
     if cat == "auto":
         cat = getattr(train_set, "categorical_feature", "auto")
     rank = init_network(cfg)
-    X, y, w, cat_idx = _distributed_raw(train_set, cfg,
-                                        "auto" if cat == "auto" else cat)
+    X, y, w, cat_idx, grp = _distributed_raw(
+        train_set, cfg, "auto" if cat == "auto" else cat)
     if cat not in ("auto", None):
         if any(isinstance(c, str) for c in cat):
             raise LightGBMError("categorical_feature by NAME needs a "
                                 "DataFrame; pass column indices with "
                                 "num_machines > 1")
         cat_idx = sorted(set(int(c) for c in cat) | set(cat_idx))
-    idx = shard_rows(len(X), rank, int(cfg.num_machines),
-                     bool(cfg.pre_partition))
-    Xv = yv = None
+    world = int(cfg.num_machines)
+    if grp is not None:
+        # ranking: shard whole queries, never splitting one across ranks
+        from .parallel.multihost import shard_queries
+        if bool(cfg.pre_partition):
+            import numpy as np
+            idx, glocal = np.arange(len(X)), np.asarray(grp, np.int64)
+        else:
+            idx, glocal = shard_queries(grp, rank, world)
+    else:
+        idx, glocal = shard_rows(len(X), rank, world,
+                                 bool(cfg.pre_partition)), None
+    Xv = yv = gvalid = None
     if valid_sets:
         others = [v for v in valid_sets if v is not train_set]
         if len(others) < len(valid_sets):
@@ -264,19 +274,29 @@ def _train_distributed(params, train_set, num_boost_round, valid_sets,
                         % (len(others) - 1))
         vset = others[0] if others else None
         if vset is not None:
-            Xv_all, yv_all, _, _ = _distributed_raw(vset, cfg)
+            Xv_all, yv_all, _, _, vgrp = _distributed_raw(vset, cfg)
             if yv_all is None:
                 raise LightGBMError("the validation Dataset needs a label "
                                     "with num_machines > 1")
-            vidx = shard_rows(len(Xv_all), rank, int(cfg.num_machines),
-                              bool(cfg.pre_partition))
+            if vgrp is not None:
+                from .parallel.multihost import shard_queries
+                if bool(cfg.pre_partition):
+                    import numpy as np
+                    vidx = np.arange(len(Xv_all))
+                    gvalid = np.asarray(vgrp, np.int64)
+                else:
+                    vidx, gvalid = shard_queries(vgrp, rank, world)
+            else:
+                vidx = shard_rows(len(Xv_all), rank, world,
+                                  bool(cfg.pre_partition))
             Xv, yv = Xv_all[vidx], yv_all[vidx]
     trees, _mappers, ds, _score = train_multihost(
         cfg, X[idx], None if y is None else y[idx],
         num_rounds=int(num_boost_round),
         categorical_features=tuple(cat_idx),
         weight_local=None if w is None else w[idx],
-        X_valid=Xv, y_valid=yv)
+        X_valid=Xv, y_valid=yv,
+        group_local=glocal, group_valid=gvalid)
     # serialization-only GBDT: populate just the fields
     # save_model_to_string reads (a full init would rebuild a tree
     # learner + device score state per rank only to be discarded)

@@ -99,27 +99,37 @@ class Application:
         (application.cpp:164-210; see parallel/multihost.py)."""
         import jax
         cfg = self.config
-        from .parallel.multihost import (init_network, shard_rows,
-                                         train_multihost)
+        from .parallel.multihost import (init_network, shard_queries,
+                                         shard_rows, train_multihost)
         rank = init_network(cfg)
+        world = int(cfg.num_machines)
         loaded = load_text_file(cfg.data, cfg)
-        idx = shard_rows(loaded.X.shape[0], rank, int(cfg.num_machines),
-                         bool(cfg.pre_partition))
-        Xv = yv = None
+
+        def _shard(n_rows, group):
+            """(row idx, local group sizes): queries shard whole when the
+            data carries them (.query sidecar / group_column)."""
+            if group is not None:
+                if bool(cfg.pre_partition):
+                    return np.arange(n_rows), np.asarray(group, np.int64)
+                return shard_queries(group, rank, world)
+            return shard_rows(n_rows, rank, world,
+                              bool(cfg.pre_partition)), None
+
+        idx, glocal = _shard(loaded.X.shape[0], loaded.group)
+        Xv = yv = gvalid = None
         if cfg.valid:
             # each rank evaluates its shard of the first valid set; metric
             # values aggregate count-weighted across ranks (SURVEY §2.6
             # pre-partitioned parallel eval)
             vloaded = load_text_file(cfg.valid[0], cfg)
-            vidx = shard_rows(vloaded.X.shape[0], rank,
-                              int(cfg.num_machines),
-                              bool(cfg.pre_partition))
+            vidx, gvalid = _shard(vloaded.X.shape[0], vloaded.group)
             Xv, yv = vloaded.X[vidx], vloaded.label[vidx]
         wl = loaded.weight[idx] if loaded.weight is not None else None
         trees, mappers, ds, _score = train_multihost(
             cfg, loaded.X[idx], loaded.label[idx],
             num_rounds=int(cfg.num_iterations),
-            weight_local=wl, X_valid=Xv, y_valid=yv)
+            weight_local=wl, X_valid=Xv, y_valid=yv,
+            group_local=glocal, group_valid=gvalid)
         if jax.process_index() == 0:
             from .boosting.gbdt import GBDT
             from .objectives import create_objective

@@ -38,9 +38,13 @@ aggregates as a count-weighted mean across ranks (the reference's
 pre-partitioned parallel eval, SURVEY §2.6), driving reference-semantics
 early stopping identically on every rank.
 
-Out of scope (loud failures below): K-trees-per-iteration objectives
-(multiclass) and query-structured objectives (ranking) — their gradient
-inputs are not row-shardable yet.
+Multiclass (K trees per iteration) computes ONE [K, N] softmax gradient
+pass per iteration and grows the K class trees inside the same scan.
+Ranking (lambdarank) shards WHOLE queries: ranks receive query-aligned
+contiguous row blocks (shard_queries) and each local device gets its own
+padded whole-query block, so per-query lambdas never cross a shard
+(rank_objective.hpp:139's locality). rank_xendcg is the one loud failure
+left — its per-iteration host LCG draws cannot ride the fused batch.
 """
 from __future__ import annotations
 
@@ -69,6 +73,99 @@ def shard_rows(n_rows: int, rank: int, world: int,
     if pre_partition or world <= 1:
         return np.arange(n_rows)
     return np.arange(rank, n_rows, world)
+
+
+def _balanced_query_cuts(sizes: np.ndarray, parts: int):
+    """parts+1 monotone query indices splitting contiguous queries into
+    `parts` groups with near-equal ROW counts (queries never split)."""
+    sizes = np.asarray(sizes, np.int64)
+    ends = np.cumsum(sizes)
+    total = int(ends[-1]) if len(ends) else 0
+    cuts = [0]
+    for r in range(1, parts):
+        q = int(np.searchsorted(ends, total * r // parts))
+        cuts.append(max(cuts[-1], min(q, len(sizes))))
+    cuts.append(len(sizes))
+    return cuts
+
+
+def shard_queries(group_sizes, rank: int, world: int):
+    """(row_indices, local_query_sizes) for `rank`: contiguous whole-query
+    assignment balanced by rows — ranking's pre-partitioned sharding (the
+    reference requires query-aligned partitions for distributed ranking,
+    docs/Parallel-Learning-Guide + rank_objective.hpp's per-query
+    gradient locality)."""
+    sizes = np.asarray(group_sizes, np.int64)
+    bounds = np.concatenate([[0], np.cumsum(sizes)])
+    cuts = _balanced_query_cuts(sizes, world)
+    q0, q1 = cuts[rank], cuts[rank + 1]
+    return (np.arange(int(bounds[q0]), int(bounds[q1])),
+            sizes[q0:q1].copy())
+
+
+def _lambdarank_block_gargs(config: Config, label_local, weight_local,
+                            qb, dev_cuts, B, NQB, Pmax):
+    """Per-local-device lambdarank gradient inputs, padded to the global
+    block geometry and stacked on axis 0 so shard_map hands each device
+    its own whole-query block. Returns (arrays, in_specs) matching the
+    lambdarank _grad_args contract: (label, weight, qidx, qvalid,
+    inverse_max_dcgs, label_gain, discounts, inv_pos)."""
+    from ..metrics.dcg import _DISCOUNT_CACHE
+    from ..objectives import create_objective
+    local_dev = len(dev_cuts) - 1
+    lab_b, w_b, qidx_b, qval_b, inv_b, ipos_b = [], [], [], [], [], []
+    label_gain = None
+    for d in range(local_dev):
+        qd0, qd1 = dev_cuts[d], dev_cuts[d + 1]
+        r0, r1 = int(qb[qd0]), int(qb[qd1])
+        nq_d, n_d = qd1 - qd0, r1 - r0
+
+        class _BMeta:
+            label = np.asarray(label_local[r0:r1], np.float64)
+            weight = (np.asarray(weight_local[r0:r1], np.float64)
+                      if weight_local is not None else None)
+            query_boundaries = (np.asarray(qb[qd0:qd1 + 1]) - r0)
+            num_queries = nq_d
+            init_score = None
+        obj_d = create_objective(config.objective, config)
+        obj_d.init(_BMeta(), n_d)
+        (lab, w, qidx, qval, inv, lgain, _disc, _ipos) = [
+            None if a is None else np.asarray(a)
+            for a in obj_d._grad_args()]
+        label_gain = lgain
+        P_d = qidx.shape[1] if nq_d else 0
+        qidx_p = np.full((NQB, Pmax), -1, np.int64)
+        qval_p = np.zeros((NQB, Pmax), bool)
+        if nq_d:
+            qidx_p[:nq_d, :P_d] = qidx
+            qval_p[:nq_d, :P_d] = qval
+        inv_p = np.zeros(NQB, np.float64)
+        inv_p[:nq_d] = inv
+        # row -> flat padded (query, position) slot; pad rows point at 0
+        # (their gradients are discarded by the in-bag mask anyway)
+        ipos = np.zeros(B, np.int64)
+        qq, pp = np.nonzero(qidx_p >= 0)
+        ipos[qidx_p[qq, pp]] = qq * Pmax + pp
+        lab_b.append(np.pad(_BMeta.label, (0, B - n_d)))
+        if _BMeta.weight is not None:
+            w_b.append(np.pad(_BMeta.weight, (0, B - n_d)))
+        qidx_b.append(qidx_p)
+        qval_b.append(qval_p)
+        inv_b.append(inv_p)
+        ipos_b.append(ipos)
+    arrays = (
+        np.concatenate(lab_b),                                # label [D*B]
+        (np.concatenate(w_b) if w_b else None),               # weight
+        np.concatenate(qidx_b),                               # [D*NQB, Pmax]
+        np.concatenate(qval_b),
+        np.concatenate(inv_b),                                # [D*NQB]
+        np.asarray(label_gain),                               # replicated
+        np.asarray(_DISCOUNT_CACHE[:max(Pmax, 1)]),           # replicated
+        np.concatenate(ipos_b),                               # [D*B]
+    )
+    specs = (P(AXIS), P(AXIS) if arrays[1] is not None else P(),
+             P(AXIS, None), P(AXIS, None), P(AXIS), P(), P(), P(AXIS))
+    return arrays, specs
 
 
 def _global_mesh() -> Mesh:
@@ -124,13 +221,22 @@ def train_multihost(config: Config, X_local: np.ndarray,
                     sample_override: Optional[np.ndarray] = None,
                     weight_local: Optional[np.ndarray] = None,
                     X_valid: Optional[np.ndarray] = None,
-                    y_valid: Optional[np.ndarray] = None):
+                    y_valid: Optional[np.ndarray] = None,
+                    group_local: Optional[np.ndarray] = None,
+                    group_valid: Optional[np.ndarray] = None):
     """Distributed training entry; returns the (identical-on-every-rank)
     list of host Trees plus the shared BinMappers for model IO.
 
     X_valid/y_valid: this rank's shard of a validation set; with
     valid data and early_stopping_round > 0 the loop stops when the
     aggregated first metric stalls.
+
+    group_local: this rank's query sizes (ranking). Rows must arrive
+    query-contiguous (shard_queries does this); internally each local
+    DEVICE receives whole queries — rows re-block with padding so the
+    per-query lambda computation stays device-local
+    (GetGradientsForOneQuery, rank_objective.hpp:139 — the reference's
+    pre-partitioned ranking contract).
     """
     from ..data.dataset import BinnedDataset
     from ..objectives import create_objective
@@ -161,6 +267,8 @@ def train_multihost(config: Config, X_local: np.ndarray,
         rank=rank, world=world)
     ds = BinnedDataset.from_matrix_with_mappers(
         X_local, config, mappers, label=y_local, weight=weight_local)
+    if group_local is not None:
+        ds.metadata.set_query(np.asarray(group_local, np.int64))
 
     objective = create_objective(config.objective, config)
     if objective is None:
@@ -179,49 +287,101 @@ def train_multihost(config: Config, X_local: np.ndarray,
         Log.fatal("the multi-value (ELL) layout is not supported with "
                   "num_machines > 1 yet; use tpu_multival=off")
 
+    is_ranking = ds.metadata.query_boundaries is not None
+    if is_ranking and str(config.objective) != "lambdarank":
+        Log.fatal("among ranking objectives only lambdarank supports "
+                  "num_machines > 1 (rank_xendcg draws per-iteration "
+                  "host randomness)")
+
     # ---- global mesh + row-sharded device state ----------------------
     from ..treelearner.serial import SerialTreeLearner
     mesh = _global_mesh()
     S = mesh.devices.size
     learner = SerialTreeLearner(config, ds)
     n_local = ds.num_data
-    # equal local shards: every process must contribute the same number of
-    # device rows; pad the tail shard
     counts = jax.experimental.multihost_utils.process_allgather(
         np.asarray([n_local], np.int64)).reshape(-1)
-    per_proc = int(counts.max())
     local_dev = S // jax.process_count()
-    pad_to = ((per_proc + local_dev - 1) // local_dev) * local_dev
-    pad = pad_to - n_local
+    # GLOBAL row ids drive the bagging hash — every rank draws the same
+    # per-row bernoulli without communication (gbdt.cpp:210-244 semantics).
+    # Ranking shards whole queries as CONTIGUOUS blocks (shard_queries),
+    # so its global ids are the rank's row range; round-robin ids would
+    # misalign under the uneven row counts query alignment produces.
+    if is_ranking:
+        off = int(counts[:rank].sum())
+        gidx_l = np.arange(off, off + n_local)
+    else:
+        gidx_l = shard_rows(int(counts.sum()), rank, world,
+                            bool(config.pre_partition))[:n_local]
+    if is_ranking:
+        # whole queries per local DEVICE: re-block this rank's rows so the
+        # per-query lambda computation never crosses a shard boundary
+        qb = np.asarray(ds.metadata.query_boundaries, np.int64)
+        dev_cuts = _balanced_query_cuts(np.diff(qb), local_dev)
+        blk_rows = [int(qb[dev_cuts[d + 1]] - qb[dev_cuts[d]])
+                    for d in range(local_dev)]
+        blk_nq = [dev_cuts[d + 1] - dev_cuts[d] for d in range(local_dev)]
+        P_l = int(np.diff(qb).max()) if len(qb) > 1 else 1
+        geom = jax.experimental.multihost_utils.process_allgather(
+            np.asarray([max(blk_rows), max(blk_nq), P_l],
+                       np.int64)).reshape(-1, 3)
+        B, NQB, Pmax = (int(geom[:, 0].max()), int(geom[:, 1].max()),
+                        int(geom[:, 2].max()))
+        pad_to = local_dev * B
+        src = np.full((local_dev, B), -1, np.int64)
+        for d in range(local_dev):
+            src[d, :blk_rows[d]] = np.arange(int(qb[dev_cuts[d]]),
+                                             int(qb[dev_cuts[d + 1]]))
+        srcf = src.reshape(-1)
+        valid_local = srcf >= 0
 
-    def padded(a, fill=0.0):
-        a = np.asarray(a)
-        if not pad:
-            return a
-        widths = ((0, pad),) + ((0, 0),) * (a.ndim - 1)
-        return np.pad(a, widths, constant_values=fill)
+        def padded(a, fill=0.0):
+            a = np.asarray(a)
+            out = np.ascontiguousarray(a[np.clip(srcf, 0, None)])
+            out[~valid_local] = fill
+            return out
+    else:
+        # equal local shards: every process must contribute the same
+        # number of device rows; pad the tail shard
+        per_proc = int(counts.max())
+        pad_to = ((per_proc + local_dev - 1) // local_dev) * local_dev
+        pad = pad_to - n_local
+        valid_local = np.pad(np.ones(n_local, bool), (0, pad))
+
+        def padded(a, fill=0.0):
+            a = np.asarray(a)
+            if not pad:
+                return a
+            widths = ((0, pad),) + ((0, 0),) * (a.ndim - 1)
+            return np.pad(a, widths, constant_values=fill)
 
     bins_g = _global_array(mesh, padded(np.ascontiguousarray(ds.binned)))
-    valid_g = _global_array(mesh, padded(np.ones(n_local, bool)))
-    # GLOBAL row ids drive the bagging hash — every rank draws the same
-    # per-row bernoulli without communication (gbdt.cpp:210-244 semantics)
-    gidx_l = shard_rows(int(counts.sum()), rank, world,
-                        bool(config.pre_partition))[:n_local]
+    valid_g = _global_array(mesh, valid_local)
     gidx_g = _global_array(mesh, padded(gidx_l.astype(np.uint32)))
 
-    # the objective's device args, row-sharded where row-aligned
+    # the objective's device gradient args
     grad_fn = objective.grad_fn()
-    gargs_local = objective._grad_args()
-    gargs_g = []
-    for a in gargs_local:
-        if a is None:
-            gargs_g.append(None)
-        elif a.ndim >= 1 and a.shape[0] == n_local:
-            gargs_g.append(_global_array(mesh, padded(np.asarray(a))))
-        else:
-            Log.fatal("objective %s has gradient inputs that are not "
-                      "row-shardable; not supported with num_machines > 1"
-                      % config.objective)
+    if is_ranking:
+        gargs_np, garg_specs = _lambdarank_block_gargs(
+            config, y_local, weight_local, qb, dev_cuts, B, NQB, Pmax)
+        gargs_g = [None if a is None else
+                   (_global_array(mesh, a) if sp != P() else jnp.asarray(a))
+                   for a, sp in zip(gargs_np, garg_specs)]
+    else:
+        # row-sharded where row-aligned
+        gargs_g = []
+        garg_specs = []
+        for a in objective._grad_args():
+            if a is None:
+                gargs_g.append(None)
+                garg_specs.append(P())
+            elif a.ndim >= 1 and a.shape[0] == n_local:
+                gargs_g.append(_global_array(mesh, padded(np.asarray(a))))
+                garg_specs.append(P(AXIS))
+            else:
+                Log.fatal("objective %s has gradient inputs that are not "
+                          "row-shardable; not supported with "
+                          "num_machines > 1" % config.objective)
 
     gc = learner.grow_config
     n_shard = pad_to * jax.process_count() // S
@@ -304,8 +464,7 @@ def train_multihost(config: Config, X_local: np.ndarray,
                 body, (score0, fu0), (fmasks, wkeys, keys), length=k)
             return scoreK, fuK, stacked
 
-        spec_gargs = tuple(P(AXIS) if a is not None else P()
-                           for a in gargs_g)
+        spec_gargs = tuple(garg_specs)
         score_spec = P(AXIS) if K == 1 else P(None, AXIS)
         return jax.jit(jax.shard_map(
             body_fn, mesh=mesh,
@@ -350,11 +509,16 @@ def train_multihost(config: Config, X_local: np.ndarray,
         names = list(config.metric) or [""]
         m = create_metric(names[0] or str(config.objective), config)
         if m is not None:
+            _vqb = (np.concatenate(
+                [[0], np.cumsum(np.asarray(group_valid, np.int64))])
+                if group_valid is not None else None)
+
             class _VMeta:
                 label = np.asarray(y_valid, np.float64)
                 weight = None
-                query_boundaries = None
-                num_queries = 0
+                query_boundaries = _vqb
+                num_queries = (len(_vqb) - 1 if _vqb is not None else 0)
+                query_weights = None
                 init_score = None
             m.init(_VMeta(), len(y_valid))
             metrics.append(m)
@@ -430,6 +594,10 @@ def train_multihost(config: Config, X_local: np.ndarray,
         it += k
         if metrics and not stopped:
             nv = (len(y_valid) if y_valid is not None else 0)
+            # rank metrics average per QUERY; aggregate query-weighted
+            if nv and getattr(metrics[0], "query_boundaries",
+                              None) is not None:
+                nv = max(len(metrics[0].query_boundaries) - 1, 0)
             local = (float(metrics[0].eval(vscore.reshape(-1),
                                            objective)[0])
                      if nv else 0.0)
