@@ -96,6 +96,37 @@ class ObjectiveFunction:
         at iteration start). None when unsupported."""
         return None
 
+    def payload_pos_fn(self):
+        """Pure (score, rid, live, *pos_args) -> (grad, hess) ALL in
+        payload order, for objectives whose gradients need global row
+        structure (lambdarank's query groups) but can reach it through the
+        carried row-id payload row with one scatter instead of a full
+        row-order round trip. None when unsupported (the persist driver
+        then falls back to row-order mode)."""
+        return None
+
+    def persist_grad_mode(self) -> str:
+        """Which gradient mode the persist scan driver should use:
+        'payload' (label-only, fastest), 'pos' (payload-order with row-id
+        scatter), or 'row' (full row-order round trip)."""
+        if getattr(self, "num_model_per_iteration", 1) > 1:
+            return "payload" if self.payload_grad_fn_multi() else "row"
+        if self.payload_grad_fn() is not None:
+            return "payload"
+        if self.payload_pos_fn() is not None:
+            return "pos"
+        return "row"
+
+    def persist_grad_args(self) -> tuple:
+        """Extra traced args for the persist driver's gradient fill,
+        matching persist_grad_mode ('payload' mode takes none)."""
+        mode = self.persist_grad_mode()
+        if mode == "payload":
+            return ()
+        if mode == "pos":
+            return self._pos_grad_args()
+        return self._grad_args()
+
     def _grad_args(self):
         """Device arrays bound as extra args of the jitted grad function."""
         import jax.numpy as jnp

@@ -95,6 +95,17 @@ class LambdarankNDCG(RankingObjective):
         qid = np.repeat(np.arange(self.num_queries, dtype=np.int64), counts)
         self._inv_pos = (qid * P + (np.arange(self.num_data, dtype=np.int64)
                                     - qb[qid])).astype(np.int32)
+        # padded per-slot statics for the payload-position gradient mode:
+        # labels never change, so the [Q, P] label/gain/weight planes are
+        # computed once and only SCORES move per iteration
+        safe = np.maximum(self._qidx, 0)
+        self._lab_pad = np.where(self._qvalid, self.label[safe], 0.0) \
+            .astype(np.float32)
+        self._gains_pad = self.label_gain[self._lab_pad.astype(np.int64)] \
+            .astype(np.float64)
+        self._w_pad = (np.where(self._qvalid, self.weight[safe], 0.0)
+                       .astype(np.float32)
+                       if self.weight is not None else None)
         if self._chunk <= 0:
             # budget the [chunk, P, P] pairwise intermediates to ~256MB:
             # tiny chunks turn lax.map into hundreds of sequential
@@ -104,10 +115,13 @@ class LambdarankNDCG(RankingObjective):
             self._chunk = max(256, min(self.num_queries,
                                        (256 << 20) // (P * P * 4)))
 
-    def grad_fn(self):
+    def _pairwise_flat(self):
+        """Shared pairwise core: fn(s_q [Q, P], l_q, qvalid, inv_max_dcgs,
+        gains_q, discounts) -> (lam_flat, hess_flat) over the padded slots
+        (chunk-padded queries appended at the end; callers index by padded
+        position, which never reaches the pad)."""
         sigmoid = self.sigmoid
         norm = self.norm
-        num_data = self.num_data
         chunk = self._chunk
         # f64 on TPU is emulated op-by-op; the pairwise tensors dominate
         # this objective, so compute them in f32 on accelerators (the
@@ -129,16 +143,22 @@ class LambdarankNDCG(RankingObjective):
             P = scores_q.shape[0]
             neg_inf = jnp.asarray(-jnp.inf, scores_q.dtype)
             s = jnp.where(valid_q, scores_q, neg_inf)
-            order = jnp.argsort(-s, stable=True)       # positions -> row
-            rank_of = jnp.argsort(order, stable=True)  # row -> position
+            # per-row discount WITHOUT a gather (TPU gathers serialize):
+            # sort rows by descending score carrying the row index, then
+            # sort back by row index carrying the rank's discount — two
+            # payload-carrying sorts replace argsort+argsort+table-gather
+            iota = jnp.arange(P, dtype=jnp.int32)
+            neg_s, row_of_rank = jax.lax.sort((-s, iota), num_keys=1,
+                                              is_stable=True)
+            _, disc = jax.lax.sort((row_of_rank, disc_from_rank[:P]),
+                                   num_keys=1, is_stable=True)
             n_valid = jnp.sum(valid_q.astype(jnp.int32))
-            best_score = s[order[0]]
-            worst_score = s[order[jnp.maximum(n_valid - 1, 0)]]
+            best_score = -neg_s[0]
+            worst_score = -neg_s[jnp.maximum(n_valid - 1, 0)]
 
             # pairwise [P, P]: i = high row, j = low row
             lab = labels_q.astype(jnp.int32)
             gain = gains_q                        # [P] label gain per row
-            disc = disc_from_rank[rank_of]        # [P] discount per row
             d_score = s[:, None] - s[None, :]
             pair_valid = (valid_q[:, None] & valid_q[None, :]
                           & (lab[:, None] > lab[None, :]))
@@ -168,13 +188,10 @@ class LambdarankNDCG(RankingObjective):
                 hess = hess * norm_factor
             return lambdas, hess
 
-        def fn(score, label, weight, qidx, qvalid, inv_max_dcgs, label_gain,
-               discounts, inv_pos):
-            Q, P = qidx.shape
-            safe_idx = jnp.maximum(qidx, 0)
-            s_q = score[safe_idx].astype(ct)            # [Q, P]
-            l_q = label[safe_idx]
-            gains_q = label_gain[l_q.astype(jnp.int32)].astype(ct)
+        def core(s_q, l_q, qvalid, inv_max_dcgs, gains_q, discounts):
+            Q, P = s_q.shape
+            s_q = s_q.astype(ct)
+            gains_q = gains_q.astype(ct)
             inv_max_dcgs = inv_max_dcgs.astype(ct)
             discounts = discounts.astype(ct)
 
@@ -193,15 +210,89 @@ class LambdarankNDCG(RankingObjective):
             resh = lambda x: x.reshape((nchunks, chunk) + x.shape[1:])
             lam_c, hes_c = jax.lax.map(
                 chunk_fn, (resh(sq), resh(lq), resh(vq), resh(inv), resh(gq)))
+            return lam_c.reshape(-1), hes_c.reshape(-1)
+        return core
+
+    def grad_fn(self):
+        core = self._pairwise_flat()
+
+        def fn(score, label, weight, qidx, qvalid, inv_max_dcgs, label_gain,
+               discounts, inv_pos):
+            safe_idx = jnp.maximum(qidx, 0)
+            s_q = score[safe_idx]                       # [Q, P]
+            l_q = label[safe_idx]
+            gains_q = label_gain[l_q.astype(jnp.int32)]
+            lam, hes = core(s_q, l_q, qvalid, inv_max_dcgs, gains_q,
+                            discounts)
             # padded [Q, P] -> flat rows with one gather (each row occupies
             # exactly one padded position)
-            g = lam_c.reshape(-1)[inv_pos]
-            h = hes_c.reshape(-1)[inv_pos]
+            g = lam[inv_pos]
+            h = hes[inv_pos]
             if weight is not None:
                 g = g * weight
                 h = h * weight
             return g.astype(jnp.float32), h.astype(jnp.float32)
         return fn
+
+    def payload_pos_fn(self):
+        """Payload-order gradient mode for the persist fast path: scores
+        arrive in PAYLOAD order with their global row ids; the padded
+        [Q, P] slots are filled with ONE scatter through the static
+        row->slot map and the lambdas return with one gather — no
+        row-order round trip (the reference has no analog: its gradient
+        buffer is always row-ordered, rank_objective.hpp:98-137)."""
+        core = self._pairwise_flat()
+        n = self.num_data
+
+        def fn(score, rid, live, lab_pad, qvalid, inv_max_dcgs, gains_pad,
+               discounts, pos_of_rid, w_pad):
+            Q, P = lab_pad.shape
+            QP = Q * P
+            NP = score.shape[0]
+            pos = pos_of_rid[jnp.minimum(rid, n - 1)]        # [NP]
+            pos = jnp.where(live, pos, QP)
+            sp = jnp.zeros((QP,), score.dtype).at[pos].set(
+                score, mode="drop", unique_indices=True)
+            lam, hes = core(sp.reshape(Q, P), lab_pad, qvalid, inv_max_dcgs,
+                            gains_pad, discounts)
+            lam = lam[:QP]
+            hes = hes[:QP]
+            if w_pad is not None:
+                # multiply BEFORE the f32 cast — same precision order as
+                # grad_fn, so weighted runs keep row/pos-mode bit-parity
+                lam = lam * w_pad.reshape(-1)
+                hes = hes * w_pad.reshape(-1)
+            lam = lam.astype(jnp.float32)
+            hes = hes.astype(jnp.float32)
+            # return via SCATTER through the inverse slot->lane map, not a
+            # gather: on TPU an [NP]-sized gather serializes (~15 ms at
+            # 2.3M rows) while the equivalent scatters run in ~1 ms
+            lane = jnp.arange(NP, dtype=jnp.int32)
+            inv = jnp.full((QP,), NP, jnp.int32).at[pos].set(
+                lane, mode="drop", unique_indices=True)
+            g = jnp.zeros((NP,), jnp.float32).at[inv].set(
+                lam, mode="drop", unique_indices=True)
+            h = jnp.zeros((NP,), jnp.float32).at[inv].set(
+                hes, mode="drop", unique_indices=True)
+            return g, h
+        return fn
+
+    def _pos_grad_args(self):
+        # device constants cached: persist_grad_args runs once per fused
+        # K-iteration batch, and these [Q, P]/[n] planes never change
+        cached = getattr(self, "_pos_args_dev", None)
+        if cached is None:
+            P = self._qidx.shape[1]
+            from ..metrics.dcg import _DISCOUNT_CACHE
+            cached = self._pos_args_dev = (
+                jnp.asarray(self._lab_pad), jnp.asarray(self._qvalid),
+                jnp.asarray(self.inverse_max_dcgs),
+                jnp.asarray(self._gains_pad),
+                jnp.asarray(_DISCOUNT_CACHE[:P]),
+                jnp.asarray(self._inv_pos),
+                (jnp.asarray(self._w_pad) if self._w_pad is not None
+                 else None))
+        return cached
 
     def _grad_args(self):
         weight = jnp.asarray(self.weight) if self.weight is not None else None

@@ -748,6 +748,18 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
         return jnp.zeros((K, n), F32).at[:, rid].set(
             scores, mode="drop", unique_indices=True)
 
+    def fill_grad_pos(pay, pos_grad_fn, gargs):
+        """Payload-position gradient mode: the objective computes (g, h)
+        directly in PAYLOAD order from (score, rid, live) — lambdarank
+        scatters scores into its padded query slots through the row-id
+        map and gathers the lambdas straight back, skipping the row-order
+        round trip of fill_grad_row."""
+        rid = pay[nbw + 1].astype(I32)
+        score = _f32r(pay[score_row])
+        live = jnp.arange(NP, dtype=I32) < n
+        g, h = pos_grad_fn(score, rid, live, *gargs)
+        return _write_grads(pay, g, h)
+
     def fill_grad_row(pay, grad_fn, gargs):
         """Row-order gradient mode for objectives whose gradients need
         global row structure (lambdarank's query groups, xentropy weights):
@@ -793,6 +805,7 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
     gr.to_tree_arrays = to_tree_arrays
     gr.apply_scores = apply_scores
     gr.fill_grad = fill_grad
+    gr.fill_grad_pos = fill_grad_pos
     gr.fill_grad_row = fill_grad_row
     gr.fill_grad_multi = fill_grad_multi
     gr.snapshot_scores = snapshot_scores
@@ -809,13 +822,15 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
     return gr
 
 
-def make_scan_driver(gr, gc, k: int, grad_fn, row_order: bool = False,
+def make_scan_driver(gr, gc, k: int, grad_fn, grad_mode: str = "payload",
                      wrap_jit: bool = True, bag_fn=None):
     """K fused boosting iterations over the persistent payload.
 
-    grad_fn is baked statically: payload mode takes (score_pos, label_pos);
-    row_order mode takes (score_row, *gargs) — the objective's standard
-    grad function (lambdarank etc.), fed by a per-tree scatter/gather
+    grad_fn is baked statically; grad_mode selects its contract:
+    'payload' takes (score_pos, label_pos); 'pos' takes
+    (score_pos, rid, live, *gargs) all in payload order (lambdarank's
+    scatter-through-rid mode); 'row' takes (score_row, *gargs) — the
+    objective's standard grad function fed by a per-tree scatter/gather
     through the rid row. Returns fn(pay, fmasks [k, F], wkeys [k, 2]u32,
     iters [k]i32, params, shrink, gargs) -> (pay', stacked TreeArrays).
 
@@ -852,7 +867,9 @@ def make_scan_driver(gr, gc, k: int, grad_fn, row_order: bool = False,
                     outs.append(gr.to_tree_arrays(lstate, tree, nl))
                 out = jax.tree.map(lambda *xs: jnp.stack(xs), *outs)
                 return pay, out
-            if row_order:
+            if grad_mode == "pos":
+                pay = gr.fill_grad_pos(pay, grad_fn, gargs)
+            elif grad_mode == "row":
                 pay = gr.fill_grad_row(pay, grad_fn, gargs)
             else:
                 pay = gr.fill_grad(pay, grad_fn)

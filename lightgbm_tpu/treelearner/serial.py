@@ -547,15 +547,13 @@ class SerialTreeLearner:
                                           objective.payload_grad_fn_multi(),
                                           bag_fn=bag_fn)
             else:
-                pfn = objective.payload_grad_fn()
-                if pfn is not None:
-                    driver = make_scan_driver(gr, self.grow_config, k, pfn,
-                                              bag_fn=bag_fn)
-                else:
-                    # row-order gradient mode (lambdarank query groups)
-                    driver = make_scan_driver(gr, self.grow_config, k,
-                                              objective.grad_fn(),
-                                              row_order=True, bag_fn=bag_fn)
+                mode = objective.persist_grad_mode()
+                fns = {"payload": objective.payload_grad_fn,
+                       "pos": objective.payload_pos_fn,
+                       "row": objective.grad_fn}
+                driver = make_scan_driver(gr, self.grow_config, k,
+                                          fns[mode](), grad_mode=mode,
+                                          bag_fn=bag_fn)
             cache[dkey] = driver
         return assets, gr, driver
 
@@ -573,7 +571,7 @@ class SerialTreeLearner:
                               jnp.asarray(wkeys, jnp.uint32),
                               jnp.asarray(iters, jnp.int32), self.params,
                               jnp.asarray(shrink, jnp.float64),
-                              objective._grad_args())
+                              objective.persist_grad_args())
         self._persist_carry = pay
         self._persist_gr = gr
         return stacked

@@ -90,7 +90,8 @@ def test_pallas_scan_used_on_default_config_shapes():
     """resolve_scan_impl must pick the kernel exactly for the fast path."""
     from lightgbm_tpu.treelearner.serial import resolve_scan_impl
     base = dict(use_dp=False, use_mc=False, use_l1=False, use_mds=False,
-                extra_trees=False, bynode_k=0, use_cegb=False)
+                extra_trees=False, bynode_k=0, use_cegb=False,
+                num_features=28, scan_width=256)
     cfg = lgb.Config({})
     # CPU backend in tests -> xla even for the fast path
     assert resolve_scan_impl(cfg, dict(base)) == "xla"
