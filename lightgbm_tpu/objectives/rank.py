@@ -19,6 +19,8 @@ stochastic gradients too.
 """
 from __future__ import annotations
 
+import functools
+
 import numpy as np
 
 import jax
@@ -103,9 +105,6 @@ class LambdarankNDCG(RankingObjective):
             .astype(np.float32)
         self._gains_pad = self.label_gain[self._lab_pad.astype(np.int64)] \
             .astype(np.float64)
-        self._w_pad = (np.where(self._qvalid, self.weight[safe], 0.0)
-                       .astype(np.float32)
-                       if self.weight is not None else None)
         if self._chunk <= 0:
             # budget the [chunk, P, P] pairwise intermediates to ~256MB:
             # tiny chunks turn lax.map into hundreds of sequential
@@ -245,36 +244,43 @@ class LambdarankNDCG(RankingObjective):
         n = self.num_data
 
         def fn(score, rid, live, lab_pad, qvalid, inv_max_dcgs, gains_pad,
-               discounts, pos_of_rid, w_pad):
+               discounts, pos_of_rid):
             Q, P = lab_pad.shape
             QP = Q * P
             NP = score.shape[0]
-            pos = pos_of_rid[jnp.minimum(rid, n - 1)]        # [NP]
+            bc32 = functools.partial(jax.lax.bitcast_convert_type,
+                                     new_dtype=jnp.float32)
+            rid_c = jnp.minimum(rid, n - 1)
+            # pos_of_rid is None when the row->slot map is the identity
+            # (all queries the same length, no padding): skip the gather
+            pos = rid_c if pos_of_rid is None else pos_of_rid[rid_c]
             pos = jnp.where(live, pos, QP)
-            sp = jnp.zeros((QP,), score.dtype).at[pos].set(
-                score, mode="drop", unique_indices=True)
+            # ONE scatter plants both the padded scores and the inverse
+            # slot->lane map (lane ids bitcast to ride the f32 scatter);
+            # dead slots keep lane NP so the return scatter drops them
+            lane = jnp.arange(NP, dtype=jnp.int32)
+            init = jnp.stack([
+                jnp.zeros((QP,), jnp.float32),
+                jnp.broadcast_to(bc32(jnp.asarray(NP, jnp.int32)), (QP,))])
+            spl = init.at[:, pos].set(
+                jnp.stack([score, bc32(lane)]), mode="drop",
+                unique_indices=True)
+            sp = spl[0]
+            inv = jax.lax.bitcast_convert_type(spl[1], jnp.int32)
             lam, hes = core(sp.reshape(Q, P), lab_pad, qvalid, inv_max_dcgs,
                             gains_pad, discounts)
+            # weighted ranking never reaches this fn: can_persist_scan
+            # gates the persist path on an unweighted dataset
             lam = lam[:QP]
             hes = hes[:QP]
-            if w_pad is not None:
-                # multiply BEFORE the f32 cast — same precision order as
-                # grad_fn, so weighted runs keep row/pos-mode bit-parity
-                lam = lam * w_pad.reshape(-1)
-                hes = hes * w_pad.reshape(-1)
-            lam = lam.astype(jnp.float32)
-            hes = hes.astype(jnp.float32)
-            # return via SCATTER through the inverse slot->lane map, not a
-            # gather: on TPU an [NP]-sized gather serializes (~15 ms at
-            # 2.3M rows) while the equivalent scatters run in ~1 ms
-            lane = jnp.arange(NP, dtype=jnp.int32)
-            inv = jnp.full((QP,), NP, jnp.int32).at[pos].set(
-                lane, mode="drop", unique_indices=True)
-            g = jnp.zeros((NP,), jnp.float32).at[inv].set(
-                lam, mode="drop", unique_indices=True)
-            h = jnp.zeros((NP,), jnp.float32).at[inv].set(
-                hes, mode="drop", unique_indices=True)
-            return g, h
+            # return via ONE scatter through the inverse map, not gathers:
+            # on TPU an [NP]-sized gather serializes while the scatter of
+            # a [2, n] block costs about the same as a [n] one
+            out = jnp.zeros((2, NP), jnp.float32).at[:, inv].set(
+                jnp.stack([lam.astype(jnp.float32),
+                           hes.astype(jnp.float32)]),
+                mode="drop", unique_indices=True)
+            return out[0], out[1]
         return fn
 
     def _pos_grad_args(self):
@@ -284,14 +290,16 @@ class LambdarankNDCG(RankingObjective):
         if cached is None:
             P = self._qidx.shape[1]
             from ..metrics.dcg import _DISCOUNT_CACHE
+            # equal-length queries make the row->slot map the identity;
+            # pass None and the pos fn skips that [n]-sized gather
+            identity = bool(np.array_equal(
+                self._inv_pos, np.arange(self.num_data, dtype=np.int32)))
             cached = self._pos_args_dev = (
                 jnp.asarray(self._lab_pad), jnp.asarray(self._qvalid),
                 jnp.asarray(self.inverse_max_dcgs),
                 jnp.asarray(self._gains_pad),
                 jnp.asarray(_DISCOUNT_CACHE[:P]),
-                jnp.asarray(self._inv_pos),
-                (jnp.asarray(self._w_pad) if self._w_pad is not None
-                 else None))
+                (None if identity else jnp.asarray(self._inv_pos)))
         return cached
 
     def _grad_args(self):

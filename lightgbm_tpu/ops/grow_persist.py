@@ -420,11 +420,20 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
     if kernel_impl == "xla":
         split_pass = make_xla_split_pass(WPA, NP, G, plan, nbw)
         root_hist = make_xla_root_hist(WPA, NP, G, plan, nbw, n)
+        seg_hist = None
     else:
+        from .pallas_grow import make_seg_hist
         # every score/snapshot row must ride the partition
         wp_live = nbw + 4 + K + (K if K > 1 else 0)
+        # the smaller-child histogram runs as a SEPARATE post-partition
+        # segment pass (make_seg_hist): split_pass skips its in-pass
+        # masked accumulation, so each tree level histograms ~n/2 rows
+        # (the smaller children) instead of all n
         split_pass = make_split_pass(WPA, NP, G, plan, nbw, C=C,
-                                     interpret=interpret, wp_live=wp_live)
+                                     interpret=interpret, wp_live=wp_live,
+                                     _skip_hist=True)
+        seg_hist = make_seg_hist(WPA, NP, G, plan, nbw, C=C,
+                                 interpret=interpret)
         root_hist = make_root_hist(WPA, NP, G, plan, nbw, n, C=CR,
                                    interpret=interpret)
     grad_row = nbw + 2
@@ -570,13 +579,23 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
             scal = scal.at[S_DL].set(bl[BC_DL].astype(I32))
             scal = scal.at[S_SMALL_L].set(smaller_is_left.astype(I32))
             pay, hist_sm, n_left = split_pass(st.pay, scal)
-            sm_g, sm_h = hist_sm
             # n_l == 0 skips the kernel (zero grid steps) and leaves its
             # histogram/count outputs undefined; mask before sums/psum
             ran = n_l > 0
-            sm_g = jnp.where(ran, sm_g, 0.0)
-            sm_h = jnp.where(ran, sm_h, 0.0)
             n_left = jnp.where(ran, n_left, 0)
+            if seg_hist is not None:
+                # post-partition smaller-child segment histogram; the
+                # smaller side is chosen from GLOBAL stats (S_SMALL_L), so
+                # sharded runs histogram the same child on every shard
+                start_sm = jnp.where(smaller_is_left, s0, s0 + n_left)
+                len_sm = jnp.where(smaller_is_left, n_left, n_l - n_left)
+                sm_g, sm_h = seg_hist(pay, start_sm, len_sm)
+                ran_h = len_sm > 0
+            else:
+                sm_g, sm_h = hist_sm
+                ran_h = ran
+            sm_g = jnp.where(ran_h, sm_g, 0.0)
+            sm_h = jnp.where(ran_h, sm_h, 0.0)
             n_right = n_l - n_left
             if axis_name is not None:
                 # per-split histogram reduction

@@ -255,6 +255,13 @@ def make_split_pass(WPA: int, NP: int, G: int, plan, nbw: int,
             st[5] = 0
             st[6] = 0
             hist_ref[...] = jnp.zeros_like(hist_ref)
+            if interpret:
+                # on hardware pay_out IS pay_in (input_output_aliases) and
+                # every read below goes through pay_out; interpreter mode
+                # does not alias, so seed the output with the input once
+                cpi = pltpu.make_async_copy(pay_in, pay_out, sem_r)
+                cpi.start()
+                cpi.wait()
 
         # ---- drain phase first: write slot (i-2)%2 ----------------------
         # (drain before read so the read below may refill the same slot)
@@ -271,7 +278,7 @@ def make_split_pass(WPA: int, NP: int, G: int, plan, nbw: int,
             al = _align128(lf)
             dL = lf - al
             cp = pltpu.make_async_copy(
-                pay_in.at[:, pl.ds(al, E)], rbuf, sem_rmw)
+                pay_out.at[:, pl.ds(al, E)], rbuf, sem_rmw)
             cp.start()
             cp.wait()
             sel = (lane >= dL) & (lane < dL + nL_)
@@ -293,7 +300,7 @@ def make_split_pass(WPA: int, NP: int, G: int, plan, nbw: int,
             al2 = _align128(rs)
             dR = rs - al2
             cp2 = pltpu.make_async_copy(
-                pay_in.at[:, pl.ds(al2, E)], rbuf, sem_rmw)
+                pay_out.at[:, pl.ds(al2, E)], rbuf, sem_rmw)
             cp2.start()
             cp2.wait()
             sel2 = (lane >= dR) & (lane < dR + nR_)
@@ -324,7 +331,7 @@ def make_split_pass(WPA: int, NP: int, G: int, plan, nbw: int,
 
             al = _align128(ptr)
             cp = pltpu.make_async_copy(
-                pay_in.at[:, pl.ds(al, E)], wbuf, sem_r)
+                pay_out.at[:, pl.ds(al, E)], wbuf, sem_r)
             cp.start()
             cp.wait()
             d = ptr - al
@@ -403,8 +410,10 @@ def make_split_pass(WPA: int, NP: int, G: int, plan, nbw: int,
 
     @jax.jit
     def split_pass(pay, scalars):
-        do_run = scalars[S_NL] > 0
-        grid = jnp.where(do_run, scalars[S_NCH] + 2, 0).astype(jnp.int32)
+        # ALWAYS run the init/fin steps even for an empty segment (grid 2,
+        # no read/drain work): a zero grid would skip the interpreter-mode
+        # pay_in -> pay_out seed and return an uninitialized payload
+        grid = (scalars[S_NCH] + 2).astype(jnp.int32)
         # trace the kernel with 32-bit default dtypes: under jax_enable_x64
         # (on for reference-parity f64 host math) weak-int promotion inside
         # Mosaic recurses/lowers to unsupported i64
@@ -450,6 +459,86 @@ def make_split_pass(WPA: int, NP: int, G: int, plan, nbw: int,
         )(scalars, pay)
 
     return split_pass
+
+
+# ---------------------------------------------------------------------------
+# seg_hist
+# ---------------------------------------------------------------------------
+
+def make_seg_hist(WPA: int, NP: int, G: int, plan, nbw: int,
+                  C: int = 16384, interpret: bool = False):
+    """Histogram of one contiguous payload segment (dynamic start/length).
+
+    Runs AFTER split_pass has partitioned a leaf: the smaller child's rows
+    are contiguous, so the histogram streams exactly those rows — the
+    leaf-wise subtraction trick then charges each tree level ~n/2 histogram
+    rows instead of the ~n that in-split masked accumulation pays (the
+    reference's ordered-bin smaller-leaf walk, include/LightGBM/bin.h:229,
+    achieves the same economy row-wise on CPU).
+
+    Returns fn(pay, start, length) -> (gh [G*256], hh [G*256]) f32; outputs
+    are UNDEFINED when length == 0 (zero grid steps) — callers mask.
+    """
+    assert WPA % 8 == 0
+    E = C + 128
+    grad_row = nbw + 2
+
+    def kernel(ns, pay_hbm, hist_ref, wbuf, sem_r):
+        i = pl.program_id(0)
+
+        @pl.when(i == 0)
+        def _init():
+            hist_ref[...] = jnp.zeros_like(hist_ref)
+
+        ptr = ns[1] + i * C
+        m = jnp.minimum(jnp.int32(C), ns[2] - i * C)
+        al = _align128(ptr)
+        cp = pltpu.make_async_copy(
+            pay_hbm.at[:, pl.ds(al, E)], wbuf, sem_r)
+        cp.start()
+        cp.wait()
+        d = ptr - al
+        w = pltpu.roll(wbuf[...], jax.lax.sub(jnp.int32(E), d), 1)
+        lane = _lane_iota(E)[0]
+        valid = (lane < m).astype(F32)
+        grad = _f32r(w[grad_row, :]) * valid
+        hess = _f32r(w[grad_row + 1, :]) * valid
+        bins_g = _unpack_group_bins(w, plan)
+        _hist_accum(hist_ref, bins_g, grad, hess, G)
+
+    E_ = E
+    _vmem_req = min(96 << 20,
+                    2 * WPA * E_ * 4 + G * 16 * 64 * 4 + (20 << 20))
+    _cparams = pltpu.CompilerParams(vmem_limit_bytes=int(_vmem_req))
+
+    @jax.jit
+    def seg_hist(pay, start, length):
+        nch = (length + C - 1) // C
+        grid = jnp.where(length > 0, nch, 0).astype(jnp.int32)
+        scalars = jnp.stack([nch, start, length]).astype(jnp.int32)
+        with jax.enable_x64(False):
+            hist = pl.pallas_call(
+                kernel,
+                grid_spec=pltpu.PrefetchScalarGridSpec(
+                    num_scalar_prefetch=1,
+                    grid=(grid,),
+                    in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+                    out_specs=[
+                        pl.BlockSpec((G, 16, 64),
+                                     lambda i, s: (i * 0, i * 0, i * 0)),
+                    ],
+                    scratch_shapes=[
+                        pltpu.VMEM((WPA, E), U32),
+                        pltpu.SemaphoreType.DMA,
+                    ],
+                ),
+                out_shape=[jax.ShapeDtypeStruct((G, 16, 64), F32)],
+                compiler_params=_cparams,
+                interpret=interpret,
+            )(scalars, pay)[0]
+        return _unpack_hist(hist)
+
+    return seg_hist
 
 
 # ---------------------------------------------------------------------------

@@ -292,3 +292,44 @@ def test_persist_lambdarank_pos_mode_matches_row_mode(monkeypatch):
         if mx > 0:
             nd.append(cal_dcg_at_k(5, lab, sc, lg) / mx)
     assert np.mean(nd) > 0.75, np.mean(nd)
+
+
+def test_persist_mosaic_kernels_interpret_match_emulation(monkeypatch):
+    """The production TPU kernel path (split_pass with _skip_hist +
+    make_seg_hist post-partition histogram) run in Pallas INTERPRETER mode
+    must reproduce the XLA-emulation trees — covers the Mosaic wiring
+    (chunk DMA alignment rolls, lane masks, FIFO drains, seg_hist
+    start/len) that the emulation-only tests never touch."""
+    from lightgbm_tpu.treelearner.serial import SerialTreeLearner
+    X, y = _data(seed=97)
+    n_small, rounds = 2048, ROUNDS   # >= the fused batch size, so the
+    Xs, ys = X[:n_small], y[:n_small]   # persist driver engages
+    base = {"objective": "binary", "num_leaves": 7, "verbosity": -1,
+            "min_data_in_leaf": 10, "max_bin": 31, "learning_rate": 0.2,
+            "tpu_persist_scan": "force"}
+    bst_emu = lgb.train(dict(base), lgb.Dataset(Xs, ys), rounds,
+                        verbose_eval=False)
+    monkeypatch.setattr(SerialTreeLearner, "_persist_kernel_mode",
+                        staticmethod(lambda: ("pallas", True)))
+    bst_mos = lgb.train(dict(base), lgb.Dataset(Xs, ys), rounds,
+                        verbose_eval=False)
+    assert getattr(bst_mos._booster.tree_learner, "_persist_carry",
+                   None) is not None
+    s_e, v_e = _tree_tuples(bst_emu)
+    s_m, v_m = _tree_tuples(bst_mos)
+    assert s_e == s_m
+    np.testing.assert_allclose(v_e, v_m, rtol=1e-4, atol=1e-6)
+    # early-stopping trees (min_data exhausts the splits before num_leaves)
+    # exercise the ZERO-GRID split_pass: the payload must pass through
+    # unharmed even though no chunk steps run (interpret has no aliasing)
+    stop = {**base, "num_leaves": 31, "min_data_in_leaf": 600}
+    bst_s = lgb.train(dict(stop), lgb.Dataset(Xs, ys), rounds,
+                      verbose_eval=False)
+    s_s, _ = _tree_tuples(bst_s)
+    nl = sum(1 for e in s_s if e[0] == "leaf")
+    assert nl < rounds * 31, "expected early-stopped trees"
+    monkeypatch.undo()
+    bst_se = lgb.train(dict(stop), lgb.Dataset(Xs, ys), rounds,
+                       verbose_eval=False)
+    s_se, _ = _tree_tuples(bst_se)
+    assert s_s == s_se
