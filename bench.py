@@ -103,6 +103,22 @@ def main():
               "%.2fM row-iters/s, vs anchor (2.27M*500/215.3s = 5.27M): "
               "%.4f" % (ltr["rows"], ltr["iters"], ltr["train_s"],
                         ltr["value"], ltr["vs_baseline"]), file=sys.stderr)
+    vote = None
+    if os.environ.get("BENCH_SKIP_VOTING", "") != "1":
+        try:
+            vote = run_voting()
+        except Exception as exc:
+            print("# voting phase failed: %r" % exc, file=sys.stderr)
+    if vote is not None:
+        result["voting_value"] = vote["value"]
+        result["voting_vs_baseline"] = vote["vs_baseline"]
+        print(json.dumps(result), flush=True)
+        print("# voting-parallel (PV-tree persist, %d-device mesh): rows=%d "
+              "iters=%d train=%.1fs -> %.2fM row-iters/s (vs the same CPU "
+              "anchor: %.4f)" % (vote["devices"], vote["rows"],
+                                 vote["iters"], vote["train_s"],
+                                 vote["value"], vote["vs_baseline"]),
+              file=sys.stderr)
 
 
 # MS-LTR anchor: 2.27M rows x 137 features, lambdarank, 500 iters in
@@ -135,6 +151,36 @@ def run_ltr():
     return {"rows": n_rows, "iters": n_iters, "train_s": train_s,
             "value": round(throughput / 1e6, 3),
             "vs_baseline": round(throughput / LTR_THROUGHPUT, 4)}
+
+
+def run_voting():
+    """Voting-parallel throughput on the available mesh (PV-tree on the
+    sharded persist driver). On a 1-chip box the mesh is degenerate but the
+    full voting program (local scan, vote psum, selective reduce) runs —
+    the line tracks its overhead vs the plain persist path."""
+    import jax
+    import lightgbm_tpu as lgb
+    n_rows = int(os.environ.get("BENCH_VOTING_ROWS", 4_000_000))
+    n_iters = int(os.environ.get("BENCH_VOTING_ITERS", 120))
+    X, y = make_higgs_like(n_rows)
+    ds = lgb.Dataset(X, y)
+    ds.construct()
+    params = {"objective": "binary", "num_leaves": 255, "max_bin": 255,
+              "verbosity": -1, "metric": "none", "tree_learner": "voting",
+              "top_k": 14}
+    warm = lgb.train(dict(params), ds, 17, verbose_eval=False)
+    warm._booster._materialize_pending()
+    del warm
+    t0 = time.time()
+    bst = lgb.train(dict(params), ds, n_iters, verbose_eval=False)
+    bst._booster._materialize_pending()
+    jax.block_until_ready(bst._booster.train_score.score_device(0))
+    train_s = time.time() - t0
+    throughput = n_rows * n_iters / train_s
+    return {"rows": n_rows, "iters": n_iters, "train_s": train_s,
+            "devices": len(jax.devices()),
+            "value": round(throughput / 1e6, 3),
+            "vs_baseline": round(throughput / REF_THROUGHPUT, 4)}
 
 
 if __name__ == "__main__":

@@ -466,11 +466,16 @@ class GBDT:
         k = self._batch_size()
         if k > 1:
             return self._train_multi_iter_fast(k)
-        if getattr(self, "_persist_bag_active", False):
+        if (getattr(self, "_persist_bag_active", False)
+                or getattr(self.tree_learner, "_persist_carry", None)
+                is not None):
             # device bagging already ran in a fused batch: the tail
             # iterations must keep drawing the same hash-keyed window bags
             # (a host redraw mid-window would break the cached-bag
-            # contract, gbdt.cpp:210-244) — run them as k=1 batches
+            # contract, gbdt.cpp:210-244). Likewise a LIVE persist carry
+            # keeps the tail on the persist driver as k=1 batches — the
+            # v1 per-iteration path would sync scores out and, for the
+            # voting/data learners, re-dispatch the far slower XLA eval
             return self._train_multi_iter_fast(1)
         self._sync_persist_scores()
         ntpi = self.num_tree_per_iteration

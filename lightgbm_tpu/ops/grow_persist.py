@@ -440,6 +440,14 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
     score_row = nbw + 4            # class k's score row = score_row + k
     snap_row = nbw + 4 + K         # class k's snapshot row (K > 1 only)
 
+    # PV-tree voting-parallel (voting_parallel_tree_learner.cpp:153-344):
+    # histogram planes stay shard-LOCAL; per split each shard proposes its
+    # top_k features from a local scan, a psum'd vote picks 2k winners,
+    # and only the winners' bins are globally summed before the real scan
+    voting = axis_name is not None and gc.parallel_mode == "voting"
+    K_TOP = min(max(int(gc.top_k), 1), F)
+    N_WIN = min(2 * K_TOP, F)
+
     # padded meta for the dense scan: feature f's window at flat f*W
     pad_meta = meta._replace(
         bin_start=jnp.arange(F, dtype=I32) * W,
@@ -456,8 +464,8 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
         Returns a [2, 12] f32 best-candidate matrix.
         """
         pad_f = ((0, 0), (0, layout.Fp - G), (0, 0))
-        gb = jnp.pad(gh[rows].reshape(2, G, W), pad_f)
-        hb = jnp.pad(hh[rows].reshape(2, G, W), pad_f)
+        g2 = gh[rows]                                  # [2, TBp]
+        h2 = hh[rows]
         p32 = params.cast(F32)
         sg = sgs.astype(F32)
         sh = shs.astype(F32) + F32(2e-15)
@@ -468,12 +476,67 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
         mgs = gain_shift + p32.min_gain_to_split.astype(F32)
         md = p32.min_data_in_leaf.astype(F32)
         mh = p32.min_sum_hessian_in_leaf.astype(F32)
+        valid_r, valid_f = layout.valid_r, layout.valid_f
+        if voting:
+            # local proposal scan: 1/S-scaled thresholds on the LOCAL
+            # planes with exact local sums (each row lands in one bin of
+            # each of the G groups, so plane_sum / G = local leaf sum)
+            Sn = jax.lax.psum(jnp.asarray(1.0, F32), axis_name)
+            local_sg = jnp.sum(g2, axis=1) / F32(max(G, 1))
+            local_sh = jnp.sum(h2, axis=1) / F32(max(G, 1)) + F32(2e-15)
+            local_cnt = jnp.round(local_sh * cnt
+                                  / jnp.maximum(sh, F32(1e-12)))
+            scal_l = jnp.stack([
+                local_sg, local_sh, local_cnt, local_cnt / local_sh,
+                jnp.broadcast_to(jnp.maximum(jnp.floor(md / Sn), 1.0),
+                                 (2,)),
+                jnp.broadcast_to(mh / Sn, (2,)),
+                local_sg * local_sg / (local_sh + l2)
+                + p32.min_gain_to_split.astype(F32),
+                jnp.broadcast_to(l2, (2,))], axis=1)
+            gb_l = jnp.pad(g2.reshape(2, G, W), pad_f)
+            hb_l = jnp.pad(h2.reshape(2, G, W), pad_f)
+            out_l = scan_pair(scal_l, gb_l, hb_l, layout.keep_r,
+                              layout.keep_f, valid_r, valid_f, layout.aux,
+                              interpret=interpret)
+            local_gains = out_l[:, 0, :][:, :F]        # [2, F]
+            neg = jnp.asarray(K_MIN_SCORE, F32)
+            vl = []
+            for c in range(2):
+                lg_ = local_gains[c]
+                _, ti = jax.lax.top_k(lg_, K_TOP)
+                vl.append(jnp.zeros((F,), I32).at[ti].add(
+                    (lg_[ti] > neg).astype(I32)))
+            votes = jax.lax.psum(jnp.stack(vl), axis_name)     # [2, F]
+            # stable ranking: ties keep the smaller feature id; the 2k
+            # quota always fills (GlobalVoting, :153-184)
+            rank_key = votes * F - jnp.arange(F, dtype=I32)[None]
+            _, win_idx = jax.lax.top_k(rank_key, N_WIN)        # [2, N_WIN]
+            # the ACTUAL communication compression: gather only the 2k
+            # winners' bin windows, psum that compact buffer, and scatter
+            # back — [2, 2, N_WIN, W] over the wire instead of the full
+            # [2, 2, TBp] planes (CopyLocalHistogram + ReduceScatter,
+            # voting_parallel_tree_learner.cpp:186-243)
+            g3 = g2.reshape(2, G, W)
+            h3 = h2.reshape(2, G, W)
+            gw = jnp.take_along_axis(g3, win_idx[:, :, None], axis=1)
+            hw = jnp.take_along_axis(h3, win_idx[:, :, None], axis=1)
+            red = jax.lax.psum(jnp.stack([gw, hw]), axis_name)
+            ar2 = jnp.arange(2, dtype=I32)[:, None]
+            g2 = g3.at[ar2, win_idx].set(red[0]).reshape(2, TBp)
+            h2 = h3.at[ar2, win_idx].set(red[1]).reshape(2, TBp)
+            winb = jnp.zeros((2, F), BOOL).at[ar2, win_idx].set(True)
+            winp = jnp.pad(winb, ((0, 0), (0, layout.Fp - G)))
+            valid_r = valid_r[None] * winp[:, :, None].astype(F32)
+            valid_f = valid_f[None] * winp[:, :, None].astype(F32)
+        gb = jnp.pad(g2.reshape(2, G, W), pad_f)
+        hb = jnp.pad(h2.reshape(2, G, W), pad_f)
         scal = jnp.stack([
             sg, sh, cnt, cf,
             jnp.broadcast_to(md, (2,)), jnp.broadcast_to(mh, (2,)),
             mgs, jnp.broadcast_to(l2, (2,))], axis=1)
         out = scan_pair(scal, gb, hb, layout.keep_r, layout.keep_f,
-                        layout.valid_r, layout.valid_f, layout.aux,
+                        valid_r, valid_f, layout.aux,
                         interpret=interpret)
         gains = out[:, 0, :]
         best_f = jnp.argmax(gains, axis=1)
@@ -516,11 +579,13 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
         root_cnt = (jnp.asarray(n, ST) if bag_cnt is None
                     else bag_cnt.astype(ST))
         if axis_name is not None:
-            # root Allreduce (data_parallel_tree_learner.cpp:120-145)
+            # root Allreduce (data_parallel_tree_learner.cpp:120-145);
+            # voting keeps the PLANES local — only scalar stats go global
             sums = jax.lax.psum(sums, axis_name)
-            gh0 = jax.lax.psum(gh0, axis_name)
-            hh0 = jax.lax.psum(hh0, axis_name)
             root_cnt = jax.lax.psum(root_cnt, axis_name)
+            if not voting:
+                gh0 = jax.lax.psum(gh0, axis_name)
+                hh0 = jax.lax.psum(hh0, axis_name)
         sum_grad = sums[0]
         sum_hess = sums[1]
         p32 = params.cast(F32)
@@ -597,10 +662,12 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
             sm_g = jnp.where(ran_h, sm_g, 0.0)
             sm_h = jnp.where(ran_h, sm_h, 0.0)
             n_right = n_l - n_left
-            if axis_name is not None:
+            if axis_name is not None and not voting:
                 # per-split histogram reduction
                 # (data_parallel_tree_learner.cpp:163-234); n_left/n_right
-                # stay shard-local for the payload segment geometry
+                # stay shard-local for the payload segment geometry.
+                # Voting mode skips this: planes stay local and eval_pair
+                # psums only the globally voted features' bins
                 sm_g = jax.lax.psum(sm_g, axis_name)
                 sm_h = jax.lax.psum(sm_h, axis_name)
             if stat_from_scan:

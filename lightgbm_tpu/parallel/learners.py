@@ -141,7 +141,10 @@ class DataParallelTreeLearner(SerialTreeLearner):
     # unchanged against the wrapper this _persist_cached returns.
 
     def _persist_axis_ok(self) -> bool:
-        return (self.grow_config.parallel_mode not in ("voting", "feature")
+        # data-parallel AND voting-parallel ride the sharded persist
+        # driver (voting = local planes + in-eval vote, grow_persist);
+        # feature-parallel replicates rows and keeps the v1 path
+        return (self.grow_config.parallel_mode != "feature"
                 and self.dataset.num_data % self.num_shards == 0)
 
     def _persist_rows_ok(self) -> bool:
@@ -250,22 +253,33 @@ class VotingParallelTreeLearner(DataParallelTreeLearner):
 
     def __init__(self, config, dataset, mesh: Mesh = None):
         super().__init__(config, dataset, mesh=mesh)
-        # the fused pair scan has an experimental PV-tree path
-        # (local scan/vote/selective psum in ops/grow._make_eval_pair_fused)
-        # but its vote ordering does not yet reproduce the XLA voting eval
-        # split-for-split, so voting stays on the XLA scan unless the user
-        # forces tpu_scan_impl=pallas explicitly
-        forced_pallas = str(config.tpu_scan_impl).lower() == "pallas"
-        if forced_pallas and np.any(dataset.needs_fix):
+        # the fast path: voting runs on the sharded PERSIST driver (local
+        # histogram planes + in-eval vote, ops/grow_persist), which needs
+        # scan_impl to stay as resolved. The V1 fused pair scan's PV-tree
+        # path is still opt-in only (its vote ordering does not reproduce
+        # the XLA voting eval split-for-split), so v1 builds downgrade to
+        # the XLA scan in _build unless the user forces pallas.
+        self._forced_pallas = (str(config.tpu_scan_impl).lower()
+                               == "pallas")
+        if self._forced_pallas and np.any(dataset.needs_fix):
             Log.warning("tpu_scan_impl=pallas: the fused voting scan does "
                         "not implement the EFB histogram fix-up; using the "
                         "XLA voting eval for this bundled dataset")
-        scan = ("xla" if not forced_pallas or np.any(dataset.needs_fix)
-                else self.grow_config.scan_impl)
         self.grow_config = self.grow_config._replace(
-            parallel_mode="voting", top_k=int(config.top_k),
-            scan_impl=scan)
+            parallel_mode="voting", top_k=int(config.top_k))
         self._sharded_grow = None
+
+    def _build(self):
+        gc = self.grow_config
+        if gc.scan_impl == "pallas" and (not self._forced_pallas
+                                         or np.any(self.dataset.needs_fix)):
+            saved = gc
+            self.grow_config = gc._replace(scan_impl="xla")
+            try:
+                return super()._build()
+            finally:
+                self.grow_config = saved
+        return super()._build()
 
 
 class FeatureParallelTreeLearner(SerialTreeLearner):

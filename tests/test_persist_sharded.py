@@ -333,3 +333,26 @@ def test_persist_mosaic_kernels_interpret_match_emulation(monkeypatch):
                        verbose_eval=False)
     s_se, _ = _tree_tuples(bst_se)
     assert s_s == s_se
+
+
+def test_persist_voting_full_vote_matches_data_parallel():
+    """Voting-parallel on the sharded persist driver: with 2*top_k >= F
+    every feature wins the vote, the selective psum covers the whole
+    histogram, and the trees must match the data-parallel persist run
+    (PV-tree exactness condition, voting_parallel_tree_learner.cpp:153)."""
+    X, y = _data(seed=43)
+    bst_data = _train(X, y, "data")
+    bst_vote = _train(X, y, "voting", extra={"top_k": F})
+    s_d, v_d = _tree_tuples(bst_data)
+    s_v, v_v = _tree_tuples(bst_vote)
+    assert s_d == s_v
+    np.testing.assert_allclose(v_d, v_v, rtol=2e-5, atol=2e-6)
+
+
+def test_persist_voting_small_vote_learns():
+    """top_k below F engages the real PV-tree approximation: the model
+    still learns (the reference makes the same accuracy trade)."""
+    X, y = _data(seed=47)
+    bst = _train(X, y, "voting", extra={"top_k": 2})
+    acc = ((bst.predict(X) > 0.5) == y).mean()
+    assert acc > 0.85, acc
