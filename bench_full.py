@@ -161,3 +161,30 @@ def main():
 
 if __name__ == "__main__":
     main()
+
+
+# Expo anchor: 11M rows x ~700 one-hot features, 500 iters in 138.5s
+# (docs/Experiments.rst:112) => 39.7M row-iters/s
+EXPO_SECONDS = 138.5
+
+
+def make_expo_like(n_rows=2_000_000, seed=0):
+    """Expo-shaped synthetic: a few dense numerics plus one-hot blocks
+    that EFB bundles into a handful of byte groups."""
+    rng = np.random.default_rng(seed)
+    nd = 8
+    blocks = [50, 30, 24, 24, 12, 300, 200]
+    Xd = rng.normal(size=(n_rows, nd)).astype(np.float32)
+    cols = [Xd]
+    sig = Xd[:, 0] * 0.5
+    for card in blocks:
+        ids = rng.integers(0, card, n_rows)
+        oh = np.zeros((n_rows, card), np.float32)
+        oh[np.arange(n_rows), ids] = 1.0
+        cols.append(oh)
+        sig = sig + (ids % 7 == 0) * 0.4
+    X = np.concatenate(cols, axis=1)
+    y = (sig + rng.logistic(size=n_rows) * 0.7 > 0.3)
+    # f32 halves the ~10GB peak a dense f64 one-hot matrix would cost;
+    # the binner accepts any float input
+    return X, y.astype(np.float64)

@@ -80,7 +80,8 @@ class BinaryLogloss(ObjectiveFunction):
         return (jnp.asarray(self._pos_mask), weight)
 
     def payload_grad_fn(self):
-        if self.weight is not None or not self.need_train:
+        # weights ride the payload and multiply AFTER this fn
+        if not self.need_train:
             return None
         base = self.grad_fn()
 

@@ -77,9 +77,8 @@ class MulticlassSoftmax(ObjectiveFunction):
         class (O(K^2 N) per iteration instead of O(K N)): the payload
         permutes between class trees, so a shared denominator would need
         its own payload row — not worth one until profiles say the exp/sum
-        shows up next to the split kernels."""
-        if self.weight is not None:
-            return None
+        shows up next to the split kernels. Weights ride the payload
+        and multiply AFTER this fn (grow_persist._apply_weight)."""
 
         def fn(scores, label, cls):
             m = jnp.max(scores, axis=0)
@@ -144,9 +143,8 @@ class MulticlassOVA(ObjectiveFunction):
 
     def payload_grad_fn_multi(self):
         """Per-class one-vs-all binary grads (multiclass_objective.hpp:180+);
-        class k's positives are payload-label == k."""
-        if self.weight is not None:
-            return None
+        class k's positives are payload-label == k; weights multiply
+        after (grow_persist._apply_weight)."""
         if not all(b.need_train for b in self.binary_losses):
             return None
         fns = [b.grad_fn() for b in self.binary_losses]

@@ -105,6 +105,9 @@ class LambdarankNDCG(RankingObjective):
             .astype(np.float32)
         self._gains_pad = self.label_gain[self._lab_pad.astype(np.int64)] \
             .astype(np.float64)
+        self._w_pad = (np.where(self._qvalid, self.weight[safe], 0.0)
+                       .astype(np.float32)
+                       if self.weight is not None else None)
         if self._chunk <= 0:
             # budget the [chunk, P, P] pairwise intermediates to ~256MB:
             # tiny chunks turn lax.map into hundreds of sequential
@@ -244,7 +247,7 @@ class LambdarankNDCG(RankingObjective):
         n = self.num_data
 
         def fn(score, rid, live, lab_pad, qvalid, inv_max_dcgs, gains_pad,
-               discounts, pos_of_rid):
+               discounts, pos_of_rid, w_pad):
             Q, P = lab_pad.shape
             QP = Q * P
             NP = score.shape[0]
@@ -269,10 +272,15 @@ class LambdarankNDCG(RankingObjective):
             inv = jax.lax.bitcast_convert_type(spl[1], jnp.int32)
             lam, hes = core(sp.reshape(Q, P), lab_pad, qvalid, inv_max_dcgs,
                             gains_pad, discounts)
-            # weighted ranking never reaches this fn: can_persist_scan
-            # gates the persist path on an unweighted dataset
             lam = lam[:QP]
             hes = hes[:QP]
+            if w_pad is not None:
+                # weights multiply BEFORE the f32 cast, exactly as the
+                # row-order grad_fn does (rank_objective.hpp:165-170) —
+                # pos-mode fns own their weighting; the grower's payload
+                # weight row is not applied in pos mode
+                lam = lam * w_pad.reshape(-1)
+                hes = hes * w_pad.reshape(-1)
             # return via ONE scatter through the inverse map, not gathers:
             # on TPU an [NP]-sized gather serializes while the scatter of
             # a [2, n] block costs about the same as a [n] one
@@ -299,7 +307,9 @@ class LambdarankNDCG(RankingObjective):
                 jnp.asarray(self.inverse_max_dcgs),
                 jnp.asarray(self._gains_pad),
                 jnp.asarray(_DISCOUNT_CACHE[:P]),
-                (None if identity else jnp.asarray(self._inv_pos)))
+                (None if identity else jnp.asarray(self._inv_pos)),
+                (jnp.asarray(self._w_pad) if self._w_pad is not None
+                 else None))
         return cached
 
     def _grad_args(self):

@@ -47,7 +47,9 @@ class RegressionL2Loss(ObjectiveFunction):
         return fn
 
     def payload_grad_fn(self):
-        if self.weight is not None or self.sqrt:
+        # weights ride the payload and multiply AFTER this fn
+        # (grow_persist._apply_weight); sqrt needs the transformed label
+        if self.sqrt:
             return None
         base = self.grad_fn()
 

@@ -30,10 +30,13 @@ row-ordered score/gradient plumbing around it:
 Numerics: f32 accumulation everywhere (the reference GPU learner's
 gpu_use_dp=false trade); trees match the v1 f32 grower up to f32 summation
 order. Gated by treelearner.serial.can_persist_scan — anything outside the
-fast path (categoricals, EFB bundles, weights, monotone, f64) takes the
-v1 path. Bagging and GOSS run INSIDE the scan as payload transforms
+fast path (categoricals, monotone, f64) takes the v1 path; sample weights
+ride as a payload row, EFB bundles decode in the split kernel with an
+in-eval FixHistogram, and lambdarank computes payload-position gradients.
+Bagging and GOSS run INSIDE the scan as payload transforms
 (make_bag_transform), and the whole driver also runs sharded under
-shard_map (make_persist_grower's axis_name) with in-loop histogram psum.
+shard_map (make_persist_grower's axis_name) with in-loop histogram psum —
+plain data-parallel or PV-tree voting (winner-window-only reduction).
 """
 from __future__ import annotations
 
@@ -45,9 +48,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from .grow import TreeArrays
-from .pallas_grow import (N_SCALARS, S_DB, S_DL, S_MASK, S_MT, S_NB, S_NCH,
-                          S_NL, S_S0, S_SH, S_SMALL_L, S_THR, S_WG,
-                          make_root_hist, make_split_pass)
+from .pallas_grow import (N_SCALARS, S_DB, S_DL, S_LE, S_LS, S_MASK, S_MF,
+                          S_MT, S_NB, S_NCH, S_NL, S_S0, S_SH, S_SMALL_L,
+                          S_THR, S_WG, make_root_hist, make_split_pass)
 from .pallas_scan import ScanLayout, scan_pair
 from .split import K_MIN_SCORE, SplitParams
 
@@ -81,21 +84,37 @@ class PersistAssets(NamedTuple):
     nb: jnp.ndarray            # [F] i32 per-feature bin count
     mt: jnp.ndarray            # [F] i32 missing type
     db: jnp.ndarray            # [F] i32 default bin
-    geometry: tuple            # (WPA, NP, G, plan, nbw, n, C, CR, K) static
+    ls: jnp.ndarray            # [F] i32 group-local byte range start (EFB)
+    le: jnp.ndarray            # [F] i32 range end
+    mf: jnp.ndarray            # [F] i32 most_freq (feature-local) bin
+    geometry: tuple            # (WPA, NP, G, plan, nbw, n, C, CR, K,
+    #                          #  has_w) static
+    efb: tuple                 # host-side np layout for the eval closure:
+    #                          # (group_of [F], ls [F], nb [F], mf [F],
+    #                          #  needs_fix [F] bool, bundled flag)
+
+
+def payload_weight_row(nbw: int, num_scores: int) -> int:
+    """Row index of the optional weight row == live-row count without it
+    (bins | label | rid | grad | hess | score*K [| snapshot*K])."""
+    K = num_scores
+    return nbw + 4 + K + (K if K > 1 else 0)
 
 
 def _payload_geometry(n: int, G: int, C: int, CR: int,
-                      num_scores: int = 1):
+                      num_scores: int = 1, has_weight: bool = False):
     """Payload rows: bins words | label | rid | grad | hess | score*K
-    [| snapshot*K when K > 1]. Multiclass (K = num_class trees per
-    iteration) carries one score row per class plus an iteration-start
+    [| snapshot*K when K > 1] [| weight]. Multiclass (K = num_class trees
+    per iteration) carries one score row per class plus an iteration-start
     snapshot block: the reference computes all K classes' gradients from
     the PRE-iteration scores (GBDT::Boosting once per TrainOneIter,
     src/boosting/gbdt.cpp:152,338-420), so per-class softmax grads read
-    the snapshot while per-class score updates land in the live rows."""
+    the snapshot while per-class score updates land in the live rows.
+    Weighted datasets append one f32 weight row that rides the partition;
+    unweighted payloads pay nothing."""
     nbw = (G + 3) // 4
     K = num_scores
-    WP = nbw + 4 + K + (K if K > 1 else 0)
+    WP = payload_weight_row(nbw, K) + (1 if has_weight else 0)
     WPA = ((WP + 7) // 8) * 8
     if C <= 0:
         # split_pass VMEM scales with WPA (7 chunk-sized u32 buffers + the
@@ -111,7 +130,7 @@ def _payload_geometry(n: int, G: int, C: int, CR: int,
 
 def _pack_payload(binned: np.ndarray, labels: np.ndarray, n: int,
                   WPA: int, NP: int, nbw: int, rid_offset: int,
-                  rid_sentinel: int):
+                  rid_sentinel: int, weights=None, weight_row: int = 0):
     """One shard's payload matrix from its binned rows + labels. Row ids
     are GLOBAL (shard offset baked in): the bag transforms hash them, so
     draws must agree between serial and sharded runs; finalize_scores
@@ -129,6 +148,9 @@ def _pack_payload(binned: np.ndarray, labels: np.ndarray, n: int,
         labels.astype(np.float32)).view(np.uint32)
     pay[nbw + 1, :n] = rid_offset + np.arange(n, dtype=np.uint32)
     pay[nbw + 1, n:] = rid_sentinel          # dropped at finalize
+    if weights is not None:
+        pay[weight_row, :n] = np.ascontiguousarray(
+            weights.astype(np.float32)).view(np.uint32)
     return pay, plan
 
 
@@ -138,6 +160,8 @@ def build_assets(dataset, labels: np.ndarray, C: int = 0,
     """Host-side payload construction (once per dataset).
 
     dataset: BinnedDataset with groups == features, widths <= 256.
+    Sample weights (metadata.weight) ride as one extra payload row — see
+    _payload_geometry.
     With num_shards > 1 the rows are cut into equal contiguous blocks
     (num_data % num_shards == 0 required; the sharded fast-path gate checks
     this) and pay0 holds the per-shard payloads concatenated on the lane
@@ -156,32 +180,52 @@ def build_assets(dataset, labels: np.ndarray, C: int = 0,
         raise NotImplementedError  # packing plan assumes byte storage
     G = binned.shape[1]
     labels = np.asarray(labels)
-    nbw, WPA, C, NP = _payload_geometry(n, G, C, CR, num_scores)
+    weight = dataset.metadata.weight
+    weight = None if weight is None else np.asarray(weight)
+    has_w = weight is not None
+    nbw, WPA, C, NP = _payload_geometry(n, G, C, CR, num_scores, has_w)
+    K = num_scores
+    weight_row = payload_weight_row(nbw, K)
     blocks = []
     plan = None
     for k in range(num_shards):
         pay_k, plan = _pack_payload(binned[k * n:(k + 1) * n],
                                     labels[k * n:(k + 1) * n], n, WPA, NP,
                                     nbw, rid_offset=k * n,
-                                    rid_sentinel=n_total)
+                                    rid_sentinel=n_total,
+                                    weights=(weight[k * n:(k + 1) * n]
+                                             if has_w else None),
+                                    weight_row=weight_row)
         blocks.append(pay_k)
     pay = blocks[0] if num_shards == 1 else np.concatenate(blocks, axis=1)
     F = dataset.num_features
-    sc = np.arange(F, dtype=np.int32)
+    # feature f's storage byte lives in column group_of[f]; its bins
+    # occupy the group-local range [ls, le) (bundled groups put several
+    # features plus the local-bin-0 sentinel in one byte)
+    group_of = dataset.group_of.astype(np.int32)
+    ls = (dataset.bin_start - dataset.group_offset[group_of]) \
+        .astype(np.int32)
+    nb_np = (dataset.bin_end - dataset.bin_start).astype(np.int32)
+    mf_np = dataset.most_freq_bin.astype(np.int32)
+    needs_fix = np.asarray(dataset.needs_fix, dtype=bool)
+    bundled = bool(G != F or needs_fix.any() or np.any(ls != 0))
     # pay0 stays a HOST array: the sharded caller device_puts it with a
     # per-shard layout (materializing the whole payload on one device
     # first would spike that device's HBM by the full dataset size)
     return PersistAssets(
         pay0=pay,
-        dec_word=jnp.asarray(sc // 4),
-        dec_shift=jnp.asarray((sc % 4) * 8),
+        dec_word=jnp.asarray(group_of // 4),
+        dec_shift=jnp.asarray((group_of % 4) * 8),
         dec_mask=jnp.asarray(np.full(F, 255, np.int32)),
-        nb=jnp.asarray((dataset.bin_end - dataset.bin_start)
-                       .astype(np.int32)),
+        nb=jnp.asarray(nb_np),
         mt=jnp.asarray(dataset.missing_type_arr.astype(np.int32)),
         db=jnp.asarray(dataset.default_bin.astype(np.int32)),
+        ls=jnp.asarray(ls),
+        le=jnp.asarray(ls + nb_np),
+        mf=jnp.asarray(mf_np),
         geometry=(WPA, NP, G, tuple(plan), nbw, n, C, CR,
-                  num_scores),
+                  num_scores, has_w),
+        efb=(group_of, ls, nb_np, mf_np, needs_fix, bundled),
     )
 
 
@@ -205,8 +249,10 @@ def make_xla_split_pass(WPA: int, NP: int, G: int, plan, nbw: int):
         lane = jnp.arange(NP, dtype=I32)
         in_seg = (lane >= s0) & (lane < s0 + n_l)
         word = jnp.take(pay, scal[S_WG], axis=0)
-        b = ((word >> scal[S_SH].astype(U32))
-             & scal[S_MASK].astype(U32)).astype(I32)
+        b_raw = ((word >> scal[S_SH].astype(U32))
+                 & scal[S_MASK].astype(U32)).astype(I32)
+        in_r = (b_raw >= scal[S_LS]) & (b_raw < scal[S_LE])
+        b = jnp.where(in_r, b_raw - scal[S_LS], scal[S_MF])
         cmp_left = b <= scal[S_THR]
         is_na = (scal[S_MT] == 2) & (b == scal[S_NB] - 1)
         is_zero = (scal[S_MT] == 1) & (b == scal[S_DB])
@@ -402,6 +448,7 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
     """
     WPA, NP, G, plan, nbw, n, C, CR = assets.geometry[:8]
     K = assets.geometry[8] if len(assets.geometry) > 8 else 1
+    has_w = bool(assets.geometry[9]) if len(assets.geometry) > 9 else False
     F = gc.num_features
     L = gc.num_leaves
     W = 256
@@ -423,8 +470,8 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
         seg_hist = None
     else:
         from .pallas_grow import make_seg_hist
-        # every score/snapshot row must ride the partition
-        wp_live = nbw + 4 + K + (K if K > 1 else 0)
+        # every score/snapshot/weight row must ride the partition
+        wp_live = payload_weight_row(nbw, K) + (1 if has_w else 0)
         # the smaller-child histogram runs as a SEPARATE post-partition
         # segment pass (make_seg_hist): split_pass skips its in-pass
         # masked accumulation, so each tree level histograms ~n/2 rows
@@ -439,6 +486,7 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
     grad_row = nbw + 2
     score_row = nbw + 4            # class k's score row = score_row + k
     snap_row = nbw + 4 + K         # class k's snapshot row (K > 1 only)
+    weight_row = payload_weight_row(nbw, K)          # only when has_w
 
     # PV-tree voting-parallel (voting_parallel_tree_learner.cpp:153-344):
     # histogram planes stay shard-LOCAL; per split each shard proposes its
@@ -448,10 +496,16 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
     K_TOP = min(max(int(gc.top_k), 1), F)
     N_WIN = min(2 * K_TOP, F)
 
-    # padded meta for the dense scan: feature f's window at flat f*W
+    # padded meta for the dense scan: feature f's window sits inside its
+    # storage group's [G, 256] block at the group-local offset (ls = 0 and
+    # group_of = identity when nothing is bundled, i.e. flat f*W)
+    group_of_np, ls_np, nb_np, mf_np, needs_fix_np, bundled = assets.efb
+    win_start_np = (group_of_np.astype(np.int64) * W + ls_np).astype(
+        np.int32)
     pad_meta = meta._replace(
-        bin_start=jnp.arange(F, dtype=I32) * W,
-        bin_end=jnp.arange(F, dtype=I32) * W + assets.nb)
+        bin_start=jnp.asarray(win_start_np),
+        bin_end=jnp.asarray(win_start_np + nb_np))
+    has_fix = bool(needs_fix_np.any())
 
     def eval_pair(gh, hh, rows, sgs, shs, cnts, depth_child, params,
                   layout: ScanLayout):
@@ -529,8 +583,38 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
             winp = jnp.pad(winb, ((0, 0), (0, layout.Fp - G)))
             valid_r = valid_r[None] * winp[:, :, None].astype(F32)
             valid_f = valid_f[None] * winp[:, :, None].astype(F32)
-        gb = jnp.pad(g2.reshape(2, G, W), pad_f)
-        hb = jnp.pad(h2.reshape(2, G, W), pad_f)
+        if bundled:
+            # EFB layouts: feature windows sit at offsets inside group
+            # blocks — assemble the scan input with the layout gather
+            # (the same per-split cost the v1 eval pays on bundled data)
+            gb = g2[:, layout.gidx]
+            hb = h2[:, layout.gidx]
+        else:
+            gb = jnp.pad(g2.reshape(2, G, W), pad_f)
+            hb = jnp.pad(h2.reshape(2, G, W), pad_f)
+        if has_fix:
+            # FixHistogram (src/io/dataset.cpp:1410) at the scan-input
+            # level: a bundled feature's most_freq bin is never stored, so
+            # its slot gets child_total - window_sum (the mf slot's own
+            # contribution cancels out of the residual)
+            Fp, Wp = layout.Fp, layout.Wp
+            w_ar = np.arange(Wp)
+            win_m = jnp.asarray(
+                (w_ar[None, :] < np.pad(nb_np, (0, Fp - F))[:, None])
+                .astype(np.float32))
+            fix_rows = np.pad(needs_fix_np.astype(np.float32),
+                              (0, Fp - F))
+            oh = np.zeros((Fp, Wp), np.float32)
+            oh[np.arange(F), np.clip(mf_np, 0, Wp - 1)] = \
+                needs_fix_np.astype(np.float32)
+            oh_mf = jnp.asarray(oh)
+            fix_rows_d = jnp.asarray(fix_rows)
+            gsum = jnp.sum(gb * win_m, axis=2)             # [2, Fp]
+            hsum = jnp.sum(hb * win_m, axis=2)
+            res_g = (sg[:, None] - gsum) * fix_rows_d
+            res_h = (shs.astype(F32)[:, None] - hsum) * fix_rows_d
+            gb = gb + res_g[:, :, None] * oh_mf[None]
+            hb = hb + res_h[:, :, None] * oh_mf[None]
         scal = jnp.stack([
             sg, sh, cnt, cf,
             jnp.broadcast_to(md, (2,)), jnp.broadcast_to(mh, (2,)),
@@ -643,6 +727,9 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
             scal = scal.at[S_THR].set(bl[BC_THR].astype(I32))
             scal = scal.at[S_DL].set(bl[BC_DL].astype(I32))
             scal = scal.at[S_SMALL_L].set(smaller_is_left.astype(I32))
+            scal = scal.at[S_LS].set(assets.ls[f])
+            scal = scal.at[S_LE].set(assets.le[f])
+            scal = scal.at[S_MF].set(assets.mf[f])
             pay, hist_sm, n_left = split_pass(st.pay, scal)
             # n_l == 0 skips the kernel (zero grid steps) and leaves its
             # histogram/count outputs undefined; mask before sums/psum
@@ -796,10 +883,20 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
         return jax.lax.dynamic_update_slice(
             pay, gh, (jnp.asarray(grad_row, I32), jnp.asarray(0, I32)))
 
+    def _apply_weight(g, h, pay):
+        """Per-row weight multiply AFTER the objective's unweighted
+        gradients — the reference objectives' uniform weighted form
+        (e.g. binary_objective.hpp GetGradients: response * weight)."""
+        if not has_w:
+            return g, h
+        w = _f32r(pay[weight_row])
+        return g * w, h * w
+
     def fill_grad(pay, payload_grad_fn):
         label = jax.lax.bitcast_convert_type(pay[nbw], F32)
         score = jax.lax.bitcast_convert_type(pay[score_row], F32)
         g, h = payload_grad_fn(score, label)
+        g, h = _apply_weight(g, h, pay)
         return _write_grads(pay, g, h)
 
     def snapshot_scores(pay):
@@ -815,6 +912,7 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
         scores = jax.lax.bitcast_convert_type(
             pay[snap_row:snap_row + K], F32)            # [K, NP]
         g, h = payload_grad_fn_multi(scores, label, cls)
+        g, h = _apply_weight(g, h, pay)
         return _write_grads(pay, g, h)
 
     def finalize_scores(pay):
@@ -843,6 +941,10 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
         rid = pay[nbw + 1].astype(I32)
         score = _f32r(pay[score_row])
         live = jnp.arange(NP, dtype=I32) < n
+        # pos-mode fns own their weighting (they get the weights through
+        # gargs in whatever layout suits them — lambdarank multiplies the
+        # padded plane BEFORE its f32 cast, matching the row-order path
+        # bit for bit); the payload weight row is NOT applied here
         g, h = pos_grad_fn(score, rid, live, *gargs)
         return _write_grads(pay, g, h)
 

@@ -43,10 +43,11 @@ Both kernels keep the histogram in the PADDED [G, 256] per-group layout
 split scan is a reshape — no gather, no scatter (v1's _hist_acc_finish
 scatter and dense-scan gather cost ~80us per split).
 
-Gated to the fast path: numerical features only, groups == features (no
-EFB bundles), <= 256 bins per feature, f32 accumulation. Everything else
-falls back to ops/grow.py. Equivalence is tested on CPU in interpreter
-mode against the v1 growers (tests/test_persist_grower.py).
+Gated to the fast path: numerical features only, <= 256 bins per feature,
+f32 accumulation; EFB-bundled groups decode in the split kernel via the
+[LS, LE) group-local range scalars. Everything else falls back to
+ops/grow.py. Equivalence is tested on CPU against the XLA kernel
+emulation and the v1 growers (tests/test_persist_sharded.py).
 """
 from __future__ import annotations
 
@@ -82,9 +83,12 @@ S_NB = 6          # feature bin count
 S_MT = 7          # missing type (0 none / 1 zero / 2 nan)
 S_DB = 8          # default (zero) bin
 S_THR = 9         # threshold (local bin)
-S_DL = 10         # default_left flag
+S_DL = 10        # default_left flag
 S_SMALL_L = 11    # smaller child is the left one
-N_SCALARS = 12
+S_LS = 12         # feature's group-local byte range start (EFB bundles)
+S_LE = 13         # range end; bytes outside [LS, LE) read as most_freq
+S_MF = 14         # most_freq (feature-local) bin
+N_SCALARS = 15
 
 
 def _log2_ceil(x: int) -> int:
@@ -338,12 +342,17 @@ def make_split_pass(WPA: int, NP: int, G: int, plan, nbw: int,
             w = pltpu.roll(wbuf[...], jax.lax.sub(jnp.int32(E), d), 1)   # chunk rows at lanes 0..m
             valid = lane < m
 
-            # decision (numerical; dense_bin.hpp:112 semantics)
+            # decision (numerical; dense_bin.hpp:112 semantics). Bundled
+            # (EFB) features read the group byte: values outside the
+            # feature's [LS, LE) range belong to another bundle member or
+            # the sentinel — the row is at this feature's most_freq bin
             word = w[0, :] * U32(0)
             for r_ in range(nbw):
                 word = jnp.where(ns[S_WG] == r_, w[r_, :], word)
-            b = ((word >> ns[S_SH].astype(U32)) & ns[S_MASK].astype(U32)) \
-                .astype(I32)
+            b_raw = ((word >> ns[S_SH].astype(U32))
+                     & ns[S_MASK].astype(U32)).astype(I32)
+            in_r = (b_raw >= ns[S_LS]) & (b_raw < ns[S_LE])
+            b = jnp.where(in_r, b_raw - ns[S_LS], ns[S_MF])
             cmp_left = b <= ns[S_THR]
             is_na = (ns[S_MT] == 2) & (b == ns[S_NB] - 1)
             is_zero = (ns[S_MT] == 1) & (b == ns[S_DB])

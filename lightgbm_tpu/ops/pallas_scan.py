@@ -37,6 +37,7 @@ import jax.numpy as jnp
 
 try:  # pallas is part of jax, but guard for exotic builds
     from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
     HAS_PALLAS = True
 except Exception:  # pragma: no cover
     HAS_PALLAS = False
@@ -197,8 +198,13 @@ def scan_pair(scal, gb, hb, keep_r, keep_f, valid_r, valid_f, aux,
     if valid_f.ndim == 2:
         valid_f = jnp.broadcast_to(valid_f, (2, Fp, Wp))
     scal = jnp.zeros((2, 1, 128), jnp.float32).at[:, 0, :8].set(scal)
+    # the kernel stages ~12 [Fp, Wp] f32 blocks plus Mosaic temporaries;
+    # the default scoped-vmem budget OOMs past ~450 features at Wp=256
+    # (v5e carries 128MB of VMEM, so size the limit to the footprint)
+    _vmem = min(100 << 20, 16 * Fp * Wp * 4 + (20 << 20))
     return pl.pallas_call(
         _scan_kernel,
+        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=int(_vmem)),
         grid=(2,),
         in_specs=[
             pl.BlockSpec((1, 1, 128), lambda c: (c, c * 0, c * 0)),

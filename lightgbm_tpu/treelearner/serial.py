@@ -468,10 +468,11 @@ class SerialTreeLearner:
         """True when the whole K-iteration scan can run on the persistent
         transposed payload (fused split kernel, no per-row gathers).
         Requirements beyond the Pallas-scan fast path: numerical features
-        only, one feature per group (no EFB bundles), <= 256 bins, label-
-        only objective, unweighted, per-payload rows < 2^24. Single device
-        or the data-parallel learner (sharded persist). tpu_persist_scan=
-        force engages the XLA kernel emulation off-TPU (tests)."""
+        only, <= 256 bins, per-payload rows < 2^24; sample weights ride
+        as a payload row and EFB bundles decode in the split kernel.
+        Single device or the data/voting-parallel learners (sharded
+        persist). tpu_persist_scan=force engages the XLA kernel emulation
+        off-TPU (tests)."""
         import jax
         from ..ops.pallas_grow import HAS_PALLAS
         ds = self.dataset
@@ -488,20 +489,24 @@ class SerialTreeLearner:
             if ds.num_data < PARTITION_MIN_ROWS:
                 return False
         widths = (ds.bin_end - ds.bin_start) if ds.num_features else None
+        bundled = (len(ds.groups) != ds.num_features
+                   or bool(np.any(ds.needs_fix)))
         return (gc.n_forced == 0
                 and not gc.use_cegb_lazy
                 and not gc.multival
                 and not gc.packed_4bit
                 and self.cat_layout.cat_feature.shape[0] == 0
                 and ds.num_features > 0
-                and len(ds.groups) == ds.num_features
-                and not bool(np.any(ds.needs_fix))
+                # EFB bundles ride the persist path (group-byte decode in
+                # split_pass + windowed scan + in-eval FixHistogram); the
+                # voting eval's winner gather is block-shaped, so bundled
+                # voting stays on the v1 path
+                and not (bundled and gc.parallel_mode == "voting")
                 and int(widths.max()) <= 256
                 and self._persist_rows_ok()
                 and self._persist_axis_ok()
                 and objective is not None
-                and self._persist_obj_ok(objective)
-                and ds.metadata.weight is None)
+                and self._persist_obj_ok(objective))
 
     @staticmethod
     def _persist_kernel_mode():
