@@ -47,6 +47,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils.log import Log
 from .grow import TreeArrays
 from .pallas_grow import (N_SCALARS, S_DB, S_DL, S_LE, S_LS, S_MASK, S_MF,
                           S_MT, S_NB, S_NCH, S_NL, S_S0, S_SH, S_SMALL_L,
@@ -156,7 +157,8 @@ def _pack_payload(binned: np.ndarray, labels: np.ndarray, n: int,
 
 def build_assets(dataset, labels: np.ndarray, C: int = 0,
                  CR: int = 16384, num_shards: int = 1,
-                 num_scores: int = 1) -> PersistAssets:
+                 num_scores: int = 1,
+                 use_weight_row: bool = True) -> PersistAssets:
     """Host-side payload construction (once per dataset).
 
     dataset: BinnedDataset with groups == features, widths <= 256.
@@ -180,7 +182,10 @@ def build_assets(dataset, labels: np.ndarray, C: int = 0,
         raise NotImplementedError  # packing plan assumes byte storage
     G = binned.shape[1]
     labels = np.asarray(labels)
-    weight = dataset.metadata.weight
+    # pos-mode objectives (lambdarank) take weights through their own
+    # gradient args — the caller then skips the payload row entirely
+    # (use_weight_row=False) so no dead row rides every partition
+    weight = dataset.metadata.weight if use_weight_row else None
     weight = None if weight is None else np.asarray(weight)
     has_w = weight is not None
     nbw, WPA, C, NP = _payload_geometry(n, G, C, CR, num_scores, has_w)
@@ -335,7 +340,56 @@ def _hash_uniform(rid, wkey):
     return x.astype(F32) * F32(1.0 / 4294967296.0)
 
 
-def make_bag_transform(bag_spec, geometry):
+def _kth_largest(vals: jnp.ndarray, live: jnp.ndarray, k, axis_name=None):
+    """EXACT k-th largest of the non-negative f32 `vals` over live lanes
+    (global over `axis_name` when set): a 32-round radix select on the
+    monotone u32 bit pattern of non-negative floats. Matches the value a
+    full sort would pick (ties included), with only [1]-sized psums over
+    the mesh — the sharded replacement for jnp.sort(s)[n - k]."""
+    bits = jax.lax.bitcast_convert_type(vals, U32)
+
+    def body(i, t):
+        cand = t | (U32(1) << (U32(31) - i.astype(U32)))
+        cnt = jnp.sum((bits >= cand) & live, dtype=I32)
+        if axis_name is not None:
+            cnt = jax.lax.psum(cnt, axis_name)
+        return jnp.where(cnt >= k, cand, t)
+
+    t = jax.lax.fori_loop(0, 32, body, U32(0))
+    return jax.lax.bitcast_convert_type(t, F32)
+
+
+def make_goss_weight_fn(n_total: int, top_rate: float, other_rate: float,
+                        skip_iters: int, axis_name=None):
+    """Shared GOSS per-row weighting (goss.hpp:75-131): rows above the
+    GLOBAL top_rate |g*h| threshold kept at weight 1, the rest kept with
+    probability other_rate/(1-top_rate) amplified by (1-top_rate)/
+    other_rate; warmup iterations (< skip_iters) keep every row. One
+    implementation serves the persist bag transform AND the multihost
+    scan so the sampling constants cannot drift.
+
+    Returns fn(s, live, u, it) -> w [same shape as s] f32, where s is
+    |g*h| (non-negative, zero on dead lanes), u a per-row uniform draw.
+    """
+    if top_rate + other_rate >= 1.0:
+        Log.fatal("The sum of top_rate and other_rate cannot be 1.0")
+    top_k = max(1, int(n_total * top_rate))
+    p_rest = min(1.0, (n_total * other_rate) / max(n_total - top_k, 1))
+    amp = (n_total - top_k) / max(n_total * other_rate, 1.0)
+
+    def fn(s, live, u, it):
+        thr = _kth_largest(s, live, top_k, axis_name)
+        big = live & (s >= thr)
+        w = jnp.where(big, F32(1.0),
+                      jnp.where(u < F32(p_rest), F32(amp), F32(0.0)))
+        w = jnp.where(live, w, F32(0.0))
+        return jnp.where(it < skip_iters, live.astype(F32), w)
+
+    return fn
+
+
+def make_bag_transform(bag_spec, geometry, axis_name=None,
+                       num_shards: int = 1):
     """Payload transform applied after the gradient fill: scales/zeroes the
     grad+hess rows per row and returns the in-bag count.
 
@@ -349,11 +403,17 @@ def make_bag_transform(bag_spec, geometry):
         probability other_rate/(1-top_rate) and amplified by
         (1-top_rate)/other_rate (goss.hpp:75-124; bernoulli where the
         reference samples exactly other_k — same expectation). Sampling
-        starts after skip_iters (goss.hpp:126-131).
+        starts after skip_iters (goss.hpp:126-131). The threshold is the
+        GLOBAL top_k-th |g*h| (radix select with psum'd counts), so
+        sharded runs redraw the identical bag.
+
+    axis_name/num_shards: set by the sharded persist learner — GOSS's
+    order statistic and the bag fractions are over the GLOBAL row count.
 
     Returns fn(pay, wkey [2]u32, it i32) -> (pay', bag_cnt f32 local).
     """
     WPA, NP, G, plan, nbw, n, C, CR = geometry[:8]
+    n_total = n * max(num_shards, 1)
     grad_row = nbw + 2
     mode = bag_spec[0]
 
@@ -390,23 +450,16 @@ def make_bag_transform(bag_spec, geometry):
 
     if mode == "goss":
         _, top_rate, other_rate, skip_iters = bag_spec
-        top_k = max(1, int(n * top_rate))
-        p_rest = min(1.0, (n * other_rate) / max(n - top_k, 1))
-        amp = (n - top_k) / max(n * other_rate, 1.0)
+        wfn = make_goss_weight_fn(n_total, top_rate, other_rate,
+                                  skip_iters, axis_name)
 
         def goss_fn(pay, wkey, it):
             live = jnp.arange(NP, dtype=I32) < n
             g = _f32r(pay[grad_row])
             h = _f32r(pay[grad_row + 1])
-            s = jnp.where(live, jnp.abs(g * h), -jnp.inf)
-            thr = jnp.sort(s)[NP - top_k]
-            big = s >= thr
+            s = jnp.where(live, jnp.abs(g * h), 0.0)
             u = _hash_uniform(pay[nbw + 1], wkey)
-            w = jnp.where(big, F32(1.0),
-                          jnp.where(u < F32(p_rest), F32(amp), F32(0.0)))
-            w = jnp.where(live, w, F32(0.0))
-            w = jnp.where(it < skip_iters, live.astype(F32), w)
-            return apply_w(pay, w)
+            return apply_w(pay, wfn(s, live, u, it))
 
         return goss_fn
 

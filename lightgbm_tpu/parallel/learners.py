@@ -158,9 +158,10 @@ class DataParallelTreeLearner(SerialTreeLearner):
         return objective.payload_grad_fn() is not None
 
     def persist_bag_ok(self, bag_spec) -> bool:
-        # bagging draws are row-local; the GOSS threshold is a global
-        # order statistic (needs a cross-shard quantile) — not yet sharded
-        return bag_spec[0] in ("none", "bagging")
+        # bagging draws are row-local; GOSS's global order statistic is a
+        # radix select on psum'd counts (grow_persist._kth_largest), so
+        # sharded runs reproduce the serial threshold exactly
+        return bag_spec[0] in ("none", "bagging", "goss")
 
     def _persist_cached(self, objective, k: int, bag_spec=("none",)):
         from ..ops.grow_persist import (EXACT_F32_ROWS, build_assets,
@@ -215,7 +216,8 @@ class DataParallelTreeLearner(SerialTreeLearner):
                 bag_spec)
         driver = cache.get(dkey)
         if driver is None:
-            bag_fn = (make_bag_transform(bag_spec, assets.geometry)
+            bag_fn = (make_bag_transform(bag_spec, assets.geometry,
+                                         axis_name=AXIS, num_shards=S)
                       if stat_from_scan else None)
             raw = make_scan_driver(wrapper.inner, gc, k,
                                    objective.payload_grad_fn(),

@@ -293,6 +293,16 @@ def train_multihost(config: Config, X_local: np.ndarray,
                   "num_machines > 1 (rank_xendcg draws per-iteration "
                   "host randomness)")
 
+    boosting = str(config.boosting).lower()
+    if boosting in ("dart", "rf", "random_forest"):
+        Log.fatal("boosting=%s is not supported with num_machines > 1 yet "
+                  "(per-iteration tree mutation/averaging needs the "
+                  "single-process driver)" % boosting)
+    use_goss = boosting == "goss"
+    if use_goss and K > 1:
+        Log.fatal("boosting=goss with num_class > 1 is not supported with "
+                  "num_machines > 1")
+
     # ---- global mesh + row-sharded device state ----------------------
     from ..treelearner.serial import SerialTreeLearner
     mesh = _global_mesh()
@@ -397,6 +407,20 @@ def train_multihost(config: Config, X_local: np.ndarray,
     bag_frac = (float(config.bagging_fraction)
                 if (config.bagging_freq > 0
                     and config.bagging_fraction < 1.0) else 1.0)
+    goss_wfn = None
+    if use_goss:
+        if bag_frac < 1.0:
+            Log.fatal("Cannot use bagging in GOSS")
+        n_glob_rows = int(ds.num_data)
+        # global row count: every rank contributes its shard size
+        if world > 1:
+            from jax.experimental import multihost_utils
+            n_glob_rows = int(np.sum(multihost_utils.process_allgather(
+                np.asarray([ds.num_data], np.int64))))
+        from ..ops.grow_persist import make_goss_weight_fn
+        goss_wfn = make_goss_weight_fn(
+            n_glob_rows, float(config.top_rate), float(config.other_rate),
+            int(1.0 / float(config.learning_rate)), AXIS)
 
     def _grow(bins, grad, hess, bag, fmask, extras):
         layout = DataLayout(bins, *layout_rest)
@@ -413,10 +437,10 @@ def train_multihost(config: Config, X_local: np.ndarray,
         K stacked tree records come back replicated, ONE transfer."""
 
         def body_fn(bins, gidx, valid, gargs, score0, fu0, fmasks, wkeys,
-                    keys):
+                    keys, its):
             def body(carry, per):
                 score, fu = carry
-                fmask, wkey, key = per
+                fmask, wkey, key, it_i = per
                 if bag_frac < 1.0:
                     u = _hash_uniform(gidx, wkey)
                     bag = valid & (u < jnp.float32(bag_frac))
@@ -428,6 +452,19 @@ def train_multihost(config: Config, X_local: np.ndarray,
                     g, h = grad_fn(score, *gargs)
                     g = g.astype(jnp.float32) * m
                     h = h.astype(jnp.float32) * m
+                    if use_goss:
+                        # the shared GOSS weighting (grow_persist.
+                        # make_goss_weight_fn): GLOBAL top-rate threshold
+                        # via radix select on psum'd counts; keep/amplify
+                        # draws hash global row ids at per-ITERATION keys
+                        # (the serial persist driver redraws each
+                        # iteration too — windows = iters for goss)
+                        s = jnp.where(valid, jnp.abs(g * h), 0.0)
+                        u = _hash_uniform(gidx, wkey)
+                        w = goss_wfn(s, valid, u, it_i)
+                        g = g * w
+                        h = h * w
+                        bag = w > 0
                     ex = base_extras._replace(key=key, feature_used=fu)
                     arrays, fu2 = _grow(bins, g, h, bag, fmask, ex)
                     upd = arrays.leaf_value.astype(jnp.float64)[
@@ -461,7 +498,7 @@ def train_multihost(config: Config, X_local: np.ndarray,
                 return (score2, fu2), stacked_c
 
             (scoreK, fuK), stacked = jax.lax.scan(
-                body, (score0, fu0), (fmasks, wkeys, keys), length=k)
+                body, (score0, fu0), (fmasks, wkeys, keys, its), length=k)
             return scoreK, fuK, stacked
 
         spec_gargs = tuple(garg_specs)
@@ -469,7 +506,7 @@ def train_multihost(config: Config, X_local: np.ndarray,
         return jax.jit(jax.shard_map(
             body_fn, mesh=mesh,
             in_specs=(P(AXIS, None), P(AXIS), P(AXIS), spec_gargs,
-                      score_spec, P(), P(), P(), P()),
+                      score_spec, P(), P(), P(), P(), P()),
             out_specs=(score_spec, P(), _tree_arrays_spec(gc,
                                                           row_sharded=False)),
             check_vma=False))
@@ -551,14 +588,18 @@ def train_multihost(config: Config, X_local: np.ndarray,
                       for _ in range(k * K)]))
         if K > 1:
             fmasks = fmasks.reshape(k, K, -1)
+        # goss redraws its sample every iteration (windows = iters, as the
+        # serial persist driver does); bagging windows follow bagging_freq
+        wwin = 1 if use_goss else freq
         wkeys = jnp.asarray(np.stack([
             np.asarray(jax.random.key_data(jax.random.fold_in(
-                base_key, (it + i) // freq))) for i in range(k)]),
+                base_key, (it + i) // wwin))) for i in range(k)]),
             jnp.uint32)
         keys = jnp.stack([learner._next_extras().key for _ in range(k)])
+        its = jnp.arange(it, it + k, dtype=jnp.int32)
         score, fu, stacked = runners[k](
             bins_g, gidx_g, valid_g, tuple(gargs_g), score, fu, fmasks,
-            wkeys, keys)
+            wkeys, keys, its)
         host = jax.device_get(stacked)          # ONE transfer per batch
         for i in range(k):
             class_trees = []

@@ -525,11 +525,14 @@ class SerialTreeLearner:
         if cache is None:
             cache = self.dataset._persist_cache = {}
         K = getattr(objective, "num_model_per_iteration", 1)
-        akey = ("assets", K)
+        # pos/row grad modes weight through their own args — only the
+        # 'payload' fill reads the payload weight row
+        use_w_row = objective.persist_grad_mode() == "payload"
+        akey = ("assets", K, use_w_row)
         assets = cache.get(akey)
         if assets is None:
             assets = build_assets(self.dataset, self.dataset.metadata.label,
-                                  num_scores=K)
+                                  num_scores=K, use_weight_row=use_w_row)
             cache[akey] = assets
         kernel_impl, interpret = self._persist_kernel_mode()
         stat_from_scan = bag_spec[0] != "none"

@@ -348,3 +348,71 @@ def test_python_api_distributed_lambdarank(tmp_path):
     r1 = json.load(open(outs[1]))
     assert r0["pred"] == r1["pred"]
     assert np.std(r0["pred"]) > 0.05   # learned a nontrivial ranking
+
+
+GOSS_WORKER = r"""
+import json, os, sys
+import numpy as np
+sys.path.insert(0, %(repo)r)
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 2)
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+rank = int(sys.argv[1])
+port = sys.argv[2]
+out = sys.argv[3]
+os.environ["JAX_PROCESS_ID"] = str(rank)
+
+import lightgbm_tpu as lgb
+
+rng = np.random.default_rng(31)
+n, nf = 2400, 6
+X = rng.normal(size=(n, nf))
+y = (X[:, 1] + 0.5 * X[:, 4] + rng.normal(size=n) * 0.3 > 0).astype(float)
+
+params = {"objective": "binary", "boosting": "goss", "num_leaves": 15,
+          "verbosity": -1, "num_machines": 2, "learning_rate": 0.2,
+          "machines": "127.0.0.1:%%s,127.0.0.1:0" %% port,
+          "top_rate": 0.2, "other_rate": 0.1,
+          "min_data_in_leaf": 5, "tree_learner": "data"}
+bst = lgb.train(params, lgb.Dataset(X, y), num_boost_round=12,
+                verbose_eval=False)
+pred = bst.predict(X[:400])
+acc = float(((pred > 0.5) == y[:400]).mean())
+with open(out, "w") as fh:
+    json.dump({"rank": rank, "acc": acc,
+               "pred": [round(float(p), 8) for p in pred[:200]]}, fh)
+"""
+
+
+@pytest.mark.slow
+def test_python_api_distributed_goss(tmp_path):
+    """boosting=goss over num_machines=2: the GLOBAL |g*h| threshold comes
+    from the radix select with psum'd counts, warmup keeps all rows, and
+    every rank materializes the identical model."""
+    port = _free_port()
+    script = tmp_path / "goss_worker.py"
+    script.write_text(GOSS_WORKER % {"repo": REPO})
+    outs = [str(tmp_path / f"goss_rank{r}.json") for r in range(2)]
+    procs = []
+    for r in range(2):
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env.pop("JAX_PLATFORMS", None)
+        procs.append(subprocess.Popen(
+            [sys.executable, str(script), str(r), str(port), outs[r]],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+    for p in procs:
+        try:
+            _, err = p.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("goss multihost worker timed out")
+        assert p.returncode == 0, err.decode()[-2000:]
+    r0 = json.load(open(outs[0]))
+    r1 = json.load(open(outs[1]))
+    assert r0["pred"] == r1["pred"]
+    assert r0["acc"] > 0.85, r0["acc"]

@@ -501,3 +501,24 @@ def test_persist_efb_sharded_matches_serial():
     s2, v2 = _tree_tuples(bst_d)
     assert s1 == s2
     np.testing.assert_allclose(v1, v2, rtol=1e-4, atol=2e-6)
+
+
+def test_persist_goss_sharded_matches_serial():
+    """Sharded GOSS redraws the serial bag exactly: the top-rate threshold
+    is the GLOBAL k-th largest |g*h| via radix select on psum'd counts,
+    and the keep/amplify draws hash global row ids."""
+    X, y = _data(seed=73)
+    extra = {"boosting": "goss", "top_rate": 0.2, "other_rate": 0.1}
+    bst_s = _train(X, y, "serial", extra=extra)
+    bst_d = _train(X, y, "data", extra=extra)
+    # early predictions match exactly (identical threshold + draws); deep
+    # into the run a row whose |g*h| sits at the threshold can flip on
+    # the f32 psum-vs-whole-sum score drift, so full models compare by
+    # quality
+    p_s = bst_s.predict(X[:1024], num_iteration=8)
+    p_d = bst_d.predict(X[:1024], num_iteration=8)
+    np.testing.assert_allclose(p_s, p_d, rtol=1e-4, atol=1e-6)
+    acc_s = ((bst_s.predict(X) > 0.5) == y).mean()
+    acc_d = ((bst_d.predict(X) > 0.5) == y).mean()
+    assert abs(acc_s - acc_d) < 0.01, (acc_s, acc_d)
+    assert acc_s > 0.85, acc_s
