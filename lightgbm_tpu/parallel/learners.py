@@ -82,16 +82,26 @@ class DataParallelTreeLearner(SerialTreeLearner):
 
         cat = self.cat_layout
         n_shard = (self.dataset.num_data + self._pad) // self.num_shards
-        use_part = n_shard >= PARTITION_MIN_ROWS
+        # the multi-value (ELL) layout always takes the masked grower
+        # (row-sparse scatter histograms have no partitioned variant)
+        use_part = n_shard >= PARTITION_MIN_ROWS and not gc.multival
         gw_global = self.gw_global
+        mv = bool(gc.multival)
+        # ELL row-sparse arrays are row-aligned: shard them WITH the rows
+        # (they ride as args, not closure constants, so shard_map splits
+        # them; pad rows carry the G sentinel group = contribute nothing)
+        ell_specs = (P(AXIS), P(AXIS)) if mv else ()
 
         @functools.partial(
             jax.shard_map, mesh=mesh,
-            in_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(), P()),
+            in_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(), P())
+            + ell_specs,
             out_specs=(_tree_arrays_spec(gc, row_sharded=True), P()),
             check_vma=False)
-        def run(bins, grad, hess, bag, fmask, extras):
+        def run(bins, grad, hess, bag, fmask, extras, *ell):
             layout = DataLayout(bins, *layout_rest)
+            if mv:
+                layout = layout._replace(ell_grp=ell[0], ell_bin=ell[1])
             if use_part:
                 return grow_tree_partitioned(
                     layout, grad, hess, bag, meta, params, fmask, fix, gc,
@@ -114,8 +124,18 @@ class DataParallelTreeLearner(SerialTreeLearner):
             hess = jnp.pad(hess, (0, pad))
             bag_mask = jnp.pad(bag_mask, (0, pad))
         fmask = jnp.asarray(self.col_sampler.sample())
+        ell = ()
+        if self.grow_config.multival:
+            ell = getattr(self, "_ell_padded", None)
+            if ell is None:
+                eg, eb = self.layout.ell_grp, self.layout.ell_bin
+                if pad:
+                    G = int(self.layout.group_offset.shape[0])
+                    eg = jnp.pad(eg, ((0, pad), (0, 0)), constant_values=G)
+                    eb = jnp.pad(eb, ((0, pad), (0, 0)))
+                ell = self._ell_padded = (eg, eb)
         arrays, fu = self._sharded_grow(bins, grad, hess, bag_mask, fmask,
-                                        self._next_extras())
+                                        self._next_extras(), *ell)
         self._feature_used_dev = fu
         if pad:
             arrays = arrays._replace(
@@ -310,7 +330,9 @@ class FeatureParallelTreeLearner(SerialTreeLearner):
         layout_rest = tuple(self.layout)[1:]   # all fields after bins
         #              (incl. the 4-bit unpack maps when packing is on)
         cat = self.cat_layout
-        use_part = self.dataset.num_data >= PARTITION_MIN_ROWS
+        # ELL always takes the masked grower (no partitioned variant)
+        use_part = (self.dataset.num_data >= PARTITION_MIN_ROWS
+                    and not gc.multival)
         gw_global = self.gw_global
 
         @functools.partial(
@@ -352,11 +374,6 @@ def create_parallel_learner(learner_type: str, config, dataset):
         # the [N, F] acquisition bitset lives in the masked grower's
         # full-N row space; sharded rows would need a gathered bitset
         Log.fatal("cegb_penalty_feature_lazy requires tree_learner=serial")
-    if (getattr(dataset, "is_multival", False)
-            or str(getattr(config, "tpu_multival", "auto")).lower()
-            == "force"):
-        Log.fatal("the multi-value (ELL) layout is not sharded yet; use "
-                  "tree_learner=serial or tpu_multival=off")
     if learner_type == "data":
         return DataParallelTreeLearner(config, dataset)
     if learner_type == "voting":

@@ -282,10 +282,6 @@ def train_multihost(config: Config, X_local: np.ndarray,
     if list(config.cegb_penalty_feature_lazy):
         Log.fatal("cegb_penalty_feature_lazy is not supported with "
                   "num_machines > 1 (per-row bitset needs unsharded rows)")
-    if str(config.tpu_multival).lower() == "force" \
-            or getattr(ds, "is_multival", False):
-        Log.fatal("the multi-value (ELL) layout is not supported with "
-                  "num_machines > 1 yet; use tpu_multival=off")
 
     is_ranking = ds.metadata.query_boundaries is not None
     if is_ranking and str(config.objective) != "lambdarank":
@@ -365,7 +361,24 @@ def train_multihost(config: Config, X_local: np.ndarray,
             widths = ((0, pad),) + ((0, 0),) * (a.ndim - 1)
             return np.pad(a, widths, constant_values=fill)
 
-    bins_g = _global_array(mesh, padded(np.ascontiguousarray(ds.binned)))
+    # evaluated AFTER the learner construction: to_device converts
+    # tpu_multival=force datasets to the ELL layout in place
+    use_mv = bool(getattr(ds, "is_multival", False))
+    if use_mv:
+        # ELL row-sparse: the placeholder dense matrix plus the row-aligned
+        # (group, bin) pair arrays, sharded WITH the rows (pad rows carry
+        # the G sentinel group and contribute nothing)
+        bins_local = np.zeros((ds.num_data, 1), np.uint8)
+        G_mv = len(ds.groups)
+        bins_g = _global_array(mesh, padded(bins_local))
+        ell_grp_g = _global_array(
+            mesh, padded(ds.ell_grp, fill=G_mv).astype(np.int32))
+        ell_bin_g = _global_array(mesh, padded(ds.ell_bin).astype(np.int32))
+        ell_g = (ell_grp_g, ell_bin_g)
+    else:
+        bins_g = _global_array(mesh,
+                               padded(np.ascontiguousarray(ds.binned)))
+        ell_g = ()
     valid_g = _global_array(mesh, valid_local)
     gidx_g = _global_array(mesh, padded(gidx_l.astype(np.uint32)))
 
@@ -395,7 +408,7 @@ def train_multihost(config: Config, X_local: np.ndarray,
 
     gc = learner.grow_config
     n_shard = pad_to * jax.process_count() // S
-    use_part = n_shard >= PARTITION_MIN_ROWS
+    use_part = n_shard >= PARTITION_MIN_ROWS and not use_mv
     meta, params, fix = learner.meta, learner.params, learner.fix
     cat = learner.cat_layout
     gw_global = learner.gw_global
@@ -411,19 +424,17 @@ def train_multihost(config: Config, X_local: np.ndarray,
     if use_goss:
         if bag_frac < 1.0:
             Log.fatal("Cannot use bagging in GOSS")
-        n_glob_rows = int(ds.num_data)
-        # global row count: every rank contributes its shard size
-        if world > 1:
-            from jax.experimental import multihost_utils
-            n_glob_rows = int(np.sum(multihost_utils.process_allgather(
-                np.asarray([ds.num_data], np.int64))))
         from ..ops.grow_persist import make_goss_weight_fn
+        # global row count: the earlier per-rank counts allgather holds it
         goss_wfn = make_goss_weight_fn(
-            n_glob_rows, float(config.top_rate), float(config.other_rate),
+            int(counts.sum()), float(config.top_rate),
+            float(config.other_rate),
             int(1.0 / float(config.learning_rate)), AXIS)
 
-    def _grow(bins, grad, hess, bag, fmask, extras):
+    def _grow(bins, grad, hess, bag, fmask, extras, ell=()):
         layout = DataLayout(bins, *layout_rest)
+        if use_mv:
+            layout = layout._replace(ell_grp=ell[0], ell_bin=ell[1])
         if use_part:
             return grow_tree_partitioned(
                 layout, grad, hess, bag, meta, params, fmask, fix, gc,
@@ -437,7 +448,7 @@ def train_multihost(config: Config, X_local: np.ndarray,
         K stacked tree records come back replicated, ONE transfer."""
 
         def body_fn(bins, gidx, valid, gargs, score0, fu0, fmasks, wkeys,
-                    keys, its):
+                    keys, its, *ell):
             def body(carry, per):
                 score, fu = carry
                 fmask, wkey, key, it_i = per
@@ -466,7 +477,7 @@ def train_multihost(config: Config, X_local: np.ndarray,
                         h = h * w
                         bag = w > 0
                     ex = base_extras._replace(key=key, feature_used=fu)
-                    arrays, fu2 = _grow(bins, g, h, bag, fmask, ex)
+                    arrays, fu2 = _grow(bins, g, h, bag, fmask, ex, ell)
                     upd = arrays.leaf_value.astype(jnp.float64)[
                         arrays.row_leaf] * shrink_t
                     score2 = score + jnp.where(arrays.num_leaves > 1,
@@ -487,7 +498,7 @@ def train_multihost(config: Config, X_local: np.ndarray,
                         key=jax.random.key_data(jax.random.fold_in(
                             jax.random.wrap_key_data(key), c)),
                         feature_used=fu2)
-                    arrays, fu2 = _grow(bins, g, h, bag, fmask[c], ex)
+                    arrays, fu2 = _grow(bins, g, h, bag, fmask[c], ex, ell)
                     upd = arrays.leaf_value.astype(jnp.float64)[
                         arrays.row_leaf] * shrink_t
                     score2 = score2.at[c].add(
@@ -506,7 +517,8 @@ def train_multihost(config: Config, X_local: np.ndarray,
         return jax.jit(jax.shard_map(
             body_fn, mesh=mesh,
             in_specs=(P(AXIS, None), P(AXIS), P(AXIS), spec_gargs,
-                      score_spec, P(), P(), P(), P(), P()),
+                      score_spec, P(), P(), P(), P(), P())
+            + ((P(AXIS, None), P(AXIS, None)) if use_mv else ()),
             out_specs=(score_spec, P(), _tree_arrays_spec(gc,
                                                           row_sharded=False)),
             check_vma=False))
@@ -599,7 +611,7 @@ def train_multihost(config: Config, X_local: np.ndarray,
         its = jnp.arange(it, it + k, dtype=jnp.int32)
         score, fu, stacked = runners[k](
             bins_g, gidx_g, valid_g, tuple(gargs_g), score, fu, fmasks,
-            wkeys, keys, its)
+            wkeys, keys, its, *ell_g)
         host = jax.device_get(stacked)          # ONE transfer per batch
         for i in range(k):
             class_trees = []

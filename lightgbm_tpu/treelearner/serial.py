@@ -536,7 +536,8 @@ class SerialTreeLearner:
             cache[akey] = assets
         kernel_impl, interpret = self._persist_kernel_mode()
         stat_from_scan = bag_spec[0] != "none"
-        gkey = ("grower", K, self.grow_config, stat_from_scan)
+        gkey = ("grower", K, use_w_row, self.grow_config,
+                stat_from_scan)
         gr = cache.get(gkey)
         if gr is None:
             gr = make_persist_grower(assets, self.meta, self.grow_config,
@@ -544,7 +545,7 @@ class SerialTreeLearner:
                                      kernel_impl=kernel_impl,
                                      stat_from_scan=stat_from_scan)
             cache[gkey] = gr
-        dkey = ("driver", K, k, self.grow_config,
+        dkey = ("driver", K, use_w_row, k, self.grow_config,
                 objective.static_fingerprint(), bag_spec)
         driver = cache.get(dkey)
         if driver is None:

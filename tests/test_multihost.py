@@ -416,3 +416,73 @@ def test_python_api_distributed_goss(tmp_path):
     r1 = json.load(open(outs[1]))
     assert r0["pred"] == r1["pred"]
     assert r0["acc"] > 0.85, r0["acc"]
+
+
+MV_WORKER = r"""
+import json, os, sys
+import numpy as np
+sys.path.insert(0, %(repo)r)
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 2)
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+rank = int(sys.argv[1])
+port = sys.argv[2]
+out = sys.argv[3]
+os.environ["JAX_PROCESS_ID"] = str(rank)
+
+import lightgbm_tpu as lgb
+
+rng = np.random.default_rng(41)
+n, nf = 2400, 40
+X = np.zeros((n, nf))
+hit = rng.random((n, nf)) < 0.15
+X[hit] = rng.normal(loc=1.0, size=int(hit.sum()))
+beta = rng.normal(size=nf)
+y = ((X @ beta) > 0).astype(float)
+
+params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+          "num_machines": 2, "tpu_multival": "force",
+          "machines": "127.0.0.1:%%s,127.0.0.1:0" %% port,
+          "min_data_in_leaf": 5, "tree_learner": "data"}
+bst = lgb.train(params, lgb.Dataset(X, y), num_boost_round=8,
+                verbose_eval=False)
+pred = bst.predict(X[:300])
+acc = float(((pred > 0.5) == y[:300]).mean())
+with open(out, "w") as fh:
+    json.dump({"rank": rank, "acc": acc,
+               "pred": [round(float(p), 8) for p in pred[:150]]}, fh)
+"""
+
+
+@pytest.mark.slow
+def test_python_api_distributed_multival(tmp_path):
+    """The multi-value (ELL) layout over num_machines=2: the row-sparse
+    arrays shard with the rows across processes and the scatter
+    histograms psum; both ranks materialize the identical model."""
+    port = _free_port()
+    script = tmp_path / "mv_worker.py"
+    script.write_text(MV_WORKER % {"repo": REPO})
+    outs = [str(tmp_path / f"mv_rank{r}.json") for r in range(2)]
+    procs = []
+    for r in range(2):
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env.pop("JAX_PLATFORMS", None)
+        procs.append(subprocess.Popen(
+            [sys.executable, str(script), str(r), str(port), outs[r]],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+    for p in procs:
+        try:
+            _, err = p.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("multival multihost worker timed out")
+        assert p.returncode == 0, err.decode()[-2000:]
+    r0 = json.load(open(outs[0]))
+    r1 = json.load(open(outs[1]))
+    assert r0["pred"] == r1["pred"]
+    assert r0["acc"] > 0.8, r0["acc"]

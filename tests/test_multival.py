@@ -17,7 +17,6 @@ import scipy.sparse as sp
 
 import lightgbm_tpu as lgb
 from lightgbm_tpu.data.dataset import BinnedDataset
-from lightgbm_tpu.utils.log import LightGBMError
 
 
 def _dense_data(n=3000, f=10, seed=0):
@@ -145,12 +144,21 @@ def test_multival_continued_training_binned_walk():
     assert r2 > 0.5
 
 
-def test_multival_parallel_learner_raises():
-    X, y = _dense_data(n=1000)
-    p = {"objective": "regression", "verbosity": -1,
-         "tree_learner": "data", "tpu_multival": "force"}
-    with pytest.raises(LightGBMError):
-        lgb.train(p, lgb.Dataset(X, y, params=p), 1, verbose_eval=False)
+def test_multival_sharded_matches_serial():
+    """The ELL layout under the 8-device data-parallel mesh: the row-sparse
+    arrays shard WITH the rows and the scatter histograms psum — trees
+    match the serial multival run."""
+    X, y = _dense_data(n=3000)
+    base = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+            "min_data_in_leaf": 20, "tpu_multival": "force"}
+    b_s = lgb.train(dict(base), lgb.Dataset(X, y, params=base), 10,
+                    verbose_eval=False)
+    p_d = dict(base, tree_learner="data")
+    ds_d = lgb.Dataset(X, y, params=p_d)
+    b_d = lgb.train(p_d, ds_d, 10, verbose_eval=False)
+    assert ds_d._inner.is_multival
+    np.testing.assert_allclose(b_s.predict(X), b_d.predict(X),
+                               rtol=1e-4, atol=1e-5)
 
 
 def test_multival_dense_row_falls_back_to_dense():
