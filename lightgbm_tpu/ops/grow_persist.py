@@ -637,11 +637,18 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
             valid_r = valid_r[None] * winp[:, :, None].astype(F32)
             valid_f = valid_f[None] * winp[:, :, None].astype(F32)
         if bundled:
-            # EFB layouts: feature windows sit at offsets inside group
-            # blocks — assemble the scan input with the layout gather
-            # (the same per-split cost the v1 eval pays on bundled data)
-            gb = g2[:, layout.gidx]
-            hb = h2[:, layout.gidx]
+            # EFB layouts: feature rows are whole [W] GROUP blocks pulled
+            # with one cheap row-take (contiguous 256-lane rows — an
+            # element gather here cost ~0.25 ms/split at 648 features);
+            # the scan masks carry the in-block window offsets (win_off)
+            # and thresholds come out absolute, corrected below
+            blocks_g = g2.reshape(2, G, W)
+            blocks_h = h2.reshape(2, G, W)
+            gof = jnp.asarray(group_of_np)
+            gb = jnp.pad(jnp.take(blocks_g, gof, axis=1),
+                         ((0, 0), (0, layout.Fp - F), (0, 0)))
+            hb = jnp.pad(jnp.take(blocks_h, gof, axis=1),
+                         ((0, 0), (0, layout.Fp - F), (0, 0)))
         else:
             gb = jnp.pad(g2.reshape(2, G, W), pad_f)
             hb = jnp.pad(h2.reshape(2, G, W), pad_f)
@@ -649,19 +656,21 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
             # FixHistogram (src/io/dataset.cpp:1410) at the scan-input
             # level: a bundled feature's most_freq bin is never stored, so
             # its slot gets child_total - window_sum (the mf slot's own
-            # contribution cancels out of the residual)
+            # contribution cancels out of the residual). Positions are in
+            # the OFFSET (group-block) coordinates the scan rows use.
             Fp, Wp = layout.Fp, layout.Wp
             w_ar = np.arange(Wp)
-            win_m = jnp.asarray(
-                (w_ar[None, :] < np.pad(nb_np, (0, Fp - F))[:, None])
-                .astype(np.float32))
-            fix_rows = np.pad(needs_fix_np.astype(np.float32),
-                              (0, Fp - F))
+            lo = ls_np[:, None]
+            hi = (ls_np + nb_np)[:, None]
+            win_m = jnp.asarray(np.pad(
+                ((w_ar[None, :] >= lo) & (w_ar[None, :] < hi))
+                .astype(np.float32), ((0, Fp - F), (0, 0))))
+            fix_rows_d = jnp.asarray(
+                np.pad(needs_fix_np.astype(np.float32), (0, Fp - F)))
             oh = np.zeros((Fp, Wp), np.float32)
-            oh[np.arange(F), np.clip(mf_np, 0, Wp - 1)] = \
+            oh[np.arange(F), np.clip(ls_np + mf_np, 0, Wp - 1)] = \
                 needs_fix_np.astype(np.float32)
             oh_mf = jnp.asarray(oh)
-            fix_rows_d = jnp.asarray(fix_rows)
             gsum = jnp.sum(gb * win_m, axis=2)             # [2, Fp]
             hsum = jnp.sum(hb * win_m, axis=2)
             res_g = (sg[:, None] - gsum) * fix_rows_d
@@ -683,6 +692,10 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
                                        axis=1)[:, 0]
         gain_b = take(0)
         t_b = take(1)
+        if bundled:
+            # scan rows are whole group blocks: thresholds come out in
+            # block coordinates — shift back to the feature-local bin
+            t_b = t_b - jnp.asarray(ls_np.astype(np.float32))[best_f]
         use_f_b = take(2) > 0.5
         lg = take(3)
         lh = take(4)
@@ -710,7 +723,9 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
         """Grow one tree in place; returns (pay', lstate, tree, num_leaves,
         root_value). bag_cnt: shard-local in-bag row count from the bag
         transform (None = every live row in bag)."""
-        layout = ScanLayout(pad_meta, fmask, F, W, TBp)
+        layout = ScanLayout(pad_meta, fmask, F, W, TBp,
+                            win_off=(jnp.asarray(ls_np) if bundled
+                                     else None))
         rhist, sums = root_hist(pay)
         gh0, hh0 = rhist
         root_cnt = (jnp.asarray(n, ST) if bag_cnt is None

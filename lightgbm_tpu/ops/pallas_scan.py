@@ -231,7 +231,8 @@ class ScanLayout:
     ops/split.find_best_split_numerical.
     """
 
-    def __init__(self, meta, feature_mask, F: int, W: int, tb: int):
+    def __init__(self, meta, feature_mask, F: int, W: int, tb: int,
+                 win_off=None):
         I32 = jnp.int32
         self.F = F
         self.W = W
@@ -248,8 +249,20 @@ class ScanLayout:
         pen = jnp.pad(meta.penalty.astype(jnp.float32), (0, pad_f))
 
         w = jnp.arange(Wp, dtype=I32)[None, :]
-        in_feat = w < nb
-        self.gidx = jnp.clip(start + w, 0, tb - 1)           # [Fp, Wp]
+        if win_off is not None:
+            # feature f's window starts at lane win_off[f] of its row
+            # (EFB rows hold whole group blocks; the scan masks shift and
+            # thresholds come out ABSOLUTE — callers subtract win_off).
+            # Lanes before the offset have every mask zero, so the
+            # bidirectional accumulations see only the window. gidx has
+            # no meaning for block-row layouts — None so misuse is loud.
+            w = w - jnp.pad(win_off, (0, pad_f))[:, None]
+            self.gidx = None
+        else:
+            self.gidx = jnp.clip(
+                start + jnp.arange(Wp, dtype=I32)[None, :],
+                0, tb - 1)                                   # [Fp, Wp]
+        in_feat = (w >= 0) & (w < nb)
 
         two_scan = (nb > 2) & (mt != 0)
         skip_default = two_scan & (mt == 1)
