@@ -17,26 +17,27 @@ import os
 import sys
 import time
 
-import numpy as np
-
 REF_ROWS = 10_500_000
 REF_ITERS = 500
 REF_SECONDS = 238.5
 REF_THROUGHPUT = REF_ROWS * REF_ITERS / REF_SECONDS   # 22.01M row-iters/s
 
 
-def make_higgs_like(n_rows: int, n_features: int = 28, seed: int = 7):
-    """Synthetic stand-in for HIGGS: continuous kinematic-like features,
-    nonlinear decision boundary, ~53/47 class balance like the real set."""
-    rng = np.random.default_rng(seed)
-    X = rng.normal(size=(n_rows, n_features)).astype(np.float32)
-    # a few derived-feature couplings like HIGGS's high-level features
-    X[:, 21] = np.abs(X[:, 0] * X[:, 1]) + 0.3 * X[:, 21]
-    X[:, 22] = X[:, 2] ** 2 + X[:, 3] ** 2 + 0.3 * X[:, 22]
-    logit = (0.8 * X[:, 0] - 0.5 * X[:, 1] + 0.4 * X[:, 21]
-             - 0.3 * X[:, 22] + 0.5 * np.tanh(X[:, 4] * X[:, 5]))
-    y = (logit + rng.logistic(size=n_rows).astype(np.float32) * 0.8 > 0.0)
-    return X.astype(np.float64), y.astype(np.float64)
+# canonical generator lives in the package (shared with the profiling CLI
+# and tests); re-exported here for bench_full / sweep_perf / prof_* imports
+from lightgbm_tpu.data.synth import make_higgs_like  # noqa: E402,F401
+
+
+def _phase_stats(telemetry):
+    """Per-category seconds + the per-scope table for one bench phase."""
+    return {
+        "categories": {k: round(v, 3)
+                       for k, v in telemetry.events.category_totals().items()},
+        "scopes": {name: {"seconds": round(sec, 3), "count": n,
+                          "category": cat}
+                   for name, (sec, n, cat)
+                   in telemetry.events.snapshot_full().items()},
+    }
 
 
 def main():
@@ -46,6 +47,17 @@ def main():
     max_bin = int(os.environ.get("BENCH_MAX_BIN", 255))
 
     import lightgbm_tpu as lgb
+    from lightgbm_tpu import telemetry
+
+    # phase attribution rides the telemetry registry (timers mode): the
+    # snapshot records WHERE the time went, next to the throughput metric.
+    # BENCH_TELEMETRY=0 opts out, measuring the headline number with zero
+    # telemetry overhead inside the timed window (comparable with BENCH
+    # rounds archived before the telemetry subsystem existed).
+    bench_telemetry = os.environ.get("BENCH_TELEMETRY", "1") != "0"
+    if bench_telemetry:
+        telemetry.enable("timers")
+    phase_snaps = {}
 
     X, y = make_higgs_like(n_rows)
     t_bin0 = time.time()
@@ -63,6 +75,8 @@ def main():
     warm._booster._materialize_pending()
     del warm
 
+    if bench_telemetry:   # opted out: never touch the process-global registry
+        telemetry.reset()   # steady state only: drop binning/warmup compiles
     t0 = time.time()
     booster = lgb.train(dict(params), ds, n_iters, verbose_eval=False)
     # force the async pipeline to finish: materialize every pending device
@@ -71,6 +85,8 @@ def main():
     import jax
     jax.block_until_ready(booster._booster.train_score.score_device(0))
     train_s = time.time() - t0
+    if bench_telemetry:
+        phase_snaps["higgs"] = _phase_stats(telemetry)
 
     throughput = n_rows * n_iters / train_s
     vs_baseline = throughput / REF_THROUGHPUT
@@ -80,6 +96,8 @@ def main():
         "unit": "Mrow_iters_per_sec",
         "vs_baseline": round(vs_baseline, 4),
     }
+    if bench_telemetry:
+        result["phases"] = phase_snaps["higgs"]["categories"]
     # print the primary metric BEFORE the MS-LTR phase so a hard crash
     # there (OOM kill, TPU fault) can't lose it; the combined line with
     # the ranking keys is re-printed last and shadows this one for
@@ -92,7 +110,11 @@ def main():
     ltr = None
     if os.environ.get("BENCH_SKIP_LTR", "") != "1":
         try:
+            if bench_telemetry:
+                telemetry.reset()
             ltr = run_ltr()
+            if bench_telemetry:
+                phase_snaps["ltr"] = _phase_stats(telemetry)
         except Exception as exc:
             print("# MS-LTR phase failed: %r" % exc, file=sys.stderr)
     if ltr is not None:
@@ -106,7 +128,11 @@ def main():
     expo = None
     if os.environ.get("BENCH_SKIP_EXPO", "") != "1":
         try:
+            if bench_telemetry:
+                telemetry.reset()
             expo = run_expo()
+            if bench_telemetry:
+                phase_snaps["expo"] = _phase_stats(telemetry)
         except Exception as exc:
             print("# expo phase failed: %r" % exc, file=sys.stderr)
     if expo is not None:
@@ -122,7 +148,11 @@ def main():
     vote = None
     if os.environ.get("BENCH_SKIP_VOTING", "") != "1":
         try:
+            if bench_telemetry:
+                telemetry.reset()
             vote = run_voting()
+            if bench_telemetry:
+                phase_snaps["voting"] = _phase_stats(telemetry)
         except Exception as exc:
             print("# voting phase failed: %r" % exc, file=sys.stderr)
     if vote is not None:
@@ -135,6 +165,18 @@ def main():
                                  vote["iters"], vote["train_s"],
                                  vote["value"], vote["vs_baseline"]),
               file=sys.stderr)
+    # full per-phase telemetry snapshot (category totals + per-scope table)
+    # so BENCH_*.json rounds can archive WHERE the time went
+    if bench_telemetry:
+        phases_out = os.environ.get("BENCH_PHASES_OUT", "BENCH_phases.json")
+        try:
+            with open(phases_out, "w") as f:
+                json.dump(phase_snaps, f, indent=1, sort_keys=True)
+            print("# telemetry phase snapshot written to %s" % phases_out,
+                  file=sys.stderr)
+        except OSError as exc:
+            print("# could not write %s: %r" % (phases_out, exc),
+                  file=sys.stderr)
 
 
 # MS-LTR anchor: 2.27M rows x 137 features, lambdarank, 500 iters in

@@ -23,8 +23,8 @@ import jax.numpy as jnp
 from ..config import Config
 from ..models.tree import Tree
 from ..objectives import create_objective, parse_objective_string
+from ..telemetry import events as telemetry
 from ..treelearner import create_tree_learner
-from ..utils import timer
 from ..utils.log import Log
 from .score_updater import HostScoreUpdater, ScoreUpdater
 
@@ -74,6 +74,7 @@ class GBDT:
     # ------------------------------------------------------------------
     def init(self, config: Config, train_data, objective,
              training_metrics=()) -> None:
+        telemetry.configure_from_config(config)
         if float(config.histogram_pool_size) > 0:
             Log.warning("histogram_pool_size is ignored on device_type=tpu: "
                         "all per-leaf histograms stay HBM-resident "
@@ -162,6 +163,7 @@ class GBDT:
                             "slow convergence" % self.objective.name)
         return 0.0
 
+    @telemetry.timed("boosting::Boosting(gradients)", category="boosting")
     def _compute_gradients(self):
         """Boosting() (gbdt.cpp:152): objective grad/hess from cached score."""
         if self.objective is None:
@@ -380,7 +382,8 @@ class GBDT:
         K = 16
         return K if remaining >= K else 1
 
-    @timer.timed("boosting::TrainMultiIterFast(launch)")
+    @telemetry.timed("boosting::TrainMultiIterFast(launch)",
+                     category="boosting")
     def _train_multi_iter_fast(self, k: int) -> bool:
         """K fused iterations (one device dispatch); see
         SerialTreeLearner.train_arrays_scan / train_arrays_scan_persist."""
@@ -510,7 +513,8 @@ class GBDT:
             self._materialize_pending()
         return False
 
-    @timer.timed("boosting::MaterializePending(D2H+wait)")
+    @telemetry.timed("boosting::MaterializePending(D2H+wait)",
+                     category="device_wait")
     def _materialize_pending(self) -> None:
         """Pull all pending device trees to host in one transfer; detect a
         no-split stop (reference stops and pops that iteration's trees —
@@ -638,7 +642,7 @@ class GBDT:
                 del self.models[cut:]
                 self.iter = len(self.models) // ntpi
 
-    @timer.timed("boosting::TrainOneIter")
+    @telemetry.timed("boosting::TrainOneIter", category="boosting")
     def train_one_iter(self, gradients: Optional[np.ndarray] = None,
                        hessians: Optional[np.ndarray] = None) -> bool:
         """One boosting iteration; returns True when training should STOP
@@ -803,10 +807,16 @@ class GBDT:
     def train(self) -> None:
         """Full training loop (GBDT::Train, gbdt.cpp:246-265)."""
         cfg = self.config
+        monitor = None
+        if telemetry.enabled():
+            from ..telemetry.monitor import TrainingMonitor
+            monitor = TrainingMonitor()
         for it in range(self.iter, cfg.num_iterations):
             finished = self.train_one_iter(None, None)
             if not finished:
                 finished = self.eval_and_check_early_stopping()
+            if monitor is not None:
+                monitor.record(it, model=self)
             if finished:
                 break
             if (cfg.snapshot_freq > 0
@@ -826,7 +836,7 @@ class GBDT:
             del self.models[-cut:]
         return met_early_stop
 
-    @timer.timed("boosting::OutputMetric(eval)")
+    @telemetry.timed("boosting::OutputMetric(eval)", category="eval")
     def output_metric(self, it: int) -> bool:
         """GBDT::OutputMetric (gbdt.cpp:485-543): print/record metrics and
         check early stopping. Returns True when early stop triggers."""

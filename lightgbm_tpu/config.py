@@ -206,6 +206,8 @@ PARAMS: List[_P] = [
     _P("max_bin_by_feature", list, []),
     _P("predict_disable_shape_check", bool, False),
     _P("tpu_4bit_packing", bool, True),      # nibble-pack <=16-bin groups in HBM
+    _P("tpu_telemetry", str, "off"),         # off | timers | trace (telemetry/)
+    _P("telemetry_out", str, ""),            # Chrome-trace/metrics path base
     _P("tpu_multival", str, "auto"),         # auto | force | off: ELL row-
     #                                        # sparse device layout (the
     #                                        # MultiValBin/SparseBin analog)

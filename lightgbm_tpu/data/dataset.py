@@ -228,9 +228,9 @@ class BinnedDataset:
         cat_set = set(int(c) for c in categorical_features)
         sample = _sample_data(X, config.bin_construct_sample_cnt,
                               config.data_random_seed)
-        with timer.scope("io::FindBinAndGroup"):
+        with timer.scope("io::FindBinAndGroup", category="io"):
             ds._construct_from_sample(sample, n, config, cat_set)
-        with timer.scope("io::PushMatrix(binning)"):
+        with timer.scope("io::PushMatrix(binning)", category="io"):
             ds._push_matrix(X)
         return ds
 
@@ -352,7 +352,7 @@ class BinnedDataset:
                     for f in range(nf)]
             rows = [sc.indices[sc.indptr[f]:sc.indptr[f + 1]]
                     for f in range(nf)]
-            with timer.scope("io::FindBinAndGroup"):
+            with timer.scope("io::FindBinAndGroup", category="io"):
                 ds._construct_from_sample(SampleCols(vals, rows, total),
                                           n, config, cat_set)
         else:
@@ -362,7 +362,7 @@ class BinnedDataset:
             ds.groups = reference.groups
             ds._finish_layout_like(reference)
 
-        with timer.scope("io::PushSparse(binning)"):
+        with timer.scope("io::PushSparse(binning)", category="io"):
             G = len(ds.groups)
             chunk = max(1024, int(2 ** 25 / max(nf, 1)))
             if ds._choose_multival(config, X):

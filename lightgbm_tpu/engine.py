@@ -338,19 +338,31 @@ def train(params: Dict[str, Any], train_set: Dataset,
     if num_boost_round <= 0:
         raise ValueError("num_boost_round should be greater than zero.")
     from .basic import params_to_config
-    if int(params_to_config(params).num_machines) > 1:
+    from .telemetry import events as telemetry_events
+    cfg0 = params_to_config(params)
+    # configure before the num_machines split so tpu_telemetry/telemetry_out
+    # params also activate the collective spans on the distributed path
+    # (multihost scans, allreduce/allgather DCN time)
+    telemetry_events.configure_from_config(cfg0)
+    if int(cfg0.num_machines) > 1:
         if evals_result is not None:
             from .utils.log import Log
             Log.warning("evals_result is not populated with "
                         "num_machines > 1")
-        return _train_distributed(params, train_set, num_boost_round,
-                                  valid_sets, fobj=fobj, feval=feval,
-                                  init_model=init_model,
-                                  early_stopping_rounds=early_stopping_rounds,
-                                  callbacks=callbacks,
-                                  categorical_feature=categorical_feature,
-                                  learning_rates=learning_rates,
-                                  keep_training_booster=keep_training_booster)
+        try:
+            return _train_distributed(
+                params, train_set, num_boost_round,
+                valid_sets, fobj=fobj, feval=feval,
+                init_model=init_model,
+                early_stopping_rounds=early_stopping_rounds,
+                callbacks=callbacks,
+                categorical_feature=categorical_feature,
+                learning_rates=learning_rates,
+                keep_training_booster=keep_training_booster)
+        finally:
+            if telemetry_events.enabled():
+                from .telemetry.export import maybe_export
+                maybe_export()
     if fobj is not None:
         params["objective"] = "none"
 
@@ -372,6 +384,13 @@ def train(params: Dict[str, Any], train_set: Dataset,
         registry.add(callback.reset_parameter(learning_rate=learning_rates))
     if evals_result is not None:
         registry.add(callback.record_evaluation(evals_result))
+    from .telemetry.monitor import TrainingMonitor
+    monitor = None
+    if telemetry_events.enabled():
+        # post-iteration CallbackEnv consumer: per-iteration wall time,
+        # phase buckets, leaf counts, memory watermarks, recompile counts
+        monitor = TrainingMonitor()
+        registry.add(monitor)
     registry.seal()
 
     booster = Booster(params=params, train_set=train_set)
@@ -412,6 +431,15 @@ def train(params: Dict[str, Any], train_set: Dataset,
     booster.best_score = collections.defaultdict(collections.OrderedDict)
     for entry in final_evals:
         booster.best_score[entry[0]][entry[1]] = entry[2]
+    if monitor is not None:
+        booster._telemetry_monitor = monitor
+        if inner is not None:
+            # flush the async pipeline so the trace's device_wait bucket
+            # covers this run's trees (telemetry-on only: the off path
+            # keeps the pipeline open exactly as before)
+            inner._materialize_pending()
+        from .telemetry.export import maybe_export
+        maybe_export()   # tpu_telemetry=trace -> Chrome trace + metrics
     return booster
 
 

@@ -41,6 +41,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from ..telemetry import events as telemetry
 from .split import (CatLayout, F64, I32, K_EPSILON, K_MIN_SCORE, FeatureMeta,
                     SplitCandidate, SplitParams, _leaf_gain,
                     _leaf_output_unconstrained, acc_dtype,
@@ -846,7 +847,7 @@ def _record_split(tree: TreeArrays, k, do, l, cand, parent_value,
     static_argnames=("gc", "axis_name"),
     donate_argnums=(),
 )
-def grow_tree(layout: DataLayout, grad: jnp.ndarray, hess: jnp.ndarray,
+def _grow_tree_jit(layout: DataLayout, grad: jnp.ndarray, hess: jnp.ndarray,
               bag_mask: jnp.ndarray, meta: FeatureMeta, params: SplitParams,
               feature_mask: jnp.ndarray, fix: FixInfo, gc: GrowConfig,
               axis_name=None, cat: CatLayout = None,
@@ -1295,7 +1296,7 @@ def _hist_contiguous(binsP, grad, hess, layout: DataLayout, start, length,
 
 @functools.partial(
     jax.jit, static_argnames=("gc", "axis_name"))
-def grow_tree_partitioned(layout: DataLayout, grad: jnp.ndarray,
+def _grow_tree_partitioned_jit(layout: DataLayout, grad: jnp.ndarray,
                           hess: jnp.ndarray, bag_mask: jnp.ndarray,
                           meta: FeatureMeta, params: SplitParams,
                           feature_mask: jnp.ndarray, fix: FixInfo,
@@ -1674,3 +1675,13 @@ def grow_tree_partitioned(layout: DataLayout, grad: jnp.ndarray,
         leaf_weight=final.leaf_sum_hess,
         row_leaf=row_leaf,
     ), final.feature_used
+
+
+# public entry points: telemetry-wrapped dispatch of the jitted growers
+# (telemetry.events.launch_wrapper — tracer_arg=1 is `grad`, so calls traced
+# into the fused K-iteration scans are tagged "(trace)" not "(launch)")
+grow_tree = telemetry.launch_wrapper(
+    _grow_tree_jit, "ops::grow_tree", category="ops", tracer_arg=1)
+grow_tree_partitioned = telemetry.launch_wrapper(
+    _grow_tree_partitioned_jit, "ops::grow_tree_partitioned",
+    category="ops", tracer_arg=1)

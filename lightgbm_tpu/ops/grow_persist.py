@@ -47,6 +47,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..telemetry import events as telemetry
 from ..utils.log import Log
 from .grow import TreeArrays
 from .pallas_grow import (N_SCALARS, S_DB, S_DL, S_LE, S_LS, S_MASK, S_MF,
@@ -155,6 +156,7 @@ def _pack_payload(binned: np.ndarray, labels: np.ndarray, n: int,
     return pay, plan
 
 
+@telemetry.timed("ops::BuildPersistPayload(H2D)", category="ops")
 def build_assets(dataset, labels: np.ndarray, C: int = 0,
                  CR: int = 16384, num_shards: int = 1,
                  num_scores: int = 1,
@@ -1148,5 +1150,7 @@ def make_scan_driver(gr, gc, k: int, grad_fn, grad_mode: str = "payload",
         return payK, stacked
 
     if wrap_jit:
-        return jax.jit(run, donate_argnums=(0,))
+        return telemetry.launch_wrapper(
+            jax.jit(run, donate_argnums=(0,)),
+            "ops::persist_scan(launch)", category="ops", k=k)
     return run

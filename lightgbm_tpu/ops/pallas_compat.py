@@ -1,0 +1,24 @@
+"""Single import point for the Pallas TPU API across jax versions.
+
+jax 0.4.x spells the Mosaic compiler-params class
+``pltpu.TPUCompilerParams``; newer releases renamed it to
+``pltpu.CompilerParams``. A build where neither attribute exists cannot
+construct the Mosaic kernels at all, so the probe treats it exactly like
+a failed pallas import: ``HAS_PALLAS`` goes False and every caller takes
+its guarded XLA fallback instead of crashing later inside kernel
+construction with a ``NoneType is not callable``.
+"""
+from __future__ import annotations
+
+try:  # pallas ships with jax; guard for exotic builds
+    from jax.experimental import pallas as pl  # noqa: F401
+    from jax.experimental.pallas import tpu as pltpu  # noqa: F401
+    TPUCompilerParams = getattr(
+        pltpu, "CompilerParams", getattr(pltpu, "TPUCompilerParams", None))
+    if TPUCompilerParams is None:
+        raise ImportError("pallas TPU backend exposes neither "
+                          "CompilerParams nor TPUCompilerParams")
+    HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    pl = pltpu = TPUCompilerParams = None
+    HAS_PALLAS = False

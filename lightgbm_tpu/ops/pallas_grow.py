@@ -57,12 +57,8 @@ import sys
 import jax
 import jax.numpy as jnp
 
-try:  # pallas ships with jax; guard for exotic builds
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-    HAS_PALLAS = True
-except Exception:  # pragma: no cover
-    HAS_PALLAS = False
+from .pallas_compat import HAS_PALLAS, pl, pltpu
+from .pallas_compat import TPUCompilerParams as _TPUCompilerParams
 
 I32 = jnp.int32
 U32 = jnp.uint32
@@ -415,7 +411,7 @@ def make_split_pass(WPA: int, NP: int, G: int, plan, nbw: int,
     _vmem_req = min(96 << 20,
                     7 * WPA * E_ * 4 + G * 16 * 64 * 4 + (20 << 20)
                     + 3 * WPA * E_ * 4)
-    _cparams = pltpu.CompilerParams(vmem_limit_bytes=int(_vmem_req))
+    _cparams = _TPUCompilerParams(vmem_limit_bytes=int(_vmem_req))
 
     @jax.jit
     def split_pass(pay, scalars):
@@ -518,7 +514,7 @@ def make_seg_hist(WPA: int, NP: int, G: int, plan, nbw: int,
     E_ = E
     _vmem_req = min(96 << 20,
                     2 * WPA * E_ * 4 + G * 16 * 64 * 4 + (20 << 20))
-    _cparams = pltpu.CompilerParams(vmem_limit_bytes=int(_vmem_req))
+    _cparams = _TPUCompilerParams(vmem_limit_bytes=int(_vmem_req))
 
     @jax.jit
     def seg_hist(pay, start, length):

@@ -27,12 +27,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
-try:  # pallas is part of jax, but guard for exotic builds
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-    HAS_PALLAS = True
-except Exception:  # pragma: no cover
-    HAS_PALLAS = False
+from .pallas_compat import HAS_PALLAS, pl, pltpu
 
 
 def _round_up(x: int, m: int) -> int:

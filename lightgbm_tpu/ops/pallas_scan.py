@@ -35,12 +35,8 @@ import functools
 import jax
 import jax.numpy as jnp
 
-try:  # pallas is part of jax, but guard for exotic builds
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-    HAS_PALLAS = True
-except Exception:  # pragma: no cover
-    HAS_PALLAS = False
+from .pallas_compat import HAS_PALLAS, pl, pltpu
+from .pallas_compat import TPUCompilerParams as _TPUCompilerParams
 
 NEG_INF = float("-inf")
 
@@ -204,7 +200,7 @@ def scan_pair(scal, gb, hb, keep_r, keep_f, valid_r, valid_f, aux,
     _vmem = min(100 << 20, 16 * Fp * Wp * 4 + (20 << 20))
     return pl.pallas_call(
         _scan_kernel,
-        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=int(_vmem)),
+        compiler_params=_TPUCompilerParams(vmem_limit_bytes=int(_vmem)),
         grid=(2,),
         in_specs=[
             pl.BlockSpec((1, 1, 128), lambda c: (c, c * 0, c * 0)),

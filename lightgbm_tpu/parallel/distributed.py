@@ -26,6 +26,7 @@ from typing import Callable, List, Optional, Sequence
 import numpy as np
 
 from ..data.bin_mapper import BinMapper, BinType, kZeroThreshold
+from ..telemetry import events as telemetry
 from ..utils.log import Log
 
 
@@ -97,6 +98,7 @@ def _feature_slice(rank: int, world: int, num_features: int):
     return start, length
 
 
+@telemetry.timed("collective::Allgather(binning,DCN)", category="collective")
 def _default_allgather(payload: bytes) -> List[bytes]:
     """Host allgather of variable-length byte blobs via
     jax.experimental.multihost_utils (runs over the JAX runtime's DCN

@@ -37,10 +37,27 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..models.tree import Tree
 from ..ops.grow import DataLayout, GrowConfig, grow_tree, grow_tree_partitioned
+from ..telemetry import events as telemetry
 from ..treelearner.serial import PARTITION_MIN_ROWS, SerialTreeLearner
 from ..utils.log import Log
 
 AXIS = "data"
+
+# jax >= 0.5 promotes shard_map to jax.shard_map with a `check_vma` kwarg;
+# 0.4.x has jax.experimental.shard_map.shard_map with `check_rep`. One
+# compat entry point so every sharded program builds on either runtime.
+try:
+    _jax_shard_map = jax.shard_map
+    _SM_LEGACY = False
+except AttributeError:
+    from jax.experimental.shard_map import shard_map as _jax_shard_map
+    _SM_LEGACY = True
+
+
+def shard_map_compat(f, **kw):
+    if _SM_LEGACY and "check_vma" in kw:
+        kw["check_rep"] = kw.pop("check_vma")
+    return _jax_shard_map(f, **kw)
 
 
 def _make_mesh(num_devices: int = 0) -> Mesh:
@@ -93,7 +110,7 @@ class DataParallelTreeLearner(SerialTreeLearner):
         ell_specs = (P(AXIS), P(AXIS)) if mv else ()
 
         @functools.partial(
-            jax.shard_map, mesh=mesh,
+            shard_map_compat, mesh=mesh,
             in_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(), P())
             + ell_specs,
             out_specs=(_tree_arrays_spec(gc, row_sharded=True), P()),
@@ -134,8 +151,15 @@ class DataParallelTreeLearner(SerialTreeLearner):
                     eg = jnp.pad(eg, ((0, pad), (0, 0)), constant_values=G)
                     eb = jnp.pad(eb, ((0, pad), (0, 0)))
                 ell = self._ell_padded = (eg, eb)
-        arrays, fu = self._sharded_grow(bins, grad, hess, bag_mask, fmask,
-                                        self._next_extras(), *ell)
+        # the sharded program's histogram psums / candidate gathers run over
+        # the mesh axis inside this one dispatch — the ReduceScatter /
+        # SyncUpGlobalBestSplit of the reference, attributed per tree
+        with telemetry.scope("collective::sharded_grow(launch)",
+                             category="collective",
+                             shards=self.num_shards,
+                             mode=self.grow_config.parallel_mode):
+            arrays, fu = self._sharded_grow(bins, grad, hess, bag_mask,
+                                            fmask, self._next_extras(), *ell)
         self._feature_used_dev = fu
         if pad:
             arrays = arrays._replace(
@@ -223,11 +247,11 @@ class DataParallelTreeLearner(SerialTreeLearner):
 
             wrapper = _ShardedGrower()
             wrapper.inner = inner
-            wrapper.init_carry = jax.jit(jax.shard_map(
+            wrapper.init_carry = jax.jit(shard_map_compat(
                 inner.init_carry, mesh=mesh,
                 in_specs=(pay_spec, P(AXIS)), out_specs=pay_spec,
                 check_vma=False))
-            wrapper.finalize_scores = jax.jit(jax.shard_map(
+            wrapper.finalize_scores = jax.jit(shard_map_compat(
                 inner.finalize_scores, mesh=mesh,
                 in_specs=(pay_spec,), out_specs=P(AXIS),
                 check_vma=False))
@@ -242,13 +266,16 @@ class DataParallelTreeLearner(SerialTreeLearner):
             raw = make_scan_driver(wrapper.inner, gc, k,
                                    objective.payload_grad_fn(),
                                    wrap_jit=False, bag_fn=bag_fn)
-            smapped = jax.shard_map(
+            smapped = shard_map_compat(
                 raw, mesh=mesh,
                 in_specs=(pay_spec, P(), P(), P(), P(), P(), P()),
                 out_specs=(pay_spec, _tree_arrays_spec(gc,
                                                        row_sharded=False)),
                 check_vma=False)
-            driver = jax.jit(smapped, donate_argnums=(0,))
+            driver = telemetry.launch_wrapper(
+                jax.jit(smapped, donate_argnums=(0,)),
+                "collective::persist_scan(launch)", category="collective",
+                shards=S, mode=gc.parallel_mode, k=k)
             cache[dkey] = driver
         return assets, wrapper, driver
 
@@ -336,7 +363,7 @@ class FeatureParallelTreeLearner(SerialTreeLearner):
         gw_global = self.gw_global
 
         @functools.partial(
-            jax.shard_map, mesh=mesh,
+            shard_map_compat, mesh=mesh,
             in_specs=(P(), P(), P(), P(), P(), P()),
             out_specs=(_tree_arrays_spec(gc, row_sharded=False), P()),
             check_vma=False)
@@ -355,9 +382,12 @@ class FeatureParallelTreeLearner(SerialTreeLearner):
         if self._sharded_grow is None:
             self._sharded_grow = self._build()
         fmask = jnp.asarray(self.col_sampler.sample())
-        arrays, fu = self._sharded_grow(self.layout.bins, grad, hess,
-                                        bag_mask, fmask,
-                                        self._next_extras())
+        with telemetry.scope("collective::sharded_grow(launch)",
+                             category="collective",
+                             shards=self.num_shards, mode="feature"):
+            arrays, fu = self._sharded_grow(self.layout.bins, grad, hess,
+                                            bag_mask, fmask,
+                                            self._next_extras())
         self._feature_used_dev = fu
         return arrays
 

@@ -58,9 +58,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..config import Config
 from ..models.tree import Tree
+from ..telemetry import events as telemetry
 from ..utils.log import Log
 from .distributed import distributed_bin_mappers, init_network
-from .learners import AXIS, _tree_arrays_spec
+from .learners import AXIS, _tree_arrays_spec, shard_map_compat
 
 __all__ = ["init_network", "shard_rows", "train_multihost"]
 
@@ -179,6 +180,8 @@ def _global_array(mesh: Mesh, local_np: np.ndarray):
     return jax.make_array_from_process_local_data(sharding, local_np)
 
 
+@telemetry.timed("collective::AllreduceMean(metrics,DCN)",
+                 category="collective")
 def _allreduce_mean_host(values: np.ndarray, weights: np.ndarray):
     """Count-weighted mean across processes via host allgather (used for
     metric aggregation over unequal validation shards; zero-weight ranks
@@ -514,7 +517,7 @@ def train_multihost(config: Config, X_local: np.ndarray,
 
         spec_gargs = tuple(garg_specs)
         score_spec = P(AXIS) if K == 1 else P(None, AXIS)
-        return jax.jit(jax.shard_map(
+        return jax.jit(shard_map_compat(
             body_fn, mesh=mesh,
             in_specs=(P(AXIS, None), P(AXIS), P(AXIS), spec_gargs,
                       score_spec, P(), P(), P(), P(), P())
@@ -530,10 +533,12 @@ def train_multihost(config: Config, X_local: np.ndarray,
         # Network::GlobalSyncUpByMean (gbdt.cpp:308): UNWEIGHTED mean over
         # machines — reference parity on unequal shards
         from jax.experimental import multihost_utils
-        init0s = [float(v) for v in np.mean(
-            multihost_utils.process_allgather(
-                np.asarray(init0s, np.float64)).reshape(world, -1),
-            axis=0)]
+        with telemetry.scope("collective::GlobalSyncUpByMean(DCN)",
+                             category="collective"):
+            init0s = [float(v) for v in np.mean(
+                multihost_utils.process_allgather(
+                    np.asarray(init0s, np.float64)).reshape(world, -1),
+                axis=0)]
     init0 = init0s[0]
     n_glob = pad_to * jax.process_count()
     if K == 1:
@@ -609,10 +614,14 @@ def train_multihost(config: Config, X_local: np.ndarray,
             jnp.uint32)
         keys = jnp.stack([learner._next_extras().key for _ in range(k)])
         its = jnp.arange(it, it + k, dtype=jnp.int32)
-        score, fu, stacked = runners[k](
-            bins_g, gidx_g, valid_g, tuple(gargs_g), score, fu, fmasks,
-            wkeys, keys, its, *ell_g)
-        host = jax.device_get(stacked)          # ONE transfer per batch
+        with telemetry.scope("collective::multihost_scan(launch)",
+                             category="collective", k=k):
+            score, fu, stacked = runners[k](
+                bins_g, gidx_g, valid_g, tuple(gargs_g), score, fu, fmasks,
+                wkeys, keys, its, *ell_g)
+        with telemetry.scope("boosting::MaterializeBatch(D2H+wait)",
+                             category="device_wait"):
+            host = jax.device_get(stacked)      # ONE transfer per batch
         for i in range(k):
             class_trees = []
             for c in range(K):

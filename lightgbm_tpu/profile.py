@@ -1,0 +1,81 @@
+"""`python -m lightgbm_tpu.profile` — op-level device profile of training.
+
+Traces N boosting iterations on the real chip with the jax profiler, then
+prints device time per XLA op name via the reusable xplane parser
+(:mod:`lightgbm_tpu.telemetry.xplane`). The old top-level ``prof_trace.py``
+dev script is now a thin wrapper over this entry point.
+
+Usage: python -m lightgbm_tpu.profile [rows] [iters] [key=value ...]
+
+Extra `key=value` tokens are passed through as training params
+(e.g. ``tree_learner=data num_leaves=511``). The host-side span registry
+runs in TRACE mode alongside, so ``telemetry_out=<path>`` also writes the
+Chrome-trace + metrics files for the same run.
+"""
+from __future__ import annotations
+
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if any(a in ("-h", "--help") for a in argv):
+        print(__doc__)
+        return 0
+    pos = [a for a in argv if "=" not in a]
+    kv = [a for a in argv if "=" in a]
+    rows = int(pos[0]) if len(pos) > 0 else 2_000_000
+    iters = int(pos[1]) if len(pos) > 1 else 16
+
+    import jax
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.config import kv2map
+    from lightgbm_tpu.data.synth import make_higgs_like
+    from lightgbm_tpu.telemetry import events, maybe_export, xplane
+
+    params = {"objective": "binary", "num_leaves": 255, "max_bin": 255,
+              "verbosity": -1, "metric": "none"}
+    params.update(kv2map(kv))
+    out = params.pop("telemetry_out", None)
+    # api-source enable, not configure(): config-driven enablement is scoped
+    # to the train that asked for it, so the default-params warmup/traced
+    # trains below would flip a configure("trace") back off
+    events.enable("trace")
+    if out:
+        events.set_out_path(out)
+
+    X, y = make_higgs_like(rows)
+    ds = lgb.Dataset(X, y)
+    ds.construct()
+    # warmup/compile outside the trace window (compiles are one-time costs)
+    warm = lgb.train(dict(params), ds, 17, verbose_eval=False)
+    warm._booster._materialize_pending()
+    del warm
+
+    events.reset()
+    with xplane.collect_trace() as tdir:
+        t0 = time.time()
+        booster = lgb.train(dict(params), ds, iters, verbose_eval=False)
+        booster._booster._materialize_pending()
+        jax.block_until_ready(booster._booster.train_score.score_device(0))
+        wall = time.time() - t0
+    print("wall=%.3fs rows=%d iters=%d -> %.2f Mri/s"
+          % (wall, rows, iters, rows * iters / wall / 1e6))
+
+    try:
+        planes = xplane.parse_xplane_dir(tdir)
+    except ImportError as exc:
+        print("xplane proto bindings unavailable (%s); raw trace left in %s"
+              % (exc, tdir), file=sys.stderr)
+        return 1
+    print(xplane.format_device_report(planes, iters=iters))
+    written = maybe_export(out) if out else None
+    if written:
+        print("host-side spans: %s ; metrics: %s" % written, file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,41 @@
+"""Telemetry subsystem: structured tracing, metrics registry, device profiling.
+
+Grown out of ``utils/timer.py`` (the reference's compile-gated ``Timer`` /
+``FunctionTimer`` pair, include/LightGBM/utils/common.h:1026-1105) into a
+real observability layer:
+
+  * :mod:`events`  — thread-safe process-global registry of spans and
+    counters (begin/end timestamps, categories, tags, an explicit
+    "device_wait" category for pipeline sync points);
+  * :mod:`export`  — Chrome-trace (``chrome://tracing`` JSON) and JSONL
+    metrics-snapshot writers plus the sorted text report;
+  * :mod:`monitor` — per-iteration :class:`TrainingMonitor` wired into the
+    boosting loop through the CallbackEnv protocol;
+  * :mod:`xplane`  — xplane-proto op-level device profiles
+    (``python -m lightgbm_tpu.profile``);
+  * :mod:`hostprof`— host-side cProfile / microbench dev helpers behind the
+    top-level ``prof_bin.py`` / ``prof_split.py`` wrappers.
+
+Enablement: ``tpu_telemetry=off|timers|trace`` config param (plus
+``telemetry_out=<path>`` for the trace/metrics files), the legacy
+``LIGHTGBM_TPU_TIMETAG=1`` env var (timers mode), or
+``LIGHTGBM_TPU_TELEMETRY=timers|trace``. The default is OFF and every
+instrumentation point is a no-op behind one integer check.
+"""
+from . import events
+from .events import (OFF, TIMERS, TRACE, add, configure, configure_from_config,
+                     count, counts_snapshot, device_wait, disable, enable,
+                     enabled, events_snapshot, iteration_records, mode, reset,
+                     scope, snapshot, timed, tracing)
+from .export import (format_report, maybe_export, print_report,
+                     write_chrome_trace, write_metrics_jsonl)
+from .monitor import TrainingMonitor
+
+__all__ = [
+    "OFF", "TIMERS", "TRACE", "TrainingMonitor", "add", "configure",
+    "configure_from_config", "count", "counts_snapshot", "device_wait",
+    "disable", "enable", "enabled", "events", "events_snapshot",
+    "format_report", "iteration_records", "maybe_export", "mode",
+    "print_report", "reset", "scope", "snapshot", "timed", "tracing",
+    "write_chrome_trace", "write_metrics_jsonl",
+]

@@ -1,0 +1,426 @@
+"""Process-global registry of spans and counters.
+
+The accounting model is the one ``utils/timer.py`` established (and whose
+public functions now alias into this module): named wall-clock scopes on
+the host side of an async device pipeline. A scope that merely *launches*
+a jitted program measures launch cost, not device time; scopes that want
+device time must block (``sync_value`` / :func:`device_wait`), and the
+explicit ``device_wait`` category marks the points where the pipeline
+actually blocks so the report separates "host work" from "waiting on the
+chip". Op-level *device* attribution is a different mechanism entirely —
+see :mod:`lightgbm_tpu.telemetry.xplane`.
+
+Three modes:
+
+  * ``OFF``    (default) — every entry point is a no-op behind one int
+    compare; nothing is recorded, nothing prints at exit, and no extra
+    ``block_until_ready`` is inserted anywhere.
+  * ``TIMERS`` — counters only: per-name accumulated seconds + hit counts
+    (the TIMETAG-style report), no per-event storage.
+  * ``TRACE``  — counters plus a bounded in-memory timeline of span events
+    (begin timestamp, duration, thread, nesting parent, tags) that
+    exports to ``chrome://tracing`` JSON via :mod:`export`.
+
+Thread safety: one process-wide lock guards the counter tables and the
+event buffer; the per-thread nesting stack lives in thread-local storage.
+"""
+from __future__ import annotations
+
+import atexit
+import contextlib
+import functools
+import os
+import threading
+import time
+from collections import defaultdict
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+OFF, TIMERS, TRACE = 0, 1, 2
+_MODE_NAMES = {"off": OFF, "timers": TIMERS, "trace": TRACE,
+               "0": OFF, "1": TIMERS, "false": OFF, "true": TIMERS}
+
+# bounded trace buffer: ~120 bytes/event, so the cap is ~120MB worst case;
+# past it events are dropped (and counted) rather than OOMing a long run
+MAX_EVENTS = 1_000_000
+
+_lock = threading.RLock()
+_acc: Dict[str, float] = defaultdict(float)
+_acc_self: Dict[str, float] = defaultdict(float)   # minus child-span time
+_cnt: Dict[str, int] = defaultdict(int)
+_cat: Dict[str, str] = {}
+_counts: Dict[str, float] = defaultdict(float)
+_count_cat: Dict[str, str] = {}
+_events: List[dict] = []
+_dropped = 0
+_iter_records: List[dict] = []
+_tls = threading.local()
+_out_path: Optional[str] = None
+_exported = False
+_compile_hook_on = False
+
+# perf_counter offset -> unix epoch, so trace timestamps are absolute
+_EPOCH = time.time() - time.perf_counter()
+
+
+def _env_mode() -> int:
+    v = os.environ.get("LIGHTGBM_TPU_TELEMETRY", "").strip().lower()
+    if v in _MODE_NAMES:
+        return _MODE_NAMES[v]
+    # legacy switch from utils/timer.py: TIMETAG=1 -> timers mode
+    if os.environ.get("LIGHTGBM_TPU_TIMETAG", "") not in ("", "0"):
+        return TIMERS
+    return OFF
+
+
+_mode = _env_mode()
+# what turned telemetry on: "env" (import-time env var), "api" (an explicit
+# enable()/disable() call), or "config" (tpu_telemetry= params). Only
+# config-driven enablement is scoped to the run that asked for it — the next
+# train with default params turns it back off (see configure()).
+_mode_source = "env"
+
+
+# ---------------------------------------------------------------------------
+# mode control
+# ---------------------------------------------------------------------------
+
+def mode() -> int:
+    return _mode
+
+
+def enabled() -> bool:
+    return _mode != OFF
+
+
+def tracing() -> bool:
+    return _mode == TRACE
+
+
+def enable(new_mode="timers") -> None:
+    global _mode, _mode_source
+    if isinstance(new_mode, str):
+        new_mode = _MODE_NAMES.get(new_mode.strip().lower(), TIMERS)
+    _mode = max(int(new_mode), TIMERS)
+    _mode_source = "api"
+    _install_compile_hook()
+
+
+def disable() -> None:
+    global _mode, _mode_source
+    _mode = OFF
+    _mode_source = "api"
+
+
+def configure(mode_name: str, out: Optional[str] = None) -> None:
+    """Apply a ``tpu_telemetry=`` / ``telemetry_out=`` pair.
+
+    ``off`` (the default) ends any previous *config*-driven session —
+    telemetry from one ``lgb.train(tpu_telemetry=...)`` call must not leak
+    into the next train in the process — but never force-disables a session
+    turned on by the env var or an explicit :func:`enable` call."""
+    global _mode, _mode_source, _out_path
+    m = str(mode_name).strip().lower()
+    if m in ("", "off", "0", "false"):
+        if out:
+            _out_path = str(out)
+        if _mode_source == "config":
+            _mode = _env_mode()
+            _mode_source = "env"
+        return
+    if m not in _MODE_NAMES:
+        from ..utils.log import Log
+        Log.warning("Unknown tpu_telemetry=%s (expected off|timers|trace); "
+                    "telemetry stays %s"
+                    % (mode_name, "off" if _mode == OFF else "on"))
+        return
+    if out:
+        _out_path = str(out)
+    enable(m)
+    _mode_source = "config"
+
+
+def configure_from_config(config) -> None:
+    configure(getattr(config, "tpu_telemetry", "off"),
+              getattr(config, "telemetry_out", "") or None)
+
+
+def out_path() -> Optional[str]:
+    return _out_path
+
+
+def set_out_path(path: Optional[str]) -> None:
+    global _out_path
+    _out_path = path
+
+
+def reset() -> None:
+    global _dropped, _exported
+    with _lock:
+        _acc.clear()
+        _acc_self.clear()
+        _cnt.clear()
+        _cat.clear()
+        _counts.clear()
+        _count_cat.clear()
+        del _events[:]
+        del _iter_records[:]
+        _dropped = 0
+        _exported = False
+
+
+# ---------------------------------------------------------------------------
+# recording
+# ---------------------------------------------------------------------------
+
+def add(name: str, seconds: float, category: str = "misc") -> None:
+    """Accumulate `seconds` under `name` (counter only, no trace event)."""
+    if _mode == OFF:
+        return
+    with _lock:
+        _acc[name] += seconds
+        _acc_self[name] += seconds
+        _cnt[name] += 1
+        _cat.setdefault(name, category)
+
+
+def count(name: str, inc: float = 1.0, category: str = "count") -> None:
+    """Unit-less monotonic counter (leaf counts, recompiles, drops...)."""
+    if _mode == OFF:
+        return
+    with _lock:
+        _counts[name] += inc
+        _count_cat.setdefault(name, category)
+
+
+def _stack() -> list:
+    st = getattr(_tls, "stack", None)
+    if st is None:
+        st = _tls.stack = []
+    return st
+
+
+def _record_event(name: str, category: str, t0: float, t1: float,
+                  parent: Optional[str], tags: Optional[dict]) -> None:
+    global _dropped
+    ev = {"name": name, "cat": category, "ts": t0 + _EPOCH,
+          "dur": t1 - t0, "tid": threading.get_ident()}
+    if parent is not None:
+        ev["parent"] = parent
+    if tags:
+        ev["args"] = tags
+    with _lock:
+        if len(_events) < MAX_EVENTS:
+            _events.append(ev)
+        else:
+            _dropped += 1
+
+
+@contextlib.contextmanager
+def scope(name: str, category: str = "misc", sync_value=None, **tags):
+    """Accumulate the wall time of the enclosed block under `name`.
+
+    When `sync_value` is a callable, it is invoked on exit and its result
+    passed to jax.block_until_ready before the clock stops — use for
+    scopes whose cost is a device computation. In TRACE mode the span is
+    also appended to the event timeline with its nesting parent.
+    """
+    if _mode == OFF:
+        yield
+        return
+    st = _stack()
+    parent = st[-1][0] if st else None
+    st.append([name, 0.0])   # [name, accumulated child-span seconds]
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        if sync_value is not None:
+            try:
+                import jax
+                jax.block_until_ready(sync_value())
+            except Exception:
+                pass
+        t1 = time.perf_counter()
+        entry = st.pop()
+        elapsed = t1 - t0
+        if st:
+            st[-1][1] += elapsed
+        with _lock:
+            _acc[name] += elapsed
+            _acc_self[name] += elapsed - entry[1]
+            _cnt[name] += 1
+            _cat.setdefault(name, category)
+        if _mode == TRACE:
+            _record_event(name, category, t0, t1, parent, tags or None)
+
+
+def timed(name: str, category: str = "misc") -> Callable:
+    """Decorator form (the FunctionTimer analog)."""
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrap(*a, **k):
+            if _mode == OFF:
+                return fn(*a, **k)
+            with scope(name, category=category):
+                return fn(*a, **k)
+        return wrap
+    return deco
+
+
+def _is_tracer(x) -> bool:
+    try:
+        from jax.core import Tracer
+    except ImportError:  # pragma: no cover - jax internals moved
+        from jax._src.core import Tracer
+    return isinstance(x, Tracer)
+
+
+def launch_wrapper(fn, name: str, category: str = "ops",
+                   tracer_arg: Optional[int] = None, **tags) -> Callable:
+    """Wrap a jitted callable in a launch-cost span (OFF: one int compare).
+
+    Dispatch is async, so the span measures LAUNCH cost; device time shows
+    up at the next sync point or the xplane profile. When ``tracer_arg``
+    names a positional argument, the span name gains a ``(trace)`` /
+    ``(launch)`` suffix depending on whether that argument is a jax Tracer
+    — i.e. the call is being traced into an outer jit (the fused
+    K-iteration scans), costing trace-construction once per compile."""
+    @functools.wraps(fn)
+    def wrapper(*a, **k):
+        if _mode == OFF:
+            return fn(*a, **k)
+        n = name
+        if tracer_arg is not None:
+            n += "(trace)" if _is_tracer(a[tracer_arg]) else "(launch)"
+        with scope(n, category=category, **tags):
+            return fn(*a, **k)
+    return wrapper
+
+
+def device_wait(name: str, value, **tags):
+    """Block on `value` (jax.block_until_ready) inside a span of the
+    explicit ``device_wait`` category; returns `value`. When telemetry is
+    OFF this does NOT block — pipeline timing stays untouched — so only
+    wrap values that a subsequent host read would block on anyway."""
+    if _mode == OFF:
+        return value
+    with scope(name, category="device_wait", **tags):
+        try:
+            import jax
+            jax.block_until_ready(value)
+        except Exception:
+            pass
+    return value
+
+
+def record_iteration(rec: dict) -> None:
+    """Store one TrainingMonitor per-iteration record for export."""
+    if _mode == OFF:
+        return
+    with _lock:
+        _iter_records.append(rec)
+
+
+# ---------------------------------------------------------------------------
+# snapshots
+# ---------------------------------------------------------------------------
+
+def snapshot() -> Dict[str, Tuple[float, int]]:
+    """{name: (total seconds, hit count)} — the utils.timer contract."""
+    with _lock:
+        return {k: (_acc[k], _cnt[k]) for k in _acc}
+
+
+def snapshot_full() -> Dict[str, Tuple[float, int, str]]:
+    """{name: (total seconds, hit count, category)}."""
+    with _lock:
+        return {k: (_acc[k], _cnt[k], _cat.get(k, "misc")) for k in _acc}
+
+
+def counts_snapshot() -> Dict[str, float]:
+    with _lock:
+        return dict(_counts)
+
+
+def category_totals() -> Dict[str, float]:
+    """SELF-seconds per category — the coarse phase breakdown.
+
+    Nested child-span time is subtracted from each span before summing
+    (boosting::TrainOneIter encloses tree_learner:: and ops:: spans; the
+    inclusive per-name table would count the same second up to 4 times
+    across categories), so these values near-partition the instrumented
+    wall time. Exception: ``compile`` rides jax.monitoring callbacks that
+    fire *inside* host spans, so it can still overlap the host categories.
+    The per-name tables (:func:`snapshot` / :func:`snapshot_full`) stay
+    inclusive, matching the reference Timer semantics."""
+    out: Dict[str, float] = defaultdict(float)
+    with _lock:
+        for k, sec in _acc_self.items():
+            out[_cat.get(k, "misc")] += sec
+    return dict(out)
+
+
+def events_snapshot() -> List[dict]:
+    with _lock:
+        return list(_events)
+
+
+def dropped_events() -> int:
+    return _dropped
+
+
+def iteration_records() -> List[dict]:
+    with _lock:
+        return list(_iter_records)
+
+
+# ---------------------------------------------------------------------------
+# XLA compile tracking (recompile counts for the TrainingMonitor)
+# ---------------------------------------------------------------------------
+
+def _on_jax_duration(event: str, duration: float, **kw) -> None:
+    if _mode == OFF:
+        return
+    if "backend_compile" in event:
+        with _lock:
+            _acc["jax::backend_compile"] += duration
+            _acc_self["jax::backend_compile"] += duration
+            _cnt["jax::backend_compile"] += 1
+            _cat.setdefault("jax::backend_compile", "compile")
+            _counts["jax::backend_compile"] += 1.0
+            _count_cat.setdefault("jax::backend_compile", "compile")
+
+
+def _install_compile_hook() -> None:
+    """Count XLA backend compiles via jax.monitoring (idempotent; the
+    listener itself no-ops when telemetry is OFF)."""
+    global _compile_hook_on
+    if _compile_hook_on:
+        return
+    try:
+        import jax
+        jax.monitoring.register_event_duration_secs_listener(_on_jax_duration)
+        _compile_hook_on = True
+    except Exception:  # pragma: no cover - very old jax
+        pass
+
+
+if _mode != OFF:
+    _install_compile_hook()
+
+
+# ---------------------------------------------------------------------------
+# exit hook: the reference global_timer-destructor report
+# ---------------------------------------------------------------------------
+
+@atexit.register
+def _report_at_exit() -> None:  # pragma: no cover - exit path
+    if _mode == OFF:
+        return
+    from . import export
+    if _mode == TRACE and _out_path and not _exported:
+        try:
+            export.maybe_export()
+        except Exception:
+            pass
+    export.print_report()

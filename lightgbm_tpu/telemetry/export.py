@@ -1,0 +1,128 @@
+"""Telemetry writers: Chrome trace JSON, JSONL metrics snapshots, text report.
+
+``write_chrome_trace`` emits the ``chrome://tracing`` / Perfetto "JSON
+Array Format": one complete ("ph": "X") event per recorded span with
+microsecond timestamps, pid/tid lanes, the category string, and the span
+tags under "args". ``write_metrics_jsonl`` emits one JSON object per line:
+a header, one line per named counter, one per unit-less count, and one per
+TrainingMonitor iteration record — grep/jq-friendly and append-safe.
+
+``print_report`` keeps the exact shape of the original
+``utils.timer.print_report`` table (sorted by total seconds) so existing
+eyeballs and scripts keep working; categories show as a suffix column.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+from . import events
+
+
+def chrome_trace_events(evs=None, pid: int = 0) -> list:
+    """Recorded spans -> chrome trace event dicts (ts/dur in microseconds)."""
+    if evs is None:
+        evs = events.events_snapshot()
+    out = []
+    for ev in evs:
+        rec = {"name": ev["name"], "cat": ev.get("cat", "misc"), "ph": "X",
+               "ts": ev["ts"] * 1e6, "dur": ev["dur"] * 1e6,
+               "pid": pid, "tid": ev.get("tid", 0)}
+        args = dict(ev.get("args") or {})
+        if "parent" in ev:
+            args["parent"] = ev["parent"]
+        if args:
+            rec["args"] = args
+        out.append(rec)
+    return out
+
+
+def write_chrome_trace(path: str, evs=None) -> str:
+    """Write the span timeline as chrome://tracing JSON; returns `path`."""
+    trace = {
+        "traceEvents": chrome_trace_events(evs),
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "producer": "lightgbm_tpu.telemetry",
+            "dropped_events": events.dropped_events(),
+        },
+    }
+    d = os.path.dirname(os.path.abspath(path))
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(trace, f)
+    return path
+
+
+def write_metrics_jsonl(path: str) -> str:
+    """Counters + counts + per-iteration monitor records, one JSON/line."""
+    d = os.path.dirname(os.path.abspath(path))
+    if d:
+        os.makedirs(d, exist_ok=True)
+    snap = events.snapshot_full()
+    with open(path, "w") as f:
+        f.write(json.dumps({"kind": "header", "time": time.time(),
+                            "categories": events.category_totals(),
+                            "dropped_events": events.dropped_events()})
+                + "\n")
+        for name, (sec, n, cat) in sorted(snap.items(),
+                                          key=lambda kv: -kv[1][0]):
+            f.write(json.dumps({"kind": "timer", "name": name,
+                                "seconds": round(sec, 6), "count": n,
+                                "category": cat}) + "\n")
+        for name, v in sorted(events.counts_snapshot().items()):
+            f.write(json.dumps({"kind": "count", "name": name,
+                                "value": v}) + "\n")
+        for rec in events.iteration_records():
+            f.write(json.dumps(dict({"kind": "iteration"}, **rec)) + "\n")
+    return path
+
+
+def _paths(base: str):
+    """telemetry_out -> (chrome trace path, metrics jsonl path)."""
+    if base.endswith(".json"):
+        return base, base[:-5] + ".metrics.jsonl"
+    return base + ".trace.json", base + ".metrics.jsonl"
+
+
+def maybe_export(out: Optional[str] = None):
+    """Write trace + metrics files when TRACE mode is on. Returns the
+    (trace_path, metrics_path) pair, or None when nothing was written."""
+    if not events.tracing():
+        return None
+    base = out or events.out_path() or "lightgbm_tpu_trace.json"
+    trace_path, metrics_path = _paths(base)
+    write_chrome_trace(trace_path)
+    write_metrics_jsonl(metrics_path)
+    events._exported = True
+    return trace_path, metrics_path
+
+
+def format_report(snap=None) -> str:
+    """Sorted-by-time table, like Timer::Print (common.h:1059)."""
+    if snap is None:
+        snap = events.snapshot_full()
+    if not snap:
+        return ""
+    lines = ["[LightGBM-TPU] [Info] time-tag report "
+             "(host wall per named scope; async launches exclude device "
+             "time)"]
+    total = sum(v for v, _, _ in snap.values())
+    width = max(len(k) for k in snap)
+    for name, (sec, n, cat) in sorted(snap.items(), key=lambda kv: -kv[1][0]):
+        lines.append("  %-*s %10.3fs  x%-7d %5.1f%%  [%s]"
+                     % (width, name, sec, n,
+                        100.0 * sec / max(total, 1e-12), cat))
+    lines.append("  %-*s %10.3fs" % (width, "(sum)", total))
+    return "\n".join(lines)
+
+
+def print_report(out=None) -> None:
+    text = format_report()
+    if not text:
+        return
+    import sys
+    print(text, file=out or sys.stderr)

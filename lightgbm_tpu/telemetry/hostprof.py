@@ -1,0 +1,165 @@
+"""Host-side dev profiling helpers behind prof_bin.py / prof_split.py.
+
+Not CI: these run cProfile over the binning pipeline and microbenchmark the
+per-split device components on whatever backend jax exposes. The top-level
+``prof_bin.py`` / ``prof_split.py`` scripts are thin wrappers over this
+module so the logic lives with the rest of the telemetry subsystem.
+"""
+from __future__ import annotations
+
+import cProfile
+import pstats
+import time
+
+
+def profile_binning(n_rows: int = 500_000, top: int = 25):
+    """cProfile Dataset construction (the old prof_bin.py)."""
+    import lightgbm_tpu as lgb
+    from ..data.synth import make_higgs_like
+
+    X, y = make_higgs_like(n_rows)
+    pr = cProfile.Profile()
+    pr.enable()
+    ds = lgb.Dataset(X, y)
+    ds.construct()
+    pr.disable()
+    st = pstats.Stats(pr)
+    st.sort_stats("cumulative").print_stats(top)
+    return st
+
+
+# ---------------------------------------------------------------------------
+# per-split component microbenchmarks (the old prof_split.py)
+# ---------------------------------------------------------------------------
+
+def _timeit(fn, *args, iters: int = 50) -> float:
+    import jax
+    fn(*args)  # compile
+    jax.block_until_ready(fn(*args))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def bench_pack(C: int, G_: int = 28) -> None:
+    """Sort-pack vs matmul-pack of one partition chunk."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    import lightgbm_tpu as lgb  # noqa: F401  (x64 etc.)
+    from ..ops import grow as G
+
+    rng = np.random.default_rng(0)
+    bw = jnp.asarray(rng.integers(0, 255, (C, G_)), jnp.uint8)
+    gw = jnp.asarray(rng.normal(size=C), jnp.float32)
+    hw = jnp.asarray(rng.random(C), jnp.float32)
+    rbw = jnp.asarray(rng.integers(0, 1 << 30, C), jnp.uint32)
+    key = jnp.asarray(rng.integers(0, 3, C), jnp.uint32)
+
+    @jax.jit
+    def sort_pack(key, bw, gw, hw, rbw):
+        return G._pack_sort(key, bw, gw, hw, rbw, 8)
+
+    t_sort = _timeit(sort_pack, key, bw, gw, hw, rbw)
+
+    gl = key == 0
+    gr = key == 2
+
+    @jax.jit
+    def mm_pack(gl, gr, bw, gw, hw, rbw):
+        posl = jnp.cumsum(gl, dtype=jnp.int32) - 1
+        nR = jnp.sum(gr, dtype=jnp.int32)
+        posr = (C - nR) + jnp.cumsum(gr, dtype=jnp.int32) - 1
+        slot = jnp.where(gl, posl, jnp.where(gr, posr, C))
+        rb_hi = (rbw >> jnp.uint32(12)).astype(jnp.float32)
+        rb_lo = (rbw & jnp.uint32(4095)).astype(jnp.float32)
+        payload = jnp.concatenate([
+            bw.astype(jnp.float32), gw[:, None], hw[:, None],
+            rb_hi[:, None], rb_lo[:, None]], axis=1)
+        return G._pack_matmul(slot, payload, C)
+
+    t_mm = _timeit(mm_pack, gl, gr, bw, gw, hw, rbw)
+    print("pack C=%6d: sort=%8.1fus (%6.2f ns/row)  matmul=%8.1fus "
+          "(%6.2f ns/row)" % (C, t_sort * 1e6, t_sort / C * 1e9,
+                              t_mm * 1e6, t_mm / C * 1e9))
+
+
+def bench_hist_chunk(C: int, G_: int = 28, W: int = 256) -> None:
+    """One Pallas histogram chunk."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    import lightgbm_tpu as lgb  # noqa: F401
+    from ..ops.pallas_histogram import hist_window
+
+    rng = np.random.default_rng(0)
+    bw = jnp.asarray(rng.integers(0, 255, (C, G_)), jnp.int32)
+    gw = jnp.asarray(rng.normal(size=C), jnp.float32)
+    hw = jnp.asarray(rng.random(C), jnp.float32)
+
+    @jax.jit
+    def pallas_chunk(bw, gw, hw):
+        return hist_window(bw.T, gw, hw, W)
+
+    t = _timeit(pallas_chunk, bw, gw, hw)
+    print("hist C=%6d: pallas=%8.1fus (%6.2f ns/row)"
+          % (C, t * 1e6, t / C * 1e9))
+
+
+def bench_scan(F: int = 28, W: int = 256) -> None:
+    """The dense best-split scan on one histogram pair."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    import lightgbm_tpu as lgb
+    from ..ops.split import (FeatureMeta, SplitParams,
+                             find_best_split_numerical)
+
+    TB = F * (W - 1)
+    rng = np.random.default_rng(0)
+    hist = jnp.asarray(rng.random((TB, 2)), jnp.float32)
+    bs = jnp.arange(F, dtype=jnp.int32) * (W - 1)
+    meta = FeatureMeta(
+        feat_id=jnp.repeat(jnp.arange(F, dtype=jnp.int32), W - 1),
+        bin_start=bs, bin_end=bs + (W - 1),
+        missing_type=jnp.zeros(F, jnp.int32),
+        default_bin=jnp.zeros(F, jnp.int32),
+        monotone=jnp.zeros(F, jnp.int32),
+        is_categorical=jnp.zeros(F, bool),
+        penalty=jnp.ones(F, jnp.float64))
+    params = SplitParams.from_config(lgb.Config({}))
+    fmask = jnp.ones(F, bool)
+
+    @jax.jit
+    def scan2(hist2):
+        def one(h):
+            return find_best_split_numerical(
+                h, jnp.asarray(1.0, jnp.float32),
+                jnp.asarray(100.0, jnp.float32),
+                jnp.asarray(1000, jnp.int32), meta, params,
+                jnp.asarray(-jnp.inf, jnp.float32),
+                jnp.asarray(jnp.inf, jnp.float32), fmask,
+                num_features=F, use_mc=False, max_w=W, use_dp=False,
+                use_l1=False, use_mds=False)
+        return jax.vmap(one)(hist2)
+
+    hist2 = jnp.stack([hist, hist])
+    t = _timeit(scan2, hist2)
+    print("scan pair (F=%d, W=%d): %8.1fus" % (F, W, t * 1e6))
+
+
+def run_split_microbench() -> None:
+    """The full prof_split.py sweep."""
+    for C in (1024, 2048, 4096, 8192, 16384):
+        bench_pack(C)
+    for C in (2048, 8192, 32768):
+        bench_hist_chunk(C)
+    bench_scan()

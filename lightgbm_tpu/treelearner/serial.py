@@ -25,6 +25,7 @@ from ..ops.grow import (DataLayout, FixInfo, ForcedInfo, GrowConfig,
                         GrowExtras, default_extras, empty_cat_layout,
                         empty_forced, grow_tree, grow_tree_partitioned)
 from ..ops.split import CatLayout, FeatureMeta, SplitParams
+from ..telemetry import events as telemetry
 from ..utils.log import Log
 
 # below this many rows the masked full-N grower compiles faster and the
@@ -397,6 +398,7 @@ class SerialTreeLearner:
         self.grow_config = new_gc
         return changed
 
+    @telemetry.timed("tree_learner::Train(launch)", category="tree_learner")
     def train_arrays(self, grad: jnp.ndarray, hess: jnp.ndarray,
                      bag_mask: jnp.ndarray):
         """Grow one tree fully on device; returns TreeArrays WITHOUT any
@@ -566,6 +568,8 @@ class SerialTreeLearner:
             cache[dkey] = driver
         return assets, gr, driver
 
+    @telemetry.timed("tree_learner::TrainScanPersist(launch)",
+                     category="tree_learner")
     def train_arrays_scan_persist(self, objective, score0, fmasks, wkeys,
                                   iters, shrink: float, k: int,
                                   bag_spec=("none",)):
@@ -594,6 +598,8 @@ class SerialTreeLearner:
         gr = self._persist_gr
         return gr.finalize_scores(pay).astype(jnp.float64)
 
+    @telemetry.timed("tree_learner::TrainScan(launch)",
+                     category="tree_learner")
     def train_arrays_scan(self, objective, score0, fmasks, keys,
                           shrink: float, k: int):
         """K boosting iterations in ONE jitted lax.scan: gradients ->
@@ -700,8 +706,10 @@ class SerialTreeLearner:
         import jax
         # row_leaf stays on device: the host Tree never reads it and the
         # [N] transfer would dominate under remote-TPU dispatch
-        host = jax.device_get(
-            arrays._replace(row_leaf=jnp.zeros((0,), jnp.int32)))
+        with telemetry.scope("tree_learner::SyncTree(D2H+wait)",
+                             category="device_wait"):
+            host = jax.device_get(
+                arrays._replace(row_leaf=jnp.zeros((0,), jnp.int32)))
         tree = Tree.from_grower(host, self.dataset)
         return tree, arrays.row_leaf
 

@@ -1,133 +1,55 @@
-"""Named-scope wall-clock accounting: where does training time go?
+"""Named-scope wall-clock accounting — thin aliases over the telemetry
+subsystem.
 
-TPU-native analog of the reference's compile-time-gated ``Timer`` /
-``FunctionTimer`` pair (include/LightGBM/utils/common.h:1026-1105, enabled
-with -DUSE_TIMETAG): one process-global accumulator of named durations,
-RAII-style scopes on the hot functions, a sorted report at exit.
-
-Differences driven by the JAX execution model:
-  * dispatch is async — a scope that merely *launches* a jitted program
-    measures launch cost, not device time. Scopes that want device time
-    must block (``sync=True`` passes the scope's result through
-    ``jax.block_until_ready``). The growers keep async pipelining, so by
-    default the report shows the honest host-side decomposition (binning,
-    gradient compute, launch, materialize/transfer, eval) and one "device
-    wait" bucket where the pipeline actually blocks.
-  * enablement is a runtime env var (``LIGHTGBM_TPU_TIMETAG=1``) or
-    ``timer.enable()``, not a compile flag.
-
-Report via ``lightgbm_tpu.utils.timer.print_report()`` (also auto-printed
-at interpreter exit when enabled, like the reference's global_timer dtor).
+This module used to own the process-global accumulator (the TPU-native
+analog of the reference's compile-time-gated ``Timer`` / ``FunctionTimer``
+pair, include/LightGBM/utils/common.h:1026-1105, -DUSE_TIMETAG). That
+registry now lives in :mod:`lightgbm_tpu.telemetry.events` — with span
+categories, a trace-event timeline, and Chrome-trace/JSONL export — and
+this module keeps the original call surface (``timer.timed``,
+``timer.scope``, ``timer.enable``, ``timer.print_report``,
+``LIGHTGBM_TPU_TIMETAG=1``, the atexit report) as pass-throughs so
+existing call sites keep working unchanged.
 """
 from __future__ import annotations
 
-import atexit
-import contextlib
-import functools
-import os
-import threading
-import time
-from collections import defaultdict
 from typing import Callable, Dict, Tuple
 
-_lock = threading.Lock()
-_acc: Dict[str, float] = defaultdict(float)
-_cnt: Dict[str, int] = defaultdict(int)
-_enabled = os.environ.get("LIGHTGBM_TPU_TIMETAG", "") not in ("", "0")
-_stack = threading.local()
+from ..telemetry import events as _ev
+from ..telemetry.export import print_report  # noqa: F401  (re-export)
 
 
 def enable() -> None:
-    global _enabled
-    _enabled = True
+    _ev.enable("timers")
 
 
 def disable() -> None:
-    global _enabled
-    _enabled = False
+    _ev.disable()
 
 
 def enabled() -> bool:
-    return _enabled
+    return _ev.enabled()
 
 
 def reset() -> None:
-    with _lock:
-        _acc.clear()
-        _cnt.clear()
+    _ev.reset()
 
 
 def add(name: str, seconds: float) -> None:
-    with _lock:
-        _acc[name] += seconds
-        _cnt[name] += 1
+    _ev.add(name, seconds)
 
 
-@contextlib.contextmanager
-def scope(name: str, sync_value=None):
-    """Accumulate the wall time of the enclosed block under `name`.
-
-    When `sync_value` is a callable, it is invoked on exit and its result
-    passed to jax.block_until_ready before the clock stops — use for
-    scopes whose cost is a device computation.
-    """
-    if not _enabled:
-        yield
-        return
-    t0 = time.perf_counter()
-    try:
-        yield
-    finally:
-        if sync_value is not None:
-            try:
-                import jax
-                jax.block_until_ready(sync_value())
-            except Exception:
-                pass
-        add(name, time.perf_counter() - t0)
+def scope(name: str, sync_value=None, category: str = "misc"):
+    """Accumulate the wall time of the enclosed block under `name` (see
+    telemetry.events.scope; `sync_value` blocks on a device value before
+    the clock stops)."""
+    return _ev.scope(name, category=category, sync_value=sync_value)
 
 
-def timed(name: str) -> Callable:
+def timed(name: str, category: str = "misc") -> Callable:
     """Decorator form (the FunctionTimer analog)."""
-    def deco(fn):
-        @functools.wraps(fn)
-        def wrap(*a, **k):
-            if not _enabled:
-                return fn(*a, **k)
-            t0 = time.perf_counter()
-            try:
-                return fn(*a, **k)
-            finally:
-                add(name, time.perf_counter() - t0)
-        return wrap
-    return deco
+    return _ev.timed(name, category=category)
 
 
 def snapshot() -> Dict[str, Tuple[float, int]]:
-    with _lock:
-        return {k: (_acc[k], _cnt[k]) for k in _acc}
-
-
-def print_report(out=None) -> None:
-    """Sorted-by-time table, like Timer::Print (common.h:1059)."""
-    snap = snapshot()
-    if not snap:
-        return
-    import sys
-    out = out or sys.stderr
-    total = sum(v for v, _ in snap.values())
-    print("[LightGBM-TPU] [Info] time-tag report "
-          "(host wall per named scope; async launches exclude device time)",
-          file=out)
-    width = max(len(k) for k in snap)
-    for name, (sec, n) in sorted(snap.items(), key=lambda kv: -kv[1][0]):
-        print("  %-*s %10.3fs  x%-7d %5.1f%%"
-              % (width, name, sec, n, 100.0 * sec / max(total, 1e-12)),
-              file=out)
-    print("  %-*s %10.3fs" % (width, "(sum)", total), file=out)
-
-
-@atexit.register
-def _report_at_exit() -> None:  # pragma: no cover - exit path
-    if _enabled:
-        print_report()
+    return _ev.snapshot()

@@ -1,16 +1,6 @@
-import cProfile
-import pstats
-import sys
+"""cProfile of Dataset construction (dev tool, not CI). Thin wrapper over
+lightgbm_tpu.telemetry.hostprof.profile_binning."""
+from lightgbm_tpu.telemetry.hostprof import profile_binning
 
-from bench import make_higgs_like
-
-import lightgbm_tpu as lgb
-
-X, y = make_higgs_like(500_000)
-pr = cProfile.Profile()
-pr.enable()
-ds = lgb.Dataset(X, y)
-ds.construct()
-pr.disable()
-st = pstats.Stats(pr)
-st.sort_stats("cumulative").print_stats(25)
+if __name__ == "__main__":
+    profile_binning(500_000)

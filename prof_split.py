@@ -1,123 +1,8 @@
 """Microbenchmark the per-split components of the partitioned grower on the
 real chip (dev tool, not CI): pack (sort vs matmul) at several chunk sizes,
-the Pallas histogram chunk, and the dense best-split scan. Identifies where
-the ~ms/split fixed cost lives."""
-import time
-
-import numpy as np
-
-import jax
-import jax.numpy as jnp
-
-import lightgbm_tpu as lgb  # noqa: F401  (x64 etc.)
-from lightgbm_tpu.ops import grow as G
-from lightgbm_tpu.ops.split import SplitParams, find_best_split_numerical
-
-
-def timeit(fn, *args, iters=50):
-    fn(*args)  # compile
-    jax.block_until_ready(fn(*args))
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / iters
-
-
-def bench_pack(C, G_=28):
-    rng = np.random.default_rng(0)
-    bw = jnp.asarray(rng.integers(0, 255, (C, G_)), jnp.uint8)
-    gw = jnp.asarray(rng.normal(size=C), jnp.float32)
-    hw = jnp.asarray(rng.random(C), jnp.float32)
-    rbw = jnp.asarray(rng.integers(0, 1 << 30, C), jnp.uint32)
-    key = jnp.asarray(rng.integers(0, 3, C), jnp.uint32)
-
-    @jax.jit
-    def sort_pack(key, bw, gw, hw, rbw):
-        return G._pack_sort(key, bw, gw, hw, rbw, 8)
-
-    t_sort = timeit(sort_pack, key, bw, gw, hw, rbw)
-
-    gl = key == 0
-    gr = key == 2
-
-    @jax.jit
-    def mm_pack(gl, gr, bw, gw, hw, rbw):
-        posl = jnp.cumsum(gl, dtype=jnp.int32) - 1
-        nR = jnp.sum(gr, dtype=jnp.int32)
-        posr = (C - nR) + jnp.cumsum(gr, dtype=jnp.int32) - 1
-        slot = jnp.where(gl, posl, jnp.where(gr, posr, C))
-        rb_hi = (rbw >> jnp.uint32(12)).astype(jnp.float32)
-        rb_lo = (rbw & jnp.uint32(4095)).astype(jnp.float32)
-        payload = jnp.concatenate([
-            bw.astype(jnp.float32), gw[:, None], hw[:, None],
-            rb_hi[:, None], rb_lo[:, None]], axis=1)
-        return G._pack_matmul(slot, payload, C)
-
-    t_mm = timeit(mm_pack, gl, gr, bw, gw, hw, rbw)
-    print(f"pack C={C:6d}: sort={t_sort*1e6:8.1f}us "
-          f"({t_sort/C*1e9:6.2f} ns/row)  matmul={t_mm*1e6:8.1f}us "
-          f"({t_mm/C*1e9:6.2f} ns/row)")
-
-
-def bench_hist_chunk(C, G_=28, W=256):
-    rng = np.random.default_rng(0)
-    bw = jnp.asarray(rng.integers(0, 255, (C, G_)), jnp.int32)
-    gw = jnp.asarray(rng.normal(size=C), jnp.float32)
-    hw = jnp.asarray(rng.random(C), jnp.float32)
-    from lightgbm_tpu.ops.pallas_histogram import hist_window
-
-    @jax.jit
-    def pallas_chunk(bw, gw, hw):
-        return hist_window(bw.T, gw, hw, W)
-
-    t = timeit(pallas_chunk, bw, gw, hw)
-    print(f"hist C={C:6d}: pallas={t*1e6:8.1f}us ({t/C*1e9:6.2f} ns/row)")
-
-
-def bench_scan(F=28, W=256):
-    TB = F * (W - 1)
-    rng = np.random.default_rng(0)
-    hist = jnp.asarray(rng.random((TB, 2)), jnp.float32)
-    from lightgbm_tpu.ops.split import FeatureMeta
-    bs = jnp.arange(F, dtype=jnp.int32) * (W - 1)
-    meta = FeatureMeta(
-        feat_id=jnp.repeat(jnp.arange(F, dtype=jnp.int32), W - 1),
-        bin_start=bs, bin_end=bs + (W - 1),
-        missing_type=jnp.zeros(F, jnp.int32),
-        default_bin=jnp.zeros(F, jnp.int32),
-        monotone=jnp.zeros(F, jnp.int32),
-        is_categorical=jnp.zeros(F, bool),
-        penalty=jnp.ones(F, jnp.float64))
-    params = SplitParams.from_config(lgb.Config({}))
-    fmask = jnp.ones(F, bool)
-
-    @jax.jit
-    def scan2(hist2):
-        def one(h):
-            return find_best_split_numerical(
-                h, jnp.asarray(1.0, jnp.float32), jnp.asarray(100.0, jnp.float32),
-                jnp.asarray(1000, jnp.int32), meta, params,
-                jnp.asarray(-jnp.inf, jnp.float32),
-                jnp.asarray(jnp.inf, jnp.float32), fmask,
-                num_features=F, use_mc=False, max_w=W, use_dp=False,
-                use_l1=False, use_mds=False)
-        return jax.vmap(one)(hist2)
-
-    hist2 = jnp.stack([hist, hist])
-    t = timeit(scan2, hist2)
-    print(f"scan pair (F={F}, W={W}): {t*1e6:8.1f}us")
-
-
-def bench_full_split_body(n_l, C):
-    """End-to-end cost proxy: pass A + pass B chunk loops for one split of a
-    leaf with n_l rows."""
-    print("(full-body benchmarks live in sweep_perf.py tree timing)")
-
+the Pallas histogram chunk, and the dense best-split scan. Thin wrapper —
+the benchmarks live in lightgbm_tpu.telemetry.hostprof."""
+from lightgbm_tpu.telemetry.hostprof import run_split_microbench
 
 if __name__ == "__main__":
-    for C in (1024, 2048, 4096, 8192, 16384):
-        bench_pack(C)
-    for C in (2048, 8192, 32768):
-        bench_hist_chunk(C)
-    bench_scan()
+    run_split_microbench()

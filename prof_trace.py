@@ -1,80 +1,14 @@
 """Op-level device-time profile of a short training run (dev tool, not CI).
 
-Traces N boosting iterations on the real chip, then parses the xplane proto
-directly (the tensorboard converter is broken against the installed TF) and
-prints device time per XLA op name, grouped, sorted by total duration.
+Thin wrapper kept for muscle memory: the xplane parsing and the traced
+training run now live in the package — see
+``lightgbm_tpu/telemetry/xplane.py`` and ``lightgbm_tpu/profile.py``.
 
-Usage: python prof_trace.py [rows] [iters]
+Usage: python prof_trace.py [rows] [iters]   (== python -m lightgbm_tpu.profile)
 """
-import os
 import sys
-import time
 
-os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
-
-import numpy as np
-import jax
-
-
-def main():
-    rows = int(sys.argv[1]) if len(sys.argv) > 1 else 2_000_000
-    iters = int(sys.argv[2]) if len(sys.argv) > 2 else 16
-
-    import lightgbm_tpu as lgb
-    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-    from bench import make_higgs_like
-
-    X, y = make_higgs_like(rows)
-    ds = lgb.Dataset(X, y)
-    ds.construct()
-    params = {"objective": "binary", "num_leaves": 255, "max_bin": 255,
-              "verbosity": -1, "metric": "none"}
-    # warmup/compile
-    warm = lgb.train(dict(params), ds, 17, verbose_eval=False)
-    warm._booster._materialize_pending()
-    del warm
-
-    tdir = "/tmp/lgbtrace"
-    os.system(f"rm -rf {tdir}")
-    jax.profiler.start_trace(tdir)
-    t0 = time.time()
-    booster = lgb.train(dict(params), ds, iters, verbose_eval=False)
-    booster._booster._materialize_pending()
-    jax.block_until_ready(booster._booster.train_score.score_device(0))
-    wall = time.time() - t0
-    jax.profiler.stop_trace()
-    print(f"wall={wall:.3f}s rows={rows} iters={iters} "
-          f"-> {rows*iters/wall/1e6:.2f} Mri/s")
-
-    # ---- parse xplane ----
-    from tensorflow.tsl.profiler.protobuf import xplane_pb2
-    import glob
-    path = glob.glob(f"{tdir}/**/*.xplane.pb", recursive=True)[0]
-    sp = xplane_pb2.XSpace()
-    sp.ParseFromString(open(path, "rb").read())
-    for plane in sp.planes:
-        if "TPU" not in plane.name and "Axon" not in plane.name:
-            continue
-        ev_meta = {m.id: m.name for m in plane.event_metadata.values()}
-        totals = {}
-        counts = {}
-        for line in plane.lines:
-            if "XLA Ops" not in line.name:
-                continue
-            for ev in line.events:
-                name = ev_meta.get(ev.metadata_id, "?")
-                totals[name] = totals.get(name, 0) + ev.duration_ps
-                counts[name] = counts.get(name, 0) + 1
-        if not totals:
-            continue
-        print(f"== plane: {plane.name} ==")
-        tot_all = sum(totals.values())
-        print(f"total device time: {tot_all/1e12:.3f}s "
-              f"({tot_all/1e12/iters*1000:.1f} ms/tree)")
-        for name, ps in sorted(totals.items(), key=lambda kv: -kv[1])[:40]:
-            print(f"{ps/1e12:8.3f}s {ps/1e12/iters*1000:7.2f}ms/tree "
-                  f"x{counts[name]:<7d} {name[:90]}")
-
+from lightgbm_tpu.profile import main
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

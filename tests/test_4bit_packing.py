@@ -56,6 +56,7 @@ def test_packed_model_identical(monkeypatch, force_partitioned):
     assert m_on.split("parameters:")[0] == m_off.split("parameters:")[0]
 
 
+@pytest.mark.slow  # tier-1 870s budget: cheaper sibling tests cover this area
 def test_packed_with_categoricals_and_bundles():
     rng = np.random.default_rng(8)
     n = 3000

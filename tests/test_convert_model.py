@@ -60,6 +60,7 @@ def test_convert_binary_with_missing(tmp_path):
     _check_model(bst, X, tmp_path, "bin", proba=bst.predict(X))
 
 
+@pytest.mark.slow  # tier-1 870s budget: cheaper sibling tests cover this area
 def test_convert_multiclass_and_categorical(tmp_path):
     rng = np.random.default_rng(1)
     n = 800

@@ -140,6 +140,7 @@ def test_add_features_from():
     np.testing.assert_array_equal(b1.predict(X), b2.predict(X))
 
 
+@pytest.mark.slow  # tier-1 870s budget: cheaper sibling tests cover this area
 def test_cli_save_binary_then_retrain(tmp_path):
     import subprocess
     import sys

@@ -88,6 +88,7 @@ def test_categorical_unseen_category_goes_right():
     np.testing.assert_allclose(unseen[1], 0.0, atol=1e-3)
 
 
+@pytest.mark.slow  # tier-1 870s budget: cheaper sibling tests cover this area
 def test_max_cat_to_onehot_paths_agree_on_separable_data():
     """One-hot path (few categories) and sorted many-vs-many path must both
     learn a separable categorical exactly."""

@@ -70,6 +70,7 @@ def test_forced_multival_matches_dense_regression_bundles():
     np.testing.assert_allclose(b0.predict(X), b1.predict(X), atol=1e-4)
 
 
+@pytest.mark.slow  # tier-1 870s budget: cheaper sibling tests cover this area
 def test_forced_multival_categorical():
     rng = np.random.default_rng(3)
     n = 2000
@@ -102,6 +103,7 @@ def test_sparse_auto_picks_multival_and_trains():
     assert acc > 0.8
 
 
+@pytest.mark.slow  # tier-1 870s budget: cheaper sibling tests cover this area
 def test_sparse_multival_matches_sparse_dense_layout():
     # same CSR data, layouts forced both ways: same quality to noise
     X, y = _wide_sparse(n=2500, f=120)
@@ -144,6 +146,7 @@ def test_multival_continued_training_binned_walk():
     assert r2 > 0.5
 
 
+@pytest.mark.slow  # 8-device shard_map compile: ~1 min on a 2-core CPU host
 def test_multival_sharded_matches_serial():
     """The ELL layout under the 8-device data-parallel mesh: the row-sparse
     arrays shard WITH the rows and the scatter histograms psum — trees

@@ -78,6 +78,7 @@ def test_data_parallel_nondivisible_rows():
                                rtol=1e-6, atol=1e-9)
 
 
+@pytest.mark.slow  # 8-device shard_map compile: ~1 min on a 2-core CPU host
 def test_train_end_to_end_data_parallel():
     """Full lgb.train with tree_learner=data matches serial predictions."""
     X, y = _problem(n=2000)
@@ -93,6 +94,7 @@ def test_train_end_to_end_data_parallel():
     np.testing.assert_allclose(p_s, p_p, rtol=1e-5, atol=1e-8)
 
 
+@pytest.mark.slow  # 8-device shard_map compile: ~1 min on a 2-core CPU host
 def test_dryrun_multichip_entry():
     """The driver's multichip gate must run in-process on the 8-dev mesh."""
     import sys

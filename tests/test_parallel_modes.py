@@ -11,6 +11,11 @@ import pytest
 
 import lightgbm_tpu as lgb
 
+# every test here trains on an 8-virtual-device shard_map mesh; on the
+# 2-core CPU CI host each is a 45-100s XLA compile, so the whole module
+# rides in the slow tier (tier-1 budget)
+pytestmark = pytest.mark.slow
+
 
 def _data(n=4000, f=10, seed=0):
     rng = np.random.default_rng(seed)

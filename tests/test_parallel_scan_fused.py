@@ -50,6 +50,7 @@ def _tree(learner_name, scan_impl):
 
 
 @pytest.mark.parametrize("mode", ["FeatureParallelTreeLearner"])
+@pytest.mark.slow  # 8-device shard_map compile: ~1 min on a 2-core CPU host
 def test_fused_scan_matches_xla(mode):
     # voting's fused path is experimental (vote ordering not yet
     # split-exact vs the XLA eval) and stays opt-in — see learners.py

@@ -123,6 +123,7 @@ def test_cegb_lazy_reference_parity(tmp_path):
             "iteration %d: ours=%.6f ref=%.6f" % (it, got, ref))
 
 
+@pytest.mark.slow  # tier-1 870s budget: cheaper sibling tests cover this area
 def test_cegb_lazy_zero_matches_coupled_zero():
     # a zero lazy penalty vector must reproduce the zero-coupled CEGB
     # model exactly (identical gain path, bitset contributes nothing)
@@ -135,6 +136,7 @@ def test_cegb_lazy_zero_matches_coupled_zero():
     np.testing.assert_allclose(bz.predict(X), bc.predict(X), atol=1e-12)
 
 
+@pytest.mark.slow  # tier-1 870s budget: cheaper sibling tests cover this area
 def test_cegb_lazy_heavy_penalty_suppresses_splits():
     # a per-row acquisition cost far above any gain: no split clears it
     X, y = _data(n=500, f=8)

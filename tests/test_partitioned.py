@@ -102,6 +102,7 @@ def test_train_partitioned_end_to_end(monkeypatch):
     assert acc > 0.85, acc
 
 
+@pytest.mark.slow  # tier-1 870s budget: cheaper sibling tests cover this area
 def test_batched_scan_matches_single_iterations(monkeypatch):
     """The fused K-iteration scan must produce the exact model the
     single-iteration path produces — same trees, same predictions (the

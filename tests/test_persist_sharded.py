@@ -19,6 +19,12 @@ import jax.numpy as jnp
 
 import lightgbm_tpu as lgb
 
+# the persist grower compiles large multi-stage programs (and most tests
+# here shard them over the 8-virtual-device mesh): 7-140s each on the
+# 2-core CPU CI host, ~14 min for the file — slow tier, not tier-1
+pytestmark = pytest.mark.slow
+
+
 N = 6144          # 8 shards x 768 rows
 F = 6
 ROUNDS = 16       # exactly one fused persist batch

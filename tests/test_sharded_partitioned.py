@@ -48,6 +48,7 @@ def _grow(learner_cls_name, cfg, ds, grad, hess, monkeypatch, force_part):
 
 @pytest.mark.parametrize("mode", ["DataParallelTreeLearner",
                                   "VotingParallelTreeLearner"])
+@pytest.mark.slow  # tier-1 870s budget: cheaper sibling tests cover this area
 def test_sharded_partitioned_matches_serial(mode, monkeypatch):
     X, y = _data()
     params = {"objective": "binary", "num_leaves": 31, "verbosity": -1,

@@ -111,6 +111,7 @@ def test_custom_objective_and_metric():
     assert "half_rmse" in str(m.evals_result_)
 
 
+@pytest.mark.slow  # tier-1 870s budget: cheaper sibling tests cover this area
 def test_class_weight_balanced_shifts_minority():
     rng = np.random.default_rng(8)
     X = rng.normal(size=(1200, 5))
@@ -151,6 +152,7 @@ def test_get_set_params_clone():
     assert m.get_params()["num_leaves"] == 7
 
 
+@pytest.mark.slow  # tier-1 870s budget: cheaper sibling tests cover this area
 def test_pipeline_and_grid_search():
     X_tr, X_te, y_tr, y_te = _reg_data(n=400)
     pipe = make_pipeline(StandardScaler(),

@@ -81,6 +81,7 @@ def test_sparse_reference_alignment():
     assert dv._inner.groups == ds._inner.groups
 
 
+@pytest.mark.slow  # tier-1 870s budget: cheaper sibling tests cover this area
 def test_sparse_predict_chunked_matches_dense():
     """Booster.predict on scipy CSR streams row blocks (no whole-matrix
     densify; reference PredictForCSR analog) and matches dense predict."""
