@@ -145,6 +145,44 @@ def main():
               % (expo["groups"], expo["features"], expo["rows"],
                  expo["iters"], expo["train_s"], expo["value"],
                  expo["vs_baseline"]), file=sys.stderr)
+    allst = None
+    if os.environ.get("BENCH_SKIP_ALLSTATE", "") != "1":
+        try:
+            if bench_telemetry:
+                telemetry.reset()
+            allst = run_allstate()
+            if bench_telemetry:
+                phase_snaps["allstate"] = _phase_stats(telemetry)
+        except Exception as exc:
+            print("# allstate phase failed: %r" % exc, file=sys.stderr)
+    if allst is not None:
+        result["allstate_value"] = allst["value"]
+        result["allstate_vs_baseline"] = allst["vs_baseline"]
+        print(json.dumps(result), flush=True)
+        print("# Allstate-like sparse one-hot (%d groups for %d features): "
+              "rows=%d iters=%d train=%.1fs -> %.2fM row-iters/s, vs anchor"
+              " (13.18M*500/348.1s = 18.94M): %.4f"
+              % (allst["groups"], allst["features"], allst["rows"],
+                 allst["iters"], allst["train_s"], allst["value"],
+                 allst["vs_baseline"]), file=sys.stderr)
+    yah = None
+    if os.environ.get("BENCH_SKIP_YAHOO", "") != "1":
+        try:
+            if bench_telemetry:
+                telemetry.reset()
+            yah = run_yahoo()
+            if bench_telemetry:
+                phase_snaps["yahoo_ltr"] = _phase_stats(telemetry)
+        except Exception as exc:
+            print("# yahoo phase failed: %r" % exc, file=sys.stderr)
+    if yah is not None:
+        result["yahoo_value"] = yah["value"]
+        result["yahoo_vs_baseline"] = yah["vs_baseline"]
+        print(json.dumps(result), flush=True)
+        print("# Yahoo-LTR-like lambdarank: rows=%d iters=%d train=%.1fs "
+              "-> %.2fM row-iters/s, vs anchor (473k*500/150.2s = 1.58M): "
+              "%.4f" % (yah["rows"], yah["iters"], yah["train_s"],
+                        yah["value"], yah["vs_baseline"]), file=sys.stderr)
     vote = None
     if os.environ.get("BENCH_SKIP_VOTING", "") != "1":
         try:
@@ -239,6 +277,70 @@ def run_expo():
             "groups": len(inner.groups), "features": inner.num_features,
             "value": round(throughput / 1e6, 3),
             "vs_baseline": round(throughput / anchor, 4)}
+
+
+# Allstate anchor: 13,184,290 rows x 4228 one-hot columns, 500 iters in
+# 348.084s (docs/Experiments.rst) => 18.94M row-iters/s
+ALLSTATE_THROUGHPUT = 13_184_290 * 500 / 348.084
+# Yahoo LTR anchor: 473,134 rows x 700 features, 500 iters in 150.186s
+# (docs/Experiments.rst) => 1.575M row-iters/s
+YAHOO_THROUGHPUT = 473_134 * 500 / 150.186
+
+
+def run_allstate():
+    """Allstate-shaped sparse one-hot throughput: ~4.1k binary features
+    EFB-bundled into byte groups, ingested as CSR (never densified)."""
+    import jax
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.data.synth import make_allstate_like
+    n_rows = int(os.environ.get("BENCH_ALLSTATE_ROWS", 1_000_000))
+    n_iters = int(os.environ.get("BENCH_ALLSTATE_ITERS", 64))
+    X, y = make_allstate_like(n_rows)
+    ds = lgb.Dataset(X, y)
+    ds.construct()
+    inner = ds._inner
+    params = {"objective": "binary", "num_leaves": 255, "max_bin": 255,
+              "verbosity": -1, "metric": "none"}
+    warm = lgb.train(dict(params), ds, 17, verbose_eval=False)
+    warm._booster._materialize_pending()
+    del warm
+    t0 = time.time()
+    bst = lgb.train(dict(params), ds, n_iters, verbose_eval=False)
+    bst._booster._materialize_pending()
+    jax.block_until_ready(bst._booster.train_score.score_device(0))
+    train_s = time.time() - t0
+    throughput = n_rows * n_iters / train_s
+    return {"rows": n_rows, "iters": n_iters, "train_s": train_s,
+            "groups": len(inner.groups), "features": inner.num_features,
+            "value": round(throughput / 1e6, 3),
+            "vs_baseline": round(throughput / ALLSTATE_THROUGHPUT, 4)}
+
+
+def run_yahoo():
+    """Yahoo-LTR-shaped lambdarank throughput (700 dense features)."""
+    import jax
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.data.synth import make_yahoo_like
+    n_rows = int(os.environ.get("BENCH_YAHOO_ROWS", 473_134))
+    n_iters = int(os.environ.get("BENCH_YAHOO_ITERS", 120))
+    X, y, group = make_yahoo_like(n_rows)
+    ds = lgb.Dataset(X, y, group=group)
+    ds.construct()
+    params = {"objective": "lambdarank", "num_leaves": 255, "max_bin": 255,
+              "verbosity": -1, "metric": "none"}
+    warm = lgb.train(dict(params), ds, 17, verbose_eval=False)
+    warm._booster._materialize_pending()
+    del warm
+    t0 = time.time()
+    bst = lgb.train(dict(params), ds, n_iters, verbose_eval=False)
+    bst._booster._materialize_pending()
+    jax.block_until_ready(bst._booster.train_score.score_device(0))
+    train_s = time.time() - t0
+    n = len(y)
+    throughput = n * n_iters / train_s
+    return {"rows": n, "iters": n_iters, "train_s": train_s,
+            "value": round(throughput / 1e6, 3),
+            "vs_baseline": round(throughput / YAHOO_THROUGHPUT, 4)}
 
 
 def run_voting():

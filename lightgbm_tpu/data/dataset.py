@@ -154,6 +154,24 @@ def _greedy_bundle(nonzero_masks: List[np.ndarray], order: List[int],
     return groups
 
 
+def nibble_slot_partition(widths):
+    """(wide, pairs, leftover): the shared 4-bit slot-assignment policy.
+
+    Groups whose bin count fits 4 bits pair up two per byte slot; the
+    rest keep full byte slots. ONE implementation feeds both storage
+    packers — BinnedDataset.device_pack_plan (HBM v1 storage) and the
+    persist payload plan (ops/grow_persist._payload_plan) — so the
+    pairing threshold/order cannot drift between them.
+    """
+    G = len(widths)
+    narrow = [g for g in range(G) if widths[g] <= 16]
+    wide = [g for g in range(G) if widths[g] > 16]
+    pairs = [(narrow[i], narrow[i + 1])
+             for i in range(0, len(narrow) - 1, 2)]
+    leftover = narrow[-1] if len(narrow) % 2 else None
+    return wide, pairs, leftover
+
+
 class BinnedDataset:
     """The binned training matrix + per-feature metadata (dataset.h:333)."""
 
@@ -766,6 +784,13 @@ class BinnedDataset:
     def has_bundles(self) -> bool:
         return bool(self.needs_fix is not None and self.needs_fix.any())
 
+    def group_widths(self) -> np.ndarray:
+        """[G] total bins per storage group (incl. the bundle sentinel) —
+        the geometry the storage pack plans (device_pack_plan here, the
+        persist payload plan in ops/grow_persist) key off."""
+        return np.diff(np.append(np.asarray(self.group_offset, np.int64),
+                                 int(self.total_bins)))
+
     def real_threshold(self, inner_feature: int, bin_threshold: int) -> float:
         """Local bin -> model-text threshold value (Tree uses upper bounds)."""
         f = self.used_features[inner_feature]
@@ -934,7 +959,7 @@ class BinnedDataset:
 
     def _ell_dtypes(self):
         G = len(self.groups)
-        widths = np.diff(np.append(self.group_offset, self.total_bins))
+        widths = self.group_widths()
         grp_dt = np.uint16 if G < 0xFFFF else np.int32
         bin_dt = (np.uint8 if (len(widths) == 0 or widths.max() <= 0xFF)
                   else (np.uint16 if widths.max() <= 0xFFFF else np.int32))
@@ -1032,26 +1057,23 @@ class BinnedDataset:
         if not bool(config.tpu_4bit_packing) or self.binned is None:
             return None
         G = len(self.groups)
-        widths = np.diff(np.append(self.group_offset, self.total_bins))
-        narrow = [g for g in range(G) if widths[g] <= 16]
-        if len(narrow) < 2:
+        widths = self.group_widths()
+        wide, pairs, leftover = nibble_slot_partition(widths)
+        if G - len(wide) < 2:       # fewer than 2 narrow groups: no pairs
             return None
-        narrow_set = set(narrow)
         storage_of = np.zeros(G, dtype=np.int32)
         shift = np.zeros(G, dtype=np.int32)
         sc = 0
-        for g in range(G):
-            if g not in narrow_set:
-                storage_of[g] = sc
-                sc += 1
-        # pair narrow groups two per storage column
-        for k in range(0, len(narrow) - 1, 2):
-            storage_of[narrow[k]] = sc
-            storage_of[narrow[k + 1]] = sc
-            shift[narrow[k + 1]] = 4
+        for g in wide:
+            storage_of[g] = sc
             sc += 1
-        if len(narrow) % 2:
-            storage_of[narrow[-1]] = sc
+        for a, b in pairs:          # two narrow groups per storage column
+            storage_of[a] = sc
+            storage_of[b] = sc
+            shift[b] = 4
+            sc += 1
+        if leftover is not None:
+            storage_of[leftover] = sc
             sc += 1
         # any narrow group's values fit in 4 bits, so &15 is safe even for
         # an unpaired trailing one; wide groups pass through unmasked

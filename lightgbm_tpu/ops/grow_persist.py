@@ -68,6 +68,78 @@ def _f32r(row):
 # payload row count up to which f32 leaf state holds exact integer counts
 EXACT_F32_ROWS = 1 << 24
 
+# group count at or below which the smaller-child histogram accumulates
+# IN the split_pass kernel instead of a separate post-partition seg_hist
+# pass: with few (wide) groups the per-row MXU histogram work is cheap and
+# the extra kernel launch per split dominates (the Expo shape: 18 groups,
+# 254 launches/tree saved); with many groups the seg_hist economy (only
+# ~n/2 rows touched per level instead of all n) wins back the launch.
+# Either way the leaf-wise subtraction trick still applies — only WHERE
+# the smaller child's histogram is computed changes.
+SEG_HIST_MIN_GROUPS = 20
+
+
+class PersistPackError(ValueError):
+    """A dataset geometry the persist payload pack plan cannot express.
+
+    Raised by build_assets instead of a bare NotImplementedError so
+    callers can fall back to the v1 grower loudly but gracefully;
+    treelearner.serial.can_persist_scan pre-checks via persist_pack_ok, so
+    user-facing paths never see this as a crash."""
+
+
+def _group_widths(dataset) -> np.ndarray:
+    """[G] bin count per storage group — BinnedDataset.group_widths()."""
+    return np.asarray(dataset.group_widths(), np.int64)
+
+
+def persist_pack_ok(dataset):
+    """(ok, reason) — can the payload pack plan express this dataset?
+
+    The plan covers any dense-binned layout with <= 256 bins per group
+    (byte slots, 4-bit slots for <= 16-bin groups); device_packed v1
+    storage is fine because the payload packs independently from
+    dataset.binned. Multi-value (ELL) layouts and > 256-bin groups are
+    the remaining v1-only geometries."""
+    if getattr(dataset, "is_multival", False) or dataset.binned is None:
+        return False, "multi-value (ELL) datasets have no dense payload"
+    widths = _group_widths(dataset)
+    if len(widths) and int(widths.max()) > 256:
+        return False, ("group width %d > 256 bins exceeds the payload "
+                       "byte-slot plan" % int(widths.max()))
+    return True, ""
+
+
+def _payload_plan(widths):
+    """Per-group payload storage plan: (plan, nbw).
+
+    plan[g] = (word_row, bit_shift, value_mask): groups whose bin count
+    fits 4 bits share a byte slot in nibble pairs (the Dense4bitsBin
+    analog, src/io/dense_nbits_bin.hpp, applied to the PERSIST payload),
+    everything else gets a full byte — 4 byte slots per u32 payload word.
+    With no narrow groups this reproduces the historical byte-per-group
+    layout exactly. The split/seg/root kernels and the XLA emulation
+    decode through (word, shift, mask), so the plan is the single source
+    of truth for payload bin storage."""
+    from ..data.dataset import nibble_slot_partition
+    G = len(widths)
+    wide, pairs, leftover = nibble_slot_partition(widths)
+    plan = [None] * G
+    slot = 0                       # byte-slot counter (4 per u32 word)
+    for g in wide:
+        plan[g] = (slot // 4, (slot % 4) * 8, 255)
+        slot += 1
+    for a, b in pairs:
+        w, sh = slot // 4, (slot % 4) * 8
+        plan[a] = (w, sh, 15)
+        plan[b] = (w, sh + 4, 15)
+        slot += 1
+    if leftover is not None:
+        plan[leftover] = (slot // 4, (slot % 4) * 8, 15)
+        slot += 1
+    nbw = max((slot + 3) // 4, 1)
+    return tuple(plan), nbw
+
 # leaf-state matrix columns
 LS_SG, LS_SH, LS_CNT, LS_VAL, LS_DEPTH, LS_START, LS_NROWS, LS_PAD = range(8)
 # best-candidate matrix columns
@@ -103,10 +175,12 @@ def payload_weight_row(nbw: int, num_scores: int) -> int:
     return nbw + 4 + K + (K if K > 1 else 0)
 
 
-def _payload_geometry(n: int, G: int, C: int, CR: int,
+def _payload_geometry(n: int, nbw: int, C: int, CR: int,
                       num_scores: int = 1, has_weight: bool = False):
     """Payload rows: bins words | label | rid | grad | hess | score*K
-    [| snapshot*K when K > 1] [| weight]. Multiclass (K = num_class trees
+    [| snapshot*K when K > 1] [| weight]. nbw comes from the pack plan
+    (_payload_plan — nibble-packed narrow groups shrink it below the
+    historical (G+3)//4). Multiclass (K = num_class trees
     per iteration) carries one score row per class plus an iteration-start
     snapshot block: the reference computes all K classes' gradients from
     the PRE-iteration scores (GBDT::Boosting once per TrainOneIter,
@@ -114,7 +188,6 @@ def _payload_geometry(n: int, G: int, C: int, CR: int,
     the snapshot while per-class score updates land in the live rows.
     Weighted datasets append one f32 weight row that rides the partition;
     unweighted payloads pay nothing."""
-    nbw = (G + 3) // 4
     K = num_scores
     WP = payload_weight_row(nbw, K) + (1 if has_weight else 0)
     WPA = ((WP + 7) // 8) * 8
@@ -127,25 +200,27 @@ def _payload_geometry(n: int, G: int, C: int, CR: int,
         C = 16384 if WPA <= 56 else 8192
     NP = max(((n + 127) // 128 + 2) * 128 + C + 256,
              ((n + CR - 1) // CR) * CR)
-    return nbw, WPA, C, NP
+    return WPA, C, NP
 
 
 def _pack_payload(binned: np.ndarray, labels: np.ndarray, n: int,
                   WPA: int, NP: int, nbw: int, rid_offset: int,
-                  rid_sentinel: int, weights=None, weight_row: int = 0):
-    """One shard's payload matrix from its binned rows + labels. Row ids
+                  rid_sentinel: int, plan=None, weights=None,
+                  weight_row: int = 0):
+    """One shard's payload matrix from its binned rows + labels, packed
+    per `plan` (byte or nibble slots — _payload_plan). Row ids
     are GLOBAL (shard offset baked in): the bag transforms hash them, so
     draws must agree between serial and sharded runs; finalize_scores
     subtracts the shard offset back out."""
     G = binned.shape[1]
     pay = np.zeros((WPA, NP), np.uint32)
-    plan = []
+    if plan is None:
+        plan = tuple((g // 4, (g % 4) * 8, 255) for g in range(G))
     col = binned.astype(np.uint32)
-    for g in range(G):
-        w, sh = g // 4, (g % 4) * 8
-        np.bitwise_or(pay[w, :n], col[:, g] << np.uint32(sh),
+    for g, (w, sh, mk) in enumerate(plan):
+        np.bitwise_or(pay[w, :n],
+                      (col[:, g] & np.uint32(mk)) << np.uint32(sh),
                       out=pay[w, :n])
-        plan.append((w, sh, 255))
     pay[nbw, :n] = np.ascontiguousarray(
         labels.astype(np.float32)).view(np.uint32)
     pay[nbw + 1, :n] = rid_offset + np.arange(n, dtype=np.uint32)
@@ -153,7 +228,7 @@ def _pack_payload(binned: np.ndarray, labels: np.ndarray, n: int,
     if weights is not None:
         pay[weight_row, :n] = np.ascontiguousarray(
             weights.astype(np.float32)).view(np.uint32)
-    return pay, plan
+    return pay
 
 
 @telemetry.timed("ops::BuildPersistPayload(H2D)", category="ops")
@@ -179,10 +254,15 @@ def build_assets(dataset, labels: np.ndarray, C: int = 0,
     if n_total % num_shards:
         raise ValueError("persist sharding needs equal row shards")
     n = n_total // num_shards
+    ok, why = persist_pack_ok(dataset)
+    if not ok:
+        # can_persist_scan pre-checks this; a direct caller gets the
+        # typed error (and the reason) instead of a bare crash
+        raise PersistPackError("persist payload pack plan unavailable: "
+                               + why)
     binned = dataset.binned          # [n_total, G] narrow int storage
-    if getattr(dataset, "device_packed", False):
-        raise NotImplementedError  # packing plan assumes byte storage
     G = binned.shape[1]
+    plan, nbw = _payload_plan(_group_widths(dataset))
     labels = np.asarray(labels)
     # pos-mode objectives (lambdarank) take weights through their own
     # gradient args — the caller then skips the payload row entirely
@@ -190,23 +270,22 @@ def build_assets(dataset, labels: np.ndarray, C: int = 0,
     weight = dataset.metadata.weight if use_weight_row else None
     weight = None if weight is None else np.asarray(weight)
     has_w = weight is not None
-    nbw, WPA, C, NP = _payload_geometry(n, G, C, CR, num_scores, has_w)
+    WPA, C, NP = _payload_geometry(n, nbw, C, CR, num_scores, has_w)
     K = num_scores
     weight_row = payload_weight_row(nbw, K)
     blocks = []
-    plan = None
     for k in range(num_shards):
-        pay_k, plan = _pack_payload(binned[k * n:(k + 1) * n],
-                                    labels[k * n:(k + 1) * n], n, WPA, NP,
-                                    nbw, rid_offset=k * n,
-                                    rid_sentinel=n_total,
-                                    weights=(weight[k * n:(k + 1) * n]
-                                             if has_w else None),
-                                    weight_row=weight_row)
+        pay_k = _pack_payload(binned[k * n:(k + 1) * n],
+                              labels[k * n:(k + 1) * n], n, WPA, NP,
+                              nbw, rid_offset=k * n,
+                              rid_sentinel=n_total, plan=plan,
+                              weights=(weight[k * n:(k + 1) * n]
+                                       if has_w else None),
+                              weight_row=weight_row)
         blocks.append(pay_k)
     pay = blocks[0] if num_shards == 1 else np.concatenate(blocks, axis=1)
     F = dataset.num_features
-    # feature f's storage byte lives in column group_of[f]; its bins
+    # feature f's storage slot lives in plan[group_of[f]]; its bins
     # occupy the group-local range [ls, le) (bundled groups put several
     # features plus the local-bin-0 sentinel in one byte)
     group_of = dataset.group_of.astype(np.int32)
@@ -214,25 +293,31 @@ def build_assets(dataset, labels: np.ndarray, C: int = 0,
         .astype(np.int32)
     nb_np = (dataset.bin_end - dataset.bin_start).astype(np.int32)
     mf_np = dataset.most_freq_bin.astype(np.int32)
+    mt_np = dataset.missing_type_arr.astype(np.int32)
+    db_np = dataset.default_bin.astype(np.int32)
     needs_fix = np.asarray(dataset.needs_fix, dtype=bool)
     bundled = bool(G != F or needs_fix.any() or np.any(ls != 0))
+    # per-feature decode scalars come from the PLAN (nibble groups carry
+    # mask 15 and 4-bit shifts; byte groups the historical 255/byte ones)
+    plan_arr = np.asarray(plan, np.int32)            # [G, 3]
     # pay0 stays a HOST array: the sharded caller device_puts it with a
     # per-shard layout (materializing the whole payload on one device
     # first would spike that device's HBM by the full dataset size)
     return PersistAssets(
         pay0=pay,
-        dec_word=jnp.asarray(group_of // 4),
-        dec_shift=jnp.asarray((group_of % 4) * 8),
-        dec_mask=jnp.asarray(np.full(F, 255, np.int32)),
+        dec_word=jnp.asarray(plan_arr[group_of, 0]),
+        dec_shift=jnp.asarray(plan_arr[group_of, 1]),
+        dec_mask=jnp.asarray(plan_arr[group_of, 2]),
         nb=jnp.asarray(nb_np),
-        mt=jnp.asarray(dataset.missing_type_arr.astype(np.int32)),
-        db=jnp.asarray(dataset.default_bin.astype(np.int32)),
+        mt=jnp.asarray(mt_np),
+        db=jnp.asarray(db_np),
         ls=jnp.asarray(ls),
         le=jnp.asarray(ls + nb_np),
         mf=jnp.asarray(mf_np),
         geometry=(WPA, NP, G, tuple(plan), nbw, n, C, CR,
                   num_scores, has_w),
-        efb=(group_of, ls, nb_np, mf_np, needs_fix, bundled),
+        efb=(group_of, ls, nb_np, mf_np, needs_fix, bundled,
+             mt_np, db_np),
     )
 
 
@@ -527,15 +612,19 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
         from .pallas_grow import make_seg_hist
         # every score/snapshot/weight row must ride the partition
         wp_live = payload_weight_row(nbw, K) + (1 if has_w else 0)
-        # the smaller-child histogram runs as a SEPARATE post-partition
-        # segment pass (make_seg_hist): split_pass skips its in-pass
-        # masked accumulation, so each tree level histograms ~n/2 rows
-        # (the smaller children) instead of all n
+        # smaller-child histogram placement (geometry heuristic): with
+        # few (wide) groups it accumulates IN split_pass — the rows are
+        # already in VMEM and the per-split seg_hist launch dominates;
+        # with many groups a SEPARATE post-partition segment pass
+        # (make_seg_hist) touches only the ~n/2 smaller-child rows per
+        # level. Both feed the same parent-minus-smaller subtraction.
+        inpass_hist = G <= SEG_HIST_MIN_GROUPS
         split_pass = make_split_pass(WPA, NP, G, plan, nbw, C=C,
                                      interpret=interpret, wp_live=wp_live,
-                                     _skip_hist=True)
-        seg_hist = make_seg_hist(WPA, NP, G, plan, nbw, C=C,
-                                 interpret=interpret)
+                                     _skip_hist=not inpass_hist)
+        seg_hist = (None if inpass_hist else
+                    make_seg_hist(WPA, NP, G, plan, nbw, C=C,
+                                  interpret=interpret))
         root_hist = make_root_hist(WPA, NP, G, plan, nbw, n, C=CR,
                                    interpret=interpret)
     grad_row = nbw + 2
@@ -554,13 +643,43 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
     # padded meta for the dense scan: feature f's window sits inside its
     # storage group's [G, 256] block at the group-local offset (ls = 0 and
     # group_of = identity when nothing is bundled, i.e. flat f*W)
-    group_of_np, ls_np, nb_np, mf_np, needs_fix_np, bundled = assets.efb
+    (group_of_np, ls_np, nb_np, mf_np, needs_fix_np, bundled,
+     mt_np, db_np) = assets.efb
     win_start_np = (group_of_np.astype(np.int64) * W + ls_np).astype(
         np.int32)
     pad_meta = meta._replace(
         bin_start=jnp.asarray(win_start_np),
         bin_end=jnp.asarray(win_start_np + nb_np))
     has_fix = bool(needs_fix_np.any())
+    if bundled:
+        # bundle-native split scan: static per-lane window masks over the
+        # [G, 256] group planes, derived ONCE per payload geometry and
+        # reused across every level and tree (the per-feature path
+        # re-gathered [2, F, 256] copies and re-applied FixHistogram
+        # tensors per split — at Expo's 648 features from 18 groups that
+        # was a 36x duplication on the hottest fixed cost)
+        from .pallas_scan import (BM_VALID_F, BM_VALID_R,
+                                  build_block_scan_meta, scan_blocks)
+        blk = build_block_scan_meta(
+            group_of_np, ls_np, nb_np, mt_np, db_np, mf_np, needs_fix_np,
+            np.asarray(meta.penalty, np.float64), G, W)
+        Gp, Wp = blk["masks"].shape[1:]
+        blk_masks0 = jnp.asarray(blk["masks"])
+        blk_owner = jnp.asarray(
+            np.where(blk["has_owner"], blk["owner"], 0)
+            .reshape(-1).astype(np.int32))
+        blk_has = jnp.asarray(blk["has_owner"].astype(np.float32))
+        forced_right_np = jnp.asarray((mt_np == 2) & (nb_np <= 2))
+        ls_f32 = jnp.asarray(ls_np.astype(np.float32))
+
+        class _BlockTreeLayout:
+            """Per-tree view of the cached block masks (fmask folded)."""
+
+            def __init__(self, fmask):
+                fm_lane = (jnp.take(fmask.astype(F32),
+                                    blk_owner).reshape(Gp, Wp) * blk_has)
+                self.masks = blk_masks0.at[BM_VALID_R:BM_VALID_F + 1] \
+                                       .multiply(fm_lane[None])
 
     def eval_pair(gh, hh, rows, sgs, shs, cnts, depth_child, params,
                   layout: ScanLayout):
@@ -572,7 +691,6 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
         rows: [2] i32 leaf-hist row ids; sgs/shs/cnts: [2] f32 sums.
         Returns a [2, 12] f32 best-candidate matrix.
         """
-        pad_f = ((0, 0), (0, layout.Fp - G), (0, 0))
         g2 = gh[rows]                                  # [2, TBp]
         h2 = hh[rows]
         p32 = params.cast(F32)
@@ -585,6 +703,62 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
         mgs = gain_shift + p32.min_gain_to_split.astype(F32)
         md = p32.min_data_in_leaf.astype(F32)
         mh = p32.min_sum_hessian_in_leaf.astype(F32)
+
+        def finish(gain_b, best_f, t_b, use_f_b, lg, lh, lc, forced_r):
+            """Shared assembly of the [2, 12] best-candidate matrix."""
+            best_valid = jnp.isfinite(gain_b)
+            if gc.max_depth > 0:
+                best_valid &= depth_child < gc.max_depth
+            rg = sg - lg
+            rh = sh - lh
+            rc = cnt - lc
+            lo = -lg / (lh + l2)
+            ro = -rg / (rh + l2)
+            default_left = (~use_f_b) & (~forced_r)
+            neg = jnp.asarray(K_MIN_SCORE, F32)
+            return jnp.stack([
+                jnp.where(best_valid, gain_b, neg),
+                jnp.where(best_valid, best_f.astype(F32), -1.0),
+                jnp.where(best_valid, t_b, 0.0),
+                jnp.where(best_valid, default_left, True).astype(F32),
+                lg, lh, rg, rh,
+                jnp.floor(lc + 0.5), jnp.floor(rc + 0.5),
+                lo, ro], axis=1)                        # [2, 12]
+
+        if bundled:
+            # bundle-native path: scan the [G, 256] group planes directly
+            # (scan_blocks) — no per-feature gather, no per-split fix
+            # tensors; masks come precomputed from the cached layout. The
+            # kernel returns per-GROUP results with ABSOLUTE block-lane
+            # thresholds; the owner map recovers the feature id.
+            gbB = jnp.pad(g2.reshape(2, G, W),
+                          ((0, 0), (0, Gp - G), (0, Wp - W)))
+            hbB = jnp.pad(h2.reshape(2, G, W),
+                          ((0, 0), (0, Gp - G), (0, Wp - W)))
+            scal9 = jnp.stack([
+                sg, sh, cnt, cf,
+                jnp.broadcast_to(md, (2,)), jnp.broadcast_to(mh, (2,)),
+                mgs, jnp.broadcast_to(l2, (2,)),
+                shs.astype(F32)], axis=1)
+            outB = scan_blocks(scal9, gbB, hbB, layout.masks,
+                               do_fix=has_fix, interpret=interpret)
+            gains_g = outB[:, 0, :]                    # [2, Gp]
+            best_g = jnp.argmax(gains_g, axis=1)
+
+            def takeg(row):
+                return jnp.take_along_axis(outB[:, row, :],
+                                           best_g[:, None], axis=1)[:, 0]
+            gain_b = takeg(0)
+            t_abs = takeg(1)
+            use_f_b = takeg(2) > 0.5
+            lg, lh, lc = takeg(3), takeg(4), takeg(5)
+            t_i = jnp.clip(t_abs, 0, Wp - 1).astype(I32)
+            best_f = jnp.take(blk_owner, best_g.astype(I32) * Wp + t_i)
+            t_b = t_abs - jnp.take(ls_f32, best_f)
+            return finish(gain_b, best_f, t_b, use_f_b, lg, lh, lc,
+                          jnp.take(forced_right_np, best_f))
+
+        pad_f = ((0, 0), (0, layout.Fp - G), (0, 0))
         valid_r, valid_f = layout.valid_r, layout.valid_f
         if voting:
             # local proposal scan: 1/S-scaled thresholds on the LOCAL
@@ -638,47 +812,8 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
             winp = jnp.pad(winb, ((0, 0), (0, layout.Fp - G)))
             valid_r = valid_r[None] * winp[:, :, None].astype(F32)
             valid_f = valid_f[None] * winp[:, :, None].astype(F32)
-        if bundled:
-            # EFB layouts: feature rows are whole [W] GROUP blocks pulled
-            # with one cheap row-take (contiguous 256-lane rows — an
-            # element gather here cost ~0.25 ms/split at 648 features);
-            # the scan masks carry the in-block window offsets (win_off)
-            # and thresholds come out absolute, corrected below
-            blocks_g = g2.reshape(2, G, W)
-            blocks_h = h2.reshape(2, G, W)
-            gof = jnp.asarray(group_of_np)
-            gb = jnp.pad(jnp.take(blocks_g, gof, axis=1),
-                         ((0, 0), (0, layout.Fp - F), (0, 0)))
-            hb = jnp.pad(jnp.take(blocks_h, gof, axis=1),
-                         ((0, 0), (0, layout.Fp - F), (0, 0)))
-        else:
-            gb = jnp.pad(g2.reshape(2, G, W), pad_f)
-            hb = jnp.pad(h2.reshape(2, G, W), pad_f)
-        if has_fix:
-            # FixHistogram (src/io/dataset.cpp:1410) at the scan-input
-            # level: a bundled feature's most_freq bin is never stored, so
-            # its slot gets child_total - window_sum (the mf slot's own
-            # contribution cancels out of the residual). Positions are in
-            # the OFFSET (group-block) coordinates the scan rows use.
-            Fp, Wp = layout.Fp, layout.Wp
-            w_ar = np.arange(Wp)
-            lo = ls_np[:, None]
-            hi = (ls_np + nb_np)[:, None]
-            win_m = jnp.asarray(np.pad(
-                ((w_ar[None, :] >= lo) & (w_ar[None, :] < hi))
-                .astype(np.float32), ((0, Fp - F), (0, 0))))
-            fix_rows_d = jnp.asarray(
-                np.pad(needs_fix_np.astype(np.float32), (0, Fp - F)))
-            oh = np.zeros((Fp, Wp), np.float32)
-            oh[np.arange(F), np.clip(ls_np + mf_np, 0, Wp - 1)] = \
-                needs_fix_np.astype(np.float32)
-            oh_mf = jnp.asarray(oh)
-            gsum = jnp.sum(gb * win_m, axis=2)             # [2, Fp]
-            hsum = jnp.sum(hb * win_m, axis=2)
-            res_g = (sg[:, None] - gsum) * fix_rows_d
-            res_h = (shs.astype(F32)[:, None] - hsum) * fix_rows_d
-            gb = gb + res_g[:, :, None] * oh_mf[None]
-            hb = hb + res_h[:, :, None] * oh_mf[None]
+        gb = jnp.pad(g2.reshape(2, G, W), pad_f)
+        hb = jnp.pad(h2.reshape(2, G, W), pad_f)
         scal = jnp.stack([
             sg, sh, cnt, cf,
             jnp.broadcast_to(md, (2,)), jnp.broadcast_to(mh, (2,)),
@@ -694,40 +829,19 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
                                        axis=1)[:, 0]
         gain_b = take(0)
         t_b = take(1)
-        if bundled:
-            # scan rows are whole group blocks: thresholds come out in
-            # block coordinates — shift back to the feature-local bin
-            t_b = t_b - jnp.asarray(ls_np.astype(np.float32))[best_f]
         use_f_b = take(2) > 0.5
         lg = take(3)
         lh = take(4)
         lc = take(5)
-        best_valid = jnp.isfinite(gain_b)
-        if gc.max_depth > 0:
-            best_valid &= depth_child < gc.max_depth
-        rg = sg - lg
-        rh = sh - lh
-        rc = cnt - lc
-        lo = -lg / (lh + l2)
-        ro = -rg / (rh + l2)
-        default_left = (~use_f_b) & (~layout.forced_right[best_f])
-        neg = jnp.asarray(K_MIN_SCORE, F32)
-        return jnp.stack([
-            jnp.where(best_valid, gain_b, neg),
-            jnp.where(best_valid, best_f.astype(F32), -1.0),
-            jnp.where(best_valid, t_b, 0.0),
-            jnp.where(best_valid, default_left, True).astype(F32),
-            lg, lh, rg, rh,
-            jnp.floor(lc + 0.5), jnp.floor(rc + 0.5),
-            lo, ro], axis=1)                        # [2, 12]
+        return finish(gain_b, best_f, t_b, use_f_b, lg, lh, lc,
+                      layout.forced_right[best_f])
 
     def grow(pay, params: SplitParams, fmask, bag_cnt=None):
         """Grow one tree in place; returns (pay', lstate, tree, num_leaves,
         root_value). bag_cnt: shard-local in-bag row count from the bag
         transform (None = every live row in bag)."""
-        layout = ScanLayout(pad_meta, fmask, F, W, TBp,
-                            win_off=(jnp.asarray(ls_np) if bundled
-                                     else None))
+        layout = (_BlockTreeLayout(fmask) if bundled
+                  else ScanLayout(pad_meta, fmask, F, W, TBp))
         rhist, sums = root_hist(pay)
         gh0, hh0 = rhist
         root_cnt = (jnp.asarray(n, ST) if bag_cnt is None
@@ -784,22 +898,25 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
             smaller_is_left = bl[BC_LCNT] <= bl[BC_RCNT]
             s0 = ls[LS_START].astype(I32)
             n_l = jnp.where(do, ls[LS_NROWS].astype(I32), 0)
-            scal = jnp.zeros((N_SCALARS,), I32)
-            scal = scal.at[S_NCH].set((n_l + C - 1) // C)
-            scal = scal.at[S_S0].set(s0)
-            scal = scal.at[S_NL].set(n_l)
-            scal = scal.at[S_WG].set(assets.dec_word[f])
-            scal = scal.at[S_SH].set(assets.dec_shift[f])
-            scal = scal.at[S_MASK].set(assets.dec_mask[f])
-            scal = scal.at[S_NB].set(assets.nb[f])
-            scal = scal.at[S_MT].set(assets.mt[f])
-            scal = scal.at[S_DB].set(assets.db[f])
-            scal = scal.at[S_THR].set(bl[BC_THR].astype(I32))
-            scal = scal.at[S_DL].set(bl[BC_DL].astype(I32))
-            scal = scal.at[S_SMALL_L].set(smaller_is_left.astype(I32))
-            scal = scal.at[S_LS].set(assets.ls[f])
-            scal = scal.at[S_LE].set(assets.le[f])
-            scal = scal.at[S_MF].set(assets.mf[f])
+            # one stack in S_* slot order (see pallas_grow) instead of 15
+            # chained dynamic updates on the [N_SCALARS] vector
+            scal = jnp.stack([
+                (n_l + C - 1) // C,                  # S_NCH
+                s0,                                  # S_S0
+                n_l,                                 # S_NL
+                assets.dec_word[f],                  # S_WG
+                assets.dec_shift[f],                 # S_SH
+                assets.dec_mask[f],                  # S_MASK
+                assets.nb[f],                        # S_NB
+                assets.mt[f],                        # S_MT
+                assets.db[f],                        # S_DB
+                bl[BC_THR].astype(I32),              # S_THR
+                bl[BC_DL].astype(I32),               # S_DL
+                smaller_is_left.astype(I32),         # S_SMALL_L
+                assets.ls[f],                        # S_LS
+                assets.le[f],                        # S_LE
+                assets.mf[f],                        # S_MF
+            ]).astype(I32)
             pay, hist_sm, n_left = split_pass(st.pay, scal)
             # n_l == 0 skips the kernel (zero grid steps) and leaves its
             # histogram/count outputs undefined; mask before sums/psum

@@ -10,6 +10,18 @@ construction with a ``NoneType is not callable``.
 """
 from __future__ import annotations
 
+import jax as _jax
+
+# `jax.enable_x64` (the scoped dtype-default context) moved between
+# releases: 0.4.x only has jax.experimental.enable_x64, newer jax
+# promotes it to the top level. The Mosaic kernels trace under
+# enable_x64(False) so reference-parity f64 host math can stay on
+# without weak-int promotion leaking i64 into the kernels.
+if hasattr(_jax, "enable_x64"):
+    enable_x64 = _jax.enable_x64
+else:  # pragma: no cover - version-dependent
+    from jax.experimental import enable_x64  # noqa: F401
+
 try:  # pallas ships with jax; guard for exotic builds
     from jax.experimental import pallas as pl  # noqa: F401
     from jax.experimental.pallas import tpu as pltpu  # noqa: F401

@@ -7,7 +7,9 @@ the ConstructHistograms inner loops (src/io/dense_bin.hpp:74-110) — as TWO
 Mosaic kernels over a single transposed payload matrix:
 
   payload: u32 [WP, NP]   (rows on lanes; one matrix, one DMA per window)
-     rows 0..nbw-1   bit-packed bin bytes, 4 storage columns per word
+     rows 0..nbw-1   bit-packed bin slots — byte per group, or 4-bit
+                     nibble pairs for <=16-bin groups (the Dense4bitsBin
+                     trade applied to the payload; grow_persist._payload_plan)
      row  nbw        label     (f32 bitcast; objective input)
      row  nbw+1      row id    (u32; positions -> original rows at the end)
      row  nbw+2      gradient  (f32 bitcast; rewritten every iteration)
@@ -57,7 +59,7 @@ import sys
 import jax
 import jax.numpy as jnp
 
-from .pallas_compat import HAS_PALLAS, pl, pltpu
+from .pallas_compat import HAS_PALLAS, enable_x64, pl, pltpu
 from .pallas_compat import TPUCompilerParams as _TPUCompilerParams
 
 I32 = jnp.int32
@@ -148,7 +150,10 @@ def _compact(block, keep, E: int, to_right: bool):
 def _unpack_group_bins(pay_block, plan):
     """[G, E] i32 group-local bins from the packed word rows of [WP, E].
 
-    plan: static tuple of (word_row, shift, mask) per logical group.
+    plan: static tuple of (word_row, shift, mask) per logical group —
+    byte slots (mask 255) or 4-bit nibble slots (mask 15) as produced by
+    grow_persist._payload_plan; the decode is slot-width agnostic, so the
+    same kernels serve byte and nibble-packed payloads.
     """
     rows = []
     for (w, sh, mk) in plan:
@@ -422,7 +427,7 @@ def make_split_pass(WPA: int, NP: int, G: int, plan, nbw: int,
         # trace the kernel with 32-bit default dtypes: under jax_enable_x64
         # (on for reference-parity f64 host math) weak-int promotion inside
         # Mosaic recurses/lowers to unsupported i64
-        with jax.enable_x64(False):
+        with enable_x64(False):
             pay2, hist, cnt = _call(pay, scalars, grid)
         # separate grad/hess planes: downstream keeps per-plane [L, TBp]
         # histograms (no strided channel slices on the hot path)
@@ -521,7 +526,7 @@ def make_seg_hist(WPA: int, NP: int, G: int, plan, nbw: int,
         nch = (length + C - 1) // C
         grid = jnp.where(length > 0, nch, 0).astype(jnp.int32)
         scalars = jnp.stack([nch, start, length]).astype(jnp.int32)
-        with jax.enable_x64(False):
+        with enable_x64(False):
             hist = pl.pallas_call(
                 kernel,
                 grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -592,7 +597,7 @@ def make_root_hist(WPA: int, NP: int, G: int, plan, nbw: int, n: int,
 
     @jax.jit
     def root_hist(pay):
-        with jax.enable_x64(False):
+        with enable_x64(False):
             hist, sums = _call(pay)
         return _unpack_hist(hist), sums
 

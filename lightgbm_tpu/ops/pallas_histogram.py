@@ -91,6 +91,28 @@ def _hist_kernel_radix(bins_ref, vals_ref, out_ref):
             [hs[0] + hs[2], hs[1] + hs[3]], axis=-1)           # [16, 16, 2]
 
 
+def _select_impl(w: int, G: int, C: int):
+    """Geometry heuristic: (use_radix, w_pad, ct stripe length).
+
+    Few wide groups (the EFB/Expo shape: byte groups at 256 bins) take the
+    radix-split kernel — two [16, ct] nibble one-hots cost ~16x less VPU
+    work than one [256, ct] one-hot, the histogram256.cl workgroup-radix
+    trick re-derived for the MXU. Many NARROW groups keep the direct
+    one-hot kernel: at w <= 64 the [<=128, ct] one-hot is already smaller
+    than the radix pair's four extra MXU issues per group. The stripe
+    length ct is retuned for the few-group regime — the radix kernel's
+    VMEM footprint scales with G*ct (not w_pad*ct), so few groups afford
+    long stripes and amortize per-stripe grid overhead.
+    """
+    use_radix = 64 < w <= 256
+    w_pad = 256 if use_radix else _round_up(max(w, 1), 128)
+    if use_radix:
+        ct = 32768 if G <= 8 else (16384 if G <= 32 else 8192)
+    else:
+        ct = 16384 if w_pad <= 128 else 8192
+    return use_radix, w_pad, min(C, ct)
+
+
 @functools.partial(jax.jit, static_argnames=("w", "interpret"))
 def hist_window(bins_t: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                 w: int, interpret: bool = False) -> jnp.ndarray:
@@ -101,10 +123,8 @@ def hist_window(bins_t: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     w: static bin-width of the output (max group width).
     """
     G, C = bins_t.shape
-    use_radix = w <= 256
-    w_pad = 256 if use_radix else _round_up(max(w, 1), 128)
+    use_radix, w_pad, ct = _select_impl(w, G, C)
     kernel = _hist_kernel_radix if use_radix else _hist_kernel
-    ct = min(C, 8192)
     nst = (C + ct - 1) // ct
     if nst * ct != C:
         pad = nst * ct - C

@@ -218,6 +218,325 @@ def scan_pair(scal, gb, hb, keep_r, keep_f, valid_r, valid_f, aux,
     )(scal, gb, hb, keep_r, keep_f, valid_r, valid_f, aux)
 
 
+# ---------------------------------------------------------------------------
+# bundle-native block scan
+# ---------------------------------------------------------------------------
+#
+# For EFB-bundled datasets the per-feature formulation above is wasteful:
+# every bundled feature's row holds a COPY of its whole [W] group block
+# (Expo: 648 feature rows from 18 groups — a 36x duplication re-gathered
+# per split). The block kernel below scans the [G, W] group planes
+# DIRECTLY: each lane belongs to exactly one feature's bin window, the six
+# cumulative sums run per group block, and per-lane window quantities
+# (windowed prefix, window total) are recovered with segmented fills —
+# log2(W) stages of static lane rolls seeded at the (static) window
+# boundary lanes. The FixHistogram repair for bundled features
+# (src/io/dataset.cpp:1410) also moves INSIDE the kernel: the residual
+# child_total - window_sum lands on each needs-fix feature's most_freq
+# lane before any cumsum reads it, so the caller no longer materializes
+# [2, F, W] fix tensors per split.
+#
+# Tie-break note: within a feature the threshold choice is identical to the
+# per-feature kernel (REVERSE keeps the highest lane = highest threshold,
+# forward the lowest). ACROSS features the per-group argmax compares
+# penalized gains lane-wise, so an exact cross-feature gain tie resolves by
+# lane position inside the block instead of by smaller feature index — an
+# f32-exact-tie corner the fast path accepts (the v1/XLA paths keep the
+# reference order).
+
+
+def _fill_fwd(v, has, W: int):
+    """Per-lane value of the NEAREST seed at-or-before the lane.
+
+    v: [R, W] f32, zero off-seed; has: [R, W] f32 0/1 seed mask. Hillis-
+    Steele doubling of the 'rightmost defined' operator — log2(W) static
+    rolls, associative, so every lane converges to its closest seed."""
+    lane = jax.lax.broadcasted_iota(jnp.int32, v.shape, 1)
+    n = 0
+    while (1 << n) < W:
+        n += 1
+    for b in range(n):
+        sh = 1 << b
+        v2 = pltpu.roll(v, sh, 1)
+        h2 = pltpu.roll(has, sh, 1)
+        take = (lane >= sh) & (has < 0.5) & (h2 > 0.5)
+        v = jnp.where(take, v2, v)
+        has = jnp.where(take, 1.0, has)
+    return v
+
+
+def _fill_bwd(v, has, W: int):
+    """Nearest seed at-or-after each lane (the backward _fill_fwd)."""
+    lane = jax.lax.broadcasted_iota(jnp.int32, v.shape, 1)
+    n = 0
+    while (1 << n) < W:
+        n += 1
+    for b in range(n):
+        sh = 1 << b
+        v2 = pltpu.roll(v, W - sh, 1)
+        h2 = pltpu.roll(has, W - sh, 1)
+        take = (lane < W - sh) & (has < 0.5) & (h2 > 0.5)
+        v = jnp.where(take, v2, v)
+        has = jnp.where(take, 1.0, has)
+    return v
+
+
+# rows of the static mask stack consumed by _scan_blocks_kernel
+(BM_KEEP_R, BM_KEEP_F, BM_VALID_R, BM_VALID_F,
+ BM_SEED_S, BM_SEED_E, BM_FIX, BM_PEN) = range(8)
+BM_ROWS = 8
+
+
+def _scan_blocks_kernel(do_fix, scal_ref, gb_ref, hb_ref, mk_ref, out_ref):
+    """One grid step = one child, scanning [G, W] group blocks.
+
+    scal_ref: [1, 1, 128] f32 (sum_grad, sum_hess(+eps), num_data,
+              cnt_factor, min_data, min_hess, min_gain_shift, lambda_l2,
+              sum_hess_raw, 0...)
+    gb/hb:    [1, G, W] f32 per-GROUP bin grad/hess planes
+    mk_ref:   [8, G, W] f32 static per-lane masks (BM_* rows): cumsum
+              keeps, positional validity (feature mask folded per tree),
+              window start / end-1 seeds, fix-target lanes, penalty
+    out_ref:  [1, 8, G] f32 per-group (gain, t_abs, use_f, lg, lh, lc,
+              has, pad) — t_abs is the ABSOLUTE block lane; the caller
+              recovers the feature from the owner map and subtracts its
+              window offset
+    """
+    G, W = mk_ref.shape[1], mk_ref.shape[2]
+    sg = scal_ref[0, 0, 0]
+    sh = scal_ref[0, 0, 1]
+    nd = scal_ref[0, 0, 2]
+    cf = scal_ref[0, 0, 3]
+    min_data = scal_ref[0, 0, 4]
+    min_hess = scal_ref[0, 0, 5]
+    min_gain_shift = scal_ref[0, 0, 6]
+    l2 = scal_ref[0, 0, 7]
+    sh_raw = scal_ref[0, 0, 8]
+
+    gb = gb_ref[0]
+    hb = hb_ref[0]
+    keep_r = mk_ref[BM_KEEP_R]
+    keep_f = mk_ref[BM_KEEP_F]
+    valid_r = mk_ref[BM_VALID_R]
+    valid_f = mk_ref[BM_VALID_F]
+    seed_s = mk_ref[BM_SEED_S]
+    seed_e = mk_ref[BM_SEED_E]
+    pen = mk_ref[BM_PEN]
+
+    iw = jax.lax.broadcasted_iota(jnp.int32, (W, W), 0)
+    jw = jax.lax.broadcasted_iota(jnp.int32, (W, W), 1)
+    tri = (iw >= jw).astype(jnp.float32)
+    dn = (((1,), (1,)), ((), ()))
+
+    def cumsum(x):
+        return jax.lax.dot_general(x, tri, dn,
+                                   precision=jax.lax.Precision.HIGHEST,
+                                   preferred_element_type=jnp.float32)
+
+    if do_fix:
+        # FixHistogram in place: each needs-fix feature's most_freq lane
+        # receives child_total - window_sum BEFORE any cumsum reads it
+        fixm = mk_ref[BM_FIX]
+        raw = jnp.concatenate([gb, hb], axis=0)              # [2G, W]
+        cum = cumsum(raw)
+        ecum = cum - raw
+        ss2 = jnp.concatenate([seed_s, seed_s], axis=0)
+        se2 = jnp.concatenate([seed_e, seed_e], axis=0)
+        cs = _fill_fwd(ecum * ss2, ss2, W)                   # cum at ws-1
+        ce = _fill_bwd(cum * se2, se2, W)                    # cum at we-1
+        wsum = ce - cs
+        tgt = jnp.concatenate([jnp.zeros_like(gb) + sg,
+                               jnp.zeros_like(hb) + sh_raw], axis=0)
+        res = (tgt - wsum) * jnp.concatenate([fixm, fixm], axis=0)
+        gb = gb + res[:G]
+        hb = hb + res[G:]
+
+    cnt_b = jnp.floor(hb * cf + 0.5)
+    stack = jnp.concatenate([gb * keep_r, hb * keep_r, cnt_b * keep_r,
+                             gb * keep_f, hb * keep_f, cnt_b * keep_f],
+                            axis=0)                          # [6G, W]
+    cums = cumsum(stack)
+
+    # ---- REVERSE: r_x(lane) = window_total_x - windowed_cum_x(lane)
+    #             = cum_x(we-1) - cum_x(lane)  (per-lane end fill) --------
+    cr = cums[:3 * G]
+    se3 = jnp.concatenate([seed_e, seed_e, seed_e], axis=0)
+    ce3 = _fill_bwd(cr * se3, se3, W)
+    r_grad = ce3[:G] - cr[:G]
+    r_hess = ce3[G:2 * G] - cr[G:2 * G]
+    r_cnt = ce3[2 * G:] - cr[2 * G:]
+    l_cnt = nd - r_cnt
+    l_grad = sg - r_grad
+    l_hess = sh - r_hess
+
+    ok_r = (valid_r > 0.0) \
+        & (r_cnt >= min_data) & (r_hess >= min_hess) \
+        & (l_cnt >= min_data) & (l_hess >= min_hess)
+    gains_r = (l_grad * l_grad) / (l_hess + l2) \
+        + (r_grad * r_grad) / (r_hess + l2)
+    ok_r &= gains_r > min_gain_shift
+    # penalized per-lane gains: constant within a feature's window (so
+    # threshold/direction choices match the per-feature kernel) and the
+    # cross-feature comparison quantity everywhere else
+    pg_r = jnp.where(ok_r, (gains_r - min_gain_shift) * pen, NEG_INF)
+
+    wrow = jax.lax.broadcasted_iota(jnp.int32, (G, W), 1).astype(jnp.float32)
+    best_gain_r = jnp.max(pg_r, axis=1)                      # [G]
+    at_max_r = ok_r & (pg_r == best_gain_r[:, None])
+    best_t_r = jnp.max(jnp.where(at_max_r, wrow, -1.0), axis=1)
+
+    # ---- forward: windowed cum = cum - ecum(ws) (per-lane start fill) ---
+    cfw = cums[3 * G:]
+    sfw = stack[3 * G:]
+    ss3 = jnp.concatenate([seed_s, seed_s, seed_s], axis=0)
+    ecw = cfw - sfw
+    cs3 = _fill_fwd(ecw * ss3, ss3, W)
+    f_l_grad = cfw[:G] - cs3[:G]
+    f_l_hess = cfw[G:2 * G] - cs3[G:2 * G]
+    f_l_cnt = cfw[2 * G:] - cs3[2 * G:]
+    f_r_cnt = nd - f_l_cnt
+    f_r_grad = sg - f_l_grad
+    f_r_hess = sh - f_l_hess
+
+    ok_f = (valid_f > 0.0) \
+        & (f_l_cnt >= min_data) & (f_l_hess >= min_hess) \
+        & (f_r_cnt >= min_data) & (f_r_hess >= min_hess)
+    gains_f = (f_l_grad * f_l_grad) / (f_l_hess + l2) \
+        + (f_r_grad * f_r_grad) / (f_r_hess + l2)
+    ok_f &= gains_f > min_gain_shift
+    pg_f = jnp.where(ok_f, (gains_f - min_gain_shift) * pen, NEG_INF)
+
+    best_gain_f = jnp.max(pg_f, axis=1)
+    big = jnp.float32(2.0 ** 30)
+    at_max_f = ok_f & (pg_f == best_gain_f[:, None])
+    best_t_f = jnp.min(jnp.where(at_max_f, wrow, big), axis=1)
+
+    # ---- combine (forward wins only on strictly more penalized gain) ----
+    has_r = best_t_r >= 0.0
+    has_f = best_t_f < big
+    bg_r = jnp.where(has_r, best_gain_r, NEG_INF)
+    bg_f = jnp.where(has_f, best_gain_f, NEG_INF)
+    use_f = bg_f > bg_r
+    group_gain = jnp.where(use_f, bg_f, bg_r)
+    group_t = jnp.where(use_f, best_t_f, best_t_r)
+    has_any = has_r | has_f
+
+    sel = (wrow == group_t[:, None]).astype(jnp.float32)
+    lg = jnp.where(use_f, jnp.sum(f_l_grad * sel, axis=1),
+                   jnp.sum(l_grad * sel, axis=1))
+    lh = jnp.where(use_f, jnp.sum(f_l_hess * sel, axis=1),
+                   jnp.sum(l_hess * sel, axis=1))
+    lc = jnp.where(use_f, jnp.sum(f_l_cnt * sel, axis=1),
+                   jnp.sum(l_cnt * sel, axis=1))
+
+    out_ref[0, 0, :] = jnp.where(has_any, group_gain, NEG_INF)
+    out_ref[0, 1, :] = group_t
+    out_ref[0, 2, :] = use_f.astype(jnp.float32)
+    out_ref[0, 3, :] = lg
+    out_ref[0, 4, :] = lh
+    out_ref[0, 5, :] = lc
+    out_ref[0, 6, :] = has_any.astype(jnp.float32)
+    out_ref[0, 7, :] = jnp.zeros((G,), jnp.float32)
+
+
+@functools.partial(jax.jit, static_argnames=("do_fix", "interpret"))
+def scan_blocks(scal, gb, hb, masks, do_fix: bool = False,
+                interpret: bool = False):
+    """Fused bundle-native scan for both children over [G, W] group planes.
+
+    scal: [2, 9] f32 (scan_pair's 8 scalars + the raw hessian sum for the
+    in-kernel fix residual); gb/hb: [2, Gp, Wp] f32 group-block planes;
+    masks: [8, Gp, Wp] f32 static stack (BM_* rows) with the per-tree
+    feature mask already folded into the valid rows.
+    Returns [2, 8, Gp] f32 per-group results (t in ABSOLUTE block lanes).
+    """
+    _, Gp, Wp = gb.shape
+    scal_p = jnp.zeros((2, 1, 128), jnp.float32).at[:, 0, :9].set(
+        scal.astype(jnp.float32))
+    # ~14 [Gp, Wp] staging planes + the [Wp, Wp] triangle + fill
+    # temporaries; small next to the per-feature kernel's footprint
+    _vmem = min(100 << 20, 48 * Gp * Wp * 4 + Wp * Wp * 4 + (20 << 20))
+    kern = functools.partial(_scan_blocks_kernel, do_fix)
+    return pl.pallas_call(
+        kern,
+        compiler_params=_TPUCompilerParams(vmem_limit_bytes=int(_vmem)),
+        grid=(2,),
+        in_specs=[
+            pl.BlockSpec((1, 1, 128), lambda c: (c, c * 0, c * 0)),
+            pl.BlockSpec((1, Gp, Wp), lambda c: (c, c * 0, c * 0)),
+            pl.BlockSpec((1, Gp, Wp), lambda c: (c, c * 0, c * 0)),
+            pl.BlockSpec((BM_ROWS, Gp, Wp),
+                         lambda c: (c * 0, c * 0, c * 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 8, Gp), lambda c: (c, c * 0, c * 0)),
+        out_shape=jax.ShapeDtypeStruct((2, 8, Gp), jnp.float32),
+        interpret=interpret,
+    )(scal_p, gb, hb, masks)
+
+
+def build_block_scan_meta(group_of, ls, nb, mt, db, mf, needs_fix,
+                          penalty, G: int, W: int = 256):
+    """Static per-lane mask stack for :func:`scan_blocks` (host numpy).
+
+    Derived ONCE per payload geometry and cached across levels and trees
+    (the per-feature ScanLayout re-derives its masks per tree; these are
+    tree-invariant — only the feature-mask fold is per-tree). All inputs
+    are host arrays in FEATURE order; `group_of`/`ls`/`nb` place feature
+    f's bins at lanes [ls, ls+nb) of block group_of[f].
+
+    Returns dict with:
+      masks     [BM_ROWS, Gp, Wp] f32 — the kernel's static stack
+      owner     [Gp, Wp] i32 — owning feature per lane (-1 = none)
+      has_owner [Gp, Wp] bool
+    """
+    import numpy as np
+    Gp = _round_up(max(G, 8), 8)
+    Wp = _round_up(max(W, 128), 128)
+    owner = np.full((Gp, Wp), -1, dtype=np.int32)
+    F = len(group_of)
+    for f in range(F):
+        owner[group_of[f], ls[f]:ls[f] + nb[f]] = f
+    has_owner = owner >= 0
+    o = np.where(has_owner, owner, 0)
+    lane = np.arange(Wp, dtype=np.int64)[None, :]
+    w_loc = lane - ls[o]
+    nb_l = nb[o]
+    mt_l = mt[o]
+    db_l = db[o]
+
+    two_scan = (nb_l > 2) & (mt_l != 0)
+    skip_default = two_scan & (mt_l == 1)
+    na_as_missing = two_scan & (mt_l == 2)
+    is_na_bin = w_loc == nb_l - 1
+    is_default_bin = w_loc == db_l
+
+    excl_r = (na_as_missing & is_na_bin) | (skip_default & is_default_bin)
+    excl_f = skip_default & is_default_bin
+    keep_r = has_owner & ~excl_r
+    keep_f = has_owner & ~excl_f
+
+    valid_r = has_owner & (w_loc <= nb_l - 2 - na_as_missing.astype(np.int64))
+    valid_r &= ~(skip_default & (w_loc == db_l - 1))
+    valid_f = two_scan & has_owner & (w_loc <= nb_l - 2)
+    valid_f &= ~(skip_default & is_default_bin)
+
+    seed_s = has_owner & (w_loc == 0)
+    seed_e = has_owner & is_na_bin          # w_loc == nb-1: window end
+    fixm = has_owner & needs_fix[o] & (w_loc == mf[o])
+    pen_l = np.where(has_owner, penalty[o], 0.0)
+
+    masks = np.zeros((BM_ROWS, Gp, Wp), np.float32)
+    masks[BM_KEEP_R] = keep_r
+    masks[BM_KEEP_F] = keep_f
+    masks[BM_VALID_R] = valid_r
+    masks[BM_VALID_F] = valid_f
+    masks[BM_SEED_S] = seed_s
+    masks[BM_SEED_E] = seed_e
+    masks[BM_FIX] = fixm
+    masks[BM_PEN] = pen_l
+    return {"masks": masks, "owner": owner, "has_owner": has_owner}
+
+
 class ScanLayout:
     """Per-tree precomputed dense layout + masks for the fused scan.
 

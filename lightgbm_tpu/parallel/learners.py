@@ -132,6 +132,8 @@ class DataParallelTreeLearner(SerialTreeLearner):
                      bag_mask: jnp.ndarray):
         """Sharded grow; returns TreeArrays with row_leaf sliced back to
         num_data (the async fast path used by GBDT.train_one_iter)."""
+        telemetry.count("tree_learner::v1_grow_trees",
+                        category="tree_learner")
         if self._sharded_grow is None:
             self._sharded_grow = self._build()
         pad = self._pad
@@ -379,6 +381,8 @@ class FeatureParallelTreeLearner(SerialTreeLearner):
         return run
 
     def train_arrays(self, grad, hess, bag_mask):
+        telemetry.count("tree_learner::v1_grow_trees",
+                        category="tree_learner")
         if self._sharded_grow is None:
             self._sharded_grow = self._build()
         fmask = jnp.asarray(self.col_sampler.sample())

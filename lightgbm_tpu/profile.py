@@ -5,17 +5,70 @@ prints device time per XLA op name via the reusable xplane parser
 (:mod:`lightgbm_tpu.telemetry.xplane`). The old top-level ``prof_trace.py``
 dev script is now a thin wrapper over this entry point.
 
-Usage: python -m lightgbm_tpu.profile [rows] [iters] [key=value ...]
+Usage: python -m lightgbm_tpu.profile [--shape NAME] [rows] [iters]
+                                      [key=value ...]
 
-Extra `key=value` tokens are passed through as training params
-(e.g. ``tree_learner=data num_leaves=511``). The host-side span registry
-runs in TRACE mode alongside, so ``telemetry_out=<path>`` also writes the
-Chrome-trace + metrics files for the same run.
+``--shape`` (or ``shape=NAME``) picks the benchmark workload the bench
+suite also trains: ``higgs`` (default), ``expo`` (EFB-bundled one-hot —
+the bundle fast-path attribution target), ``allstate`` (sparse wide
+one-hot), ``yahoo`` / ``msltr`` (lambdarank). Extra ``key=value`` tokens
+are passed through as training params (e.g. ``tree_learner=data
+num_leaves=511``), except:
+
+  * ``phases_out=PATH`` — write a BENCH_phases.json-style telemetry
+    category/scope snapshot for the traced run, keyed by the shape name,
+    so the bench's phase breakdown reproduces without the full bench;
+  * ``xplane=0`` — skip the device xplane trace (host spans + phase
+    snapshot only; the CI smoke test runs this on CPU).
+
+The host-side span registry runs in TRACE mode alongside, so
+``telemetry_out=<path>`` also writes the Chrome-trace + metrics files.
 """
 from __future__ import annotations
 
+import json
 import sys
 import time
+
+SHAPE_DEFAULT_ROWS = {"higgs": 2_000_000, "expo": 2_000_000,
+                      "allstate": 500_000, "yahoo": 473_134,
+                      "msltr": 1_000_000}
+
+
+def _make_shape(shape: str, rows: int):
+    """(X, y, group_or_None, objective) for one bench shape."""
+    from lightgbm_tpu.data.synth import (make_allstate_like,
+                                         make_expo_like, make_higgs_like,
+                                         make_ltr_like, make_yahoo_like)
+    if shape == "higgs":
+        X, y = make_higgs_like(rows)
+        return X, y, None, "binary"
+    if shape == "expo":
+        X, y = make_expo_like(rows)
+        return X, y, None, "binary"
+    if shape == "allstate":
+        X, y = make_allstate_like(rows)
+        return X, y, None, "binary"
+    if shape == "yahoo":
+        X, y, g = make_yahoo_like(rows)
+        return X, y, g, "lambdarank"
+    if shape == "msltr":
+        X, y, g = make_ltr_like(rows)
+        return X, y, g, "lambdarank"
+    raise SystemExit("unknown --shape %r (expected higgs|expo|allstate|"
+                     "yahoo|msltr)" % shape)
+
+
+def _phase_stats(events):
+    return {
+        "categories": {k: round(v, 3)
+                       for k, v in events.category_totals().items()},
+        "scopes": {name: {"seconds": round(sec, 3), "count": n,
+                          "category": cat}
+                   for name, (sec, n, cat)
+                   in events.snapshot_full().items()},
+        "counters": {k: v for k, v in events.counts_snapshot().items()},
+    }
 
 
 def main(argv=None) -> int:
@@ -23,22 +76,37 @@ def main(argv=None) -> int:
     if any(a in ("-h", "--help") for a in argv):
         print(__doc__)
         return 0
+    shape = "higgs"
+    if "--shape" in argv:
+        i = argv.index("--shape")
+        if i + 1 >= len(argv):
+            print("--shape needs a value (higgs|expo|allstate|yahoo|"
+                  "msltr)", file=sys.stderr)
+            return 2
+        shape = argv[i + 1]
+        del argv[i:i + 2]
     pos = [a for a in argv if "=" not in a]
     kv = [a for a in argv if "=" in a]
-    rows = int(pos[0]) if len(pos) > 0 else 2_000_000
-    iters = int(pos[1]) if len(pos) > 1 else 16
 
     import jax
 
     import lightgbm_tpu as lgb
     from lightgbm_tpu.config import kv2map
-    from lightgbm_tpu.data.synth import make_higgs_like
     from lightgbm_tpu.telemetry import events, maybe_export, xplane
 
-    params = {"objective": "binary", "num_leaves": 255, "max_bin": 255,
+    # objective comes from the SHAPE (lambdarank for the LTR ones) unless
+    # the caller overrides it via key=value
+    params = {"num_leaves": 255, "max_bin": 255,
               "verbosity": -1, "metric": "none"}
     params.update(kv2map(kv))
+    shape = str(params.pop("shape", shape)).lower()
+    rows = int(pos[0]) if len(pos) > 0 else SHAPE_DEFAULT_ROWS.get(
+        shape, 2_000_000)
+    iters = int(pos[1]) if len(pos) > 1 else 16
     out = params.pop("telemetry_out", None)
+    phases_out = params.pop("phases_out", None)
+    use_xplane = str(params.pop("xplane", "1")).lower() not in ("0",
+                                                                "false")
     # api-source enable, not configure(): config-driven enablement is scoped
     # to the train that asked for it, so the default-params warmup/traced
     # trains below would flip a configure("trace") back off
@@ -46,31 +114,48 @@ def main(argv=None) -> int:
     if out:
         events.set_out_path(out)
 
-    X, y = make_higgs_like(rows)
-    ds = lgb.Dataset(X, y)
+    X, y, group, obj = _make_shape(shape, rows)
+    params.setdefault("objective", obj)
+    ds = lgb.Dataset(X, y, group=group) if group is not None \
+        else lgb.Dataset(X, y)
     ds.construct()
+    n_rows = ds._inner.num_data
     # warmup/compile outside the trace window (compiles are one-time costs)
     warm = lgb.train(dict(params), ds, 17, verbose_eval=False)
     warm._booster._materialize_pending()
     del warm
 
     events.reset()
-    with xplane.collect_trace() as tdir:
+    import contextlib
+    tracer = xplane.collect_trace() if use_xplane else None
+    with (tracer if tracer is not None else contextlib.nullcontext()) \
+            as tdir:
         t0 = time.time()
         booster = lgb.train(dict(params), ds, iters, verbose_eval=False)
         booster._booster._materialize_pending()
         jax.block_until_ready(booster._booster.train_score.score_device(0))
         wall = time.time() - t0
-    print("wall=%.3fs rows=%d iters=%d -> %.2f Mri/s"
-          % (wall, rows, iters, rows * iters / wall / 1e6))
+    print("shape=%s wall=%.3fs rows=%d iters=%d -> %.2f Mri/s"
+          % (shape, wall, n_rows, iters, n_rows * iters / wall / 1e6))
 
-    try:
-        planes = xplane.parse_xplane_dir(tdir)
-    except ImportError as exc:
-        print("xplane proto bindings unavailable (%s); raw trace left in %s"
-              % (exc, tdir), file=sys.stderr)
-        return 1
-    print(xplane.format_device_report(planes, iters=iters))
+    if phases_out:
+        # the bench's BENCH_phases.json layout, keyed by shape, plus the
+        # path counters (persist_scan_trees vs v1_grow_trees) so fast-path
+        # engagement is visible next to the attribution
+        with open(phases_out, "w") as f:
+            json.dump({shape: _phase_stats(events)}, f, indent=1,
+                      sort_keys=True)
+        print("telemetry phase snapshot written to %s" % phases_out,
+              file=sys.stderr)
+
+    if use_xplane:
+        try:
+            planes = xplane.parse_xplane_dir(tdir)
+        except ImportError as exc:
+            print("xplane proto bindings unavailable (%s); raw trace left "
+                  "in %s" % (exc, tdir), file=sys.stderr)
+            return 1
+        print(xplane.format_device_report(planes, iters=iters))
     written = maybe_export(out) if out else None
     if written:
         print("host-side spans: %s ; metrics: %s" % written, file=sys.stderr)

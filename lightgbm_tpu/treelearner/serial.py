@@ -404,6 +404,10 @@ class SerialTreeLearner:
         """Grow one tree fully on device; returns TreeArrays WITHOUT any
         host synchronization (the async fast path — dispatch returns
         immediately, XLA pipelines successive trees)."""
+        # which path trained: tests and the profiling CLIs assert the fast
+        # path engaged (or deliberately fell back) via these counters
+        telemetry.count("tree_learner::v1_grow_trees",
+                        category="tree_learner")
         fmask = jnp.asarray(self.col_sampler.sample())
         extras = self._next_extras()
         if self.use_partitioned:
@@ -470,12 +474,14 @@ class SerialTreeLearner:
         """True when the whole K-iteration scan can run on the persistent
         transposed payload (fused split kernel, no per-row gathers).
         Requirements beyond the Pallas-scan fast path: numerical features
-        only, <= 256 bins, per-payload rows < 2^24; sample weights ride
-        as a payload row and EFB bundles decode in the split kernel.
-        Single device or the data/voting-parallel learners (sharded
-        persist). tpu_persist_scan=force engages the XLA kernel emulation
-        off-TPU (tests)."""
+        only, a payload pack plan (<= 256 bins per group — narrow groups
+        nibble-pack, device_packed v1 storage is fine), per-payload rows
+        < 2^24; sample weights ride as a payload row and EFB bundles
+        decode in the split kernel. Single device or the data/voting-
+        parallel learners (sharded persist). tpu_persist_scan=force
+        engages the XLA kernel emulation off-TPU (tests)."""
         import jax
+        from ..ops.grow_persist import persist_pack_ok
         from ..ops.pallas_grow import HAS_PALLAS
         ds = self.dataset
         gc = self.grow_config
@@ -490,21 +496,26 @@ class SerialTreeLearner:
                 return False
             if ds.num_data < PARTITION_MIN_ROWS:
                 return False
-        widths = (ds.bin_end - ds.bin_start) if ds.num_features else None
+        pack_ok, why = persist_pack_ok(ds)
+        if not pack_ok and not getattr(ds, "_persist_pack_warned", False):
+            # graceful, logged fallback instead of the historical
+            # NotImplementedError hard crash on unpackable geometries
+            ds._persist_pack_warned = True
+            Log.info("persistent-payload fast path unavailable (%s); "
+                     "using the v1 grower" % why)
         bundled = (len(ds.groups) != ds.num_features
                    or bool(np.any(ds.needs_fix)))
-        return (gc.n_forced == 0
+        return (pack_ok
+                and gc.n_forced == 0
                 and not gc.use_cegb_lazy
                 and not gc.multival
-                and not gc.packed_4bit
                 and self.cat_layout.cat_feature.shape[0] == 0
                 and ds.num_features > 0
                 # EFB bundles ride the persist path (group-byte decode in
-                # split_pass + windowed scan + in-eval FixHistogram); the
-                # voting eval's winner gather is block-shaped, so bundled
-                # voting stays on the v1 path
+                # split_pass + bundle-native block scan with in-kernel
+                # FixHistogram); the voting eval's winner gather is
+                # block-shaped, so bundled voting stays on the v1 path
                 and not (bundled and gc.parallel_mode == "voting")
-                and int(widths.max()) <= 256
                 and self._persist_rows_ok()
                 and self._persist_axis_ok()
                 and objective is not None
@@ -546,6 +557,9 @@ class SerialTreeLearner:
                                      interpret=interpret,
                                      kernel_impl=kernel_impl,
                                      stat_from_scan=stat_from_scan)
+            if assets.efb[5]:          # bundled: block-scan fast path
+                telemetry.count("tree_learner::persist_bundle_blockscan",
+                                category="tree_learner")
             cache[gkey] = gr
         dkey = ("driver", K, use_w_row, k, self.grow_config,
                 objective.static_fingerprint(), bag_spec)
@@ -576,6 +590,8 @@ class SerialTreeLearner:
         """K iterations on the persistent payload. Keeps (pay, score_pos)
         as a device carry on this learner; scores return to row order only
         in persist_finalize_scores()."""
+        telemetry.count("tree_learner::persist_scan_trees", float(k),
+                        category="tree_learner")
         assets, gr, driver = self._persist_cached(objective, k, bag_spec)
         pay = getattr(self, "_persist_carry", None)
         if pay is None:

@@ -35,6 +35,59 @@ def test_pallas_hist_matches_xla(C, G, W):
     np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
 
 
+def _scatter_ref(bins, grad, hess, w):
+    """CPU scatter-add oracle (the reference ConstructHistogram inner
+    loop, src/io/dense_bin.hpp:74): exact f64 bincount per group."""
+    C, G = bins.shape
+    out = np.zeros((G, w, 2), np.float64)
+    for g in range(G):
+        out[g, :, 0] = np.bincount(bins[:, g], weights=grad.astype(np.float64),
+                                   minlength=w)[:w]
+        out[g, :, 1] = np.bincount(bins[:, g], weights=hess.astype(np.float64),
+                                   minlength=w)[:w]
+    return out
+
+
+@pytest.mark.skipif(not HAS_PALLAS, reason="pallas unavailable")
+@pytest.mark.parametrize("C,G,W", [
+    (768, 3, 256),    # byte groups -> radix-split kernel
+    (768, 18, 256),   # the Expo geometry (few wide groups, radix)
+    (768, 5, 16),     # nibble-width groups -> direct one-hot kernel
+    (512, 2, 64),     # heuristic boundary: one-hot side
+    (512, 2, 65),     # heuristic boundary: radix side
+])
+def test_kernel_variants_match_scatter_add(C, G, W):
+    """Both kernel variants (radix-split for few wide groups, direct
+    one-hot for narrow groups — ops/pallas_histogram._select_impl) must
+    reproduce the CPU scatter-add path in interpreter mode, for nibble-
+    width and byte-width storage alike."""
+    from lightgbm_tpu.ops.pallas_histogram import _select_impl
+    rng = np.random.default_rng(3 + W + G)
+    bins = rng.integers(0, W, size=(C, G)).astype(np.int32)
+    grad = rng.normal(size=C).astype(np.float32)
+    hess = rng.random(C).astype(np.float32)
+    ref = _scatter_ref(bins, grad, hess, W)
+    out = np.asarray(hist_window(jnp.asarray(bins.T), jnp.asarray(grad),
+                                 jnp.asarray(hess), W, interpret=True))
+    assert out.shape == (G, W, 2)
+    np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
+    # pin the heuristic: wide groups radix, narrow groups one-hot
+    use_radix = _select_impl(W, G, C)[0]
+    assert use_radix == (W > 64)
+
+
+def test_stripe_retune_few_groups():
+    """The radix stripe length grows in the few-group regime and the
+    one-hot kernel keeps its VMEM-bounded stripes."""
+    from lightgbm_tpu.ops.pallas_histogram import _select_impl
+    assert _select_impl(256, 4, 1 << 20)[2] == 32768     # Expo-ish: long
+    assert _select_impl(256, 18, 1 << 20)[2] == 16384
+    assert _select_impl(256, 64, 1 << 20)[2] == 8192     # many groups
+    assert _select_impl(16, 40, 1 << 20)[2] == 16384     # narrow one-hot
+    assert _select_impl(300, 2, 1 << 20)[2] == 8192      # uint16-wide
+    assert _select_impl(256, 4, 4096)[2] == 4096         # capped by C
+
+
 @pytest.mark.skipif(not HAS_PALLAS, reason="pallas unavailable")
 def test_pallas_hist_totals_exact():
     """Per-group totals must equal the f32 sums exactly (bf16 hi/lo split)."""
