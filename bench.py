@@ -203,6 +203,28 @@ def main():
                                  vote["iters"], vote["train_s"],
                                  vote["value"], vote["vs_baseline"]),
               file=sys.stderr)
+    pred = None
+    if os.environ.get("BENCH_SKIP_PREDICT", "") != "1":
+        try:
+            if bench_telemetry:
+                telemetry.reset()
+            pred = run_predict()
+            if bench_telemetry:
+                phase_snaps["predict"] = _phase_stats(telemetry)
+        except Exception as exc:
+            print("# predict phase failed: %r" % exc, file=sys.stderr)
+    if pred is not None:
+        result["predict_value"] = pred["higgs"]["value"]
+        result["predict_compiles"] = pred["higgs"]["compiles"]
+        result["predict_expo_value"] = pred["expo"]["value"]
+        result["predict_expo_compiles"] = pred["expo"]["compiles"]
+        print(json.dumps(result), flush=True)
+        for shape in ("higgs", "expo"):
+            r = pred[shape]
+            print("# predict[%s]: %d trees, rows=%d served in %.2fs -> "
+                  "%.2fM rows/s, %d serve compiles (bound %d)"
+                  % (shape, r["trees"], r["rows"], r["serve_s"], r["value"],
+                     r["compiles"], r["compile_bound"]), file=sys.stderr)
     # full per-phase telemetry snapshot (category totals + per-scope table)
     # so BENCH_*.json rounds can archive WHERE the time went
     if bench_telemetry:
@@ -341,6 +363,65 @@ def run_yahoo():
     return {"rows": n, "iters": n_iters, "train_s": train_s,
             "value": round(throughput / 1e6, 3),
             "vs_baseline": round(throughput / YAHOO_THROUGHPUT, 4)}
+
+
+def _predict_one_shape(X, y, params, n_trees, serve_rows, tag):
+    """Train a model on the shape, then serve `serve_rows` ragged batches
+    through the bucketed device runtime; rows/sec + compile count."""
+    import numpy as np
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.predict import BatchServer
+
+    ds = lgb.Dataset(X, y)
+    ds.construct()
+    bst = lgb.train(dict(params), ds, n_trees, verbose_eval=False)
+    bst._booster._materialize_pending()
+    server = BatchServer(bst._booster.device_predictor(),
+                         min_batch=4096, max_batch=1 << 17)
+    rng = np.random.default_rng(0)
+    n = len(X)
+    # warmup: compile EVERY ladder bucket once so the timed loop measures
+    # steady-state serving (the training phases' warmup convention)
+    b = server.min_batch
+    while b <= server.max_batch:
+        server.predict(X[:min(b, n)])
+        b <<= 1
+    served = 0
+    t0 = time.time()
+    while served < serve_rows:
+        # ragged batch sizes exercise the bucket ladder like real traffic
+        k = int(rng.integers(server.min_batch // 2, server.max_batch))
+        idx0 = int(rng.integers(0, max(n - k, 1)))
+        server.predict(X[idx0:idx0 + min(k, n - idx0)])
+        served += min(k, n - idx0)
+    serve_s = time.time() - t0
+    stats = server.stats()   # per-server: correct with telemetry off AND
+    #                        # uncontaminated by the other shape's counters
+    return {"rows": served, "serve_s": serve_s, "trees": bst.num_trees(),
+            "value": round(served / serve_s / 1e6, 3),
+            "compiles": int(stats["compiles"]),
+            "compile_bound": server.max_compiles(), "tag": tag}
+
+
+def run_predict():
+    """Inference-subsystem phase: HIGGS-like dense and Expo-like bundled
+    shapes served through predict/ (rows/sec + compile counts in the
+    BENCH json)."""
+    from bench_full import make_expo_like
+    n_rows = int(os.environ.get("BENCH_PREDICT_ROWS", 2_000_000))
+    n_trees = int(os.environ.get("BENCH_PREDICT_TREES", 100))
+    n_leaves = int(os.environ.get("BENCH_PREDICT_LEAVES", 255))
+    serve_rows = int(os.environ.get("BENCH_PREDICT_SERVE_ROWS", 8_000_000))
+    params = {"objective": "binary", "num_leaves": n_leaves, "max_bin": 255,
+              "verbosity": -1, "metric": "none"}
+    Xh, yh = make_higgs_like(n_rows)
+    higgs = _predict_one_shape(Xh, yh, params, n_trees, serve_rows, "higgs")
+    del Xh, yh
+    Xe, ye = make_expo_like(min(n_rows, 1_000_000))
+    expo = _predict_one_shape(Xe, ye, params, n_trees, serve_rows // 2,
+                              "expo")
+    return {"higgs": higgs, "expo": expo}
 
 
 def run_voting():

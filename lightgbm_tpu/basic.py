@@ -665,10 +665,22 @@ class Booster:
         if num_iteration is None:
             num_iteration = (self.best_iteration
                              if self.best_iteration > 0 else -1)
+        # inference device selection (predict/ subsystem): kwarg wins over
+        # the Booster params; default cpu keeps the numpy walk
+        device = str(kwargs.get(
+            "predict_device",
+            self.params.get("predict_device", "cpu"))).lower()
         if pred_leaf:
             return self._booster.predict_leaf_index(
-                X, start_iteration, num_iteration)
+                X, start_iteration, num_iteration, device=device)
         if pred_contrib:
+            if device == "tpu":
+                # native TreeSHAP stays host-side (logged, counter-pinned)
+                from .telemetry import events as _ev
+                _ev.count("predict::fallback_pred_contrib", 1,
+                          category="predict")
+                Log.info("predict_device=tpu does not cover pred_contrib; "
+                         "using the host TreeSHAP path")
             return self._booster.predict_contrib(
                 X, start_iteration, num_iteration)
         early_stop = None
@@ -686,10 +698,18 @@ class Booster:
                 float(kwargs.get("pred_early_stop_margin",
                                  self.params.get("pred_early_stop_margin",
                                                  10.0))))
+        if early_stop is not None and device == "tpu":
+            # the margin early exit is a host-walk optimization; honoring
+            # it beats ignoring it silently
+            from .telemetry import events as _ev
+            _ev.count("predict::fallback_early_stop", 1, category="predict")
+            Log.info("pred_early_stop is host-only; predict_device=tpu "
+                     "request served by the host predictor")
+            device = "cpu"
         return self._booster.predict(X, raw_score=raw_score,
                                      start_iteration=start_iteration,
                                      num_iteration=num_iteration,
-                                     early_stop=early_stop)
+                                     early_stop=early_stop, device=device)
 
     # ------------------------------------------------------------------
     def model_to_string(self, num_iteration: Optional[int] = None,

@@ -70,6 +70,9 @@ class GBDT:
         self.planned_rounds = 0
         self._rounds_done = 0
         self._batch_credit = 0
+        # compiled device predictors keyed by (start, num, model length);
+        # stale keys age out when the model grows (see device_predictor)
+        self._tpu_predictors: Dict[tuple, object] = {}
 
     # ------------------------------------------------------------------
     def init(self, config: Config, train_data, objective,
@@ -647,6 +650,7 @@ class GBDT:
                        hessians: Optional[np.ndarray] = None) -> bool:
         """One boosting iteration; returns True when training should STOP
         (no splittable leaves), mirroring gbdt.cpp:338-420."""
+        self._invalidate_predictors()
         ntpi = self.num_tree_per_iteration
         self._rounds_done += 1
         if gradients is None and hessians is None and self._fast_path_ok():
@@ -755,6 +759,7 @@ class GBDT:
         scores and blending old/new by decay_rate. The objective must be
         bound to the refit dataset (Booster.refit builds such a booster)."""
         self._materialize_pending()
+        self._invalidate_predictors()
         X = np.ascontiguousarray(X, dtype=np.float64)
         n = X.shape[0]
         ntpi = self.num_tree_per_iteration
@@ -789,6 +794,7 @@ class GBDT:
     def rollback_one_iter(self) -> None:
         """gbdt.cpp:422-438."""
         self._materialize_pending()
+        self._invalidate_predictors()
         if self.iter <= 0:
             return
         ntpi = self.num_tree_per_iteration
@@ -898,6 +904,54 @@ class GBDT:
             end = total_iter
         return self.models[start * ntpi:end * ntpi]
 
+    def _invalidate_predictors(self) -> None:
+        """Drop compiled device predictors whenever the model mutates
+        (new/rolled-back/refit trees) — a stale HBM ensemble must never
+        serve predictions for a changed model."""
+        if self._tpu_predictors:
+            self._tpu_predictors.clear()
+
+    def device_predictor(self, start_iteration=0, num_iteration=-1):
+        """Compiled TPU predictor for the selected iteration range
+        (predict/ subsystem); cached per (range, model size) so repeated
+        serving calls reuse the HBM-resident ensemble tensors."""
+        from ..predict import TPUPredictor, compile_ensemble
+        models = self._used_models(start_iteration, num_iteration)
+        key = (int(start_iteration), int(num_iteration), len(self.models))
+        cached = self._tpu_predictors.get(key)
+        if cached is not None:
+            return cached
+        cfg = self.config
+        dtype = getattr(cfg, "tpu_predict_dtype", "f64") if cfg else "f64"
+        min_rows = (int(getattr(cfg, "tpu_predict_min_batch", 256))
+                    if cfg else 256)
+        ens = compile_ensemble(models, self.num_tree_per_iteration,
+                               self.average_output, self.max_feature_idx)
+        pred = TPUPredictor(ens, self.objective, dtype=dtype,
+                            min_rows=min_rows)
+        if len(self._tpu_predictors) >= 8:
+            # model grew or many ranges requested: drop stale executables
+            self._tpu_predictors.clear()
+        self._tpu_predictors[key] = pred
+        return pred
+
+    def _predict_device_or_none(self, X, raw_score, start_iteration,
+                                num_iteration, leaf=False):
+        """TPU-path predict; None (with a logged counter) on any geometry
+        the compiler rejects, so callers keep the numpy walk as fallback."""
+        from ..predict import EnsembleCompileError
+        try:
+            pred = self.device_predictor(start_iteration, num_iteration)
+            if leaf:
+                return pred.predict_leaf(X)
+            return pred.predict(X, raw_score=raw_score)
+        except EnsembleCompileError as exc:
+            telemetry.count("predict::fallback_compile", 1,
+                            category="predict")
+            Log.warning("predict_device=tpu: %s; falling back to the host "
+                        "predictor" % exc)
+            return None
+
     def predict_raw(self, X: np.ndarray, start_iteration=0,
                     num_iteration=-1, early_stop=None) -> np.ndarray:
         """Raw scores [N, ntpi] (PredictRaw).
@@ -938,7 +992,15 @@ class GBDT:
         return out
 
     def predict(self, X: np.ndarray, raw_score=False, start_iteration=0,
-                num_iteration=-1, early_stop=None) -> np.ndarray:
+                num_iteration=-1, early_stop=None,
+                device: str = "cpu") -> np.ndarray:
+        if device == "tpu" and early_stop is None:
+            # no pre-conversion: TPUPredictor does the one dtype-aware copy
+            out = self._predict_device_or_none(X, raw_score,
+                                               start_iteration,
+                                               num_iteration)
+            if out is not None:
+                return out
         raw = self.predict_raw(X, start_iteration, num_iteration,
                                early_stop=early_stop)
         if not raw_score and self.objective is not None:
@@ -948,8 +1010,14 @@ class GBDT:
         return raw[:, 0] if self.num_tree_per_iteration == 1 else raw
 
     def predict_leaf_index(self, X: np.ndarray, start_iteration=0,
-                           num_iteration=-1) -> np.ndarray:
+                           num_iteration=-1,
+                           device: str = "cpu") -> np.ndarray:
         X = np.ascontiguousarray(X, dtype=np.float64)
+        if device == "tpu":
+            out = self._predict_device_or_none(X, False, start_iteration,
+                                               num_iteration, leaf=True)
+            if out is not None:
+                return out
         models = self._used_models(start_iteration, num_iteration)
         out = np.zeros((X.shape[0], len(models)), dtype=np.int32)
         for i, tree in enumerate(models):
@@ -1126,6 +1194,7 @@ extern "C" void Predict(const double* arr, double* out) {
 
     def load_model_from_string(self, text: str) -> None:
         """GBDT::LoadModelFromString (gbdt_model_text.cpp:385+)."""
+        self._invalidate_predictors()
         self.models = []
         lines = text.splitlines()
         kv: Dict[str, str] = {}

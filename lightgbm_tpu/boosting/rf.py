@@ -49,6 +49,7 @@ class RF(GBDT):
     def train_one_iter(self, gradients=None, hessians=None) -> bool:
         if gradients is not None or hessians is not None:
             Log.fatal("RF mode does not support custom objective functions")
+        self._invalidate_predictors()
         self.bagging(self.iter)
         g_dev, h_dev = self._rf_grad
         bag_mask = self._bag_mask_dev

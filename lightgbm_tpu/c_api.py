@@ -653,6 +653,8 @@ def _predict(cb: _CBooster, X: np.ndarray, predict_type, num_iteration,
             v = params[k]
             kwargs[k] = (v.lower() in ("true", "1", "+")
                          if k == "pred_early_stop" else float(v))
+    if "predict_device" in params:   # device inference via the C ABI too
+        kwargs["predict_device"] = params["predict_device"]
     out = cb.booster.predict(
         X, num_iteration=int(num_iteration) if int(num_iteration) else None,
         raw_score=(pt == C_API_PREDICT_RAW_SCORE),

@@ -208,6 +208,12 @@ PARAMS: List[_P] = [
     _P("tpu_4bit_packing", bool, True),      # nibble-pack <=16-bin groups in HBM
     _P("tpu_telemetry", str, "off"),         # off | timers | trace (telemetry/)
     _P("telemetry_out", str, ""),            # Chrome-trace/metrics path base
+    # ---- inference subsystem (predict/) ----
+    _P("predict_device", str, "cpu",         # cpu = numpy walk (default),
+       ("predict_backend",)),                # tpu = compiled device runtime
+    _P("tpu_predict_dtype", str, "f64"),     # f64 (exact parity) | f32
+    _P("tpu_predict_min_batch", int, 256, lo=1),   # serve bucket ladder
+    _P("tpu_predict_max_batch", int, 65536, lo=1),  # bounds (pow2-rounded)
     _P("tpu_multival", str, "auto"),         # auto | force | off: ELL row-
     #                                        # sparse device layout (the
     #                                        # MultiValBin/SparseBin analog)
@@ -425,6 +431,16 @@ class Config:
         if dev not in ("cpu", "gpu", "tpu"):
             Log.fatal("Unknown device type %s" % dev)
         self.device_type = dev
+        pdev = str(self.predict_device).lower()
+        if pdev not in ("cpu", "tpu"):
+            Log.fatal("Unknown predict_device %s (expected cpu|tpu)" % pdev)
+        self.predict_device = pdev
+        pdt = str(self.tpu_predict_dtype).lower()
+        if pdt not in ("f64", "f32", "float64", "float32"):
+            Log.fatal("Unknown tpu_predict_dtype %s (expected f64|f32)" % pdt)
+        self.tpu_predict_dtype = "f32" if pdt in ("f32", "float32") else "f64"
+        if self.tpu_predict_max_batch < self.tpu_predict_min_batch:
+            Log.fatal("tpu_predict_max_batch < tpu_predict_min_batch")
         if self.boosting == "rf":
             if not (self.bagging_freq > 0 and 0.0 < self.bagging_fraction < 1.0):
                 Log.fatal("Random forest needs bagging_freq > 0 and "

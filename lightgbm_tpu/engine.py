@@ -217,9 +217,6 @@ def _train_distributed(params, train_set, num_boost_round, valid_sets,
         raise LightGBMError("custom eval functions are not supported with "
                             "num_machines > 1 (metrics aggregate "
                             "count-weighted across ranks)")
-    if init_model is not None:
-        raise LightGBMError("continued training (init_model) is not "
-                            "supported with num_machines > 1 yet")
     if callbacks:
         Log.warning("callbacks are ignored with num_machines > 1")
     if learning_rates is not None:
@@ -290,13 +287,28 @@ def _train_distributed(params, train_set, num_boost_round, valid_sets,
                 vidx = shard_rows(len(Xv_all), rank, world,
                                   bool(cfg.pre_partition))
             Xv, yv = Xv_all[vidx], yv_all[vidx]
+    # continued training: seed every rank's score shard with the init
+    # model's raw predictions (the distributed analog of
+    # _graft_init_model's binned-walk score push), then prepend its trees
+    init_stump = None
+    isc_local = isc_valid = None
+    model_str = _load_init_model(init_model)
+    if model_str is not None:
+        init_stump = Booster(model_str=model_str)
+        ntpi0 = init_stump._booster.num_tree_per_iteration
+        raw = init_stump._booster.predict_raw(X[idx])      # [n, K]
+        isc_local = raw[:, 0] if ntpi0 == 1 else raw.T
+        if Xv is not None:
+            vraw = init_stump._booster.predict_raw(Xv)
+            isc_valid = vraw[:, 0] if ntpi0 == 1 else vraw.T
     trees, _mappers, ds, _score = train_multihost(
         cfg, X[idx], None if y is None else y[idx],
         num_rounds=int(num_boost_round),
         categorical_features=tuple(cat_idx),
         weight_local=None if w is None else w[idx],
         X_valid=Xv, y_valid=yv,
-        group_local=glocal, group_valid=gvalid)
+        group_local=glocal, group_valid=gvalid,
+        init_score_local=isc_local, init_score_valid=isc_valid)
     # serialization-only GBDT: populate just the fields
     # save_model_to_string reads (a full init would rebuild a tree
     # learner + device score state per rank only to be discarded)
@@ -311,8 +323,12 @@ def _train_distributed(params, train_set, num_boost_round, valid_sets,
     inner.feature_names = list(ds.feature_names)
     inner.feature_infos = [GBDT._feature_info(m) for m in ds.bin_mappers]
     inner.monotone_constraints = list(cfg.monotone_constraints)
-    inner.models = trees
-    inner.iter = len(trees)
+    if init_stump is not None:
+        inner.models = init_stump._booster.models + trees
+        inner.num_init_iteration = init_stump.current_iteration
+    else:
+        inner.models = trees
+    inner.iter = len(inner.models)
     return Booster(model_str=inner.save_model_to_string(),
                    params=dict(params))
 

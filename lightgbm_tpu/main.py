@@ -160,6 +160,29 @@ class Application:
         elif cfg.predict_contrib:
             result = booster.predict(loaded.X, pred_contrib=True,
                                      num_iteration=num_iteration)
+        elif cfg.predict_device == "tpu" and not cfg.pred_early_stop:
+            # (pred_early_stop is host-only; that combination falls through
+            # to booster.predict, which logs the fallback — CLI and Python
+            # API behave identically)
+            # score predictions ride the bucketed batch-serving runtime:
+            # bounded recompiles, chunked device memory, mesh fan-out
+            from .predict import BatchServer, EnsembleCompileError
+            Log.info("Serving predictions on the device runtime "
+                     "(predict_device=tpu)")
+            try:
+                server = BatchServer(
+                    booster._booster.device_predictor(
+                        0, num_iteration if num_iteration else -1),
+                    min_batch=cfg.tpu_predict_min_batch,
+                    max_batch=cfg.tpu_predict_max_batch)
+                result = server.predict(loaded.X,
+                                        raw_score=cfg.predict_raw_score)
+            except EnsembleCompileError as exc:
+                Log.warning("predict_device=tpu: %s; falling back to the "
+                            "host predictor" % exc)
+                result = booster.predict(loaded.X,
+                                         raw_score=cfg.predict_raw_score,
+                                         num_iteration=num_iteration)
         else:
             result = booster.predict(loaded.X,
                                      raw_score=cfg.predict_raw_score,

@@ -226,7 +226,9 @@ def train_multihost(config: Config, X_local: np.ndarray,
                     X_valid: Optional[np.ndarray] = None,
                     y_valid: Optional[np.ndarray] = None,
                     group_local: Optional[np.ndarray] = None,
-                    group_valid: Optional[np.ndarray] = None):
+                    group_valid: Optional[np.ndarray] = None,
+                    init_score_local: Optional[np.ndarray] = None,
+                    init_score_valid: Optional[np.ndarray] = None):
     """Distributed training entry; returns the (identical-on-every-rank)
     list of host Trees plus the shared BinMappers for model IO.
 
@@ -527,8 +529,15 @@ def train_multihost(config: Config, X_local: np.ndarray,
             check_vma=False))
 
     # ---- init score (BoostFromAverage; GlobalSyncUpByMean) -----------
-    init0s = [(objective.boost_from_score(c)
-               if config.boost_from_average else 0.0) for c in range(K)]
+    # continued training (init_model graft): per-row raw scores from the
+    # init model replace boost-from-average entirely, matching the
+    # single-host _graft_init_model contract (has_init_score suppresses
+    # the average seed)
+    if init_score_local is not None:
+        init0s = [0.0] * K
+    else:
+        init0s = [(objective.boost_from_score(c)
+                   if config.boost_from_average else 0.0) for c in range(K)]
     if world > 1:
         # Network::GlobalSyncUpByMean (gbdt.cpp:308): UNWEIGHTED mean over
         # machines — reference parity on unequal shards
@@ -541,7 +550,16 @@ def train_multihost(config: Config, X_local: np.ndarray,
                 axis=0)]
     init0 = init0s[0]
     n_glob = pad_to * jax.process_count()
-    if K == 1:
+    if init_score_local is not None:
+        isc = np.asarray(init_score_local, np.float64)
+        if K == 1:
+            score = _global_array(mesh, padded(isc.reshape(-1)))
+        else:
+            isc_p = np.stack([padded(isc.reshape(K, -1)[c])
+                              for c in range(K)])        # [K, pad_to]
+            score = jax.make_array_from_process_local_data(
+                NamedSharding(mesh, P(None, AXIS)), isc_p)
+    elif K == 1:
         score = jax.device_put(
             jnp.full((n_glob,), float(init0), jnp.float64),
             NamedSharding(mesh, P(AXIS)))
@@ -582,10 +600,15 @@ def train_multihost(config: Config, X_local: np.ndarray,
           if metrics and int(config.early_stopping_round) > 0 else None)
     vscore = None
     if metrics:
-        vscore = (np.zeros(len(y_valid), np.float64) + init0 if K == 1
-                  else np.broadcast_to(
-                      np.asarray(init0s)[:, None],
-                      (K, len(y_valid))).astype(np.float64).copy())
+        if init_score_valid is not None:
+            vsc = np.asarray(init_score_valid, np.float64)
+            vscore = (vsc.reshape(-1).copy() if K == 1
+                      else vsc.reshape(K, -1).copy())
+        else:
+            vscore = (np.zeros(len(y_valid), np.float64) + init0 if K == 1
+                      else np.broadcast_to(
+                          np.asarray(init0s)[:, None],
+                          (K, len(y_valid))).astype(np.float64).copy())
 
     # ---- batched boosting loop ---------------------------------------
     shrink = float(config.learning_rate)

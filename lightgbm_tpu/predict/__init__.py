@@ -1,0 +1,23 @@
+"""TPU-native inference subsystem.
+
+The reference treats prediction as a first-class subsystem
+(include/LightGBM/predictor.hpp); here it is three layers:
+
+* :mod:`compile`  — pack a trained ensemble into padded, depth-bucketed
+  SoA tensors (one-time, host side);
+* :mod:`runtime`  — the jitted on-device traversal + objective transform
+  (`TPUPredictor`), exact-parity f64 by default;
+* :mod:`serve`    — power-of-two row-bucketed batching, chunking and
+  local-mesh sharding for ragged serving traffic (`BatchServer`).
+
+Selected through ``predict_device=tpu`` (config / Booster.predict kwarg);
+the default ``cpu`` keeps the vectorized numpy walk in models/tree.py.
+"""
+from .compile import (CompiledEnsemble, EnsembleCompileError, TreeBucket,
+                      compile_ensemble)
+from .runtime import TPUPredictor, make_device_transform
+from .serve import BatchServer
+
+__all__ = ["CompiledEnsemble", "EnsembleCompileError", "TreeBucket",
+           "compile_ensemble", "TPUPredictor", "make_device_transform",
+           "BatchServer"]

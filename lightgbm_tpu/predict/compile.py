@@ -1,0 +1,147 @@
+"""Compile a trained ensemble into device-friendly SoA tensors.
+
+The host predictor (models/tree.py) walks each tree per row with numpy
+gathers — fine for a handful of rows, but the ROADMAP's serving story
+("heavy traffic from millions of users") needs the traversal expressed as
+dense tensor ops the XLA/TPU pipeline can fuse, the same recast the GBDT
+inference accelerators make (Booster, arXiv:2011.02022; XGBoost's GPU
+predictor, arXiv:1806.11248).
+
+This module is the ahead-of-time half: it packs the per-tree SoA arrays
+(`split_feature`, `threshold`, `decision_type`, children, leaf values,
+categorical bitsets) into padded `[T, N]` tensors, with trees **bucketed by
+next-power-of-two depth** so a shallow early tree does not force the whole
+ensemble through a 64-level loop. Each bucket traverses in `depth` steps of
+gather-select; the per-bucket tensors are what `runtime.TPUPredictor` ships
+to HBM once and reuses for every batch.
+
+Categorical thresholds keep the reference bitset representation: all bitset
+words of a bucket flatten into one uint32 array with per-node (offset,
+nwords) so membership stays a word/shift test on device — no per-node
+ragged structures survive compilation.
+
+Node encoding matches models/tree.py: child >= 0 is an internal node index,
+child < 0 encodes leaf ~child. Traversal freezes at negative nodes, so
+padded levels are no-ops for rows that already landed.
+"""
+from __future__ import annotations
+
+from typing import List, NamedTuple, Tuple
+
+import numpy as np
+
+from ..utils.log import LightGBMError
+
+# refuse to ship absurd categorical blobs to HBM; the host walk handles the
+# long tail (runtime falls back with a logged counter)
+MAX_CAT_WORDS = 1 << 26
+
+
+class EnsembleCompileError(LightGBMError):
+    """Raised when the model geometry cannot be packed for the device
+    runtime; callers fall back to the host walk (logged, never silent)."""
+
+
+class TreeBucket(NamedTuple):
+    """One depth bucket of the ensemble, padded to common geometry.
+
+    T trees, N internal-node slots, L leaf slots, W categorical words.
+    """
+
+    depth: int                 # traversal steps (max leaf depth in bucket)
+    tree_pos: np.ndarray       # [T] int32 — position in the model list
+    split_feature: np.ndarray  # [T, N] int32
+    threshold: np.ndarray      # [T, N] f64 (cat nodes: unused)
+    decision_type: np.ndarray  # [T, N] int32 (widened from the int8 field)
+    left: np.ndarray           # [T, N] int32
+    right: np.ndarray          # [T, N] int32
+    leaf_value: np.ndarray     # [T, L] f64
+    cat_offset: np.ndarray     # [T, N] int32 into cat_words
+    cat_nwords: np.ndarray     # [T, N] int32 (0 = not categorical)
+    cat_words: np.ndarray      # [W] uint32 (>= 1 word, zero-padded)
+
+
+class CompiledEnsemble(NamedTuple):
+    buckets: Tuple[TreeBucket, ...]
+    num_trees: int
+    num_tree_per_iteration: int
+    average_output: bool
+    max_feature_idx: int
+
+
+def _next_pow2(x: int) -> int:
+    return 1 << max(int(x) - 1, 0).bit_length()
+
+
+def _pack_bucket(models: List, positions: List[int], depth: int) -> TreeBucket:
+    T = len(positions)
+    ni = max(max(models[p].num_leaves - 1 for p in positions), 1)
+    nl = max(max(models[p].num_leaves for p in positions), 1)
+    split_feature = np.zeros((T, ni), dtype=np.int32)
+    threshold = np.zeros((T, ni), dtype=np.float64)
+    decision_type = np.zeros((T, ni), dtype=np.int32)
+    left = np.full((T, ni), -1, dtype=np.int32)
+    right = np.full((T, ni), -1, dtype=np.int32)
+    leaf_value = np.zeros((T, nl), dtype=np.float64)
+    cat_offset = np.zeros((T, ni), dtype=np.int32)
+    cat_nwords = np.zeros((T, ni), dtype=np.int32)
+    words: List[int] = []
+    for t, pos in enumerate(positions):
+        tree = models[pos]
+        n = tree.num_leaves
+        leaf_value[t, :n] = tree.leaf_value[:n]
+        if n <= 1:
+            # stub: one synthetic numeric node routing everything to leaf 0
+            continue
+        k = n - 1
+        split_feature[t, :k] = tree.split_feature[:k]
+        threshold[t, :k] = tree.threshold[:k]
+        decision_type[t, :k] = tree.decision_type[:k].astype(np.int32)
+        left[t, :k] = tree.left_child[:k]
+        right[t, :k] = tree.right_child[:k]
+        for node in range(k):
+            if not (int(tree.decision_type[node]) & 1):   # kCategoricalMask
+                continue
+            ci = int(tree.threshold[node])
+            b0, b1 = tree.cat_boundaries[ci], tree.cat_boundaries[ci + 1]
+            cat_offset[t, node] = len(words)
+            cat_nwords[t, node] = b1 - b0
+            words.extend(int(w) & 0xFFFFFFFF
+                         for w in tree.cat_threshold[b0:b1])
+    if len(words) > MAX_CAT_WORDS:
+        raise EnsembleCompileError(
+            "categorical bitsets too large for the device predictor "
+            "(%d words > %d)" % (len(words), MAX_CAT_WORDS))
+    cat_words = np.asarray(words or [0], dtype=np.uint32)
+    return TreeBucket(
+        depth=depth, tree_pos=np.asarray(positions, dtype=np.int32),
+        split_feature=split_feature, threshold=threshold,
+        decision_type=decision_type, left=left, right=right,
+        leaf_value=leaf_value, cat_offset=cat_offset,
+        cat_nwords=cat_nwords, cat_words=cat_words)
+
+
+def compile_ensemble(models: List, num_tree_per_iteration: int = 1,
+                     average_output: bool = False,
+                     max_feature_idx: int = 0) -> CompiledEnsemble:
+    """Pack host Trees into depth-bucketed device tensors.
+
+    Raises EnsembleCompileError for geometry the runtime cannot serve
+    (empty model, oversized categorical bitsets); the caller keeps the
+    numpy walk as the logged fallback.
+    """
+    if not models:
+        raise EnsembleCompileError("cannot compile an empty model")
+    if any(m is None for m in models):
+        raise EnsembleCompileError("model has unmaterialized trees")
+    by_depth = {}
+    for pos, tree in enumerate(models):
+        d = _next_pow2(max(tree.max_depth(), 1))
+        by_depth.setdefault(d, []).append(pos)
+    buckets = tuple(_pack_bucket(models, by_depth[d], d)
+                    for d in sorted(by_depth))
+    return CompiledEnsemble(
+        buckets=buckets, num_trees=len(models),
+        num_tree_per_iteration=max(int(num_tree_per_iteration), 1),
+        average_output=bool(average_output),
+        max_feature_idx=int(max_feature_idx))

@@ -1,0 +1,271 @@
+"""Device-resident batched prediction runtime.
+
+The execution half of the predict subsystem: ship a `CompiledEnsemble`'s
+bucket tensors to device once, then serve batches as ONE jitted program —
+a `lax.fori_loop` over tree levels of gather-select steps per depth bucket,
+leaf-output accumulation across trees, and the objective transform
+(sigmoid / softmax / exp / identity) on device.
+
+Traversal semantics reproduce `Tree._decision` (models/tree.py) exactly:
+
+* numerical: NaN -> 0 unless missing_type==NaN; zero/NaN routes to the
+  recorded default direction; otherwise `fval <= threshold`;
+* categorical: `int(fval)` bitset membership via word/shift tests against
+  the bucket's flattened uint32 words; NaN counts as category 0 unless
+  missing_type==NaN (-> right); negative values go right.
+
+Accumulation order matters for parity: the host walk adds tree outputs to
+each class accumulator in model order, so the runtime assembles the
+`[T_total, rows]` contribution matrix in model order and folds it with a
+sequential `lax.scan` over iterations — f64 sums are then bit-identical to
+the numpy walk (`raw_score` parity is exact, not approximate). An f32 mode
+(`dtype='f32'`) trades that for cheaper HBM/compute on chip; parity is then
+pinned at 1e-6 by the tests.
+
+Every distinct (rows, geometry) signature costs an XLA compile; callers
+bound that by padding rows to power-of-two buckets — `TPUPredictor.predict`
+does so by default and `serve.BatchServer` adds chunking + mesh sharding.
+Compiles and served rows are pinned by telemetry counters under the
+`predict` category.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..telemetry import events as telemetry
+from .compile import (CompiledEnsemble, EnsembleCompileError, _next_pow2)
+
+kZeroThreshold = 1e-35
+
+# counter names (telemetry category "predict")
+C_COMPILE = "predict::compile"
+C_ROWS = "predict::tpu_rows"
+C_BATCHES = "predict::tpu_batches"
+
+
+def make_device_transform(objective) -> Optional[Callable]:
+    """Device analog of ObjectiveFunction.convert_output for the common
+    objectives (the reference Predictor's ConvertOutput hook). Returns None
+    when the objective needs host conversion — the runtime then returns raw
+    scores and the caller converts on host (still one device round trip)."""
+    if objective is None:
+        return None
+    name = getattr(objective, "name", "")
+    if name in ("none", "", "regression_l1", "huber", "fair", "quantile",
+                "mape", "lambdarank", "rank_xendcg"):
+        return lambda r: r
+    if name == "regression":
+        if getattr(objective, "sqrt", False):
+            return lambda r: jnp.sign(r) * r * r
+        return lambda r: r
+    if name in ("binary", "multiclassova"):
+        sig = float(getattr(objective, "sigmoid", 1.0))
+        return lambda r: 1.0 / (1.0 + jnp.exp(-sig * r))
+    if name == "multiclass":
+        def softmax(r):
+            m = jnp.max(r, axis=-1, keepdims=True)
+            e = jnp.exp(r - m)
+            return e / jnp.sum(e, axis=-1, keepdims=True)
+        return softmax
+    if name == "cross_entropy":
+        return lambda r: 1.0 / (1.0 + jnp.exp(-r))
+    if name == "cross_entropy_lambda":
+        return lambda r: jnp.log1p(jnp.exp(r))
+    if name in ("poisson", "gamma", "tweedie"):
+        return jnp.exp
+    return None
+
+
+def _traverse_bucket(bucket_dev, X, depth: int):
+    """One depth bucket: [T, rows] leaf indices after `depth` gather-select
+    steps. X is [rows, F] (already on the traversal dtype)."""
+    sf, thr, dt, left, right, cat_off, cat_nw, cat_words = bucket_dev
+    T, N = sf.shape
+    R = X.shape[0]
+    XT = X.T                                  # [F, rows]
+    rows = jnp.arange(R)[None, :]
+    node0 = jnp.zeros((T, R), dtype=jnp.int32)
+
+    def step(_, node):
+        nd = jnp.clip(node, 0, N - 1)
+        feat = jnp.take_along_axis(sf, nd, axis=1)
+        fv = XT[feat, rows]                   # [T, rows]
+        th = jnp.take_along_axis(thr, nd, axis=1)
+        d = jnp.take_along_axis(dt, nd, axis=1)
+        is_cat = (d & 1) != 0
+        mt = (d >> 2) & 3
+        default_left = (d & 2) != 0
+        isnan = jnp.isnan(fv)
+        # numerical (Tree._decision numeric branch)
+        fvn = jnp.where(isnan & (mt != 2), jnp.zeros_like(fv), fv)
+        go_default = ((mt == 1) & (jnp.abs(fvn) <= kZeroThreshold)) \
+            | ((mt == 2) & isnan)
+        num_left = jnp.where(go_default, default_left, fvn <= th)
+        # categorical (bitset membership, NaN->category 0, negatives right)
+        int_fval = jnp.where(isnan, jnp.zeros_like(fv), fv).astype(jnp.int64)
+        off = jnp.take_along_axis(cat_off, nd, axis=1).astype(jnp.int64)
+        nw = jnp.take_along_axis(cat_nw, nd, axis=1).astype(jnp.int64)
+        word = int_fval >> 5
+        ok = (int_fval >= 0) & (word < nw)
+        widx = off + jnp.clip(word, 0, jnp.maximum(nw - 1, 0))
+        bits = cat_words[jnp.clip(widx, 0, cat_words.shape[0] - 1)]
+        shift = (int_fval & 31).astype(jnp.uint32)
+        hit = ok & (((bits >> shift) & jnp.uint32(1)) != 0)
+        cat_left = hit & ~(isnan & (mt == 2)) & ~(fv < 0)
+        go_left = jnp.where(is_cat, cat_left, num_left)
+        nxt = jnp.where(go_left,
+                        jnp.take_along_axis(left, nd, axis=1),
+                        jnp.take_along_axis(right, nd, axis=1))
+        return jnp.where(node >= 0, nxt, node)
+
+    node = lax.fori_loop(0, depth, step, node0)
+    # every row lands on a leaf within the bucket depth; clip for safety
+    return jnp.clip(~node, 0, None).astype(jnp.int32)
+
+
+class TPUPredictor:
+    """Serve batched predictions for one compiled ensemble.
+
+    One instance pins the ensemble tensors in HBM; `predict` pads rows to a
+    power-of-two bucket (bounding recompiles to ~log2 of the batch-size
+    range) and runs the jitted traversal. `predict_padded` is the raw
+    entry for callers that manage padding themselves (serve.BatchServer).
+    """
+
+    def __init__(self, ensemble: CompiledEnsemble, objective=None,
+                 dtype: str = "f64", min_rows: int = 128,
+                 donate: Optional[bool] = None):
+        if ensemble.num_trees % ensemble.num_tree_per_iteration != 0:
+            raise EnsembleCompileError(
+                "tree count %d is not a multiple of num_tree_per_iteration"
+                " %d" % (ensemble.num_trees, ensemble.num_tree_per_iteration))
+        self.ensemble = ensemble
+        self.objective = objective
+        self.num_class = ensemble.num_tree_per_iteration
+        self.min_rows = max(int(min_rows), 1)
+        self._dtype = jnp.float32 if dtype == "f32" else jnp.float64
+        if donate is None:
+            donate = jax.default_backend() not in ("cpu",)
+        self._transform = make_device_transform(objective)
+        self._dev_buckets = []
+        for b in ensemble.buckets:
+            self._dev_buckets.append((
+                b.depth,
+                jnp.asarray(b.tree_pos),
+                (jnp.asarray(b.split_feature),
+                 jnp.asarray(b.threshold, dtype=self._dtype),
+                 jnp.asarray(b.decision_type),
+                 jnp.asarray(b.left), jnp.asarray(b.right),
+                 jnp.asarray(b.cat_offset), jnp.asarray(b.cat_nwords),
+                 jnp.asarray(b.cat_words)),
+                jnp.asarray(b.leaf_value, dtype=self._dtype)))
+        donate_args = (0,) if donate else ()
+        self._raw_fn = jax.jit(self._forward_raw,
+                               static_argnums=(1,),
+                               donate_argnums=donate_args)
+        self._leaf_fn = jax.jit(self._forward_leaves,
+                                donate_argnums=donate_args)
+        self._seen_shapes = set()
+
+    # -- jitted bodies --------------------------------------------------
+    def _leaf_matrix(self, X):
+        """[T_total, rows] leaf indices assembled in model order."""
+        T_total = self.ensemble.num_trees
+        leaves = jnp.zeros((T_total, X.shape[0]), dtype=jnp.int32)
+        for depth, tree_pos, arrays, _leaf_value in self._dev_buckets:
+            lf = _traverse_bucket(arrays, X, depth)
+            leaves = leaves.at[tree_pos].set(lf)
+        return leaves
+
+    def _forward_raw(self, X, with_transform: bool):
+        """[rows, K] scores; accumulation is a sequential per-iteration
+        scan so the f64 sum order matches the host walk bit-for-bit."""
+        T_total = self.ensemble.num_trees
+        K = self.num_class
+        contrib = jnp.zeros((T_total, X.shape[0]), dtype=self._dtype)
+        for depth, tree_pos, arrays, leaf_value in self._dev_buckets:
+            lf = _traverse_bucket(arrays, X, depth)
+            contrib = contrib.at[tree_pos].set(
+                jnp.take_along_axis(leaf_value, lf, axis=1))
+        per_iter = contrib.reshape(T_total // K, K, X.shape[0])
+        raw = lax.scan(lambda acc, c: (acc + c, None),
+                       jnp.zeros((K, X.shape[0]), dtype=self._dtype),
+                       per_iter)[0]
+        raw = raw.T                                      # [rows, K]
+        if with_transform and self._transform is not None:
+            if self.ensemble.average_output:
+                # inside jit only when a transform consumes it; the raw
+                # path divides on host (XLA:CPU fast-math strength-reduces
+                # /const to *recip, costing the bit-exact raw parity)
+                raw = raw / max(T_total // K, 1)
+            if K == 1:
+                return self._transform(raw[:, 0])[:, None]
+            return self._transform(raw)
+        return raw
+
+    def _forward_leaves(self, X):
+        return self._leaf_matrix(X).T                    # [rows, T_total]
+
+    # -- host API -------------------------------------------------------
+    def _pad(self, X: np.ndarray):
+        n = X.shape[0]
+        n_pad = max(_next_pow2(n), self.min_rows)
+        if n_pad == n:
+            return X, n
+        Xp = np.zeros((n_pad, X.shape[1]), dtype=X.dtype)
+        Xp[:n] = X
+        return Xp, n
+
+    def _to_device(self, X: np.ndarray):
+        key = (X.shape, "x")
+        if key not in self._seen_shapes:
+            self._seen_shapes.add(key)
+            telemetry.count(C_COMPILE, 1, category="predict")
+        return jnp.asarray(X, dtype=self._dtype)
+
+    def predict_padded(self, X_dev, n_valid: int, raw_score: bool = False):
+        """Device rows [n_pad, F] (padding rows are dropped) -> host
+        predictions [n_valid(, K)]."""
+        want_transform = not raw_score
+        out = self._raw_fn(X_dev, want_transform)
+        out = np.asarray(out)[:n_valid]
+        if not (want_transform and self._transform is not None) \
+                and self.ensemble.average_output:
+            # host-side numpy division: bit-parity with predict_raw
+            out = out / max(self.ensemble.num_trees // self.num_class, 1)
+        if want_transform and self._transform is None \
+                and self.objective is not None:
+            out = (self.objective.convert_output(out[:, 0])[:, None]
+                   if self.num_class == 1
+                   else self.objective.convert_output(out))
+        telemetry.count(C_ROWS, n_valid, category="predict")
+        telemetry.count(C_BATCHES, 1, category="predict")
+        return out[:, 0] if self.num_class == 1 else out
+
+    def predict(self, X: np.ndarray, raw_score: bool = False) -> np.ndarray:
+        X = np.ascontiguousarray(
+            X, dtype=np.float64 if self._dtype == jnp.float64
+            else np.float32)
+        Xp, n = self._pad(X)
+        return self.predict_padded(self._to_device(Xp), n,
+                                   raw_score=raw_score)
+
+    def predict_leaf(self, X: np.ndarray) -> np.ndarray:
+        X = np.ascontiguousarray(
+            X, dtype=np.float64 if self._dtype == jnp.float64
+            else np.float32)
+        Xp, n = self._pad(X)
+        key = (Xp.shape, "leaf")
+        if key not in self._seen_shapes:
+            self._seen_shapes.add(key)
+            telemetry.count(C_COMPILE, 1, category="predict")
+        out = np.asarray(self._leaf_fn(jnp.asarray(Xp, dtype=self._dtype)))
+        telemetry.count(C_ROWS, n, category="predict")
+        telemetry.count(C_BATCHES, 1, category="predict")
+        return out[:n]

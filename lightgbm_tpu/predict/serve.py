@@ -1,0 +1,126 @@
+"""Bucketed batch-serving layer over the device predictor.
+
+Serving traffic is ragged: every distinct row count is a distinct XLA
+program, and an unbounded shape set means unbounded recompiles. This layer
+pads each incoming batch up to a power-of-two row bucket between
+`min_batch` and `max_batch`, so the steady-state program cache holds at
+most ``ceil(log2(max_batch / min_batch)) + 1`` traversal executables no
+matter what batch sizes arrive — the property the serve-layer test pins
+via the `predict::serve_compile` / `predict::serve_bucket_hit` counters.
+
+Batches larger than `max_batch` stream through in `max_batch` chunks
+(bounded device memory). When more than one local device is visible and
+the bucket divides evenly, the padded batch is placed row-sharded over the
+local mesh (`NamedSharding` + jit — the pjit path), so one large request
+fans out across chips; input buffers are donated on accelerator backends
+(the padded copy is serving-owned, never reused).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..telemetry import events as telemetry
+from .runtime import TPUPredictor, _next_pow2
+
+C_SERVE_COMPILE = "predict::serve_compile"
+C_SERVE_HIT = "predict::serve_bucket_hit"
+C_SERVE_SHARDED = "predict::serve_sharded_batches"
+
+ROWS_AXIS = "rows"
+
+
+class BatchServer:
+    """Pad-to-bucket batching + mesh fan-out for one TPUPredictor.
+
+    ``min_batch``/``max_batch`` bound the power-of-two bucket ladder (and
+    with it the compile count); ``shard_min_rows`` gates when a padded
+    batch is worth sharding across the local devices.
+    """
+
+    def __init__(self, predictor: TPUPredictor, min_batch: int = 256,
+                 max_batch: int = 1 << 16, shard_min_rows: int = 8192,
+                 devices=None):
+        if max_batch < min_batch:
+            raise ValueError("max_batch %d < min_batch %d"
+                             % (max_batch, min_batch))
+        self.predictor = predictor
+        self.min_batch = _next_pow2(max(int(min_batch), 1))
+        self.max_batch = _next_pow2(int(max_batch))
+        self.shard_min_rows = int(shard_min_rows)
+        self.devices = list(devices) if devices is not None \
+            else list(jax.local_devices())
+        self._mesh = (Mesh(np.array(self.devices), (ROWS_AXIS,))
+                      if len(self.devices) > 1 else None)
+        # instance-local serving stats: stats() must work (and the bench
+        # must report true compile counts) even with telemetry off, where
+        # events.count() is a no-op
+        self._compiled_buckets = set()
+        self._bucket_hits = 0
+        self._sharded_batches = 0
+
+    # ------------------------------------------------------------------
+    def bucket_rows(self, n: int) -> int:
+        """Smallest ladder bucket holding n rows (n <= max_batch)."""
+        return min(max(_next_pow2(n), self.min_batch), self.max_batch)
+
+    def max_compiles(self) -> int:
+        """The compile bound the ladder guarantees."""
+        return int(np.log2(self.max_batch // self.min_batch)) + 1
+
+    def _place(self, Xp: np.ndarray):
+        """Padded host batch -> device array, row-sharded over the local
+        mesh when large enough and evenly divisible."""
+        dt = np.float32 if self.predictor._dtype == jnp.float32 \
+            else np.float64
+        if (self._mesh is not None and Xp.shape[0] >= self.shard_min_rows
+                and Xp.shape[0] % len(self.devices) == 0):
+            self._sharded_batches += 1
+            telemetry.count(C_SERVE_SHARDED, 1, category="predict")
+            return jax.device_put(
+                Xp.astype(dt, copy=False),
+                NamedSharding(self._mesh, P(ROWS_AXIS, None)))
+        return jnp.asarray(Xp, dtype=self.predictor._dtype)
+
+    def _serve_chunk(self, X: np.ndarray, raw_score: bool) -> np.ndarray:
+        n = X.shape[0]
+        bucket = self.bucket_rows(n)
+        if bucket in self._compiled_buckets:
+            self._bucket_hits += 1
+            telemetry.count(C_SERVE_HIT, 1, category="predict")
+        else:
+            self._compiled_buckets.add(bucket)
+            telemetry.count(C_SERVE_COMPILE, 1, category="predict")
+        Xp = np.zeros((bucket, X.shape[1]), dtype=np.float64)
+        Xp[:n] = X
+        return self.predictor.predict_padded(self._place(Xp), n,
+                                             raw_score=raw_score)
+
+    def predict(self, X, raw_score: bool = False) -> np.ndarray:
+        """Serve one request of any size; rows beyond max_batch stream in
+        max_batch chunks."""
+        X = np.ascontiguousarray(np.asarray(X, dtype=np.float64))
+        if X.ndim == 1:
+            X = X.reshape(1, -1)
+        if X.shape[0] <= self.max_batch:
+            return self._serve_chunk(X, raw_score)
+        outs = [self._serve_chunk(X[i:i + self.max_batch], raw_score)
+                for i in range(0, X.shape[0], self.max_batch)]
+        return np.concatenate(outs, axis=0)
+
+    # ------------------------------------------------------------------
+    def stats(self) -> dict:
+        """Per-server serving stats (telemetry-independent; the same
+        figures also land on the telemetry counters when enabled)."""
+        return {
+            "buckets_compiled": sorted(self._compiled_buckets),
+            "compiles": len(self._compiled_buckets),
+            "compile_bound": self.max_compiles(),
+            "bucket_hits": self._bucket_hits,
+            "sharded_batches": self._sharded_batches,
+        }

@@ -249,10 +249,16 @@ class LGBMModel(_SKBase):
         return compute_sample_weight(self.class_weight, y)
 
     def predict(self, X, raw_score=False, num_iteration=None,
-                pred_leaf=False, pred_contrib=False, **kwargs):
+                pred_leaf=False, pred_contrib=False, predict_device=None,
+                **kwargs):
+        """predict_device="tpu" serves through the compiled device runtime
+        (predict/ subsystem); None defers to the fit params / the "cpu"
+        numpy-walk default."""
         if self._Booster is None:
             raise LightGBMError("Estimator not fitted, call fit before "
                                 "exploiting the model.")
+        if predict_device is not None:
+            kwargs = dict(kwargs, predict_device=predict_device)
         return self._Booster.predict(X, raw_score=raw_score,
                                      num_iteration=num_iteration,
                                      pred_leaf=pred_leaf,

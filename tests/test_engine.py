@@ -92,6 +92,27 @@ def test_continue_train():
     assert mse2 < mse1
 
 
+def test_continue_train_file_roundtrip_exact(tmp_path):
+    """train 10 -> save -> init_model resume 10 == straight 20-iter model:
+    same tree count AND bit-identical predictions (the graft seeds the
+    score cache from the loaded trees' binned walk, so the resumed run
+    grows the identical trees)."""
+    X, y = _make_binary(n=600)
+    params = {"objective": "binary", "num_leaves": 7, "verbosity": -1,
+              "min_data_in_leaf": 5}
+    b20 = lgb.train(dict(params), lgb.Dataset(X, y, params=params), 20,
+                    verbose_eval=False)
+    b10 = lgb.train(dict(params), lgb.Dataset(X, y, params=params), 10,
+                    verbose_eval=False)
+    path = str(tmp_path / "init10.txt")
+    b10.save_model(path)
+    resumed = lgb.train(dict(params), lgb.Dataset(X, y, params=params), 10,
+                        init_model=path, verbose_eval=False)
+    assert resumed.num_trees() == b20.num_trees() == 20
+    np.testing.assert_array_equal(resumed.predict(X, raw_score=True),
+                                  b20.predict(X, raw_score=True))
+
+
 def test_model_roundtrip(tmp_path):
     X, y = _make_binary()
     b = lgb.train({"objective": "binary", "num_leaves": 15, "verbosity": -1},

@@ -203,6 +203,90 @@ def test_python_api_distributed_train(tmp_path):
     assert np.std(r0["pred"]) > 0.05
 
 
+RESUME_WORKER = r"""
+import json, os, sys
+import numpy as np
+sys.path.insert(0, %(repo)r)
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=2")
+import jax
+jax.config.update("jax_platforms", "cpu")
+try:
+    jax.config.update("jax_num_cpu_devices", 2)
+except AttributeError:   # jax 0.4.x: the XLA_FLAGS above covers it
+    pass
+try:
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except AttributeError:
+    pass
+
+rank = int(sys.argv[1])
+port = sys.argv[2]
+out = sys.argv[3]
+os.environ["JAX_PROCESS_ID"] = str(rank)
+
+import lightgbm_tpu as lgb
+
+rng = np.random.default_rng(51)
+n, nf = 2400, 6
+X = rng.normal(size=(n, nf))
+y = (X[:, 1] + 0.5 * X[:, 4] + rng.normal(size=n) * 0.3 > 0).astype(float)
+
+params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+          "num_machines": 2,
+          "machines": "127.0.0.1:%%s,127.0.0.1:0" %% port,
+          "min_data_in_leaf": 5, "tree_learner": "data"}
+b6 = lgb.train(dict(params), lgb.Dataset(X, y), num_boost_round=6,
+               verbose_eval=False)
+b12 = lgb.train(dict(params), lgb.Dataset(X, y), num_boost_round=6,
+                init_model=b6, verbose_eval=False)
+p6 = b6.predict(X[:400])
+p12 = b12.predict(X[:400])
+ll = lambda p: float(-np.mean(y[:400] * np.log(np.clip(p, 1e-9, 1))
+                              + (1 - y[:400])
+                              * np.log(np.clip(1 - p, 1e-9, 1))))
+with open(out, "w") as fh:
+    json.dump({"rank": rank, "trees6": b6.num_trees(),
+               "trees12": b12.num_trees(),
+               "loss6": ll(p6), "loss12": ll(p12),
+               "pred": [round(float(p), 8) for p in p12]}, fh)
+"""
+
+
+@pytest.mark.slow
+def test_python_api_distributed_init_model_resume(tmp_path):
+    """Continued training over num_machines=2: each rank seeds its score
+    shard from the init model's raw predictions and the resumed booster
+    carries init + new trees (train 6 -> resume 6 == 12-tree model that
+    keeps improving), identical on every rank."""
+    port = _free_port()
+    script = tmp_path / "resume_worker.py"
+    script.write_text(RESUME_WORKER % {"repo": REPO})
+    outs = [str(tmp_path / f"resume_rank{r}.json") for r in range(2)]
+    procs = []
+    for r in range(2):
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env.pop("JAX_PLATFORMS", None)
+        procs.append(subprocess.Popen(
+            [sys.executable, str(script), str(r), str(port), outs[r]],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+    for p in procs:
+        try:
+            _, err = p.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("resume multihost worker timed out")
+        assert p.returncode == 0, err.decode()[-2000:]
+    r0 = json.load(open(outs[0]))
+    r1 = json.load(open(outs[1]))
+    assert r0["pred"] == r1["pred"]
+    assert r0["trees6"] == 6 and r0["trees12"] == 12
+    assert r0["loss12"] < r0["loss6"]
+
+
 MC_WORKER = r"""
 import json, os, sys
 import numpy as np

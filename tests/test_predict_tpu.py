@@ -1,0 +1,292 @@
+"""TPU inference subsystem (lightgbm_tpu/predict/).
+
+Parity contract: with the default f64 runtime, `predict_device=tpu` raw
+scores match the numpy walk BIT-FOR-BIT (the runtime folds tree outputs in
+the host walk's accumulation order), leaf indices match exactly, and
+transformed outputs agree to float-ulp level. The f32 runtime is pinned at
+1e-6. Counters pin that the device runtime — not the host fallback —
+served each assertion.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.telemetry import events
+
+FIXDIR = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+@pytest.fixture
+def counters():
+    """Telemetry counters on for the test, restored to off after."""
+    prev_mode = events.mode()
+    events.enable("timers")
+    events.reset()
+    yield events.counts_snapshot
+    events.reset()
+    if prev_mode == events.OFF:
+        events.disable()
+
+
+def _binary_data(seed=3, n=600, nf=8, nan_frac=0.15):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, nf))
+    if nan_frac:
+        X[rng.random((n, nf)) < nan_frac] = np.nan
+    y = (np.nan_to_num(X[:, 0]) - np.nan_to_num(X[:, 2]) > 0).astype(float)
+    return X, y
+
+
+def _assert_served_by_tpu(counts):
+    assert counts.get("predict::tpu_batches", 0) > 0, counts
+    assert counts.get("predict::fallback_compile", 0) == 0, counts
+
+
+@pytest.mark.parametrize("boosting", ["gbdt", "goss", "dart", "rf"])
+def test_parity_boosting_modes(boosting, counters):
+    X, y = _binary_data()
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+              "boosting": boosting, "min_data_in_leaf": 5}
+    if boosting == "rf":
+        params.update(bagging_freq=1, bagging_fraction=0.7)
+    b = lgb.train(dict(params), lgb.Dataset(X, y, params=params), 10,
+                  verbose_eval=False)
+    raw_cpu = b.predict(X, raw_score=True)
+    raw_tpu = b.predict(X, raw_score=True, predict_device="tpu")
+    np.testing.assert_array_equal(raw_cpu, raw_tpu)   # bit-for-bit (f64)
+    np.testing.assert_allclose(b.predict(X, predict_device="tpu"),
+                               b.predict(X), rtol=0, atol=1e-12)
+    np.testing.assert_array_equal(
+        b.predict(X, pred_leaf=True),
+        b.predict(X, pred_leaf=True, predict_device="tpu"))
+    _assert_served_by_tpu(counters())
+
+
+@pytest.mark.slow
+def test_parity_sparse_csr(counters):
+    sp = pytest.importorskip("scipy.sparse")
+    rng = np.random.default_rng(5)
+    n, nf = 700, 30
+    X = np.zeros((n, nf))
+    hit = rng.random((n, nf)) < 0.12
+    X[hit] = rng.normal(loc=1.0, size=int(hit.sum()))
+    y = ((X @ rng.normal(size=nf)) > 0).astype(float)
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1}
+    b = lgb.train(dict(params), lgb.Dataset(X, y, params=params), 8,
+                  verbose_eval=False)
+    csr = sp.csr_matrix(X)
+    np.testing.assert_array_equal(
+        b.predict(csr, raw_score=True),
+        b.predict(csr, raw_score=True, predict_device="tpu"))
+    _assert_served_by_tpu(counters())
+
+
+def test_parity_categorical_bitsets_and_nan(counters):
+    rng = np.random.default_rng(7)
+    n = 800
+    X = rng.normal(size=(n, 6))
+    X[:, 2] = rng.integers(0, 40, size=n)          # wide categorical
+    X[:, 4] = rng.integers(0, 5, size=n)           # narrow categorical
+    X[rng.random(n) < 0.25, 1] = np.nan
+    X[rng.random(n) < 0.10, 2] = np.nan            # NaN in a categorical
+    y = ((X[:, 2] % 3 == 1) | (np.nan_to_num(X[:, 0]) > 0.5)).astype(float)
+    params = {"objective": "binary", "num_leaves": 31, "verbosity": -1,
+              "min_data_in_leaf": 3, "categorical_feature": [2, 4],
+              "max_cat_to_onehot": 2}
+    ds = lgb.Dataset(X, y, params=params, categorical_feature=[2, 4])
+    b = lgb.train(dict(params), ds, 12, verbose_eval=False)
+    assert any(t.num_cat > 0 for t in b._booster.models), \
+        "test needs categorical splits to exercise the bitset path"
+    Xq = X.copy()
+    Xq[:20, 2] = -3.0          # negative categories route right
+    Xq[20:40, 2] = 10_000.0    # beyond any bitset word
+    np.testing.assert_array_equal(
+        b.predict(Xq, raw_score=True),
+        b.predict(Xq, raw_score=True, predict_device="tpu"))
+    np.testing.assert_array_equal(
+        b.predict(Xq, pred_leaf=True),
+        b.predict(Xq, pred_leaf=True, predict_device="tpu"))
+    _assert_served_by_tpu(counters())
+
+
+def test_parity_multiclass(counters):
+    rng = np.random.default_rng(9)
+    n = 600
+    X = rng.normal(size=(n, 6))
+    y = np.argmax(np.stack([X[:, 0], X[:, 1], -X[:, 0] + X[:, 2]]),
+                  axis=0).astype(float)
+    params = {"objective": "multiclass", "num_class": 3, "num_leaves": 7,
+              "verbosity": -1}
+    b = lgb.train(dict(params), lgb.Dataset(X, y, params=params), 6,
+                  verbose_eval=False)
+    np.testing.assert_array_equal(
+        b.predict(X, raw_score=True),
+        b.predict(X, raw_score=True, predict_device="tpu"))
+    np.testing.assert_allclose(b.predict(X, predict_device="tpu"),
+                               b.predict(X), rtol=0, atol=1e-12)
+    _assert_served_by_tpu(counters())
+
+
+@pytest.mark.slow
+def test_num_iteration_and_start_iteration(counters):
+    X, y = _binary_data(seed=11)
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1}
+    b = lgb.train(dict(params), lgb.Dataset(X, y, params=params), 12,
+                  verbose_eval=False)
+    for kw in ({"num_iteration": 5}, {"num_iteration": 4,
+                                      "start_iteration": 3}):
+        np.testing.assert_array_equal(
+            b.predict(X, raw_score=True, **kw),
+            b.predict(X, raw_score=True, predict_device="tpu", **kw))
+    _assert_served_by_tpu(counters())
+
+
+def test_pred_leaf_parity_interop_fixture(counters):
+    """pred_leaf on the reference-written model (categorical-free HIGGS
+    model text): device traversal == numpy walk, and the transformed
+    predictions still match the reference's own outputs."""
+    b = lgb.Booster(model_file=os.path.join(FIXDIR, "interop_model.txt"))
+    rng = np.random.default_rng(13)
+    nf = b.num_feature()
+    X = rng.normal(size=(300, nf)) * 2.0
+    X[rng.random((300, nf)) < 0.1] = np.nan
+    np.testing.assert_array_equal(
+        b.predict(X, pred_leaf=True),
+        b.predict(X, pred_leaf=True, predict_device="tpu"))
+    np.testing.assert_array_equal(
+        b.predict(X, raw_score=True),
+        b.predict(X, raw_score=True, predict_device="tpu"))
+    _assert_served_by_tpu(counters())
+
+
+@pytest.mark.slow
+def test_f32_runtime_pinned_tolerance():
+    """tpu_predict_dtype=f32: cheaper on-chip serving, parity pinned at
+    1e-6 against the f64 host walk."""
+    X, y = _binary_data(seed=17, nan_frac=0.0)
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+              "tpu_predict_dtype": "f32"}
+    b = lgb.train(dict(params), lgb.Dataset(X, y, params=params), 10,
+                  verbose_eval=False)
+    raw_cpu = b.predict(X, raw_score=True)
+    raw_tpu = b.predict(X, raw_score=True, predict_device="tpu")
+    np.testing.assert_allclose(raw_tpu, raw_cpu, rtol=0, atol=1e-6)
+
+
+@pytest.mark.slow
+def test_pred_contrib_falls_back_logged(counters):
+    X, y = _binary_data(seed=19)
+    params = {"objective": "binary", "num_leaves": 7, "verbosity": -1}
+    b = lgb.train(dict(params), lgb.Dataset(X, y, params=params), 5,
+                  verbose_eval=False)
+    contrib_tpu = b.predict(X, pred_contrib=True, predict_device="tpu")
+    contrib_cpu = b.predict(X, pred_contrib=True)
+    np.testing.assert_array_equal(contrib_cpu, contrib_tpu)
+    assert counters().get("predict::fallback_pred_contrib", 0) > 0
+
+
+def test_serve_bucket_compile_bound(counters):
+    """The serve-layer acceptance pin: a sweep of ragged batch sizes costs
+    at most ceil(log2(max_batch/min_batch)) + 1 traversal compiles."""
+    from lightgbm_tpu.predict import BatchServer
+
+    X, y = _binary_data(seed=23)
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1}
+    b = lgb.train(dict(params), lgb.Dataset(X, y, params=params), 8,
+                  verbose_eval=False)
+    server = BatchServer(b._booster.device_predictor(),
+                         min_batch=64, max_batch=1024)
+    bound = server.max_compiles()
+    assert bound == int(np.ceil(np.log2(1024 / 64))) + 1
+    rng = np.random.default_rng(0)
+    sizes = [65, 100, 128, 1, 300, 511, 700, 1000, 64, 77, 950, 513, 256,
+             129, 2, 333]
+    for n in sizes:
+        idx = rng.integers(0, len(X), size=n)
+        out = server.predict(X[idx])
+        np.testing.assert_allclose(out, b.predict(X[idx]),
+                                   rtol=0, atol=1e-12)
+    counts = counters()
+    assert counts.get("predict::serve_compile", 0) <= bound, counts
+    assert counts.get("predict::serve_bucket_hit", 0) >= len(sizes) - bound
+    assert server.stats()["compiles"] <= bound
+
+
+@pytest.mark.slow
+def test_serve_chunks_large_requests(counters):
+    from lightgbm_tpu.predict import BatchServer
+
+    X, y = _binary_data(seed=29, n=500)
+    params = {"objective": "binary", "num_leaves": 7, "verbosity": -1}
+    b = lgb.train(dict(params), lgb.Dataset(X, y, params=params), 5,
+                  verbose_eval=False)
+    server = BatchServer(b._booster.device_predictor(),
+                         min_batch=64, max_batch=128)
+    rng = np.random.default_rng(1)
+    Xbig = X[rng.integers(0, len(X), size=1000)]
+    np.testing.assert_allclose(server.predict(Xbig), b.predict(Xbig),
+                               rtol=0, atol=1e-12)
+    # 1000 rows -> ceil(1000/128) chunks, a single 128-bucket executable
+    assert server.stats()["compiles"] == 1
+
+
+@pytest.mark.slow
+def test_serve_sharded_over_local_mesh(counters):
+    """Large padded batches place row-sharded over the 8-device test mesh
+    (the pjit fan-out path); traversal is row-local so parity stays
+    bit-exact."""
+    import jax
+    from lightgbm_tpu.predict import BatchServer
+
+    if len(jax.local_devices()) < 2:
+        pytest.skip("needs a multi-device mesh")
+    X, y = _binary_data(seed=31, n=9000, nf=6)
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1}
+    b = lgb.train(dict(params), lgb.Dataset(X, y, params=params), 6,
+                  verbose_eval=False)
+    server = BatchServer(b._booster.device_predictor(), min_batch=256,
+                         max_batch=1 << 14, shard_min_rows=4096)
+    out = server.predict(X, raw_score=True)
+    np.testing.assert_array_equal(out, b.predict(X, raw_score=True))
+    assert counters().get("predict::serve_sharded_batches", 0) > 0
+
+
+@pytest.mark.slow
+def test_cli_predict_device_tpu(tmp_path):
+    """CLI task=predict with predict_device=tpu writes the same result
+    file the host predictor writes (main.py serve-layer path)."""
+    from lightgbm_tpu.main import main as cli_main
+
+    X, y = _binary_data(seed=37, n=300, nan_frac=0.0)
+    data = np.column_stack([y, X])
+    train_path = str(tmp_path / "train.csv")
+    np.savetxt(train_path, data, delimiter=",")
+    model_path = str(tmp_path / "model.txt")
+    assert cli_main(["task=train", "data=%s" % train_path,
+                     "objective=binary", "num_leaves=7", "num_trees=5",
+                     "verbosity=-1", "label_column=0",
+                     "output_model=%s" % model_path]) == 0
+    out_cpu = str(tmp_path / "pred_cpu.txt")
+    out_tpu = str(tmp_path / "pred_tpu.txt")
+    for dev, out in (("cpu", out_cpu), ("tpu", out_tpu)):
+        assert cli_main(["task=predict", "data=%s" % train_path,
+                         "input_model=%s" % model_path,
+                         "label_column=0", "verbosity=-1",
+                         "predict_device=%s" % dev,
+                         "output_result=%s" % out]) == 0
+    np.testing.assert_allclose(np.loadtxt(out_tpu), np.loadtxt(out_cpu),
+                               rtol=0, atol=1e-12)
+
+
+@pytest.mark.slow
+def test_sklearn_predict_device():
+    sk = pytest.importorskip("sklearn")  # noqa: F841
+    X, y = _binary_data(seed=41, nan_frac=0.0)
+    clf = lgb.LGBMClassifier(n_estimators=5, num_leaves=7)
+    clf.fit(X, y.astype(int), verbose=False)
+    np.testing.assert_allclose(
+        clf.predict_proba(X, predict_device="tpu"),
+        clf.predict_proba(X), rtol=0, atol=1e-12)
+    assert (clf.predict(X, predict_device="tpu") == clf.predict(X)).all()
