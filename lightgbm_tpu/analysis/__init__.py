@@ -1,0 +1,44 @@
+"""Graft-lint: JAX-aware static analysis + jaxpr audit gate.
+
+The repo's two worst defect classes — silent f32->f64 dtype promotion
+(the persist-f32 vs v1-f64 tie-flip family pinned by
+tests/test_known_divergence.py) and recompile/host-sync hazards on the
+serving path — are invisible to pytest until they bite at scale. This
+package machine-checks them on every run:
+
+* :mod:`lint` — an AST rule engine (rules JG001-JG007, see
+  :mod:`rules`) scanning the package for JAX/TPU pitfalls specific to
+  this codebase, with inline suppressions, a checked-in baseline for
+  grandfathered findings, and an autofix mode (unused imports).
+* :mod:`jaxpr_audit` — traces the real TPU entry points
+  (``hist_window``, ``scan_pair``/``scan_blocks``, the persist
+  ``split_pass``, the predict traversal) with abstract inputs and
+  asserts structural invariants on the jaxpr: no f64
+  ``convert_element_type`` inside persist-f32 kernels, no host
+  callbacks/transfers inside ``fori_loop``/``scan`` bodies, donation
+  actually recorded, the serve ladder's compile bound.
+* :mod:`strict` — the strict-numerics test harness (strict dtype
+  promotion + debug-nans) the kernel-parity tests run under.
+
+Gate: ``python -m lightgbm_tpu.analysis`` exits non-zero on any
+unsuppressed finding or failed audit; ``tests/test_analysis.py`` runs
+the same self-scan inside the tier-1 suite.
+"""
+from __future__ import annotations
+
+from .config import GraftlintConfig, load_config
+from .core import Finding
+from .jaxpr_audit import AuditResult, run_audits
+from .lint import LintReport, run_lint
+from .strict import strict_numerics
+
+__all__ = [
+    "AuditResult",
+    "Finding",
+    "GraftlintConfig",
+    "LintReport",
+    "load_config",
+    "run_audits",
+    "run_lint",
+    "strict_numerics",
+]

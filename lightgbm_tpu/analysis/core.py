@@ -1,0 +1,217 @@
+"""Shared lint primitives: findings and the per-module AST context.
+
+The rules in :mod:`lightgbm_tpu.analysis.rules` are pure functions over a
+:class:`ModuleContext` — one parsed module plus the derived maps every
+rule needs (parent links, import alias resolution, jit/kernel scope
+classification, loop nesting). Building those once per file keeps each
+rule to a dozen lines of actual logic.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Set, Tuple
+
+from .config import GraftlintConfig
+
+# inline suppression grammar:
+#   x = risky()            # graftlint: disable=JG003
+#   # graftlint: disable=JG002,JG004   (on the line above also works)
+#   # graftlint: skip-file             (first 10 lines: whole module)
+_DISABLE_RE = re.compile(r"#\s*graftlint:\s*disable=([A-Z0-9, ]+)")
+_SKIP_FILE_RE = re.compile(r"#\s*graftlint:\s*skip-file")
+
+
+@dataclass
+class Finding:
+    """One lint hit. `snippet` (the stripped source line) is part of the
+    identity used for baseline matching, so findings survive line drift."""
+
+    rule: str
+    path: str            # repo-relative, '/' separated
+    line: int            # 1-based
+    col: int
+    message: str
+    snippet: str
+    suppressed: bool = False
+    suppression: str = ""        # "inline" | "baseline"
+    # optional autofix: ("replace_span", (lineno, end_lineno, new_text)),
+    # new_text == None means delete the statement lines outright
+    fix: Optional[Tuple[str, tuple]] = None
+
+    def key(self) -> Tuple[str, str, str]:
+        return (self.rule, self.path, self.snippet)
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "message": self.message,
+                "snippet": self.snippet, "suppressed": self.suppressed,
+                "suppression": self.suppression}
+
+
+class ModuleContext:
+    """One parsed module + the derived maps rules share."""
+
+    def __init__(self, source: str, relpath: str, config: GraftlintConfig):
+        self.source = source
+        self.relpath = relpath.replace("\\", "/")
+        self.config = config
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source)
+        self.parent: Dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self.parent[child] = node
+        self.aliases = self._collect_aliases()
+        self._kernel_res = config.kernel_regexes()
+        self.jit_scopes = self._collect_jit_scopes()
+        self._disabled_lines = self._collect_suppressions()
+        self.skip_file = any(_SKIP_FILE_RE.search(ln)
+                             for ln in self.lines[:10])
+
+    # -- imports ------------------------------------------------------
+    def _collect_aliases(self) -> Dict[str, str]:
+        """local name -> dotted origin ('jnp' -> 'jax.numpy'; a relative
+        'from .pallas_compat import pl' -> '.pallas_compat.pl')."""
+        aliases: Dict[str, str] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    aliases[a.asname or a.name.split(".")[0]] = \
+                        a.name if a.asname else a.name.split(".")[0]
+            elif isinstance(node, ast.ImportFrom):
+                mod = ("." * node.level) + (node.module or "")
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    aliases[a.asname or a.name] = mod + "." + a.name
+        return aliases
+
+    def dotted(self, node: ast.AST) -> Optional[str]:
+        """Resolved dotted name of a Name/Attribute chain, with the root
+        segment mapped through the module's import aliases."""
+        parts: List[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        root = self.aliases.get(node.id, node.id)
+        parts.append(root)
+        return ".".join(reversed(parts))
+
+    def call_target(self, call: ast.Call) -> Optional[str]:
+        return self.dotted(call.func)
+
+    # -- scopes -------------------------------------------------------
+    def enclosing_function(self, node: ast.AST) -> Optional[ast.AST]:
+        cur = self.parent.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                return cur
+            cur = self.parent.get(cur)
+        return None
+
+    def _decorator_is_jit(self, dec: ast.AST) -> bool:
+        d = self.dotted(dec)
+        if d in ("jax.jit", "jax.pmap", "jit"):
+            return True
+        if isinstance(dec, ast.Call):
+            target = self.dotted(dec.func)
+            if target in ("jax.jit", "jax.pmap", "jit"):
+                return True
+            if target in ("functools.partial", "partial") and dec.args:
+                return self.dotted(dec.args[0]) in ("jax.jit", "jax.pmap",
+                                                    "jit")
+        return False
+
+    def is_kernel_name(self, name: str) -> bool:
+        return any(r.search(name) for r in self._kernel_res)
+
+    def _collect_jit_scopes(self) -> Set[ast.AST]:
+        """Functions whose bodies trace: jit-decorated ones, kernel-named
+        ones, and everything (transitively) nested inside either."""
+        scopes: Set[ast.AST] = set()
+        for node in ast.walk(self.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            if any(self._decorator_is_jit(d) for d in node.decorator_list) \
+                    or self.is_kernel_name(node.name):
+                scopes.add(node)
+        # transitive nesting
+        changed = True
+        while changed:
+            changed = False
+            for node in ast.walk(self.tree):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)) \
+                        and node not in scopes:
+                    fn = self.enclosing_function(node)
+                    if fn is not None and fn in scopes:
+                        scopes.add(node)
+                        changed = True
+        return scopes
+
+    def in_jit_scope(self, node: ast.AST) -> bool:
+        fn = self.enclosing_function(node)
+        return fn is not None and fn in self.jit_scopes
+
+    def in_kernel_scope(self, node: ast.AST) -> bool:
+        fn = self.enclosing_function(node)
+        while fn is not None:
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and self.is_kernel_name(fn.name):
+                return True
+            fn = self.enclosing_function(fn)
+        return False
+
+    def in_host_loop(self, node: ast.AST) -> bool:
+        """Inside a for/while body, not crossing a function boundary (a
+        function *defined* in a loop does not run per iteration)."""
+        cur = self.parent.get(node)
+        child = node
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                return False
+            if isinstance(cur, (ast.For, ast.While)) \
+                    and child in getattr(cur, "body", []) + \
+                    getattr(cur, "orelse", []):
+                return True
+            child = cur
+            cur = self.parent.get(cur)
+        return False
+
+    # -- suppression --------------------------------------------------
+    def _collect_suppressions(self) -> Dict[int, Set[str]]:
+        out: Dict[int, Set[str]] = {}
+        for i, line in enumerate(self.lines, start=1):
+            m = _DISABLE_RE.search(line)
+            if m:
+                ids = {s.strip() for s in m.group(1).split(",") if s.strip()}
+                out[i] = ids
+        return out
+
+    def is_inline_suppressed(self, rule: str, line: int) -> bool:
+        for ln in (line, line - 1):
+            ids = self._disabled_lines.get(ln)
+            if ids and (rule in ids or "ALL" in ids):
+                # a line-above suppression must be a pure comment line
+                if ln == line - 1 and ln >= 1 \
+                        and not self.lines[ln - 1].lstrip().startswith("#"):
+                    continue
+                return True
+        return False
+
+    # -- findings -----------------------------------------------------
+    def finding(self, rule: str, node: ast.AST, message: str,
+                fix=None) -> Finding:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        snippet = (self.lines[line - 1].strip()
+                   if 0 < line <= len(self.lines) else "")
+        return Finding(rule=rule, path=self.relpath, line=line, col=col,
+                       message=message, snippet=snippet, fix=fix)

@@ -1,0 +1,365 @@
+"""Jaxpr/HLO structural audits of the real TPU entry points.
+
+Where the AST linter reasons about source text, this module traces the
+actual hot-path programs with abstract inputs (``jax.make_jaxpr`` — no
+device execution, runs fine on CPU) and asserts invariants on the IR:
+
+* **persist-f32 kernels stay f32** — no ``convert_element_type`` to
+  f64 anywhere in the jaxprs of ``hist_window`` (both variants),
+  ``scan_pair``, ``scan_blocks``, or the persist ``split_pass``. This
+  is the machine-checked half of the tie-flip characterization
+  (tests/test_known_divergence.py tracks the residual v1-vs-persist
+  gap; this audit pins that the persist side cannot silently widen).
+* **no host callbacks/transfers inside loop bodies** — the predict
+  traversal's ``fori_loop``/``scan`` bodies (and the kernels') must be
+  free of ``pure_callback``/``io_callback``/``debug_callback``/
+  ``device_put``: one of those inside a loop serializes the pipeline
+  per level instead of per batch.
+* **donation is real** — the predict runtime's jit wrapper must record
+  input-output aliasing in its lowered IR when donation is requested,
+  and the persist split kernel must alias its payload in/out (the
+  in-place partition the whole design assumes).
+* **the serve ladder bound holds analytically** — every batch size in
+  [1, max_batch] maps into at most ceil(log2(max/min)) + 1 buckets.
+
+Each audit returns an :class:`AuditResult`; audits that need pallas
+report ``skipped`` on builds without it instead of failing the gate.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..telemetry import events as telemetry
+
+C_AUDIT_FAIL = "analysis::audit_fail"
+
+# primitives that round-trip to the host or move buffers; forbidden
+# inside fori_loop / scan / while bodies on the audited paths
+_HOST_PRIMS = {
+    "pure_callback", "io_callback", "debug_callback", "callback",
+    "infeed", "outfeed", "device_put", "copy_to_host_async",
+}
+
+_F64 = np.dtype("float64")
+
+
+@dataclass
+class AuditResult:
+    name: str
+    ok: bool
+    detail: str = ""
+    skipped: bool = False
+
+    def to_dict(self) -> dict:
+        return {"name": self.name, "ok": self.ok, "detail": self.detail,
+                "skipped": self.skipped}
+
+
+# ---------------------------------------------------------------------------
+# jaxpr walking
+# ---------------------------------------------------------------------------
+
+def _sub_jaxprs(eqn) -> Iterator:
+    for val in eqn.params.values():
+        if hasattr(val, "jaxpr"):          # ClosedJaxpr
+            yield val.jaxpr
+        elif hasattr(val, "eqns"):         # raw Jaxpr
+            yield val
+        elif isinstance(val, (list, tuple)):
+            for v in val:
+                if hasattr(v, "jaxpr"):
+                    yield v.jaxpr
+                elif hasattr(v, "eqns"):
+                    yield v
+
+
+def iter_eqns(jaxpr, loop_depth: int = 0) -> Iterator[Tuple[object, int]]:
+    """(eqn, loop_depth) over a jaxpr and every sub-jaxpr; loop_depth
+    counts enclosing while/scan bodies."""
+    for eqn in jaxpr.eqns:
+        yield eqn, loop_depth
+        inner = loop_depth + (1 if eqn.primitive.name in ("while", "scan")
+                              else 0)
+        for sub in _sub_jaxprs(eqn):
+            yield from iter_eqns(sub, inner)
+
+
+def find_f64_converts(jaxpr) -> List[str]:
+    out = []
+    for eqn, _ in iter_eqns(jaxpr):
+        if eqn.primitive.name == "convert_element_type" \
+                and np.dtype(eqn.params.get("new_dtype")) == _F64:
+            out.append(str(eqn))
+    return out
+
+
+def find_f64_outputs(jaxpr) -> List[str]:
+    """Ops *producing* f64 anywhere (stricter than converts: catches f64
+    constants and dtype-defaulted iota/broadcast)."""
+    out = []
+    for eqn, _ in iter_eqns(jaxpr):
+        for var in eqn.outvars:
+            aval = getattr(var, "aval", None)
+            if aval is not None \
+                    and getattr(aval, "dtype", None) == _F64:
+                out.append("%s -> %s" % (eqn.primitive.name, aval))
+    return out
+
+
+def find_host_prims_in_loops(jaxpr) -> List[str]:
+    out = []
+    for eqn, depth in iter_eqns(jaxpr):
+        if depth > 0 and eqn.primitive.name in _HOST_PRIMS:
+            out.append(eqn.primitive.name)
+    return out
+
+
+def _audit_jaxpr(name: str, closed, forbid_f64: bool = True,
+                 strict_f64: bool = False) -> AuditResult:
+    jaxpr = closed.jaxpr
+    problems: List[str] = []
+    if forbid_f64:
+        finder = find_f64_outputs if strict_f64 else find_f64_converts
+        hits = finder(jaxpr)
+        if hits:
+            problems.append("f64 values in a persist-f32 program: %s"
+                            % "; ".join(hits[:3]))
+    loops = find_host_prims_in_loops(jaxpr)
+    if loops:
+        problems.append("host/transfer primitives inside loop bodies: %s"
+                        % ", ".join(sorted(set(loops))))
+    return AuditResult(name=name, ok=not problems,
+                       detail="; ".join(problems))
+
+
+def _skip(name: str, why: str) -> AuditResult:
+    return AuditResult(name=name, ok=True, detail=why, skipped=True)
+
+
+# ---------------------------------------------------------------------------
+# individual audits
+# ---------------------------------------------------------------------------
+
+def audit_hist_window() -> AuditResult:
+    """Both histogram kernel variants (radix W=256, one-hot W<=64) trace
+    f64-free with f32 gradients."""
+    from ..ops.pallas_compat import HAS_PALLAS
+    name = "hist_window_f32"
+    if not HAS_PALLAS:
+        return _skip(name, "pallas unavailable")
+    from ..ops.pallas_histogram import hist_window
+    problems = []
+    for w, G, C in ((256, 3, 1024), (64, 5, 512)):
+        bins = jax.ShapeDtypeStruct((G, C), jnp.int32)
+        vec = jax.ShapeDtypeStruct((C,), jnp.float32)
+        closed = jax.make_jaxpr(
+            lambda b, g, h, _w=w: hist_window(b, g, h, w=_w))(
+                bins, vec, vec)
+        r = _audit_jaxpr(name, closed, strict_f64=True)
+        if not r.ok:
+            problems.append("w=%d: %s" % (w, r.detail))
+    return AuditResult(name=name, ok=not problems,
+                       detail="; ".join(problems))
+
+
+def audit_scan_pair() -> AuditResult:
+    from ..ops.pallas_compat import HAS_PALLAS
+    name = "scan_pair_f32"
+    if not HAS_PALLAS:
+        return _skip(name, "pallas unavailable")
+    from ..ops.pallas_scan import scan_pair
+    Fp, Wp = 8, 128
+    f32 = jnp.float32
+    closed = jax.make_jaxpr(scan_pair)(
+        jax.ShapeDtypeStruct((2, 8), f32),
+        jax.ShapeDtypeStruct((2, Fp, Wp), f32),
+        jax.ShapeDtypeStruct((2, Fp, Wp), f32),
+        jax.ShapeDtypeStruct((Fp, Wp), f32),
+        jax.ShapeDtypeStruct((Fp, Wp), f32),
+        jax.ShapeDtypeStruct((Fp, Wp), f32),
+        jax.ShapeDtypeStruct((Fp, Wp), f32),
+        jax.ShapeDtypeStruct((8, Fp), f32))
+    return _audit_jaxpr(name, closed, strict_f64=True)
+
+
+def audit_scan_blocks() -> AuditResult:
+    from ..ops.pallas_compat import HAS_PALLAS
+    name = "scan_blocks_f32"
+    if not HAS_PALLAS:
+        return _skip(name, "pallas unavailable")
+    from ..ops.pallas_scan import BM_ROWS, scan_blocks
+    Gp, Wp = 8, 128
+    f32 = jnp.float32
+    closed = jax.make_jaxpr(
+        lambda s, g, h, m: scan_blocks(s, g, h, m, do_fix=True))(
+            jax.ShapeDtypeStruct((2, 9), f32),
+            jax.ShapeDtypeStruct((2, Gp, Wp), f32),
+            jax.ShapeDtypeStruct((2, Gp, Wp), f32),
+            jax.ShapeDtypeStruct((BM_ROWS, Gp, Wp), f32))
+    return _audit_jaxpr(name, closed, strict_f64=True)
+
+
+def audit_persist_split_pass() -> AuditResult:
+    """The Mosaic split_pass on a toy payload geometry: f64-free, and
+    the payload must be donated (input_output_aliases) — the in-place
+    partition contract."""
+    from ..ops.pallas_compat import HAS_PALLAS
+    name = "persist_split_pass"
+    if not HAS_PALLAS:
+        return _skip(name, "pallas unavailable")
+    from ..ops.pallas_grow import make_split_pass
+    WPA, NP, G, nbw = 8, 1024, 2, 2
+    plan = ((0, 0, 255), (1, 0, 255))
+    sp = make_split_pass(WPA, NP, G, plan, nbw, C=256)
+    closed = jax.make_jaxpr(sp)(
+        jax.ShapeDtypeStruct((WPA, NP), jnp.uint32),
+        jax.ShapeDtypeStruct((16,), jnp.int32))
+    res = _audit_jaxpr(name, closed, strict_f64=True)
+    if not res.ok:
+        return res
+    aliased = False
+    for eqn, _ in iter_eqns(closed.jaxpr):
+        if "pallas_call" in eqn.primitive.name:
+            ioa = eqn.params.get("input_output_aliases") or ()
+            aliased = aliased or bool(tuple(ioa))
+    if not aliased:
+        return AuditResult(
+            name=name, ok=False,
+            detail="split_pass pallas_call lost its payload "
+                   "input_output_aliases (in-place partition broken)")
+    return res
+
+
+def _toy_ensemble(num_class: int = 1):
+    """Hand-built 3-tree CompiledEnsemble (two depth buckets, one
+    categorical bitset node) — no training required. With num_class=3
+    the 3 trees become one iteration of 3 classes, which makes the raw
+    output shape [rows, 3] match an X of 3 features — the geometry the
+    donation audit needs for input-output aliasing to be legal."""
+    from ..predict.compile import CompiledEnsemble, TreeBucket
+    i32 = np.int32
+    b1 = TreeBucket(
+        depth=2,
+        tree_pos=np.array([0, 2], i32),
+        split_feature=np.array([[0, 1, 0], [1, 0, 2]], i32),
+        threshold=np.array([[0.5, -1.0, 1.0], [0.0, 0.25, 0.5]]),
+        decision_type=np.array([[2, 0, 0], [1, 0, 2]], i32),
+        left=np.array([[1, -1, -3], [1, -1, -3]], i32),
+        right=np.array([[2, -2, -4], [2, -2, -4]], i32),
+        leaf_value=np.array([[0.1, -0.2, 0.3, -0.4],
+                             [0.5, -0.6, 0.7, -0.8]]),
+        cat_offset=np.array([[0, 0, 0], [0, 0, 0]], i32),
+        cat_nwords=np.array([[0, 0, 0], [1, 0, 0]], i32),
+        cat_words=np.array([0b1010], np.uint32))
+    b2 = TreeBucket(
+        depth=1,
+        tree_pos=np.array([1], i32),
+        split_feature=np.array([[2]], i32),
+        threshold=np.array([[0.0]]),
+        decision_type=np.array([[0]], i32),
+        left=np.array([[-1]], i32),
+        right=np.array([[-2]], i32),
+        leaf_value=np.array([[0.05, -0.05]]),
+        cat_offset=np.array([[0]], i32),
+        cat_nwords=np.array([[0]], i32),
+        cat_words=np.array([0], np.uint32))
+    return CompiledEnsemble(buckets=(b1, b2), num_trees=3,
+                            num_tree_per_iteration=num_class,
+                            average_output=False, max_feature_idx=2)
+
+
+def audit_predict_traversal() -> AuditResult:
+    """The f32 predict runtime traces f64-free and keeps its
+    fori_loop/scan bodies free of host callbacks/transfers."""
+    from ..predict.runtime import TPUPredictor
+    name = "predict_traversal_f32"
+    pred = TPUPredictor(_toy_ensemble(), dtype="f32", donate=False)
+    X = jax.ShapeDtypeStruct((64, 3), jnp.float32)
+    closed = jax.make_jaxpr(
+        lambda x: pred._forward_raw(x, False))(X)
+    return _audit_jaxpr(name, closed, strict_f64=True)
+
+
+def audit_predict_donation() -> AuditResult:
+    """With donation requested, the lowered predict program must record
+    input-output buffer aliasing (jax drops donation silently when the
+    wrapper loses the donate_argnums — this pins it structurally). Uses
+    the 3-class toy so the [rows, K] output is alias-compatible with the
+    [rows, F] input; an alias-incompatible program cannot witness
+    donation at all."""
+    import warnings
+
+    from ..predict.runtime import TPUPredictor
+    name = "predict_donation"
+    pred = TPUPredictor(_toy_ensemble(num_class=3), dtype="f32",
+                        donate=True)
+    X = jax.ShapeDtypeStruct((64, 3), jnp.float32)
+    with warnings.catch_warnings():
+        # CPU emits "donated buffers were not usable" for the aliases it
+        # cannot honor; the audit reads the IR, not the backend support
+        warnings.simplefilter("ignore")
+        txt = pred._raw_fn.lower(X, False).as_text()
+    ok = ("tf.aliasing_output" in txt) or ("jax.buffer_donor" in txt)
+    return AuditResult(
+        name=name, ok=ok,
+        detail="" if ok else "donate=True produced no input-output "
+                             "aliasing in the lowered IR")
+
+
+def audit_serve_ladder() -> AuditResult:
+    """Every batch size in [1, max_batch] lands in at most
+    ceil(log2(max/min)) + 1 buckets — the compile bound BatchServer
+    guarantees and predict::serve_compile pins at runtime."""
+    from ..predict.serve import BatchServer
+    name = "serve_ladder_bound"
+
+    class _Stub:
+        _dtype = jnp.float32
+    problems = []
+    for mn, mx in ((256, 1 << 16), (64, 1024), (128, 128)):
+        srv = BatchServer.__new__(BatchServer)
+        srv.min_batch = mn
+        srv.max_batch = mx
+        buckets = {srv.bucket_rows(n) for n in range(1, mx + 1)}
+        bound = int(np.log2(mx // mn)) + 1
+        if len(buckets) > bound:
+            problems.append("ladder [%d, %d]: %d buckets > bound %d"
+                            % (mn, mx, len(buckets), bound))
+    return AuditResult(name=name, ok=not problems,
+                       detail="; ".join(problems))
+
+
+AUDITS: Tuple[Callable[[], AuditResult], ...] = (
+    audit_hist_window,
+    audit_scan_pair,
+    audit_scan_blocks,
+    audit_persist_split_pass,
+    audit_predict_traversal,
+    audit_predict_donation,
+    audit_serve_ladder,
+)
+
+
+def run_audits(names: Optional[List[str]] = None) -> List[AuditResult]:
+    """Run all (or the named) audits; an audit that raises reports as a
+    failed result rather than killing the gate."""
+    out: List[AuditResult] = []
+    for fn in AUDITS:
+        nm = fn.__name__.replace("audit_", "")
+        if names and nm not in names and fn.__name__ not in names:
+            continue
+        try:
+            out.append(fn())
+        except Exception as e:  # pragma: no cover - defensive
+            out.append(AuditResult(name=nm, ok=False,
+                                   detail="audit raised: %r" % e))
+    failed = sum(1 for r in out if not r.ok)
+    if failed:
+        telemetry.count(C_AUDIT_FAIL, failed, category="analysis")
+    return out

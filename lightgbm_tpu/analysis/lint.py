@@ -1,0 +1,253 @@
+"""Graft-lint engine: file walking, rule running, suppression, autofix.
+
+Suppression has three layers, in order of preference:
+
+1. fix the finding;
+2. inline ``# graftlint: disable=JG00X`` on (or the comment line above)
+   the flagged line — for deliberate, locally-justified exceptions;
+3. the checked-in baseline file — for grandfathered findings that
+   predate the linter. Baseline entries match on (rule, path, stripped
+   source line), NOT line numbers, so they survive unrelated edits; a
+   baselined line that is fixed or deleted simply stops matching and
+   the entry goes stale (``--write-baseline`` re-emits a minimal file).
+
+The gate counts only unsuppressed findings. Telemetry counters under
+the ``analysis`` category record findings/suppressed/files per run so
+long-lived services that embed the gate surface lint drift in the same
+place as their perf counters.
+"""
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..telemetry import events as telemetry
+from .config import GraftlintConfig, load_config
+from .core import Finding, ModuleContext
+from . import rules as rules_pkg
+
+C_FINDINGS = "analysis::findings"
+C_SUPPRESSED = "analysis::suppressed"
+C_FILES = "analysis::files_scanned"
+C_AUTOFIXED = "analysis::autofixed"
+
+
+@dataclass
+class LintReport:
+    findings: List[Finding] = field(default_factory=list)
+    files_scanned: int = 0
+    parse_errors: List[Tuple[str, str]] = field(default_factory=list)
+    autofixed: int = 0
+
+    @property
+    def unsuppressed(self) -> List[Finding]:
+        return [f for f in self.findings if not f.suppressed]
+
+    @property
+    def suppressed(self) -> List[Finding]:
+        return [f for f in self.findings if f.suppressed]
+
+    def to_dict(self) -> dict:
+        return {
+            "files_scanned": self.files_scanned,
+            "autofixed": self.autofixed,
+            "parse_errors": [{"path": p, "error": e}
+                             for p, e in self.parse_errors],
+            "findings": [f.to_dict() for f in self.findings],
+            "counts": {
+                "total": len(self.findings),
+                "unsuppressed": len(self.unsuppressed),
+                "suppressed": len(self.suppressed),
+            },
+        }
+
+
+def iter_py_files(config: GraftlintConfig,
+                  paths: Optional[List[str]] = None) -> List[str]:
+    """Repo-relative .py paths under the include roots (or `paths`)."""
+    roots = paths if paths else config.include
+    out: List[str] = []
+    for root in roots:
+        ap = os.path.join(config.root, root)
+        if os.path.isfile(ap) and ap.endswith(".py"):
+            rel = os.path.relpath(ap, config.root).replace(os.sep, "/")
+            if not config.is_excluded(rel):
+                out.append(rel)
+            continue
+        for dirpath, dirnames, filenames in os.walk(ap):
+            dirnames[:] = [d for d in sorted(dirnames)
+                           if d != "__pycache__"]
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                rel = os.path.relpath(os.path.join(dirpath, fn),
+                                      config.root).replace(os.sep, "/")
+                if not config.is_excluded(rel):
+                    out.append(rel)
+    return out
+
+
+def lint_source(source: str, relpath: str,
+                config: Optional[GraftlintConfig] = None,
+                rule_ids: Optional[List[str]] = None) -> List[Finding]:
+    """Lint one in-memory module (the fixture-test entry point)."""
+    config = config or GraftlintConfig()
+    ctx = ModuleContext(source, relpath, config)
+    if ctx.skip_file:
+        return []
+    findings: List[Finding] = []
+    for rule in rules_pkg.all_rules():
+        if rule.id in config.disable:
+            continue
+        if rule_ids is not None and rule.id not in rule_ids:
+            continue
+        for f in rule.check(ctx):
+            if ctx.is_inline_suppressed(f.rule, f.line):
+                f.suppressed = True
+                f.suppression = "inline"
+            findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+
+def load_baseline(path: str) -> Dict[Tuple[str, str, str], int]:
+    if not os.path.isfile(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    out: Dict[Tuple[str, str, str], int] = {}
+    for ent in data.get("findings", []):
+        key = (ent["rule"], ent["path"], ent["snippet"])
+        out[key] = out.get(key, 0) + int(ent.get("count", 1))
+    return out
+
+
+def apply_baseline(findings: List[Finding],
+                   baseline: Dict[Tuple[str, str, str], int]) -> None:
+    budget = dict(baseline)
+    for f in findings:
+        if f.suppressed:
+            continue
+        left = budget.get(f.key(), 0)
+        if left > 0:
+            budget[f.key()] = left - 1
+            f.suppressed = True
+            f.suppression = "baseline"
+
+
+def write_baseline(findings: List[Finding], path: str) -> int:
+    """Emit a minimal baseline covering every currently-unsuppressed
+    finding (inline-suppressed ones stay inline). Returns entry count."""
+    counts: Dict[Tuple[str, str, str], int] = {}
+    for f in findings:
+        if f.suppression == "inline":
+            continue
+        counts[f.key()] = counts.get(f.key(), 0) + 1
+    ents = [{"rule": r, "path": p, "snippet": s, "count": c}
+            for (r, p, s), c in sorted(counts.items())]
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"version": 1,
+                   "comment": "grandfathered graft-lint findings; matched "
+                              "by (rule, path, source line), not line "
+                              "numbers. Shrink this file, never grow it.",
+                   "findings": ents}, f, indent=1)
+        f.write("\n")
+    return len(ents)
+
+
+# ---------------------------------------------------------------------------
+# autofix
+# ---------------------------------------------------------------------------
+
+def apply_fixes(findings: List[Finding], config: GraftlintConfig) -> int:
+    """Apply textual fixes bottom-up per file; returns fixes applied."""
+    by_path: Dict[str, List[Finding]] = {}
+    for f in findings:
+        if f.fix is not None and not f.suppressed:
+            by_path.setdefault(f.path, []).append(f)
+    applied = 0
+    for relpath, fs in by_path.items():
+        ap = os.path.join(config.root, relpath)
+        with open(ap, "r", encoding="utf-8") as fh:
+            lines = fh.read().splitlines(keepends=True)
+        fs.sort(key=lambda f: f.fix[1][0], reverse=True)
+        seen_spans = set()
+        for f in fs:
+            kind, (lo, hi, new_text) = f.fix
+            assert kind == "replace_span", kind
+            if (lo, hi) in seen_spans:      # one fix per statement
+                continue
+            seen_spans.add((lo, hi))
+            repl = [] if new_text is None else [new_text + "\n"]
+            lines[lo - 1:hi] = repl
+            applied += 1
+        with open(ap, "w", encoding="utf-8") as fh:
+            fh.write("".join(lines))
+    return applied
+
+
+# ---------------------------------------------------------------------------
+# top-level run
+# ---------------------------------------------------------------------------
+
+def run_lint(paths: Optional[List[str]] = None,
+             config: Optional[GraftlintConfig] = None,
+             rule_ids: Optional[List[str]] = None,
+             use_baseline: bool = True,
+             autofix: bool = False) -> LintReport:
+    """Lint the repo (or `paths`); the CLI and the self-scan test both
+    land here. With `autofix`, fixable findings are applied and the
+    affected files re-linted so the report reflects the fixed tree."""
+    config = config or load_config()
+    report = LintReport()
+    relpaths = iter_py_files(config, paths)
+    for rel in relpaths:
+        ap = os.path.join(config.root, rel)
+        try:
+            with open(ap, "r", encoding="utf-8") as f:
+                src = f.read()
+            report.findings.extend(
+                lint_source(src, rel, config, rule_ids))
+        except SyntaxError as e:
+            report.parse_errors.append((rel, str(e)))
+    report.files_scanned = len(relpaths)
+    if use_baseline:
+        apply_baseline(report.findings,
+                       load_baseline(config.baseline_path()))
+    if autofix:
+        report.autofixed = apply_fixes(report.findings, config)
+        if report.autofixed:
+            fixed_paths = sorted({f.path for f in report.findings
+                                  if f.fix is not None})
+            report.findings = [f for f in report.findings
+                               if f.path not in fixed_paths]
+            for rel in fixed_paths:
+                ap = os.path.join(config.root, rel)
+                with open(ap, "r", encoding="utf-8") as f:
+                    src = f.read()
+                report.findings.extend(
+                    lint_source(src, rel, config, rule_ids))
+            if use_baseline:
+                for f in report.findings:
+                    f.suppressed = False if f.suppression == "baseline" \
+                        else f.suppressed
+                    if f.suppression == "baseline":
+                        f.suppression = ""
+                apply_baseline(report.findings,
+                               load_baseline(config.baseline_path()))
+            report.findings.sort(
+                key=lambda f: (f.path, f.line, f.col, f.rule))
+    telemetry.count(C_FILES, report.files_scanned, category="analysis")
+    telemetry.count(C_FINDINGS, len(report.unsuppressed),
+                    category="analysis")
+    telemetry.count(C_SUPPRESSED, len(report.suppressed),
+                    category="analysis")
+    if report.autofixed:
+        telemetry.count(C_AUTOFIXED, report.autofixed, category="analysis")
+    return report

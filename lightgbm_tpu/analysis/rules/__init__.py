@@ -1,0 +1,41 @@
+"""Graft-lint rule registry.
+
+A rule is a class with ``id``, ``name``, ``description`` and a
+``check(ctx) -> list[Finding]`` method over a
+:class:`~lightgbm_tpu.analysis.core.ModuleContext`. Register with the
+:func:`register` decorator; the engine iterates :func:`all_rules` in id
+order. To add a rule: drop a module in this package, define the class,
+decorate it, and import it below — then give it a positive + negative
+fixture in tests/test_analysis.py (the fixture test parametrizes over
+the registry, so a rule without fixtures fails CI by construction).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Type
+
+_REGISTRY: Dict[str, object] = {}
+
+
+def register(cls):
+    inst = cls()
+    if inst.id in _REGISTRY:
+        raise ValueError("duplicate rule id %s" % inst.id)
+    _REGISTRY[inst.id] = inst
+    return cls
+
+
+def all_rules() -> List[object]:
+    return [_REGISTRY[k] for k in sorted(_REGISTRY)]
+
+
+def get_rule(rule_id: str):
+    return _REGISTRY[rule_id]
+
+
+from . import jg001_traced_control  # noqa: E402,F401
+from . import jg002_host_sync  # noqa: E402,F401
+from . import jg003_weak_literals  # noqa: E402,F401
+from . import jg004_jit_in_loop  # noqa: E402,F401
+from . import jg005_nondeterminism  # noqa: E402,F401
+from . import jg006_raw_pallas  # noqa: E402,F401
+from . import jg007_unused_imports  # noqa: E402,F401

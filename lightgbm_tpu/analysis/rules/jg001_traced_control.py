@@ -1,0 +1,62 @@
+"""JG001 — Python control flow on traced values inside jitted code.
+
+``if jnp.any(x):`` inside a jit/kernel scope either raises a
+ConcretizationError at trace time or, worse, silently bakes one branch
+into the compiled program when the value happens to be concrete during
+tracing (a constant-folded input). Both are trace bugs: data-dependent
+branching belongs in ``jax.lax.cond`` / ``jnp.where`` / ``pl.when``.
+
+The static approximation: within a jit scope (jit-decorated function,
+kernel-pattern function, or anything nested in one), flag ``if``/
+``while`` whose test expression calls into ``jax.*`` / ``jax.numpy.*``
+(``jax.debug`` excluded). Tests on plain Python names — static config
+flags, geometry ints — stay silent, which is what keeps the repo's
+jitted growers (full of ``if use_radix:``-style static dispatch) clean.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ..core import Finding, ModuleContext
+from . import register
+
+_TRACED_ROOTS = ("jax.numpy.", "jax.lax.", "jax.nn.", "jax.scipy.")
+_EXEMPT = ("jax.debug.", "jax.tree_util.", "jax.core.")
+
+
+@register
+class TracedControlFlow:
+    id = "JG001"
+    name = "traced-control-flow"
+    description = ("Python if/while on a traced (jax/jnp) value inside a "
+                   "jitted call graph; use lax.cond/jnp.where/pl.when")
+
+    def _test_is_traced(self, ctx: ModuleContext, test: ast.AST) -> bool:
+        for node in ast.walk(test):
+            if not isinstance(node, ast.Call):
+                continue
+            target = ctx.call_target(node)
+            if target is None:
+                continue
+            t = target + "."
+            if t.startswith(_EXEMPT):
+                continue
+            if t.startswith(_TRACED_ROOTS) or t.startswith("jax."):
+                return True
+        return False
+
+    def check(self, ctx: ModuleContext) -> List[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.If, ast.While)):
+                continue
+            if not ctx.in_jit_scope(node):
+                continue
+            if self._test_is_traced(ctx, node.test):
+                kind = "while" if isinstance(node, ast.While) else "if"
+                out.append(ctx.finding(
+                    self.id, node,
+                    "Python `%s` on a traced value inside a jitted scope; "
+                    "use jax.lax.cond / jnp.where / pl.when" % kind))
+        return out

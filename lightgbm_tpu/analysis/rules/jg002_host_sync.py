@@ -1,0 +1,65 @@
+"""JG002 — host syncs inside hot-path loops.
+
+The growers and the serving path are async device pipelines: the host
+queues work and only blocks at deliberate `device_wait` points. A
+``.item()`` / ``float(dev_array)`` / ``np.asarray(dev_array)`` inside a
+per-tree/per-split/per-batch host loop silently serializes the pipeline
+— every iteration round-trips to the device, and the profiler shows the
+cost as idle host time rather than a named span.
+
+Scope: files under the configured ``hot_paths`` (ops/, predict/,
+parallel/ by default), ``for``/``while`` bodies only, *host* code only —
+loops inside jit scopes are traced, where these calls either fail loudly
+or run once at trace time, so they are excluded rather than double-
+reported. Deliberate end-of-pipeline syncs stay allowed via inline
+``# graftlint: disable=JG002`` or the baseline file.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ..core import Finding, ModuleContext
+from . import register
+
+_NP_SYNCS = ("numpy.asarray", "numpy.array", "numpy.ascontiguousarray")
+_BUILTIN_SYNCS = ("float",)
+
+
+@register
+class HostSyncInHotLoop:
+    id = "JG002"
+    name = "host-sync-in-hot-loop"
+    description = ("`.item()` / `float()` / `np.asarray()` inside a "
+                   "hot-path host loop forces a device sync per iteration")
+
+    def check(self, ctx: ModuleContext) -> List[Finding]:
+        if not ctx.config.is_hot_path(ctx.relpath):
+            return []
+        out: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if not ctx.in_host_loop(node) or ctx.in_jit_scope(node):
+                continue
+            msg = self._sync_kind(ctx, node)
+            if msg:
+                out.append(ctx.finding(
+                    self.id, node,
+                    msg + " inside a hot-path loop forces a per-iteration "
+                    "device sync; hoist it or batch the transfer"))
+        return out
+
+    def _sync_kind(self, ctx: ModuleContext, node: ast.Call) -> str:
+        if isinstance(node.func, ast.Attribute) and node.func.attr == "item" \
+                and not node.args and not node.keywords:
+            return "`.item()`"
+        target = ctx.call_target(node)
+        if target in _NP_SYNCS:
+            return "`np.%s()`" % target.split(".", 1)[1]
+        if target in _BUILTIN_SYNCS and target not in ctx.aliases:
+            # float(x)/int(x) on non-literals; literal casts are static
+            if len(node.args) == 1 \
+                    and not isinstance(node.args[0], ast.Constant):
+                return "`%s()`" % target
+        return ""

@@ -1,0 +1,151 @@
+"""JG003 — dtype-defaulted literals: the f64-promotion (tie-flip) class.
+
+The package runs under ``jax_enable_x64`` for reference-parity f64 host
+math, which flips every *dtype-defaulted* construction to f64/i64. The
+pinned persist-f32 vs v1-f64 tie-flip divergence
+(tests/test_known_divergence.py) is exactly this class biting: a value
+silently materialized at f64 joins f32 kernel math, the extra precision
+shifts a noise-gain split's tie, and two otherwise-identical runs grow
+different trees. Three statically checkable shapes:
+
+* ``jnp.zeros(shape)`` / ``ones`` / ``full`` / ``empty`` / ``arange`` /
+  ``eye`` with no dtype → f64/i64 arrays under x64 (``zeros_like``
+  et al. inherit and are fine);
+* ``jnp.asarray(0.5)`` / ``jnp.array([...])`` of bare literals with no
+  dtype → f64 scalars/arrays (asarray of an existing typed array keeps
+  its dtype and is fine);
+* ``jnp.where(cond, 1.0, -1.0)`` with BOTH branches literal → a
+  materialized default-float (f64) select; one literal branch keeps the
+  other operand's dtype through weak typing and stays silent;
+* plus, inside kernel-pattern functions only: bare float literals in
+  arithmetic/comparisons (``hb * cf + 0.5``) — weak-typed today, but
+  one non-weak operand away from promoting the whole expression, and
+  cheap to make explicit with ``jnp.float32(...)``.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from ..core import Finding, ModuleContext
+from . import register
+
+_CONSTRUCTORS = {"zeros", "ones", "full", "empty", "arange", "eye",
+                 "linspace"}
+_FROM_VALUE = {"asarray", "array"}
+_JNP = "jax.numpy."
+# calls whose direct literal args are dtype-explicit already
+_CAST_CALLS = {"jax.numpy.float32", "jax.numpy.float64", "jax.numpy.int32",
+               "numpy.float32", "numpy.float64", "numpy.int32"}
+
+
+def _is_literal(node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant) and isinstance(node.value,
+                                                     (int, float)):
+        return not isinstance(node.value, bool)
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op,
+                                                    (ast.USub, ast.UAdd)):
+        return _is_literal(node.operand)
+    if isinstance(node, (ast.List, ast.Tuple)):
+        return bool(node.elts) and all(_is_literal(e) for e in node.elts)
+    if isinstance(node, ast.BinOp):   # 2.0 ** 30 style constant folds
+        return _is_literal(node.left) and _is_literal(node.right)
+    return False
+
+
+def _is_float_literal(node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant):
+        return isinstance(node.value, float)
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op,
+                                                    (ast.USub, ast.UAdd)):
+        return _is_float_literal(node.operand)
+    return False
+
+
+@register
+class WeakTypeLiterals:
+    id = "JG003"
+    name = "dtype-defaulted-literal"
+    description = ("dtype-defaulted jnp construction or bare-literal "
+                   "kernel arithmetic promotes to f64/i64 under x64 "
+                   "(the persist-f32 tie-flip class)")
+
+    def check(self, ctx: ModuleContext) -> List[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                msg = self._check_call(ctx, node)
+                if msg:
+                    out.append(ctx.finding(self.id, node, msg))
+            elif isinstance(node, (ast.BinOp, ast.Compare)) \
+                    and ctx.in_kernel_scope(node):
+                msg = self._check_kernel_arith(ctx, node)
+                if msg:
+                    out.append(ctx.finding(self.id, node, msg))
+        return out
+
+    # -- dtype-defaulted constructors ---------------------------------
+    def _check_call(self, ctx: ModuleContext, node: ast.Call) -> str:
+        target = ctx.call_target(node)
+        if target is None or not target.startswith(_JNP):
+            return ""
+        fn = target[len(_JNP):]
+        has_dtype = any(kw.arg == "dtype" for kw in node.keywords)
+        if fn in _CONSTRUCTORS:
+            # positional dtype: zeros(shape, dt) / full(shape, v, dt);
+            # arange's 2nd..4th positionals are bounds, never a dtype
+            pos_dtype = (len(node.args) >= 2 and fn not in
+                         ("full", "arange")) or \
+                        (fn == "full" and len(node.args) >= 3)
+            if not (has_dtype or pos_dtype):
+                return ("`jnp.%s` without dtype defaults to f64/i64 "
+                        "under x64; pass an explicit dtype" % fn)
+        elif fn in _FROM_VALUE:
+            pos_dtype = len(node.args) >= 2
+            if not (has_dtype or pos_dtype) and node.args \
+                    and _is_literal(node.args[0]):
+                return ("`jnp.%s` of a bare literal defaults to f64/i64 "
+                        "under x64; pass an explicit dtype" % fn)
+        elif fn == "where" and len(node.args) == 3 \
+                and _is_float_literal(node.args[1]) \
+                and _is_float_literal(node.args[2]) \
+                and not self._immediately_cast(ctx, node):
+            return ("`jnp.where` with two literal branches materializes "
+                    "a default-float (f64 under x64) array; cast to the "
+                    "consumer's dtype or use `.astype`")
+        return ""
+
+    def _immediately_cast(self, ctx: ModuleContext, node: ast.Call) -> bool:
+        """True when the call's result is directly `.astype(...)`-ed or
+        wrapped in an explicit cast — the fix this rule asks for."""
+        parent = ctx.parent.get(node)
+        if isinstance(parent, ast.Attribute) and parent.attr == "astype":
+            return True
+        if isinstance(parent, ast.Call) \
+                and ctx.call_target(parent) in _CAST_CALLS:
+            return True
+        return False
+
+    # -- bare literals in kernel arithmetic ---------------------------
+    def _literal_operand(self, ctx, node) -> Optional[ast.AST]:
+        if isinstance(node, ast.BinOp):
+            operands = [node.left, node.right]
+        else:
+            operands = [node.left] + list(node.comparators)
+        lits = [op for op in operands if _is_float_literal(op)]
+        if not lits or len(lits) == len(operands):
+            return None            # pure-literal expressions are static
+        return lits[0]
+
+    def _check_kernel_arith(self, ctx: ModuleContext, node) -> str:
+        # skip when the literal is already inside an explicit cast call
+        parent = ctx.parent.get(node)
+        if isinstance(parent, ast.Call) \
+                and ctx.call_target(parent) in _CAST_CALLS:
+            return ""
+        lit = self._literal_operand(ctx, node)
+        if lit is None:
+            return ""
+        return ("bare float literal in kernel arithmetic; wrap it as "
+                "`jnp.float32(...)` so the expression cannot promote "
+                "under x64")

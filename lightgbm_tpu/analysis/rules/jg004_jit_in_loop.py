@@ -1,0 +1,58 @@
+"""JG004 — jit/pallas program construction inside per-tree/per-split loops.
+
+``jax.jit(f)`` (and a raw ``pl.pallas_call`` construction) builds a NEW
+callable with its own compile cache entry; doing it inside a host loop
+means every iteration traces and compiles from scratch — the classic
+recompile storm that turns a 50ms training iteration into seconds. The
+serving path's whole bucket-ladder design exists to bound compile
+counts; this rule keeps the construction sites out of loops so the
+ladder bound is the only compile multiplier.
+
+Builders that close over loop state legitimately (``make_split_pass``
+called once per payload geometry) are fine because the *call to jit*
+happens once inside the builder, not in the loop.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ..core import Finding, ModuleContext
+from . import register
+
+_COMPILE_CALLS = ("jax.jit", "jax.pmap", "jit")
+
+
+@register
+class JitInLoop:
+    id = "JG004"
+    name = "jit-in-loop"
+    description = ("jax.jit / pallas_call construction inside a host "
+                   "loop recompiles every iteration")
+
+    def check(self, ctx: ModuleContext) -> List[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if not ctx.in_host_loop(node):
+                continue
+            target = ctx.call_target(node)
+            if target in _COMPILE_CALLS:
+                out.append(ctx.finding(
+                    self.id, node,
+                    "`%s(...)` inside a loop builds a fresh compiled "
+                    "callable per iteration; hoist the jit out of the "
+                    "loop" % target))
+            elif target is not None and target.endswith(".pallas_call"):
+                out.append(ctx.finding(
+                    self.id, node,
+                    "`pallas_call` construction inside a loop re-traces "
+                    "the kernel per iteration; build it once and reuse"))
+            elif target in ("functools.partial", "partial") and node.args \
+                    and ctx.dotted(node.args[0]) in _COMPILE_CALLS:
+                out.append(ctx.finding(
+                    self.id, node,
+                    "partial(jax.jit, ...) inside a loop builds a fresh "
+                    "compiled callable per iteration"))
+        return out

@@ -1,0 +1,73 @@
+"""JG005 — unseeded RNG / wall-clock nondeterminism in library code.
+
+Reproducibility is part of the parity contract: the golden-parity and
+sharded-equivalence tests require that the same seed grows the same
+trees on 1 or N devices. Global-state RNG (``np.random.rand`` et al.,
+stdlib ``random.*``) breaks that silently — draw order then depends on
+import order and whatever else touched the global stream. Library code
+must thread an explicitly seeded ``np.random.default_rng(seed)`` /
+``RandomState(seed)`` (the repo convention) or a jax PRNG key.
+
+Also flagged: seeding any RNG from the wall clock
+(``default_rng(time.time())``), which launders nondeterminism through
+an otherwise-seeded constructor.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ..core import Finding, ModuleContext
+from . import register
+
+_SEEDED_CTORS = {"default_rng", "RandomState", "SeedSequence", "Generator",
+                 "PCG64", "Philox"}
+_STDLIB_RANDOM_FNS = {
+    "random", "randint", "uniform", "choice", "choices", "shuffle",
+    "sample", "randrange", "gauss", "normalvariate", "betavariate",
+    "expovariate", "getrandbits", "seed"}
+_CLOCK_CALLS = ("time.time", "time.time_ns", "datetime.datetime.now")
+
+
+@register
+class Nondeterminism:
+    id = "JG005"
+    name = "unseeded-nondeterminism"
+    description = ("global np.random / stdlib random draws or wall-clock "
+                   "seeding make runs irreproducible")
+
+    def check(self, ctx: ModuleContext) -> List[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            target = ctx.call_target(node)
+            if target is None:
+                continue
+            if target.startswith("numpy.random."):
+                fn = target.split(".")[-1]
+                if fn not in _SEEDED_CTORS:
+                    out.append(ctx.finding(
+                        self.id, node,
+                        "`np.random.%s` draws from the process-global "
+                        "RNG; use an explicitly seeded "
+                        "`np.random.default_rng(seed)`" % fn))
+                    continue
+            elif target.startswith("random.") \
+                    and target.split(".")[-1] in _STDLIB_RANDOM_FNS \
+                    and ctx.aliases.get("random", "random") == "random":
+                out.append(ctx.finding(
+                    self.id, node,
+                    "stdlib `%s` uses the global RNG; use a seeded "
+                    "`np.random.default_rng`" % target))
+                continue
+            if target.split(".")[-1] in _SEEDED_CTORS or \
+                    target == "numpy.random.seed":
+                if any(isinstance(sub, ast.Call)
+                       and ctx.call_target(sub) in _CLOCK_CALLS
+                       for a in node.args for sub in ast.walk(a)):
+                    out.append(ctx.finding(
+                        self.id, node,
+                        "RNG seeded from the wall clock is "
+                        "nondeterministic; take the seed from config"))
+        return out

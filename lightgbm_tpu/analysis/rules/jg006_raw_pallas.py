@@ -1,0 +1,52 @@
+"""JG006 — pallas imported around the compat shim.
+
+``ops/pallas_compat.py`` is the single import point for the Pallas TPU
+API: it papers over the ``TPUCompilerParams``/``CompilerParams`` rename,
+provides the ``enable_x64`` shim, and — critically — degrades to
+``HAS_PALLAS = False`` so every caller takes its guarded XLA fallback on
+builds where pallas cannot construct kernels. A module that imports
+``jax.experimental.pallas`` directly bypasses all three: it crashes on
+0.4.x/exotic builds instead of falling back, and silently skips the
+version shims. Only the modules listed in ``pallas_compat_allow``
+(the shim itself) may touch the raw import.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ..core import Finding, ModuleContext
+from . import register
+
+_RAW = "jax.experimental.pallas"
+
+
+@register
+class RawPallasImport:
+    id = "JG006"
+    name = "raw-pallas-import"
+    description = ("direct jax.experimental.pallas import bypasses "
+                   "ops/pallas_compat.py (version shims + XLA fallback)")
+
+    def check(self, ctx: ModuleContext) -> List[Finding]:
+        allowed = {p.replace("\\", "/")
+                   for p in ctx.config.pallas_compat_allow}
+        if ctx.relpath in allowed:
+            return []
+        out: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            hit = False
+            if isinstance(node, ast.Import):
+                hit = any(a.name == _RAW or a.name.startswith(_RAW + ".")
+                          for a in node.names)
+            elif isinstance(node, ast.ImportFrom) and node.level == 0:
+                mod = node.module or ""
+                hit = mod == _RAW or mod.startswith(_RAW + ".") or (
+                    mod == "jax.experimental"
+                    and any(a.name == "pallas" for a in node.names))
+            if hit:
+                out.append(ctx.finding(
+                    self.id, node,
+                    "import pallas via ops/pallas_compat.py (pl, pltpu, "
+                    "TPUCompilerParams, HAS_PALLAS), not directly"))
+        return out

@@ -1,0 +1,118 @@
+"""JG007 — unused imports (the autofix-driven dead-code sweep).
+
+Unused imports are not just noise: in this codebase an import can pull
+in jax machinery with real side effects (device init, x64 config), and
+stale imports are where dead subsystems hide after a refactor. The rule
+is deliberately conservative so its autofix is safe to run blind:
+
+* usage = the bound name appearing as a word ANYWHERE else in the file
+  (code, annotations, docstrings, ``__all__`` strings) — false "used"
+  beats false "unused";
+* skipped entirely: ``__init__.py`` (re-export surface), ``__future__``
+  imports, star imports, ``# noqa`` lines, and imports inside
+  ``try:`` blocks (version/feature probing idiom, e.g. pallas_compat).
+
+The fix rewrites the import statement without the dead names, or
+removes it outright; the engine applies fixes bottom-up so line numbers
+stay valid.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Optional
+
+from ..core import Finding, ModuleContext
+from . import register
+
+
+def _binding(alias: ast.alias, is_from: bool) -> str:
+    if alias.asname:
+        return alias.asname
+    return alias.name if is_from else alias.name.split(".")[0]
+
+
+def _rebuild(node, kept: List[ast.alias], indent: str) -> str:
+    def fmt(a: ast.alias) -> str:
+        return a.name + (" as " + a.asname if a.asname else "")
+    names = [fmt(a) for a in kept]
+    if not isinstance(node, ast.ImportFrom):
+        # plain `import a, b` has no parenthesized form; a long line is
+        # valid Python, which beats a SyntaxError
+        return indent + "import " + ", ".join(names)
+    mod = "." * node.level + (node.module or "")
+    stmt = "from %s import %s" % (mod, ", ".join(names))
+    if len(indent + stmt) <= 79:
+        return indent + stmt
+    # wrap: from m import (a, b,\n<align>c)
+    head = indent + "from %s import (" % mod
+    cont = " " * len(head)
+    lines, cur = [], head
+    for i, nm in enumerate(names):
+        piece = nm + ("," if i < len(names) - 1 else ")")
+        if cur != head and cur != cont and len(cur) + len(piece) + 1 > 79:
+            lines.append(cur)
+            cur = cont
+        cur += piece if cur in (head, cont) else " " + piece
+    lines.append(cur)
+    return "\n".join(lines)
+
+
+@register
+class UnusedImports:
+    id = "JG007"
+    name = "unused-import"
+    description = "import bound to a name the module never uses"
+
+    def check(self, ctx: ModuleContext) -> List[Finding]:
+        if ctx.relpath.endswith("__init__.py"):
+            return []
+        out: List[Finding] = []
+        src = ctx.source
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.Import, ast.ImportFrom)):
+                continue
+            if isinstance(node, ast.ImportFrom) \
+                    and node.module == "__future__":
+                continue
+            if any(a.name == "*" for a in node.names):
+                continue
+            if self._in_try(ctx, node) or self._has_noqa(ctx, node):
+                continue
+            is_from = isinstance(node, ast.ImportFrom)
+            seg = ast.get_source_segment(src, node) or ""
+            unused, kept = [], []
+            for a in node.names:
+                name = _binding(a, is_from)
+                total = len(re.findall(r"\b%s\b" % re.escape(name), src))
+                inside = len(re.findall(r"\b%s\b" % re.escape(name), seg))
+                (unused if total <= inside else kept).append(a)
+            if not unused:
+                continue
+            indent = ctx.lines[node.lineno - 1][
+                :len(ctx.lines[node.lineno - 1])
+                - len(ctx.lines[node.lineno - 1].lstrip())]
+            new_text: Optional[str] = (
+                _rebuild(node, kept, indent) if kept else None)
+            fix = ("replace_span", (node.lineno, node.end_lineno, new_text))
+            for i, a in enumerate(unused):
+                out.append(ctx.finding(
+                    self.id, node,
+                    "imported name `%s` is never used"
+                    % _binding(a, is_from),
+                    fix=fix if i == 0 else None))
+        return out
+
+    def _in_try(self, ctx: ModuleContext, node: ast.AST) -> bool:
+        cur = ctx.parent.get(node)
+        while cur is not None:
+            if isinstance(cur, ast.Try):
+                return True
+            cur = ctx.parent.get(cur)
+        return False
+
+    def _has_noqa(self, ctx: ModuleContext, node: ast.AST) -> bool:
+        for ln in range(node.lineno, (node.end_lineno or node.lineno) + 1):
+            if 0 < ln <= len(ctx.lines) and "# noqa" in ctx.lines[ln - 1]:
+                return True
+        return False

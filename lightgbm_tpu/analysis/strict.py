@@ -1,0 +1,33 @@
+"""Strict-numerics harness for kernel-parity tests.
+
+``strict_numerics()`` scopes two jax config flips:
+
+* ``jax.numpy_dtype_promotion('strict')`` — any implicit promotion
+  between two *non-weak* dtypes raises instead of silently widening.
+  Weak Python scalars stay allowed (``f32_array + 0.5`` is fine); what
+  dies is exactly the JG003 hazard class at runtime: an f64 value that
+  leaked into f32 kernel math, or an i64 iota meeting an i32 index.
+* ``jax.debug_nans`` — any NaN materializing in a jitted result raises
+  at the producing op instead of surfacing 50 ops later as a wrong
+  split choice.
+
+The kernel-parity tests (test_pallas_histogram.py, test_block_scan.py)
+run their kernel invocations under this context, so a dtype regression
+in the hot kernels fails the parity suite even when the numeric outputs
+happen to still match.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+
+@contextlib.contextmanager
+def strict_numerics(debug_nans: bool = True):
+    """Context manager: strict dtype promotion + NaN trapping."""
+    with contextlib.ExitStack() as stack:
+        stack.enter_context(jax.numpy_dtype_promotion("strict"))
+        if debug_nans:
+            stack.enter_context(jax.debug_nans(True))
+        yield

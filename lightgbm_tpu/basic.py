@@ -11,7 +11,6 @@ categorical handling (basic.py:331-418) all follow the reference semantics.
 """
 from __future__ import annotations
 
-import json
 from typing import Any, Dict, List, Optional
 
 import numpy as np

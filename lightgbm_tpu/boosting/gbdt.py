@@ -22,7 +22,7 @@ import jax.numpy as jnp
 
 from ..config import Config
 from ..models.tree import Tree
-from ..objectives import create_objective, parse_objective_string
+from ..objectives import parse_objective_string
 from ..telemetry import events as telemetry
 from ..treelearner import create_tree_learner
 from ..utils.log import Log

@@ -12,7 +12,7 @@ int8/int16 binned matrix that lives in TPU HBM.
 from __future__ import annotations
 
 import math
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Sequence
 
 import numpy as np
 

@@ -27,7 +27,7 @@ import numpy as np
 from ..config import Config
 from ..utils import timer
 from ..utils.log import Log
-from .bin_mapper import BinMapper, BinType, MissingType, kZeroThreshold
+from .bin_mapper import BinMapper, BinType, kZeroThreshold
 
 MAX_GROUP_BINS = 256  # keep bundled groups addressable by uint8 (GPU ref: 256)
 
@@ -337,7 +337,7 @@ class BinnedDataset:
         streams sparse rows through Dataset::PushOneRow the same way,
         src/io/dataset_loader.cpp:714-1004). Host memory is bounded by one
         row chunk (~256 MB dense) + the binned output [n, groups]."""
-        import scipy.sparse as sp
+        import scipy.sparse as sp  # noqa: F401 — import guard: a clear ImportError beats a tocsr AttributeError
         X = X.tocsr()
         X.sort_indices()
         n, nf = X.shape

@@ -8,7 +8,7 @@ lambdarank objective and the ndcg/map metrics.
 """
 from __future__ import annotations
 
-from typing import List, Optional, Sequence
+from typing import List, Sequence
 
 import numpy as np
 

@@ -64,7 +64,9 @@ class BinaryLogloss(ObjectiveFunction):
             if not need_train:
                 z = jnp.zeros_like(score)
                 return z, z
-            y = jnp.where(pos_mask, 1.0, -1.0)
+            # dtype-following ±1: a dtype-defaulted select is f64 under
+            # x64 and would drag persist-path f32 grads through f64
+            y = jnp.where(pos_mask, 1.0, -1.0).astype(score.dtype)
             lw = jnp.where(pos_mask, w_pos, w_neg)
             response = -y * sig / (1.0 + jnp.exp(y * sig * score))
             abs_resp = jnp.abs(response)

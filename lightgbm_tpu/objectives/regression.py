@@ -14,12 +14,13 @@ import numpy as np
 import jax.numpy as jnp
 
 from ..utils.log import Log
-from .base import (K_EPSILON, ObjectiveFunction, percentile, register,
-                   weighted_percentile)
+from .base import ObjectiveFunction, percentile, register, weighted_percentile
 
 
 def _sign(x):
-    return jnp.where(x > 0, 1.0, jnp.where(x < 0, -1.0, 0.0))
+    # dtype-following ±1/0 (NaN -> 0, unlike jnp.sign): a dtype-defaulted
+    # select is f64 under x64 and would silently widen f32 gradient math
+    return jnp.where(x > 0, 1.0, jnp.where(x < 0, -1.0, jnp.zeros_like(x)))
 
 
 @register

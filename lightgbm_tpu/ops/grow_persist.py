@@ -40,7 +40,6 @@ plain data-parallel or PV-tree voting (winner-window-only reduction).
 """
 from __future__ import annotations
 
-import functools
 from typing import NamedTuple
 
 import jax

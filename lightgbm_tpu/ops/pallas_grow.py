@@ -53,13 +53,12 @@ emulation and the v1 growers (tests/test_persist_sharded.py).
 """
 from __future__ import annotations
 
-import functools
 import sys
 
 import jax
 import jax.numpy as jnp
 
-from .pallas_compat import HAS_PALLAS, enable_x64, pl, pltpu
+from .pallas_compat import HAS_PALLAS, enable_x64, pl, pltpu  # noqa: F401 — HAS_PALLAS re-exported (serial.py persist gate)
 from .pallas_compat import TPUCompilerParams as _TPUCompilerParams
 
 I32 = jnp.int32

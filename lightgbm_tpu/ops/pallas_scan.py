@@ -35,7 +35,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
-from .pallas_compat import HAS_PALLAS, pl, pltpu
+from .pallas_compat import HAS_PALLAS, pl, pltpu  # noqa: F401 — HAS_PALLAS re-exported (kernel tests gate on it)
 from .pallas_compat import TPUCompilerParams as _TPUCompilerParams
 
 NEG_INF = float("-inf")
@@ -77,7 +77,7 @@ def _scan_kernel(scal_ref, gb_ref, hb_ref, keepr_ref, keepf_ref,
     valid_f0 = validf_ref[0]
     pen = aux_ref[0, :]
 
-    cnt_b = jnp.floor(hb * cf + 0.5)
+    cnt_b = jnp.floor(hb * cf + jnp.float32(0.5))
 
     # ---- six cumulative sums as one triangular MXU contraction ----------
     # tri[w, w'] = 1 when w' <= w  (inclusive prefix along lanes)
@@ -109,7 +109,7 @@ def _scan_kernel(scal_ref, gb_ref, hb_ref, keepr_ref, keepf_ref,
     l_grad = sg - r_grad
     l_hess = sh - r_hess
 
-    ok_r = (valid_r0 > 0.0) \
+    ok_r = (valid_r0 > jnp.float32(0.0)) \
         & (r_cnt >= min_data) & (r_hess >= min_hess) \
         & (l_cnt >= min_data) & (l_hess >= min_hess)
     gains_r = (l_grad * l_grad) / (l_hess + l2) \
@@ -130,7 +130,7 @@ def _scan_kernel(scal_ref, gb_ref, hb_ref, keepr_ref, keepf_ref,
     f_r_grad = sg - f_l_grad
     f_r_hess = sh - f_l_hess
 
-    ok_f = (valid_f0 > 0.0) \
+    ok_f = (valid_f0 > jnp.float32(0.0)) \
         & (f_l_cnt >= min_data) & (f_l_hess >= min_hess) \
         & (f_r_cnt >= min_data) & (f_r_hess >= min_hess)
     gains_f = (f_l_grad * f_l_grad) / (f_l_hess + l2) \
@@ -144,7 +144,7 @@ def _scan_kernel(scal_ref, gb_ref, hb_ref, keepr_ref, keepf_ref,
     best_t_f = jnp.min(jnp.where(at_max_f, wrow, big), axis=1)
 
     # ---- combine directions (forward wins only on strictly more gain) ---
-    has_r = best_t_r >= 0.0
+    has_r = best_t_r >= jnp.float32(0.0)
     has_f = best_t_f < big
     best_gain_r = jnp.where(has_r, best_gain_r, NEG_INF)
     best_gain_f = jnp.where(has_f, best_gain_f, NEG_INF)
@@ -259,7 +259,7 @@ def _fill_fwd(v, has, W: int):
         sh = 1 << b
         v2 = pltpu.roll(v, sh, 1)
         h2 = pltpu.roll(has, sh, 1)
-        take = (lane >= sh) & (has < 0.5) & (h2 > 0.5)
+        take = (lane >= sh) & (has < jnp.float32(0.5)) & (h2 > jnp.float32(0.5))
         v = jnp.where(take, v2, v)
         has = jnp.where(take, 1.0, has)
     return v
@@ -275,7 +275,7 @@ def _fill_bwd(v, has, W: int):
         sh = 1 << b
         v2 = pltpu.roll(v, W - sh, 1)
         h2 = pltpu.roll(has, W - sh, 1)
-        take = (lane < W - sh) & (has < 0.5) & (h2 > 0.5)
+        take = (lane < W - sh) & (has < jnp.float32(0.5)) & (h2 > jnp.float32(0.5))
         v = jnp.where(take, v2, v)
         has = jnp.where(take, 1.0, has)
     return v
@@ -351,7 +351,7 @@ def _scan_blocks_kernel(do_fix, scal_ref, gb_ref, hb_ref, mk_ref, out_ref):
         gb = gb + res[:G]
         hb = hb + res[G:]
 
-    cnt_b = jnp.floor(hb * cf + 0.5)
+    cnt_b = jnp.floor(hb * cf + jnp.float32(0.5))
     stack = jnp.concatenate([gb * keep_r, hb * keep_r, cnt_b * keep_r,
                              gb * keep_f, hb * keep_f, cnt_b * keep_f],
                             axis=0)                          # [6G, W]
@@ -369,7 +369,7 @@ def _scan_blocks_kernel(do_fix, scal_ref, gb_ref, hb_ref, mk_ref, out_ref):
     l_grad = sg - r_grad
     l_hess = sh - r_hess
 
-    ok_r = (valid_r > 0.0) \
+    ok_r = (valid_r > jnp.float32(0.0)) \
         & (r_cnt >= min_data) & (r_hess >= min_hess) \
         & (l_cnt >= min_data) & (l_hess >= min_hess)
     gains_r = (l_grad * l_grad) / (l_hess + l2) \
@@ -398,7 +398,7 @@ def _scan_blocks_kernel(do_fix, scal_ref, gb_ref, hb_ref, mk_ref, out_ref):
     f_r_grad = sg - f_l_grad
     f_r_hess = sh - f_l_hess
 
-    ok_f = (valid_f > 0.0) \
+    ok_f = (valid_f > jnp.float32(0.0)) \
         & (f_l_cnt >= min_data) & (f_l_hess >= min_hess) \
         & (f_r_cnt >= min_data) & (f_r_hess >= min_hess)
     gains_f = (f_l_grad * f_l_grad) / (f_l_hess + l2) \
@@ -412,7 +412,7 @@ def _scan_blocks_kernel(do_fix, scal_ref, gb_ref, hb_ref, mk_ref, out_ref):
     best_t_f = jnp.min(jnp.where(at_max_f, wrow, big), axis=1)
 
     # ---- combine (forward wins only on strictly more penalized gain) ----
-    has_r = best_t_r >= 0.0
+    has_r = best_t_r >= jnp.float32(0.0)
     has_f = best_t_f < big
     bg_r = jnp.where(has_r, best_gain_r, NEG_INF)
     bg_f = jnp.where(has_f, best_gain_f, NEG_INF)
@@ -587,8 +587,8 @@ class ScanLayout:
 
         excl_r = (na_as_missing & is_na_bin) | (skip_default & is_default_bin)
         excl_f = skip_default & is_default_bin
-        keep_r = jnp.where(in_feat & ~excl_r, 1.0, 0.0)
-        keep_f = jnp.where(in_feat & ~excl_f, 1.0, 0.0)
+        keep_r = (in_feat & ~excl_r)
+        keep_f = (in_feat & ~excl_f)
 
         valid_r = in_feat & (w <= nb - 2 - na_as_missing.astype(I32))
         valid_r &= ~(skip_default & (w == d_local - 1))

@@ -473,7 +473,7 @@ def _cat_onehot_scan(grad_b, hess_b, cnt_b, used_mask, sum_grad, sum_hess_adj,
     t = jnp.argmax(gains)
     best_gain = gains[t]
     W = grad_b.shape[0]
-    cat_mask = jnp.arange(W) == t
+    cat_mask = jnp.arange(W, dtype=I32) == t
     return (best_gain, cat_mask, grad_b[t], hess_adj[t], cnt_b[t])
 
 
@@ -598,7 +598,7 @@ def find_best_split_categorical(hist, sum_grad, sum_hess, num_data,
     def per_feature(f_idx, g_idx, valid, used_bin, nb):
         grad_b = hist[g_idx, 0].astype(ft)
         hess_b = hist[g_idx, 1].astype(ft)
-        used_mask = valid & (jnp.arange(W) < used_bin)
+        used_mask = valid & (jnp.arange(W, dtype=I32) < used_bin)
         grad_b = jnp.where(used_mask, grad_b, 0.0)
         hess_b = jnp.where(used_mask, hess_b, 0.0)
         cnt_b = _round_int(hess_b * cnt_factor)

@@ -12,7 +12,6 @@ from __future__ import annotations
 import numpy as np
 
 from .basic import Booster
-from .utils.log import LightGBMError
 
 
 def _require_mpl(what="plot"):

@@ -89,7 +89,7 @@ def _traverse_bucket(bucket_dev, X, depth: int):
     T, N = sf.shape
     R = X.shape[0]
     XT = X.T                                  # [F, rows]
-    rows = jnp.arange(R)[None, :]
+    rows = jnp.arange(R, dtype=jnp.int32)[None, :]
     node0 = jnp.zeros((T, R), dtype=jnp.int32)
 
     def step(_, node):

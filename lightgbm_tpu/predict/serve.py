@@ -17,7 +17,6 @@ fans out across chips; input buffers are donated on accelerator backends
 """
 from __future__ import annotations
 
-from typing import Optional
 
 import numpy as np
 

@@ -7,13 +7,13 @@ y_pred) into the engine's fobj(preds, dataset) convention.
 """
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, Optional
 
 import numpy as np
 
 from .basic import Booster, Dataset, _data_to_2d
 from .engine import train
-from .utils.log import LightGBMError, Log
+from .utils.log import LightGBMError
 
 try:
     from sklearn.base import BaseEstimator as _SKBase

@@ -33,7 +33,7 @@ import os
 import threading
 import time
 from collections import defaultdict
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 OFF, TIMERS, TRACE = 0, 1, 2
 _MODE_NAMES = {"off": OFF, "timers": TIMERS, "trace": TRACE,

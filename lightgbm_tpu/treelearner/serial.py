@@ -13,7 +13,7 @@ the same grower under jax.sharding — see lightgbm_tpu/parallel/.
 from __future__ import annotations
 
 import math
-from typing import Optional, Tuple
+from typing import Tuple
 
 import numpy as np
 
@@ -21,9 +21,9 @@ import jax.numpy as jnp
 
 from ..config import Config
 from ..models.tree import Tree
-from ..ops.grow import (DataLayout, FixInfo, ForcedInfo, GrowConfig,
-                        GrowExtras, default_extras, empty_cat_layout,
-                        empty_forced, grow_tree, grow_tree_partitioned)
+from ..ops.grow import (ForcedInfo, GrowConfig, GrowExtras, default_extras,
+                        empty_cat_layout, empty_forced, grow_tree,
+                        grow_tree_partitioned)
 from ..ops.split import CatLayout, FeatureMeta, SplitParams
 from ..telemetry import events as telemetry
 from ..utils.log import Log

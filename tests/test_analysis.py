@@ -1,0 +1,498 @@
+"""Graft-lint: rule fixtures, engine mechanics, jaxpr audits, self-scan.
+
+Layout mirrors the acceptance criteria:
+
+* every registered JG rule is exercised against seeded-violation
+  fixture snippets (positive) and clean twins (negative) — the
+  parametrization is driven by the registry, so adding a rule without
+  fixtures fails here by construction;
+* engine mechanics: inline suppression, skip-file, baseline
+  round-trip, unused-import autofix;
+* the jaxpr audits run green (the two pinned invariants — no f64
+  convert in persist-f32 kernels, serve ladder bound — are tier-1);
+* the repo self-scan: ZERO unsuppressed findings, same gate as
+  `python -m lightgbm_tpu.analysis`.
+"""
+import json
+import os
+import textwrap
+
+import pytest
+
+from lightgbm_tpu.analysis import (GraftlintConfig, load_config, run_audits,
+                                   run_lint)
+from lightgbm_tpu.analysis.config import _parse_table
+from lightgbm_tpu.analysis.lint import (apply_baseline, iter_py_files,
+                                        lint_source, load_baseline,
+                                        write_baseline)
+from lightgbm_tpu.analysis.rules import all_rules
+
+OPS = "lightgbm_tpu/ops/fake.py"          # hot path, kernel-bearing
+COLD = "lightgbm_tpu/data/fake.py"        # not a hot path
+
+
+def _ids(findings, rule=None):
+    return [f.rule for f in findings
+            if not f.suppressed and (rule is None or f.rule == rule)]
+
+
+def _lint(src, relpath=OPS, **cfg):
+    config = GraftlintConfig(**cfg) if cfg else GraftlintConfig()
+    return lint_source(textwrap.dedent(src), relpath, config)
+
+
+# ---------------------------------------------------------------------------
+# per-rule fixtures: positive (fires) + negative (clean twin)
+# ---------------------------------------------------------------------------
+
+FIXTURES = {
+    "JG001": {
+        "positive": """
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def f(x):
+                if jnp.any(x > 0):
+                    return x + 1
+                return x
+            """,
+        "negative": """
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def f(x, flag: bool):
+                if flag:                       # static python value: fine
+                    return x + 1
+                return jax.lax.cond(jnp.any(x > 0),
+                                    lambda v: v + 1, lambda v: v, x)
+
+            def host(x):
+                if jnp.any(x > 0):             # not a jitted scope
+                    return 1
+                return 0
+            """,
+    },
+    "JG002": {
+        "positive": """
+            import numpy as np
+
+            def serve(batches, dev):
+                out = []
+                for b in batches:
+                    out.append(np.asarray(dev(b)))     # per-batch sync
+                    total = dev(b).sum().item()        # and another
+                    scale = float(dev(b)[0])           # and another
+                return out, total, scale
+            """,
+        "negative": """
+            import numpy as np
+
+            def serve(batches, dev):
+                outs = [dev(b) for b in batches]
+                return np.asarray(outs)                # one batched sync
+            """,
+    },
+    "JG003": {
+        "positive": """
+            import jax.numpy as jnp
+
+            def setup(m):
+                pad = jnp.zeros((4, 4))                # f64 under x64
+                half = jnp.asarray(0.5)                # f64 under x64
+                y = jnp.where(m, 1.0, -1.0)            # f64 select
+                return pad, half, y
+
+            def _scan_kernel(hb, cf):
+                return jnp.floor(hb * cf + 0.5)        # kernel literal
+            """,
+        "negative": """
+            import jax.numpy as jnp
+
+            def setup(m, x):
+                pad = jnp.zeros((4, 4), jnp.float32)
+                half = jnp.asarray(0.5, jnp.float32)
+                y = jnp.where(m, 1.0, -1.0).astype(x.dtype)
+                keep = jnp.where(m, 1.0, x)            # one literal: weak
+                return pad, half, y, keep
+
+            def _scan_kernel(hb, cf):
+                return jnp.floor(hb * cf + jnp.float32(0.5))
+
+            def host_math(a):
+                return a * 0.5                         # not a kernel
+            """,
+    },
+    "JG004": {
+        "positive": """
+            import jax
+
+            def train(trees, step):
+                outs = []
+                for t in trees:
+                    f = jax.jit(step)                  # recompile storm
+                    outs.append(f(t))
+                return outs
+            """,
+        "negative": """
+            import jax
+
+            def train(trees, step):
+                f = jax.jit(step)                      # hoisted
+                outs = []
+                for t in trees:
+                    outs.append(f(t))
+
+                def make(c):                           # builder in loop is
+                    return jax.jit(lambda x: x + c)    # a def, not a call
+                return outs, [make(c) for c in (1, 2)]
+            """,
+    },
+    "JG005": {
+        "positive": """
+            import time
+            import numpy as np
+
+            def sample(n):
+                idx = np.random.permutation(n)         # global RNG
+                rng = np.random.default_rng(time.time())   # clock seed
+                return idx, rng
+            """,
+        "negative": """
+            import numpy as np
+
+            def sample(n, seed):
+                rng = np.random.default_rng(seed)
+                return rng.permutation(n), np.random.RandomState(seed)
+            """,
+    },
+    "JG006": {
+        "positive": """
+            from jax.experimental import pallas as pl
+            from jax.experimental.pallas import tpu as pltpu
+
+            def kernel_call(f, shape):
+                return pl.pallas_call(f, out_shape=shape)
+            """,
+        "negative": """
+            from .pallas_compat import HAS_PALLAS, pl, pltpu
+
+            def kernel_call(f, shape):
+                if not HAS_PALLAS:
+                    return None
+                return pl.pallas_call(f, out_shape=shape)
+            """,
+    },
+    "JG007": {
+        "positive": """
+            import json
+            from typing import Dict, List
+
+            def f(d: Dict) -> Dict:
+                return d
+            """,
+        "negative": """
+            import json
+            from typing import Dict
+
+            try:
+                import exotic_backend              # probing idiom: skipped
+            except ImportError:
+                exotic = None
+
+            import unused_but_marked  # noqa: F401
+
+            def f(d: Dict) -> str:
+                return json.dumps(d)
+            """,
+    },
+}
+
+
+def test_every_rule_has_fixtures():
+    ids = {r.id for r in all_rules()}
+    assert ids == set(FIXTURES), "every JG rule needs fixture snippets"
+    assert ids == {"JG001", "JG002", "JG003", "JG004", "JG005", "JG006",
+                   "JG007"}
+
+
+@pytest.mark.parametrize("rule_id", sorted(FIXTURES))
+def test_rule_fires_on_seeded_violation(rule_id):
+    hits = _ids(_lint(FIXTURES[rule_id]["positive"]), rule_id)
+    assert hits, "%s stayed silent on its seeded violation" % rule_id
+
+
+@pytest.mark.parametrize("rule_id", sorted(FIXTURES))
+def test_rule_silent_on_clean_twin(rule_id):
+    hits = _ids(_lint(FIXTURES[rule_id]["negative"]), rule_id)
+    assert not hits, "%s false-positived on its clean twin" % rule_id
+
+
+def test_jg002_fixture_counts_and_cold_path():
+    pos = FIXTURES["JG002"]["positive"]
+    assert len(_ids(_lint(pos), "JG002")) == 3     # asarray + item + float
+    assert _ids(_lint(pos, relpath=COLD), "JG002") == []
+
+
+def test_jg003_flags_each_shape_once():
+    hits = _ids(_lint(FIXTURES["JG003"]["positive"]), "JG003")
+    assert len(hits) == 4   # zeros, asarray-literal, where, kernel literal
+
+
+def test_jg007_fix_wraps_long_from_imports(tmp_path):
+    """The rewritten statement must stay valid Python: long from-imports
+    wrap in parentheses; plain `import a, b` (no legal paren form) is
+    left long rather than broken."""
+    import ast as ast_mod
+
+    pkg = tmp_path / "lightgbm_tpu"
+    pkg.mkdir()
+    mod = pkg / "mod.py"
+    mod.write_text(
+        "import json, very_long_module_name_aaaa, "
+        "very_long_module_name_bbbb, very_long_module_name_cccc\n"
+        "from some.rather.deep.package.path import (unused_name_xx, "
+        "kept_name_aaaaaaaa, kept_name_bbbbbbbb, kept_name_cccccccc)\n"
+        "print(very_long_module_name_aaaa, very_long_module_name_bbbb,\n"
+        "      very_long_module_name_cccc, kept_name_aaaaaaaa,\n"
+        "      kept_name_bbbbbbbb, kept_name_cccccccc)\n")
+    cfg = GraftlintConfig(root=str(tmp_path), baseline="baseline.json")
+    report = run_lint(config=cfg, autofix=True)
+    assert report.autofixed == 2
+    fixed = mod.read_text()
+    ast_mod.parse(fixed)                     # must still be valid Python
+    assert "json" not in fixed and "unused_name_xx" not in fixed
+    from_lines = [ln for ln in fixed.splitlines()
+                  if ln.startswith("from ")]
+    assert all(len(ln) <= 79 for ln in from_lines), from_lines
+
+
+def test_write_baseline_keeps_grandfathered(tmp_path):
+    """Refreshing the baseline from a report whose findings are already
+    baseline-suppressed must re-emit them, not silently drop them (the
+    CLI --write-baseline path)."""
+    src = """
+        import jax.numpy as jnp
+
+        def setup():
+            return jnp.zeros((4,))
+        """
+    findings = _lint(src)
+    bl = str(tmp_path / "b.json")
+    assert write_baseline(findings, bl) == 1
+    again = _lint(src)
+    apply_baseline(again, load_baseline(bl))
+    assert all(f.suppression == "baseline" for f in again)
+    # the refresh the CLI performs: full findings list, suppressed or not
+    assert write_baseline(again, bl) == 1
+    assert load_baseline(bl)
+
+
+def test_jg007_fix_rewrites_imports(tmp_path):
+    pkg = tmp_path / "lightgbm_tpu"
+    pkg.mkdir()
+    mod = pkg / "mod.py"
+    mod.write_text(textwrap.dedent("""\
+        import json
+        from typing import Dict, List
+
+        def f(d: Dict) -> Dict:
+            return d
+        """))
+    cfg = GraftlintConfig(root=str(tmp_path), baseline="baseline.json")
+    report = run_lint(config=cfg, autofix=True)
+    assert report.autofixed == 2
+    assert [f for f in report.findings if not f.suppressed] == []
+    fixed = mod.read_text()
+    assert "import json" not in fixed
+    assert "from typing import Dict" in fixed and "List" not in fixed
+
+
+# ---------------------------------------------------------------------------
+# engine mechanics
+# ---------------------------------------------------------------------------
+
+def test_inline_suppression_same_line_and_above():
+    src = """
+        import jax.numpy as jnp
+
+        def setup():
+            a = jnp.zeros((4,))  # graftlint: disable=JG003
+            # graftlint: disable=JG003
+            b = jnp.zeros((4,))
+            c = jnp.zeros((4,))
+            return a, b, c
+        """
+    fs = [f for f in _lint(src) if f.rule == "JG003"]
+    assert [f.suppressed for f in fs] == [True, True, False]
+    assert {f.suppression for f in fs if f.suppressed} == {"inline"}
+
+
+def test_skip_file_marker():
+    src = "# graftlint: skip-file\nimport jax.numpy as jnp\n" \
+          "bad = jnp.zeros((4,))\n"
+    assert lint_source(src, OPS, GraftlintConfig()) == []
+
+
+def test_baseline_roundtrip(tmp_path):
+    src = """
+        import jax.numpy as jnp
+
+        def setup():
+            a = jnp.zeros((4,))
+            return a, jnp.zeros((8,))
+        """
+    findings = _lint(src)
+    assert len(_ids(findings)) == 2
+    bl_path = str(tmp_path / "baseline.json")
+    assert write_baseline(findings, bl_path) == 2
+    baseline = load_baseline(bl_path)
+    fresh = _lint(src)
+    apply_baseline(fresh, baseline)
+    assert _ids(fresh) == []
+    assert all(f.suppression == "baseline" for f in fresh)
+    # baseline matches by source line, not line number: new unrelated
+    # findings stay unsuppressed
+    grown = _lint(src.rstrip() + "\n\n        more = jnp.zeros((2,))\n")
+    apply_baseline(grown, baseline)
+    assert len(_ids(grown)) == 1
+
+
+def test_config_table_parsing():
+    table = _parse_table(textwrap.dedent("""\
+        [tool.other]
+        x = 1
+        [tool.graftlint]
+        include = ["lightgbm_tpu"]
+        exclude = [
+            "__pycache__",
+            "native",
+        ]
+        baseline = "b.json"
+        disable = []
+        [tool.after]
+        y = 2
+        """))
+    assert table["include"] == ["lightgbm_tpu"]
+    assert table["exclude"] == ["__pycache__", "native"]
+    assert table["baseline"] == "b.json"
+    assert table["disable"] == []
+
+
+def test_repo_config_loads_and_walks():
+    cfg = load_config()
+    files = iter_py_files(cfg)
+    assert any(p.endswith("ops/pallas_scan.py") for p in files)
+    assert not any("__pycache__" in p for p in files)
+    assert cfg.is_hot_path("lightgbm_tpu/ops/grow.py")
+    assert not cfg.is_hot_path("lightgbm_tpu/data/dataset.py")
+
+
+# ---------------------------------------------------------------------------
+# jaxpr audits (the two pinned invariants are tier-1 here)
+# ---------------------------------------------------------------------------
+
+def test_audits_all_green():
+    results = {r.name: r for r in run_audits()}
+    assert set(results) == {
+        "hist_window_f32", "scan_pair_f32", "scan_blocks_f32",
+        "persist_split_pass", "predict_traversal_f32",
+        "predict_donation", "serve_ladder_bound"}
+    bad = {n: r.detail for n, r in results.items() if not r.ok}
+    assert not bad, bad
+
+
+def test_audit_catches_f64_convert():
+    """The f64 detector actually detects: a deliberately-widening
+    program must fail the same check the kernels pass."""
+    import jax
+    import jax.numpy as jnp
+
+    from lightgbm_tpu.analysis.jaxpr_audit import find_f64_converts
+
+    def leaky(x):
+        return x.astype(jnp.float64) * 2.0
+
+    closed = jax.make_jaxpr(leaky)(
+        jax.ShapeDtypeStruct((8,), jnp.float32))
+    assert find_f64_converts(closed.jaxpr)
+
+
+def test_audit_catches_callback_in_loop():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from lightgbm_tpu.analysis.jaxpr_audit import find_host_prims_in_loops
+
+    def bad(x):
+        def body(_, v):
+            return v + jax.pure_callback(
+                lambda a: np.asarray(a), jax.ShapeDtypeStruct((), v.dtype),
+                v[0])
+        return jax.lax.fori_loop(0, 3, body, x)
+
+    closed = jax.make_jaxpr(bad)(jax.ShapeDtypeStruct((4,), jnp.float32))
+    assert find_host_prims_in_loops(closed.jaxpr)
+
+
+# ---------------------------------------------------------------------------
+# the gate: repo self-scan
+# ---------------------------------------------------------------------------
+
+def test_repo_self_scan_clean():
+    """`python -m lightgbm_tpu.analysis` must exit 0: zero unsuppressed
+    findings over the whole package (baseline-suppressed grandfathered
+    ones are allowed, parse errors are not)."""
+    report = run_lint()
+    assert report.parse_errors == []
+    bad = [(f.path, f.line, f.rule, f.message)
+           for f in report.unsuppressed]
+    assert not bad, "unsuppressed graft-lint findings:\n%s" % \
+        "\n".join("%s:%d %s %s" % b for b in bad)
+    assert report.files_scanned > 60
+
+
+def test_baseline_only_contains_known_grandfathered():
+    """The baseline must shrink, never grow: pin its current contents so
+    a PR that adds entries has to justify itself here."""
+    cfg = load_config()
+    with open(cfg.baseline_path()) as f:
+        data = json.load(f)
+    by_rule = {}
+    for ent in data["findings"]:
+        by_rule.setdefault(ent["rule"], 0)
+        by_rule[ent["rule"]] += ent["count"]
+    assert set(by_rule) <= {"JG002"}, by_rule
+    assert sum(by_rule.values()) <= 9, by_rule
+
+
+def test_lint_lands_on_telemetry_counters():
+    """Findings/files land on `analysis::*` counters when telemetry is
+    on, so services embedding the gate see lint drift next to their
+    perf counters."""
+    from lightgbm_tpu.telemetry import events
+
+    prev = events.mode()
+    events.enable("timers")
+    events.reset()
+    try:
+        run_lint(paths=["lightgbm_tpu/analysis/lint.py"])
+        counts = events.counts_snapshot()
+        assert counts.get("analysis::files_scanned", 0) == 1
+        assert "analysis::findings" in counts
+    finally:
+        events.reset()
+        if prev == events.OFF:
+            events.disable()
+
+
+def test_cli_smoke(capsys):
+    from lightgbm_tpu.analysis.__main__ import main
+    assert main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    assert "JG001" in out and "JG007" in out
+    # lint-only over one file: exits 0 and prints the summary line
+    assert main(["lightgbm_tpu/analysis/lint.py", "--no-audit"]) == 0
+    assert "graft-lint:" in capsys.readouterr().out

@@ -15,6 +15,7 @@ import pytest
 
 import jax.numpy as jnp
 
+from lightgbm_tpu.analysis import strict_numerics
 from lightgbm_tpu.ops.pallas_scan import (HAS_PALLAS, ScanLayout,
                                           build_block_scan_meta,
                                           scan_blocks, scan_pair)
@@ -101,18 +102,21 @@ def test_block_scan_matches_per_feature_kernel(seed):
     rows_g, rows_h = _apply_fix(
         [_feature_rows(gb, group_of, Fp), _feature_rows(hb, group_of, Fp)],
         sg, shr, ls, nb, mf, needs_fix)
-    out_pair = np.asarray(scan_pair(
-        jnp.asarray(scal8), jnp.asarray(rows_g), jnp.asarray(rows_h),
-        layout.keep_r, layout.keep_f, layout.valid_r, layout.valid_f,
-        layout.aux, interpret=True))                  # [2, 8, Fp]
+    # strict-numerics harness: a silent f64 leak into either kernel's
+    # f32 math fails here even if the numeric outputs still agree
+    with strict_numerics():
+        out_pair = np.asarray(scan_pair(
+            jnp.asarray(scal8), jnp.asarray(rows_g), jnp.asarray(rows_h),
+            layout.keep_r, layout.keep_f, layout.valid_r, layout.valid_f,
+            layout.aux, interpret=True))              # [2, 8, Fp]
 
-    # ---- block kernel: raw blocks, in-kernel fix ----------------------
-    Gp = meta_blk["masks"].shape[1]
-    gbB = np.pad(gb, ((0, 0), (0, Gp - G), (0, 0)))
-    hbB = np.pad(hb, ((0, 0), (0, Gp - G), (0, 0)))
-    out_blk = np.asarray(scan_blocks(
-        jnp.asarray(scal9), jnp.asarray(gbB), jnp.asarray(hbB),
-        jnp.asarray(meta_blk["masks"]), do_fix=True, interpret=True))
+        # ---- block kernel: raw blocks, in-kernel fix ------------------
+        Gp = meta_blk["masks"].shape[1]
+        gbB = np.pad(gb, ((0, 0), (0, Gp - G), (0, 0)))
+        hbB = np.pad(hb, ((0, 0), (0, Gp - G), (0, 0)))
+        out_blk = np.asarray(scan_blocks(
+            jnp.asarray(scal9), jnp.asarray(gbB), jnp.asarray(hbB),
+            jnp.asarray(meta_blk["masks"]), do_fix=True, interpret=True))
 
     for c in range(2):
         for g in range(G):
@@ -156,8 +160,10 @@ def test_block_scan_feature_mask_fold():
          np.full(2, 0.5), shr], axis=1).astype(np.float32))
 
     def run(masks):
-        return np.asarray(scan_blocks(scal9, gbB, hbB, jnp.asarray(masks),
-                                      do_fix=False, interpret=True))
+        with strict_numerics():
+            return np.asarray(scan_blocks(scal9, gbB, hbB,
+                                          jnp.asarray(masks),
+                                          do_fix=False, interpret=True))
 
     base = run(meta_blk["masks"])
     # mask out group 0's feature that currently wins it

@@ -6,14 +6,28 @@ in interpreter mode on CPU and must match the plain einsum bit-for-bit in
 its f32 totals. The kernel's bf16 hi/lo gradient split carries a ~1e-7
 relative residual-rounding error per element (the hi half is exact, the lo
 half is itself bf16-rounded), so tolerances are f32-grade, not bitwise.
+
+Kernel invocations run under the strict-numerics harness
+(analysis.strict_numerics: strict dtype promotion + debug-nans), so a
+silent f64 leak into the f32 kernel math fails here even when the
+numeric outputs still match.
 """
 import numpy as np
 import pytest
 
 import jax.numpy as jnp
 
+from lightgbm_tpu.analysis import strict_numerics
 from lightgbm_tpu.ops.pallas_histogram import (HAS_PALLAS, hist_window,
                                                hist_window_xla)
+
+
+def _hist_strict(bins_t, grad, hess, w):
+    with strict_numerics():
+        out = hist_window(jnp.asarray(bins_t), jnp.asarray(grad),
+                          jnp.asarray(hess), w, interpret=True)
+        out.block_until_ready()
+    return np.asarray(out)
 
 
 @pytest.mark.skipif(not HAS_PALLAS, reason="pallas unavailable")
@@ -29,8 +43,7 @@ def test_pallas_hist_matches_xla(C, G, W):
 
     ref = np.asarray(hist_window_xla(jnp.asarray(bins), jnp.asarray(grad),
                                      jnp.asarray(hess), W))
-    out = np.asarray(hist_window(jnp.asarray(bins.T), jnp.asarray(grad),
-                                 jnp.asarray(hess), W, interpret=True))
+    out = _hist_strict(bins.T, grad, hess, W)
     assert out.shape == (G, W, 2)
     np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
 
@@ -67,8 +80,7 @@ def test_kernel_variants_match_scatter_add(C, G, W):
     grad = rng.normal(size=C).astype(np.float32)
     hess = rng.random(C).astype(np.float32)
     ref = _scatter_ref(bins, grad, hess, W)
-    out = np.asarray(hist_window(jnp.asarray(bins.T), jnp.asarray(grad),
-                                 jnp.asarray(hess), W, interpret=True))
+    out = _hist_strict(bins.T, grad, hess, W)
     assert out.shape == (G, W, 2)
     np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
     # pin the heuristic: wide groups radix, narrow groups one-hot
@@ -96,8 +108,7 @@ def test_pallas_hist_totals_exact():
     bins = rng.integers(0, W, size=(C, G)).astype(np.int32)
     grad = (rng.normal(size=C) * 3).astype(np.float32)
     hess = rng.random(C).astype(np.float32)
-    out = np.asarray(hist_window(jnp.asarray(bins.T), jnp.asarray(grad),
-                                 jnp.asarray(hess), W, interpret=True))
+    out = _hist_strict(bins.T, grad, hess, W)
     np.testing.assert_allclose(out[..., 0].sum(axis=1),
                                np.repeat(np.float64(grad.astype(np.float64).sum()), G),
                                rtol=1e-5)

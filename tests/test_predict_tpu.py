@@ -214,6 +214,45 @@ def test_serve_bucket_compile_bound(counters):
     assert server.stats()["compiles"] <= bound
 
 
+def test_serve_recompile_regression_second_pass(counters):
+    """Recompile pin: replaying ragged traffic through the SAME server
+    must be pure cache reuse — `predict::serve_compile` stays at its
+    first-pass value (<= the ladder bound) and every second-pass chunk
+    is a bucket hit; the predictor's own compile counter
+    (`predict::compile` via _seen_shapes) must not move either."""
+    from lightgbm_tpu.predict import BatchServer
+
+    X, y = _binary_data(seed=37, n=700)
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1}
+    b = lgb.train(dict(params), lgb.Dataset(X, y, params=params), 8,
+                  verbose_eval=False)
+    server = BatchServer(b._booster.device_predictor(),
+                         min_batch=64, max_batch=512)
+    bound = server.max_compiles()
+    rng = np.random.default_rng(7)
+    first = [3, 64, 65, 100, 130, 256, 300, 500, 512, 1]
+    for n in first:
+        server.predict(X[rng.integers(0, len(X), size=n)])
+    counts1 = counters()
+    compiles1 = counts1.get("predict::serve_compile", 0)
+    predictor_compiles1 = counts1.get("predict::compile", 0)
+    assert 0 < compiles1 <= bound, counts1
+
+    # second pass: a DIFFERENT ragged size sequence hitting the same
+    # ladder — no new serve compiles, no new traversal executables
+    second = [2, 70, 90, 128, 257, 333, 480, 512, 64, 5, 511, 200]
+    for n in second:
+        out = server.predict(X[rng.integers(0, len(X), size=n)])
+        assert out.shape[0] == n
+    counts2 = counters()
+    assert counts2.get("predict::serve_compile", 0) == compiles1, counts2
+    assert counts2.get("predict::compile", 0) == predictor_compiles1, \
+        counts2
+    assert counts2.get("predict::serve_bucket_hit", 0) \
+        >= len(first) + len(second) - bound, counts2
+    assert server.stats()["compiles"] <= bound
+
+
 @pytest.mark.slow
 def test_serve_chunks_large_requests(counters):
     from lightgbm_tpu.predict import BatchServer
