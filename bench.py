@@ -203,6 +203,29 @@ def main():
                                  vote["iters"], vote["train_s"],
                                  vote["value"], vote["vs_baseline"]),
               file=sys.stderr)
+    ckpt = None
+    if os.environ.get("BENCH_SKIP_CHECKPOINT", "") != "1":
+        try:
+            if bench_telemetry:
+                telemetry.reset()
+            ckpt = run_checkpoint()
+            if bench_telemetry:
+                phase_snaps["checkpoint"] = _phase_stats(telemetry)
+        except Exception as exc:
+            print("# checkpoint phase failed: %r" % exc, file=sys.stderr)
+    if ckpt is not None:
+        result["checkpoint_overhead_frac"] = ckpt["overhead_frac"]
+        result["checkpoint_write_s"] = ckpt["write_s"]
+        result["checkpoint_writes"] = ckpt["writes"]
+        result["checkpoint_mb"] = ckpt["mb"]
+        print(json.dumps(result), flush=True)
+        print("# checkpoint[higgs-like]: rows=%d iters=%d freq=%d -> %d "
+              "snapshots (%.1f MB) in %.2fs write time; train %.1fs with "
+              "vs %.1fs without = %.2f%% overhead (budget 3%%)"
+              % (ckpt["rows"], ckpt["iters"], ckpt["freq"], ckpt["writes"],
+                 ckpt["mb"], ckpt["write_s"], ckpt["train_on_s"],
+                 ckpt["train_off_s"], 100.0 * ckpt["overhead_frac"]),
+              file=sys.stderr)
     pred = None
     if os.environ.get("BENCH_SKIP_PREDICT", "") != "1":
         try:
@@ -422,6 +445,66 @@ def run_predict():
     expo = _predict_one_shape(Xe, ye, params, n_trees, serve_rows // 2,
                               "expo")
     return {"higgs": higgs, "expo": expo}
+
+
+def run_checkpoint():
+    """Resilience-subsystem phase: HIGGS-like training with
+    snapshot_freq=10 full-state checkpoints vs the same run with them off.
+    Reports the wall overhead fraction (acceptance budget: < 3%) plus the
+    write time / count / bytes from the checkpoint::* telemetry."""
+    import shutil
+    import tempfile
+
+    import jax
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu import telemetry
+
+    n_rows = int(os.environ.get("BENCH_CHECKPOINT_ROWS", 2_000_000))
+    n_iters = int(os.environ.get("BENCH_CHECKPOINT_ITERS", 60))
+    freq = int(os.environ.get("BENCH_CHECKPOINT_FREQ", 10))
+    n_leaves = int(os.environ.get("BENCH_CHECKPOINT_LEAVES", 255))
+    X, y = make_higgs_like(n_rows)
+    ds = lgb.Dataset(X, y)
+    ds.construct()
+    base = {"objective": "binary", "num_leaves": n_leaves, "max_bin": 255,
+            "verbosity": -1, "metric": "none"}
+
+    def _timed_train(params, wipe_dir=None):
+        warm = lgb.train(dict(params), ds, 17, verbose_eval=False)
+        warm._booster._materialize_pending()
+        del warm
+        if wipe_dir is not None:
+            # the warmup wrote snapshots; the timed run must train the
+            # full n_iters (not resume from them) and the checkpoint::*
+            # telemetry must count only the timed run's writes
+            for name in os.listdir(wipe_dir):
+                os.remove(os.path.join(wipe_dir, name))
+            telemetry.reset()
+        t0 = time.time()
+        bst = lgb.train(dict(params), ds, n_iters, verbose_eval=False)
+        bst._booster._materialize_pending()
+        jax.block_until_ready(bst._booster.train_score.score_device(0))
+        return time.time() - t0
+
+    t_off = _timed_train(base)
+    ckpt_dir = tempfile.mkdtemp(prefix="bench_ckpt_")
+    try:
+        on = dict(base)
+        on.update({"snapshot_freq": freq, "checkpoint_dir": ckpt_dir,
+                   "checkpoint_keep": 2})
+        t_on = _timed_train(on, wipe_dir=ckpt_dir)
+        counts = telemetry.events.counts_snapshot()
+        scopes = telemetry.events.snapshot_full()
+        write_s = scopes.get("checkpoint::write", (0.0, 0, ""))[0]
+    finally:
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+    return {"rows": n_rows, "iters": n_iters, "freq": freq,
+            "train_on_s": t_on, "train_off_s": t_off,
+            "overhead_frac": round(max(t_on - t_off, 0.0)
+                                   / max(t_off, 1e-9), 4),
+            "write_s": round(float(write_s), 3),
+            "writes": int(counts.get("checkpoint::write", 0)),
+            "mb": round(counts.get("checkpoint::bytes", 0) / 1e6, 2)}
 
 
 def run_voting():

@@ -39,6 +39,10 @@ class GraftlintConfig:
     # JG006: the only modules allowed to import pallas directly
     pallas_compat_allow: List[str] = field(default_factory=lambda: [
         "lightgbm_tpu/ops/pallas_compat.py"])
+    # JG008: path fragments whose file writes must be atomic
+    # (tmp + fsync + os.replace) — the checkpoint/state durability contract
+    atomic_write_paths: List[str] = field(default_factory=lambda: [
+        "lightgbm_tpu/resilience/"])
     # baseline suppression file, relative to the repo root
     baseline: str = "lightgbm_tpu/analysis/baseline.json"
     root: str = "."

@@ -39,3 +39,4 @@ from . import jg004_jit_in_loop  # noqa: E402,F401
 from . import jg005_nondeterminism  # noqa: E402,F401
 from . import jg006_raw_pallas  # noqa: E402,F401
 from . import jg007_unused_imports  # noqa: E402,F401
+from . import jg008_nonatomic_write  # noqa: E402,F401

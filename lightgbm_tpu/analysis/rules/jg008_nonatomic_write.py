@@ -1,0 +1,117 @@
+"""JG008 — non-atomic file write on a durability-critical path.
+
+A checkpoint (or any resume-critical state file) written with a plain
+``open(path, "w")`` can be torn by a preemption mid-write: the next run
+then resumes from garbage, or — worse — from a file whose length is right
+but whose tail is stale. The resilience subsystem's contract is
+tmp + flush + fsync + ``os.replace`` (``resilience/checkpoint.py``
+``atomic_write_bytes``), which leaves either the old file or the complete
+new one.
+
+Within the configured ``atomic_write_paths`` (default:
+``lightgbm_tpu/resilience/``) this rule flags every write-mode ``open``
+call unless BOTH hold:
+
+  * the file argument is visibly a temp target (an identifier, attribute
+    or string containing ``tmp``), and
+  * the module publishes it atomically somewhere (calls ``os.replace`` /
+    ``os.rename``).
+
+Reads are never flagged. Intentional corruption helpers (fault
+injection) carry an inline ``# graftlint: disable=JG008``.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ..core import Finding, ModuleContext
+from . import register
+
+_WRITE_CHARS = ("w", "a", "x", "+")
+
+
+_OS_WRITE_FLAGS = ("O_WRONLY", "O_RDWR", "O_APPEND", "O_CREAT", "O_TRUNC")
+
+
+def _mode_of(call: ast.Call):
+    """The mode string of an open() call, or None when undecidable."""
+    if len(call.args) >= 2:
+        node = call.args[1]
+    else:
+        node = next((kw.value for kw in call.keywords
+                     if kw.arg == "mode"), None)
+    if node is None:
+        return "r"
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    # os.open takes int flags: writeable iff an O_* write flag appears
+    names = {sub.attr for sub in ast.walk(node)
+             if isinstance(sub, ast.Attribute)}
+    names |= {sub.id for sub in ast.walk(node) if isinstance(sub, ast.Name)}
+    if any(n.startswith("O_") for n in names):
+        return "w" if names & set(_OS_WRITE_FLAGS) else "r"
+    return None
+
+
+def _looks_tmp(node: ast.AST) -> bool:
+    """True when the file-argument expression visibly names a temp target."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and "tmp" in sub.id.lower():
+            return True
+        if isinstance(sub, ast.Attribute) and "tmp" in sub.attr.lower():
+            return True
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, str) \
+                and "tmp" in sub.value.lower():
+            return True
+    return False
+
+
+def _is_open(call: ast.Call) -> bool:
+    f = call.func
+    if isinstance(f, ast.Name) and f.id == "open":
+        return True
+    return (isinstance(f, ast.Attribute) and f.attr == "open"
+            and isinstance(f.value, ast.Name) and f.value.id in ("io", "os"))
+
+
+def _module_renames(tree: ast.AST) -> bool:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and isinstance(node.func,
+                                                     ast.Attribute):
+            if node.func.attr in ("replace", "rename") and isinstance(
+                    node.func.value, ast.Name) and node.func.value.id == "os":
+                return True
+    return False
+
+
+@register
+class NonAtomicWrite:
+    id = "JG008"
+    name = "non-atomic-write"
+    description = ("open-for-write without tmp + os.replace on a "
+                   "durability-critical path (torn checkpoint on kill)")
+
+    def check(self, ctx: ModuleContext) -> List[Finding]:
+        cfg_paths = getattr(ctx.config, "atomic_write_paths", ())
+        rp = ctx.relpath
+        if not any(rp.startswith(frag) or frag in rp for frag in cfg_paths):
+            return []
+        out: List[Finding] = []
+        has_rename = _module_renames(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call) and _is_open(node)
+                    and node.args):
+                continue
+            mode = _mode_of(node)
+            if mode is not None and not any(c in mode
+                                            for c in _WRITE_CHARS):
+                continue   # read-only open
+            if _looks_tmp(node.args[0]) and has_rename:
+                continue   # tmp target + module publishes via os.replace
+            out.append(ctx.finding(
+                self.id, node,
+                "write files atomically: open a '*.tmp' sibling, flush + "
+                "fsync, then os.replace onto the final name "
+                "(resilience.checkpoint.atomic_write_bytes)"))
+        return out

@@ -54,6 +54,17 @@ class DART(GBDT):
         self.output_metric(self.iter)
         return False
 
+    # -- resilience: drop RNG + per-tree weights continue bit-exactly ---
+    def _extra_resilience_state(self) -> dict:
+        return {"dart_rng": self._drop_rng.bit_generator.state,
+                "tree_weight": [float(w) for w in self.tree_weight],
+                "sum_weight": float(self.sum_weight)}
+
+    def _restore_extra_state(self, state: dict) -> None:
+        self._drop_rng.bit_generator.state = state["dart_rng"]
+        self.tree_weight = list(state["tree_weight"])
+        self.sum_weight = float(state["sum_weight"])
+
     # ------------------------------------------------------------------
     def _subtract_tree(self, model_idx: int, tree_id: int) -> None:
         tree = self.models[model_idx]

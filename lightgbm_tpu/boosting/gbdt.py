@@ -70,6 +70,10 @@ class GBDT:
         self.planned_rounds = 0
         self._rounds_done = 0
         self._batch_credit = 0
+        # resilience: >0 caps fused batches so they never cross a
+        # snapshot boundary (the checkpoint writer needs the exact
+        # iteration-k state; a 16-iteration scan would overshoot it)
+        self.snapshot_stride = 0
         # compiled device predictors keyed by (start, num, model length);
         # stale keys age out when the model grows (see device_predictor)
         self._tpu_predictors: Dict[tuple, object] = {}
@@ -383,7 +387,16 @@ class GBDT:
         # fixed batch size: every distinct k compiles its own scan program,
         # so the tail runs as single iterations instead of a second compile
         K = 16
-        return K if remaining >= K else 1
+        if self.snapshot_stride > 0:
+            # checkpointing run: batches end exactly on snapshot
+            # boundaries (one extra program per distinct stride, and the
+            # resumed run re-aligns to the identical batch shapes). The
+            # saver fires on ABSOLUTE iterations, so grafted init-model
+            # iterations count toward the alignment
+            abs_iter = self.iter + self.num_init_iteration
+            K = min(K, self.snapshot_stride
+                    - (abs_iter % self.snapshot_stride))
+        return K if remaining >= K and K > 1 else 1
 
     @telemetry.timed("boosting::TrainMultiIterFast(launch)",
                      category="boosting")
@@ -810,6 +823,139 @@ class GBDT:
         self.iter -= 1
 
     # ------------------------------------------------------------------
+    # resilience: full training-state snapshot / restore at an iteration
+    # boundary (resilience/checkpoint.py owns the container + IO). The
+    # captured set is everything the next iteration reads that is not a
+    # pure function of (config, dataset): exact f64 scores, the bag
+    # mask/weights, every host RNG stream, the learner's key counter and
+    # CEGB bitsets, and the model itself.
+    # ------------------------------------------------------------------
+    def capture_training_state(self):
+        """(arrays, state) for a bit-exact resume; arrays are numpy, state
+        is JSON-able. Only valid on a training booster (init() ran)."""
+        self._materialize_pending()
+        if len(self.models) != ((self.iter + self.num_init_iteration)
+                                * self.num_tree_per_iteration):
+            # a snapshot mid-batch would label trees with the wrong
+            # iteration and desync scores from the model — loud, not torn
+            Log.fatal("checkpoint capture off an iteration boundary: "
+                      "%d trees vs iteration %d (+%d init)"
+                      % (len(self.models), self.iter,
+                         self.num_init_iteration))
+        arrays = {
+            "scores": np.stack([np.asarray(s)
+                                for s in self.train_score._score]),
+            "bag_mask": np.asarray(self._bag_mask_dev).astype(np.uint8),
+            "model_text": np.frombuffer(
+                self.save_model_to_string().encode(), dtype=np.uint8),
+        }
+        # model text keeps the reference's lossy %g for shrinkage /
+        # internal_value; boosters that keep MUTATING old trees after a
+        # resume (DART's renormalize) need them exact, so the checkpoint
+        # carries the full-precision values alongside
+        ivs = [np.asarray(t.internal_value[:max(t.num_leaves - 1, 0)],
+                          np.float64) for t in self.models]
+        arrays["tree_shrinkage"] = np.asarray(
+            [t.shrinkage for t in self.models], np.float64)
+        arrays["tree_iv_len"] = np.asarray([len(v) for v in ivs], np.int64)
+        arrays["tree_iv_flat"] = (np.concatenate(ivs) if ivs
+                                  else np.zeros(0, np.float64))
+        if self._bag_weight_dev is not None:
+            arrays["bag_weight"] = np.asarray(self._bag_weight_dev)
+        learner = getattr(self, "tree_learner", None)
+        if learner is not None:
+            if learner._feature_used_dev is not None:
+                arrays["feature_used"] = np.asarray(
+                    learner._feature_used_dev)
+            if learner._row_feat_used_dev is not None:
+                arrays["row_feat_used"] = np.asarray(
+                    learner._row_feat_used_dev).astype(np.uint8)
+        if self.objective is not None and hasattr(self.objective, "_lcg_x"):
+            # rank_xendcg's reference-exact LCG planes advance per
+            # iteration; without them a resume would re-randomize
+            arrays["obj_lcg_x"] = np.asarray(self.objective._lcg_x)
+        state = {
+            "boosting": type(self).__name__,
+            "iter": int(self.iter),
+            "num_init_iteration": int(self.num_init_iteration),
+            "shrinkage_rate": float(self.shrinkage_rate),
+            "bag_data_cnt": int(self.bag_data_cnt),
+            "need_re_bagging": bool(self.need_re_bagging),
+            "bagging_rng": self._bagging_rng.bit_generator.state,
+            "col_sampler_rng": (
+                learner.col_sampler.rng.bit_generator.state
+                if learner is not None else None),
+            "tree_counter": (int(learner._tree_counter)
+                             if learner is not None else 0),
+        }
+        state.update(self._extra_resilience_state())
+        return arrays, state
+
+    def _extra_resilience_state(self) -> dict:
+        """Subclass hook (DART adds its drop RNG + tree weights)."""
+        return {}
+
+    def _restore_extra_state(self, state: dict) -> None:
+        pass
+
+    def restore_training_state(self, arrays, state) -> None:
+        """Inverse of capture_training_state onto a freshly init()-ed
+        booster of the same config + dataset: the next train_one_iter
+        behaves exactly as iteration `state['iter']` of the snapshotted
+        run would have."""
+        if state.get("boosting") != type(self).__name__:
+            Log.fatal("checkpoint was written by boosting=%s, cannot "
+                      "restore into %s"
+                      % (state.get("boosting"), type(self).__name__))
+        self._invalidate_predictors()
+        stump = GBDT()
+        stump.config = self.config
+        stump.load_model_from_string(
+            arrays["model_text"].tobytes().decode())
+        for tree in stump.models:
+            # loaded trees carry real thresholds; the binned walks (valid
+            # replay, DART subtraction, rollback) need dataset bins
+            tree.bind_to_dataset(self.train_data)
+        self.models = list(stump.models)
+        if "tree_shrinkage" in arrays:
+            # overwrite the %g-lossy fields with the exact snapshot values
+            off = 0
+            lens = arrays["tree_iv_len"]
+            flat = arrays["tree_iv_flat"]
+            for i, tree in enumerate(self.models):
+                tree.shrinkage = float(arrays["tree_shrinkage"][i])
+                ln = int(lens[i])
+                tree.internal_value[:ln] = flat[off:off + ln]
+                off += ln
+        self.iter = int(state["iter"])
+        self.num_init_iteration = int(state["num_init_iteration"])
+        self.shrinkage_rate = float(state["shrinkage_rate"])
+        scores = arrays["scores"]
+        for k in range(self.num_tree_per_iteration):
+            self.train_score._score[k] = jnp.asarray(scores[k])
+        self._bag_mask_dev = jnp.asarray(arrays["bag_mask"].astype(bool))
+        self._bag_weight_dev = (jnp.asarray(arrays["bag_weight"])
+                                if "bag_weight" in arrays else None)
+        self.bag_data_cnt = int(state["bag_data_cnt"])
+        self.need_re_bagging = bool(state["need_re_bagging"])
+        self._bagging_rng.bit_generator.state = state["bagging_rng"]
+        learner = getattr(self, "tree_learner", None)
+        if learner is not None:
+            if state.get("col_sampler_rng") is not None:
+                learner.col_sampler.rng.bit_generator.state = \
+                    state["col_sampler_rng"]
+            learner._tree_counter = int(state.get("tree_counter", 0))
+            if "feature_used" in arrays:
+                learner._feature_used_dev = jnp.asarray(
+                    arrays["feature_used"])
+            if "row_feat_used" in arrays:
+                learner._row_feat_used_dev = jnp.asarray(
+                    arrays["row_feat_used"].astype(bool))
+        if "obj_lcg_x" in arrays and self.objective is not None:
+            self.objective._lcg_x = arrays["obj_lcg_x"].copy()
+        self._restore_extra_state(state)
+
+    # ------------------------------------------------------------------
     def train(self) -> None:
         """Full training loop (GBDT::Train, gbdt.cpp:246-265)."""
         cfg = self.config
@@ -827,8 +973,11 @@ class GBDT:
                 break
             if (cfg.snapshot_freq > 0
                     and (it + 1) % cfg.snapshot_freq == 0):
+                # reference-style model snapshot, made atomic: a worker
+                # killed mid-write must never leave a torn snapshot
+                from ..resilience.checkpoint import atomic_write_text
                 snapshot_out = cfg.output_model + ".snapshot_iter_%d" % (it + 1)
-                self.save_model_to_file(snapshot_out)
+                atomic_write_text(snapshot_out, self.save_model_to_string())
         self._materialize_pending()
 
     # ------------------------------------------------------------------

@@ -204,6 +204,31 @@ class _EarlyStopper:
         self.states = [_MetricState(bool(item[3]))
                        for item in env.evaluation_result_list]
 
+    # -- resilience: the best-so-far trackers ride the checkpoint -------
+    def state_dict(self) -> Optional[Dict]:
+        """JSON-able snapshot of the per-metric best trackers (None until
+        the first evaluation); resilience checkpoints carry it so a
+        resumed run keeps the same patience clock and rollback point."""
+        if self.states is None:
+            return None
+        return {"first_metric": self.first_metric,
+                "states": [{"bigger": s.bigger,
+                            "best_value": s.best_value,
+                            "best_iteration": s.best_iteration,
+                            "best_snapshot": s.best_snapshot}
+                           for s in self.states]}
+
+    def load_state_dict(self, snap: Dict) -> None:
+        self.first_metric = snap["first_metric"]
+        self.states = []
+        for sd in snap["states"]:
+            st = _MetricState(bool(sd["bigger"]))
+            st.best_value = float(sd["best_value"])
+            st.best_iteration = int(sd["best_iteration"])
+            st.best_snapshot = ([tuple(t) for t in sd["best_snapshot"]]
+                                if sd["best_snapshot"] else None)
+            self.states.append(st)
+
     def _stop(self, state: _MetricState, reason: str) -> None:
         if self.verbose:
             Log.info("%s, best iteration is:\n[%d]\t%s" % (

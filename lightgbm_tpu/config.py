@@ -217,6 +217,17 @@ PARAMS: List[_P] = [
     _P("tpu_multival", str, "auto"),         # auto | force | off: ELL row-
     #                                        # sparse device layout (the
     #                                        # MultiValBin/SparseBin analog)
+    # ---- resilience subsystem (resilience/) ----
+    # snapshot_freq (reference save_period) above gates HOW OFTEN; these
+    # gate WHERE full training-state checkpoints land and how many stay
+    _P("checkpoint_dir", str, "", ("checkpoint_directory",)),
+    _P("checkpoint_keep", int, 3, lo=1),
+    _P("tpu_fault_plan", str, ""),           # deterministic fault injection
+    #                                        # (kill@iter= / drop_collective@
+    #                                        # round= / corrupt_checkpoint@n=)
+    _P("tpu_collective_timeout", float, 300.0, lo=0.0),  # DCN host-
+    _P("tpu_collective_retries", int, 2, lo=0),          # collective guard
+    _P("tpu_collective_backoff", float, 0.25, lo=0.0),   # (resilience/retry)
 ]
 
 _BY_NAME: Dict[str, _P] = {p.name: p for p in PARAMS}

@@ -137,7 +137,12 @@ def _load_init_model(init_model) -> Optional[str]:
     if init_model is None:
         return None
     if isinstance(init_model, Booster):
-        return init_model.model_to_string(num_iteration=-1)
+        # an early-stopped Booster carries its rollback point in
+        # best_iteration; continued training must resume from there
+        # (model_to_string's default honors it) — the old explicit
+        # num_iteration=-1 grafted the over-trained tail trees while
+        # best_iteration kept pointing at the truncated model
+        return init_model.model_to_string(num_iteration=None)
     with open(init_model) as fh:
         return fh.read()
 
@@ -192,6 +197,38 @@ def _distributed_raw(ds, cfg, categorical_feature="auto"):
     return X, y, w, cat_idx, ds.group
 
 
+def _serialization_stump(cfg, ds):
+    """A serialization-only GBDT populated with just the fields
+    save_model_to_string reads (a full init would rebuild a tree learner
+    + device score state per rank only to be discarded). Built ONCE per
+    training run — the objective init can be O(shard) host work
+    (lambdarank's inverse-max-DCG tables) — then reused by every
+    snapshot-hook invocation and the final Booster assembly by swapping
+    the model list (_serialize_distributed_model)."""
+    from .boosting.gbdt import GBDT
+    from .objectives import create_objective
+    inner = GBDT()
+    obj = create_objective(cfg.objective, cfg)
+    obj.init(ds.metadata, ds.num_data)
+    inner.config = cfg
+    inner.objective = obj
+    inner.num_class = int(cfg.num_class)
+    inner.num_tree_per_iteration = getattr(obj, "num_model_per_iteration", 1)
+    inner.max_feature_idx = ds.num_total_features - 1
+    inner.feature_names = list(ds.feature_names)
+    inner.feature_infos = [GBDT._feature_info(m) for m in ds.bin_mappers]
+    inner.monotone_constraints = list(cfg.monotone_constraints)
+    return inner
+
+
+def _serialize_distributed_model(stump, models, num_init_iteration=0):
+    """Model text from the (identical-on-every-rank) tree list."""
+    stump.models = list(models)
+    stump.num_init_iteration = int(num_init_iteration)
+    stump.iter = len(stump.models)
+    return stump.save_model_to_string()
+
+
 def _train_distributed(params, train_set, num_boost_round, valid_sets,
                        fobj=None, feval=None, init_model=None,
                        early_stopping_rounds=None, callbacks=None,
@@ -205,8 +242,6 @@ def _train_distributed(params, train_set, num_boost_round, valid_sets,
     Returns a prediction-ready Booster holding the full model on every
     rank. Custom objectives and callbacks are not supported."""
     from .basic import Booster, params_to_config
-    from .boosting.gbdt import GBDT
-    from .objectives import create_objective
     from .parallel.multihost import (init_network, shard_rows,
                                      train_multihost)
     from .utils.log import LightGBMError, Log
@@ -287,12 +322,42 @@ def _train_distributed(params, train_set, num_boost_round, valid_sets,
                 vidx = shard_rows(len(Xv_all), rank, world,
                                   bool(cfg.pre_partition))
             Xv, yv = Xv_all[vidx], yv_all[vidx]
+    # ---- resilience: per-rank auto-resume + snapshot stream ----------
+    # checkpoints on the distributed path are model-only (kind=model);
+    # resume re-enters the init-model machinery below, so every rank's
+    # score shard is reconstructed from the checkpointed model's raw
+    # predictions rather than recomputed from scratch
+    from .resilience import restore as resilience_restore
+    from .resilience.checkpoint import (CheckpointWriter, array_fingerprint,
+                                        config_hash)
+    y_local = None if y is None else y[idx]
+    resume_iter = 0
+    ck_text = None
+    es_resume = None
+    ck_orig_init = None
+    if str(cfg.checkpoint_dir):
+        found = resilience_restore.find_distributed(cfg, rank, X[idx],
+                                                    y_local)
+        if found is not None:
+            resume_iter, ck_text, ck_meta = found
+            es_resume = ck_meta.get("early_stopping")
+            # iterations of the ORIGINAL init model (if any) embedded in
+            # the checkpoint — propagated across resume chains so the
+            # round-space <-> tree-list accounting stays right
+            ck_orig_init = int(ck_meta.get("n_init", 0))
+    model_str = _load_init_model(init_model)
+    if ck_text is not None:
+        if model_str is not None:
+            Log.warning("auto-resume from checkpoint_dir overrides "
+                        "init_model")
+        model_str = ck_text
+        # num_boost_round is the TOTAL target when resuming the same run
+        num_boost_round = max(int(num_boost_round) - resume_iter, 0)
     # continued training: seed every rank's score shard with the init
     # model's raw predictions (the distributed analog of
     # _graft_init_model's binned-walk score push), then prepend its trees
     init_stump = None
     isc_local = isc_valid = None
-    model_str = _load_init_model(init_model)
     if model_str is not None:
         init_stump = Booster(model_str=model_str)
         ntpi0 = init_stump._booster.num_tree_per_iteration
@@ -301,36 +366,63 @@ def _train_distributed(params, train_set, num_boost_round, valid_sets,
         if Xv is not None:
             vraw = init_stump._booster.predict_raw(Xv)
             isc_valid = vraw[:, 0] if ntpi0 == 1 else vraw.T
+    init_models = (list(init_stump._booster.models)
+                   if init_stump is not None else [])
+    n_init = init_stump.current_iteration if init_stump is not None else 0
+    # round space counts iterations beyond the ORIGINAL init model; on a
+    # resume the checkpoint model already contains round-space trees, so
+    # the original offset comes from the checkpoint meta, not n_init
+    orig_init_iters = ck_orig_init if ck_text is not None else n_init
+    stump_cache = {}
+
+    def _stump(ds_):
+        if "inner" not in stump_cache:
+            stump_cache["inner"] = _serialization_stump(cfg, ds_)
+        return stump_cache["inner"]
+
+    snapshot_hook = None
+    if str(cfg.checkpoint_dir) and int(cfg.snapshot_freq) > 0:
+        writer = CheckpointWriter(
+            str(cfg.checkpoint_dir), keep=int(cfg.checkpoint_keep),
+            cfg_hash=config_hash(cfg), rank=rank,
+            fingerprint=array_fingerprint(X[idx], y_local))
+
+        def snapshot_hook(it_done, new_trees, ds_, es_state=None):
+            # every rank holds the identical trees; each writes its own
+            # rank-tagged snapshot (no shared-filesystem assumption); the
+            # early-stopping patience clock and the original-init offset
+            # ride the snapshot meta
+            extra = {"n_init": orig_init_iters}
+            if es_state:
+                extra["early_stopping"] = es_state
+            writer.write_model_text(
+                _serialize_distributed_model(
+                    _stump(ds_), init_models + list(new_trees),
+                    num_init_iteration=n_init),
+                it_done, extra_meta=extra)
+    result_info = {}
     trees, _mappers, ds, _score = train_multihost(
-        cfg, X[idx], None if y is None else y[idx],
+        cfg, X[idx], y_local,
         num_rounds=int(num_boost_round),
         categorical_features=tuple(cat_idx),
         weight_local=None if w is None else w[idx],
         X_valid=Xv, y_valid=yv,
         group_local=glocal, group_valid=gvalid,
-        init_score_local=isc_local, init_score_valid=isc_valid)
-    # serialization-only GBDT: populate just the fields
-    # save_model_to_string reads (a full init would rebuild a tree
-    # learner + device score state per rank only to be discarded)
-    inner = GBDT()
-    obj = create_objective(cfg.objective, cfg)
-    obj.init(ds.metadata, ds.num_data)
-    inner.config = cfg
-    inner.objective = obj
-    inner.num_class = int(cfg.num_class)
-    inner.num_tree_per_iteration = getattr(obj, "num_model_per_iteration", 1)
-    inner.max_feature_idx = ds.num_total_features - 1
-    inner.feature_names = list(ds.feature_names)
-    inner.feature_infos = [GBDT._feature_info(m) for m in ds.bin_mappers]
-    inner.monotone_constraints = list(cfg.monotone_constraints)
-    if init_stump is not None:
-        inner.models = init_stump._booster.models + trees
-        inner.num_init_iteration = init_stump.current_iteration
-    else:
-        inner.models = trees
-    inner.iter = len(inner.models)
-    return Booster(model_str=inner.save_model_to_string(),
-                   params=dict(params))
+        init_score_local=isc_local, init_score_valid=isc_valid,
+        start_iteration=resume_iter, snapshot_hook=snapshot_hook,
+        es_resume=es_resume, result_info=result_info)
+    models_all = init_models + trees
+    best_iter = result_info.get("early_stop_best_iter")
+    if best_iter is not None:
+        # a resumed patience clock rolled back into the restored model:
+        # keep the original init model plus best_iter round-space rounds
+        keep = ((orig_init_iters + best_iter)
+                * int(result_info["trees_per_iteration"]))
+        models_all = models_all[:keep]
+    return Booster(
+        model_str=_serialize_distributed_model(
+            _stump(ds), models_all, num_init_iteration=n_init),
+        params=dict(params))
 
 
 def train(params: Dict[str, Any], train_set: Dataset,
@@ -360,9 +452,16 @@ def train(params: Dict[str, Any], train_set: Dataset,
     # params also activate the collective spans on the distributed path
     # (multihost scans, allreduce/allgather DCN time)
     telemetry_events.configure_from_config(cfg0)
+    # resilience knobs ride the same pattern: the fault plan and the
+    # collective retry policy apply to whichever path runs below
+    from .resilience import faults as resilience_faults
+    from .resilience import retry as resilience_retry
+    resilience_faults.configure_from_config(cfg0)
+    resilience_retry.configure_from_config(cfg0)
     if int(cfg0.num_machines) > 1:
         if evals_result is not None:
-            from .utils.log import Log
+            # NOTE: no local Log import here — a function-local binding
+            # would shadow the module-level Log for the whole function
             Log.warning("evals_result is not populated with "
                         "num_machines > 1")
         try:
@@ -392,10 +491,12 @@ def train(params: Dict[str, Any], train_set: Dataset,
         registry.add(callback.print_evaluation())
     elif isinstance(verbose_eval, int) and not isinstance(verbose_eval, bool):
         registry.add(callback.print_evaluation(verbose_eval))
+    es_cb = None
     if early_stopping_rounds is not None and early_stopping_rounds > 0:
-        registry.add(callback.early_stopping(
+        es_cb = callback.early_stopping(
             early_stopping_rounds, params.get("first_metric_only", False),
-            verbose=bool(verbose_eval)))
+            verbose=bool(verbose_eval))
+        registry.add(es_cb)
     if learning_rates is not None:
         registry.add(callback.reset_parameter(learning_rate=learning_rates))
     if evals_result is not None:
@@ -407,13 +508,65 @@ def train(params: Dict[str, Any], train_set: Dataset,
         # phase buckets, leaf counts, memory watermarks, recompile counts
         monitor = TrainingMonitor()
         registry.add(monitor)
+    saver = None
+    if int(cfg0.snapshot_freq) > 0:
+        # the reference's snapshot_freq (config.h, alias save_period):
+        # here it gates full training-state checkpoints into
+        # checkpoint_dir (resilience/), written post-iteration AFTER the
+        # early-stopping callback so a stopping round never snapshots
+        if str(cfg0.checkpoint_dir):
+            from .resilience.checkpoint import (CheckpointWriter,
+                                                TrainingSaver, config_hash)
+            saver = TrainingSaver(
+                CheckpointWriter(str(cfg0.checkpoint_dir),
+                                 keep=int(cfg0.checkpoint_keep),
+                                 cfg_hash=config_hash(cfg0)),
+                int(cfg0.snapshot_freq),
+                # the engine-made early-stopping trackers ride the
+                # snapshot (user-supplied callbacks stay outside it)
+                extra_state_fn=(
+                    (lambda: {"early_stopping": es_cb.state_dict()})
+                    if es_cb is not None else None))
+            registry.add(saver)
+        else:
+            Log.warning("snapshot_freq=%d has no checkpoint_dir=; set one "
+                        "to write resume checkpoints (the CLI train task "
+                        "keeps writing model-only snapshots next to "
+                        "output_model)" % int(cfg0.snapshot_freq))
+
     registry.seal()
 
     booster = Booster(params=params, train_set=train_set)
     model_str = _load_init_model(init_model)
     first_round = 0
-    if model_str is not None:
+    last_round = num_boost_round
+    restored = None
+    if str(cfg0.checkpoint_dir):
+        # auto-resume: newest valid snapshot matching this config +
+        # dataset; corruption falls back, a foreign run starts fresh
+        from .resilience import restore as resilience_restore
+        restored = resilience_restore.find_restorable(cfg0,
+                                                      train_set._inner)
+    if restored is not None:
+        if model_str is not None:
+            Log.warning("auto-resume from checkpoint_dir overrides "
+                        "init_model")
+        first_round = resilience_restore.resume_booster(booster, restored)
+        # num_boost_round is the TOTAL target of NEW rounds when resuming
+        # the same run: a snapshotted run that itself started from an
+        # init model counts its grafted iterations in first_round, so the
+        # target is offset by the restored num_init_iteration
+        last_round = max(
+            num_boost_round + booster._booster.num_init_iteration,
+            first_round)
+        es_state = resilience_restore.extra_state(restored,
+                                                  "early_stopping")
+        if es_state and es_cb is not None:
+            # the patience clock and rollback point survive the resume
+            es_cb.load_state_dict(es_state)
+    elif model_str is not None:
         first_round = _graft_init_model(booster, model_str, train_set)
+        last_round = first_round + num_boost_round
     plan.attach(booster, params, train_set)
     booster.best_iteration = 0
     # with no per-iteration host work (no before-iter callbacks, no eval
@@ -423,8 +576,10 @@ def train(params: Dict[str, Any], train_set: Dataset,
     if inner is not None:
         inner.allow_batch = (not registry.has_pre_stage
                              and not plan.active and fobj is None)
-        inner.planned_rounds = num_boost_round
-    last_round = first_round + num_boost_round
+        inner.planned_rounds = last_round - first_round
+        if saver is not None:
+            # fused batches must end exactly on snapshot boundaries
+            inner.snapshot_stride = int(cfg0.snapshot_freq)
 
     def env_for(round_no: int, evals) -> callback.CallbackEnv:
         return callback.CallbackEnv(
@@ -433,7 +588,12 @@ def train(params: Dict[str, Any], train_set: Dataset,
             evaluation_result_list=evals)
 
     final_evals: List = []
+    fault_plan = resilience_faults.active()
     for round_no in range(first_round, last_round):
+        if fault_plan is not None:
+            # deterministic preemption: raises TrainingKilled before this
+            # iteration trains (checkpoints up to here are on disk)
+            fault_plan.check_kill(round_no)
         registry.fire_pre(env_for(round_no, None))
         booster.update(fobj=fobj)
         final_evals = plan.evaluate(booster, feval) if plan.active else []

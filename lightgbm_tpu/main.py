@@ -94,53 +94,25 @@ class Application:
 
     # ------------------------------------------------------------------
     def train_distributed(self):
-        """num_machines > 1: Network::Init -> per-rank row shard ->
-        distributed binning -> sharded training over the global mesh
-        (application.cpp:164-210; see parallel/multihost.py)."""
+        """num_machines > 1: delegate to the engine's distributed path
+        (engine._train_distributed via engine.train) so the CLI rides the
+        same sharding, collective-retry, and checkpoint/resume wiring as
+        the Python API — file-backed Datasets load + shard inside
+        (engine._distributed_raw handles paths); every rank materializes
+        the full model, rank 0 persists it (application.cpp:164-210)."""
         import jax
         cfg = self.config
-        from .parallel.multihost import (init_network, shard_queries,
-                                         shard_rows, train_multihost)
-        rank = init_network(cfg)
-        world = int(cfg.num_machines)
-        loaded = load_text_file(cfg.data, cfg)
-
-        def _shard(n_rows, group):
-            """(row idx, local group sizes): queries shard whole when the
-            data carries them (.query sidecar / group_column)."""
-            if group is not None:
-                if bool(cfg.pre_partition):
-                    return np.arange(n_rows), np.asarray(group, np.int64)
-                return shard_queries(group, rank, world)
-            return shard_rows(n_rows, rank, world,
-                              bool(cfg.pre_partition)), None
-
-        idx, glocal = _shard(loaded.X.shape[0], loaded.group)
-        Xv = yv = gvalid = None
-        if cfg.valid:
-            # each rank evaluates its shard of the first valid set; metric
-            # values aggregate count-weighted across ranks (SURVEY §2.6
-            # pre-partitioned parallel eval)
-            vloaded = load_text_file(cfg.valid[0], cfg)
-            vidx, gvalid = _shard(vloaded.X.shape[0], vloaded.group)
-            Xv, yv = vloaded.X[vidx], vloaded.label[vidx]
-        wl = loaded.weight[idx] if loaded.weight is not None else None
-        trees, mappers, ds, _score = train_multihost(
-            cfg, loaded.X[idx], loaded.label[idx],
-            num_rounds=int(cfg.num_iterations),
-            weight_local=wl, X_valid=Xv, y_valid=yv,
-            group_local=glocal, group_valid=gvalid)
+        params = cfg.to_dict()
+        train_set = Dataset(cfg.data, params=params)
+        valid_sets = [Dataset(v, params=params) for v in cfg.valid]
+        booster = engine_train(
+            params, train_set,
+            num_boost_round=cfg.num_iterations,
+            valid_sets=valid_sets or None,
+            early_stopping_rounds=(cfg.early_stopping_round or None),
+            verbose_eval=True)
         if jax.process_index() == 0:
-            from .boosting.gbdt import GBDT
-            from .objectives import create_objective
-            booster = GBDT()
-            obj = create_objective(cfg.objective, cfg)
-            obj.init(ds.metadata, ds.num_data)
-            booster.init(cfg, ds, obj)
-            booster.models = trees
-            booster.iter = len(trees)
-            with open(cfg.output_model, "w") as f:
-                f.write(booster.save_model_to_string())
+            booster.save_model(cfg.output_model)
             Log.info("Finished distributed training; model saved to %s"
                      % cfg.output_model)
 

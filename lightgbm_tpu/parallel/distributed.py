@@ -26,6 +26,7 @@ from typing import Callable, List, Optional, Sequence
 import numpy as np
 
 from ..data.bin_mapper import BinMapper, BinType, kZeroThreshold
+from ..resilience import retry as resilience_retry
 from ..telemetry import events as telemetry
 from ..utils.log import Log
 
@@ -102,17 +103,22 @@ def _feature_slice(rank: int, world: int, num_features: int):
 def _default_allgather(payload: bytes) -> List[bytes]:
     """Host allgather of variable-length byte blobs via
     jax.experimental.multihost_utils (runs over the JAX runtime's DCN
-    channel — the Network::Allgather analog)."""
+    channel — the Network::Allgather analog). Both rounds run under the
+    resilience retry guard: a gone peer raises a bounded-retry
+    LightGBMError instead of hanging the binning phase forever."""
     import jax
     from jax.experimental import multihost_utils
 
     arr = np.frombuffer(payload, dtype=np.uint8)
-    sizes = multihost_utils.process_allgather(
+    sizes = resilience_retry.guard(
+        "allgather:binning_sizes", multihost_utils.process_allgather,
         np.asarray([arr.size], np.int64))
     cap = int(sizes.max())
     padded = np.zeros(cap, np.uint8)
     padded[:arr.size] = arr
-    gathered = multihost_utils.process_allgather(padded)
+    gathered = resilience_retry.guard(
+        "allgather:binning_mappers", multihost_utils.process_allgather,
+        padded)
     gathered = np.asarray(gathered).reshape(jax.process_count(), cap)
     return [gathered[r, :int(sizes.reshape(-1)[r])].tobytes()
             for r in range(jax.process_count())]

@@ -23,6 +23,14 @@ All three reference strategies are real here:
     (PV-tree; voting_parallel_tree_learner.cpp:153-344) — the
     communication-volume compression that matters once the mesh axis
     crosses DCN.
+
+Fault scope (resilience/): the in-program mesh collectives here
+(psum/all_gather inside the jitted growers) fail via XLA's distributed
+runtime — an abort with an XlaRuntimeError that the retry guard's caller
+surfaces — while the HOST-side DCN collectives around them (binning
+allgather, metric allreduce, resume agreement) run under
+``resilience.retry.guard`` with a deadline and bounded retries, so a gone
+peer never hangs the launch loop.
 """
 from __future__ import annotations
 

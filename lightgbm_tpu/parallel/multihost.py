@@ -58,12 +58,23 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..config import Config
 from ..models.tree import Tree
+from ..resilience import faults as resilience_faults
+from ..resilience import retry as resilience_retry
 from ..telemetry import events as telemetry
 from ..utils.log import Log
 from .distributed import distributed_bin_mappers, init_network
 from .learners import AXIS, _tree_arrays_spec, shard_map_compat
 
 __all__ = ["init_network", "shard_rows", "train_multihost"]
+
+
+def _pallgather(name: str, arr: np.ndarray) -> np.ndarray:
+    """process_allgather under the resilience retry guard: DCN-side host
+    collectives get a deadline + bounded retries instead of hanging
+    forever on a gone peer (resilience/retry.py)."""
+    from jax.experimental import multihost_utils
+    return resilience_retry.guard(name, multihost_utils.process_allgather,
+                                  arr)
 
 
 def shard_rows(n_rows: int, rank: int, world: int,
@@ -186,11 +197,12 @@ def _allreduce_mean_host(values: np.ndarray, weights: np.ndarray):
     """Count-weighted mean across processes via host allgather (used for
     metric aggregation over unequal validation shards; zero-weight ranks
     contribute nothing but still participate in the collective)."""
-    from jax.experimental import multihost_utils
-    v = multihost_utils.process_allgather(
+    v = _pallgather(
+        "allreduce:metrics_values",
         np.asarray(values, np.float64).reshape(1, -1)).reshape(
         jax.process_count(), -1)
-    w = multihost_utils.process_allgather(
+    w = _pallgather(
+        "allreduce:metrics_weights",
         np.asarray(weights, np.float64).reshape(1, -1)).reshape(
         jax.process_count(), -1)
     tot = np.sum(w, axis=0)
@@ -202,11 +214,12 @@ class _EarlyStop:
     gbdt.cpp:440-543): stop when the first metric fails to improve for
     early_stopping_round consecutive evaluations."""
 
-    def __init__(self, rounds: int, higher_better: bool):
+    def __init__(self, rounds: int, higher_better: bool,
+                 start_iteration: int = 0):
         self.rounds = rounds
         self.higher = higher_better
         self.best = -np.inf if higher_better else np.inf
-        self.best_iter = 0
+        self.best_iter = start_iteration
 
     def update(self, value: float, it: int) -> bool:
         """Patience counts ITERATIONS (not evaluations): evaluations here
@@ -228,9 +241,20 @@ def train_multihost(config: Config, X_local: np.ndarray,
                     group_local: Optional[np.ndarray] = None,
                     group_valid: Optional[np.ndarray] = None,
                     init_score_local: Optional[np.ndarray] = None,
-                    init_score_valid: Optional[np.ndarray] = None):
+                    init_score_valid: Optional[np.ndarray] = None,
+                    start_iteration: int = 0,
+                    snapshot_hook=None,
+                    es_resume=None, result_info=None):
     """Distributed training entry; returns the (identical-on-every-rank)
     list of host Trees plus the shared BinMappers for model IO.
+
+    start_iteration: checkpoint resume offset — the bagging/GOSS hash
+    windows, tree key stream, and early-stopping patience all run at
+    ABSOLUTE iteration indices so a resumed run draws the identical
+    randomness the uninterrupted run would have (`num_rounds` counts the
+    NEW rounds to train). snapshot_hook(it_done, trees, ds) fires at
+    every snapshot_freq boundary (engine._train_distributed writes the
+    per-rank model checkpoint there).
 
     X_valid/y_valid: this rank's shard of a validation set; with
     valid data and early_stopping_round > 0 the loop stops when the
@@ -242,6 +266,13 @@ def train_multihost(config: Config, X_local: np.ndarray,
     per-query lambda computation stays device-local
     (GetGradientsForOneQuery, rank_objective.hpp:139 — the reference's
     pre-partitioned ranking contract).
+
+    es_resume: {"best": float, "best_iter": int} from a resumed
+    checkpoint — the early-stopping patience clock and rollback point
+    survive the resume. result_info (a caller-supplied dict) reports
+    "early_stop_best_iter"/"trees_per_iteration" when a resumed run's
+    rollback may land inside the restored model, so the caller truncates
+    the COMBINED tree list (offsetting any original init model itself).
     """
     from ..data.dataset import BinnedDataset
     from ..objectives import create_objective
@@ -309,9 +340,19 @@ def train_multihost(config: Config, X_local: np.ndarray,
     mesh = _global_mesh()
     S = mesh.devices.size
     learner = SerialTreeLearner(config, ds)
+    if int(start_iteration) > 0:
+        # resume: the per-tree key stream folds the tree counter into the
+        # base key; continue it where the snapshotted run left off. The
+        # feature-fraction RNG is sequential (one sample() per tree when
+        # fraction < 1) — fast-forward it to the resume point so resumed
+        # column masks match the uninterrupted run's
+        learner._tree_counter = int(start_iteration)
+        if learner.col_sampler.fraction < 1.0:
+            for _ in range(int(start_iteration) * K):
+                learner.col_sampler.sample()
     n_local = ds.num_data
-    counts = jax.experimental.multihost_utils.process_allgather(
-        np.asarray([n_local], np.int64)).reshape(-1)
+    counts = _pallgather("allgather:row_counts",
+                         np.asarray([n_local], np.int64)).reshape(-1)
     local_dev = S // jax.process_count()
     # GLOBAL row ids drive the bagging hash — every rank draws the same
     # per-row bernoulli without communication (gbdt.cpp:210-244 semantics).
@@ -333,7 +374,8 @@ def train_multihost(config: Config, X_local: np.ndarray,
                     for d in range(local_dev)]
         blk_nq = [dev_cuts[d + 1] - dev_cuts[d] for d in range(local_dev)]
         P_l = int(np.diff(qb).max()) if len(qb) > 1 else 1
-        geom = jax.experimental.multihost_utils.process_allgather(
+        geom = _pallgather(
+            "allgather:ranking_geometry",
             np.asarray([max(blk_rows), max(blk_nq), P_l],
                        np.int64)).reshape(-1, 3)
         B, NQB, Pmax = (int(geom[:, 0].max()), int(geom[:, 1].max()),
@@ -541,12 +583,12 @@ def train_multihost(config: Config, X_local: np.ndarray,
     if world > 1:
         # Network::GlobalSyncUpByMean (gbdt.cpp:308): UNWEIGHTED mean over
         # machines — reference parity on unequal shards
-        from jax.experimental import multihost_utils
         with telemetry.scope("collective::GlobalSyncUpByMean(DCN)",
                              category="collective"):
             init0s = [float(v) for v in np.mean(
-                multihost_utils.process_allgather(
-                    np.asarray(init0s, np.float64)).reshape(world, -1),
+                _pallgather("allreduce:boost_from_average",
+                            np.asarray(init0s,
+                                       np.float64)).reshape(world, -1),
                 axis=0)]
     init0 = init0s[0]
     n_glob = pad_to * jax.process_count()
@@ -596,8 +638,12 @@ def train_multihost(config: Config, X_local: np.ndarray,
             metrics.append(m)
             Xv = np.ascontiguousarray(X_valid, np.float64)
     es = (_EarlyStop(int(config.early_stopping_round),
-                     metrics[0].factor_to_bigger_better > 0)
+                     metrics[0].factor_to_bigger_better > 0,
+                     start_iteration=int(start_iteration))
           if metrics and int(config.early_stopping_round) > 0 else None)
+    if es is not None and es_resume is not None:
+        es.best = float(es_resume["best"])
+        es.best_iter = int(es_resume["best_iter"])
     vscore = None
     if metrics:
         if init_score_valid is not None:
@@ -617,10 +663,27 @@ def train_multihost(config: Config, X_local: np.ndarray,
     trees: List[Tree] = []
     fu = base_extras.feature_used
     runners = {}
-    it = 0
+    it = int(start_iteration)
+    end_round = it + int(num_rounds)
+    fault_plan = resilience_faults.active()
+    # batch clamping must be IDENTICAL on every rank (the fused scan is
+    # one global-mesh collective program; mismatched k desyncs psum);
+    # only the raise itself is rank-filtered
+    kill_clamp = (fault_plan.kill_iter if fault_plan is not None else None)
+    snap_freq = int(config.snapshot_freq)
     stopped = False
-    while it < num_rounds and not stopped:
-        k = min(8 if metrics else 16, num_rounds - it)
+    while it < end_round and not stopped:
+        if fault_plan is not None:
+            fault_plan.check_kill(it, rank)
+        k = min(8 if metrics else 16, end_round - it)
+        if snapshot_hook is not None and snap_freq > 0:
+            # batches end exactly on snapshot boundaries, so the hook
+            # always sees iteration-k state (and a resumed run re-aligns
+            # to the identical batch shapes)
+            k = min(k, snap_freq - (it % snap_freq))
+        if kill_clamp is not None and kill_clamp > it:
+            # clamp so the injected kill lands on an iteration boundary
+            k = min(k, kill_clamp - it)
         if k not in runners:
             runners[k] = _batch(k)
         fmasks = jnp.asarray(
@@ -693,6 +756,30 @@ def train_multihost(config: Config, X_local: np.ndarray,
             if es is not None and es.update(agg, it):
                 Log.info("Early stopping at iteration %d, best %g at %d"
                          % (it, es.best, es.best_iter))
-                trees = trees[:max(es.best_iter, 1) * K]
+                # the local tree list starts at start_iteration; truncate
+                # relative to it. A RESUMED patience clock may roll back
+                # into the restored model itself — report the combined
+                # truncation to the caller (which holds the init trees)
+                if es_resume is not None:
+                    trees = trees[:max(es.best_iter
+                                       - int(start_iteration), 0) * K]
+                    if result_info is not None:
+                        # ROUND-space iterations (excludes any original
+                        # init model); the caller adds its init offset
+                        result_info["early_stop_best_iter"] = \
+                            max(es.best_iter, 1)
+                        result_info["trees_per_iteration"] = K
+                else:
+                    trees = trees[:max(es.best_iter
+                                       - int(start_iteration), 1) * K]
                 stopped = True
+        if (snapshot_hook is not None and snap_freq > 0 and not stopped
+                and it % snap_freq == 0):
+            # after the metrics/early-stop check: a stopping boundary is
+            # never snapshotted past its truncation point; the patience
+            # state rides along so a resume keeps the same clock
+            # es.best/best_iter are host scalars already (no device sync)
+            es_state = ({"best": es.best, "best_iter": es.best_iter}
+                        if es is not None else None)
+            snapshot_hook(it, trees, ds, es_state)
     return trees, mappers, ds, score

@@ -1,0 +1,30 @@
+"""Resilience subsystem: survive preemptions and DCN faults.
+
+Four pieces (see docs/COMPONENTS.md "Resilience"):
+
+  * :mod:`checkpoint` — atomic (tmp + fsync + rename), CRC-checksummed
+    full-training-state snapshots every ``snapshot_freq`` iterations into
+    ``checkpoint_dir`` (``checkpoint_keep`` prunes);
+  * :mod:`restore` — auto-resume that validates checksums + dataset
+    fingerprint + config hash, falls back over corrupt snapshots, and
+    continues training bit-exactly;
+  * :mod:`retry` — timeout/backoff/jitter guard for the host-side DCN
+    collectives (bounded retries; a gone peer becomes a clean
+    ``LightGBMError``, not a hang);
+  * :mod:`faults` — deterministic ``tpu_fault_plan=`` injection
+    (``kill@iter=`` / ``drop_collective@round=`` /
+    ``corrupt_checkpoint@n=``) so all of the above is tier-1-testable.
+"""
+from .checkpoint import (CheckpointError, CheckpointWriter, TrainingSaver,
+                         atomic_write_bytes, atomic_write_text, config_hash,
+                         dataset_fingerprint)
+from .faults import FaultPlan, TrainingKilled
+from .restore import find_restorable, resume_booster
+from .retry import RetryPolicy, guard
+
+__all__ = [
+    "CheckpointError", "CheckpointWriter", "TrainingSaver",
+    "atomic_write_bytes", "atomic_write_text", "config_hash",
+    "dataset_fingerprint", "FaultPlan", "TrainingKilled",
+    "find_restorable", "resume_booster", "RetryPolicy", "guard",
+]

@@ -1,0 +1,363 @@
+"""Atomic, checksummed training-state checkpoints.
+
+The reference exposes ``snapshot_freq`` (config.h Config: a model snapshot
+every k iterations); on a TPU pod a model-only snapshot is not enough to
+survive a preemption without losing work — continuing bit-exactly needs
+the full training state at an iteration boundary: the model text, every
+rank's exact f64 score buffer, the bagging mask/weights, each host RNG
+stream (bagging / GOSS sampling / DART drops / feature fraction /
+rank_xendcg's LCG planes), and the cross-iteration learner state
+(tree-counter key stream, CEGB feature bitsets). ``GBDT.
+capture_training_state`` gathers all of it; this module owns the
+container format and the atomic IO.
+
+Container (one file per snapshot, ``ckpt_<iter>.r<rank>.lgc``):
+
+    magic  b"LGBMTPUCKPT1\\n"
+    u64    little-endian JSON-meta length
+    meta   JSON: format, kind (train|model), iteration, rank,
+           config_hash, data_fingerprint, payload_crc, payload_len
+    blob   npz payload (named numpy arrays incl. the model text and a
+           JSON state blob), CRC32-checked against the meta
+
+Writes are atomic and durable: serialize to ``.<name>.tmp`` in the target
+directory, flush + fsync, ``os.replace`` onto the final name, fsync the
+directory. A kill at any point leaves either the previous snapshot set or
+the complete new one — never a torn file (JG008 lints this invariant for
+everything under resilience/). ``checkpoint_keep`` bounds disk usage by
+pruning the oldest snapshots after each write.
+"""
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+import re
+import struct
+import zlib
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..telemetry import events as telemetry
+from ..utils.log import LightGBMError, Log
+from . import faults
+
+MAGIC = b"LGBMTPUCKPT1\n"
+FORMAT = 1
+_NAME_RE = re.compile(r"^ckpt_(\d+)\.r(\d+)\.lgc$")
+
+# params that must not invalidate a resume: where the run writes its
+# checkpoints, how long it runs, what telemetry/faults ride along, and the
+# IO/network addressing — none of them shape the training computation
+_VOLATILE_PARAMS = frozenset({
+    "checkpoint_dir", "checkpoint_keep", "snapshot_freq", "num_iterations",
+    "tpu_fault_plan", "tpu_telemetry", "telemetry_out", "verbosity",
+    "output_model", "input_model", "output_result", "config", "task",
+    "data", "valid", "machines", "machine_list_filename",
+    "local_listen_port", "time_out", "tpu_collective_timeout",
+    "tpu_collective_retries", "tpu_collective_backoff",
+})
+
+
+class CheckpointError(LightGBMError):
+    """A checkpoint file failed validation (magic / CRC / truncation)."""
+
+
+# ---------------------------------------------------------------------------
+# identity: config hash + dataset fingerprint
+# ---------------------------------------------------------------------------
+
+def config_hash(config) -> str:
+    """Stable digest of the training-shaping parameters (volatile keys —
+    checkpoint/telemetry/IO/network addressing — excluded so a resume
+    with a longer num_iterations or a different fault plan matches)."""
+    d = config.to_dict() if hasattr(config, "to_dict") else dict(config)
+    items = {k: v for k, v in d.items()
+             if k not in _VOLATILE_PARAMS and not callable(v)}
+    blob = json.dumps(items, sort_keys=True, default=str).encode()
+    return hashlib.sha256(blob).hexdigest()[:16]
+
+
+def _mix(h: int, arr) -> int:
+    a = np.ascontiguousarray(np.asarray(arr))
+    h = zlib.crc32(str((a.shape, str(a.dtype))).encode(), h)
+    flat = a.reshape(-1)
+    cap = 65536
+    h = zlib.crc32(flat[:cap].tobytes(), h)
+    if flat.size > cap:
+        h = zlib.crc32(flat[-cap:].tobytes(), h)
+    return h
+
+
+def array_fingerprint(*arrays) -> str:
+    """CRC fingerprint of (samples of) the given arrays — O(1) in the row
+    count: shape + dtype + head/tail slices of each."""
+    h = zlib.crc32(b"lgbtpu-fp")
+    for arr in arrays:
+        if arr is None:
+            h = zlib.crc32(b"none", h)
+        else:
+            h = _mix(h, arr)
+    return "%08x" % (h & 0xFFFFFFFF)
+
+
+def dataset_fingerprint(inner) -> str:
+    """Fingerprint of a constructed BinnedDataset: the binned storage (or
+    the ELL pair arrays for multi-value layouts) plus label/weight/query
+    metadata — a resumed run must be feeding the identical rows."""
+    parts = []
+    binned = getattr(inner, "binned", None)
+    if binned is not None:
+        parts.append(binned)
+    else:
+        parts.append(getattr(inner, "ell_grp", None))
+        parts.append(getattr(inner, "ell_bin", None))
+    md = getattr(inner, "metadata", None)
+    parts.append(getattr(md, "label", None) if md is not None else None)
+    parts.append(getattr(md, "weight", None) if md is not None else None)
+    parts.append(getattr(md, "query_boundaries", None)
+                 if md is not None else None)
+    parts.append(np.asarray([int(getattr(inner, "num_data", 0)),
+                             int(getattr(inner, "num_total_features", 0))]))
+    return array_fingerprint(*parts)
+
+
+# ---------------------------------------------------------------------------
+# atomic IO
+# ---------------------------------------------------------------------------
+
+def _fsync_dir(directory: str) -> None:
+    try:
+        fd = os.open(directory, os.O_RDONLY)
+    except OSError:  # pragma: no cover - platforms without dir fds
+        return
+    try:
+        os.fsync(fd)
+    except OSError:  # pragma: no cover
+        pass
+    finally:
+        os.close(fd)
+
+
+def atomic_write_bytes(path: str, data: bytes) -> None:
+    """tmp + flush + fsync + rename: a crash mid-write never leaves a
+    torn file at `path` (the invariant JG008 lints for)."""
+    directory = os.path.dirname(os.path.abspath(path))
+    tmp_path = os.path.join(directory,
+                            ".%s.tmp" % os.path.basename(path))
+    with open(tmp_path, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp_path, path)
+    _fsync_dir(directory)
+
+
+def atomic_write_text(path: str, text: str) -> None:
+    atomic_write_bytes(path, text.encode())
+
+
+# ---------------------------------------------------------------------------
+# container
+# ---------------------------------------------------------------------------
+
+def _text_to_arr(text: str) -> np.ndarray:
+    return np.frombuffer(text.encode(), dtype=np.uint8)
+
+
+def _arr_to_text(arr: np.ndarray) -> str:
+    return arr.tobytes().decode()
+
+
+def pack_checkpoint(iteration: int, arrays: Dict[str, np.ndarray],
+                    meta: Dict[str, object]) -> bytes:
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    payload = buf.getvalue()
+    full_meta = dict(meta)
+    full_meta.update({
+        "format": FORMAT,
+        "iteration": int(iteration),
+        "payload_len": len(payload),
+        "payload_crc": zlib.crc32(payload) & 0xFFFFFFFF,
+    })
+    meta_blob = json.dumps(full_meta, sort_keys=True).encode()
+    return (MAGIC + struct.pack("<Q", len(meta_blob)) + meta_blob + payload)
+
+
+def load_checkpoint(path: str) -> Tuple[Dict[str, object],
+                                        Dict[str, np.ndarray]]:
+    """Read + validate one checkpoint file; CheckpointError on any
+    corruption (bad magic, truncation, CRC mismatch, unparseable npz)."""
+    try:
+        with open(path, "rb") as f:
+            blob = f.read()
+    except OSError as exc:
+        raise CheckpointError("cannot read checkpoint %s: %s" % (path, exc))
+    if not blob.startswith(MAGIC):
+        raise CheckpointError("bad magic in checkpoint %s" % path)
+    off = len(MAGIC)
+    if len(blob) < off + 8:
+        raise CheckpointError("truncated checkpoint %s" % path)
+    (meta_len,) = struct.unpack("<Q", blob[off:off + 8])
+    off += 8
+    if len(blob) < off + meta_len:
+        raise CheckpointError("truncated checkpoint meta in %s" % path)
+    try:
+        meta = json.loads(blob[off:off + meta_len].decode())
+    except (ValueError, UnicodeDecodeError):
+        raise CheckpointError("unparseable checkpoint meta in %s" % path)
+    payload = blob[off + meta_len:]
+    if len(payload) != int(meta.get("payload_len", -1)):
+        raise CheckpointError("payload length mismatch in %s" % path)
+    if (zlib.crc32(payload) & 0xFFFFFFFF) != int(meta.get("payload_crc",
+                                                          -1)):
+        raise CheckpointError("payload CRC mismatch in %s" % path)
+    try:
+        with np.load(io.BytesIO(payload)) as npz:
+            arrays = {k: npz[k] for k in npz.files}
+    except (ValueError, OSError, zlib.error):
+        raise CheckpointError("unparseable checkpoint payload in %s" % path)
+    return meta, arrays
+
+
+def checkpoint_name(iteration: int, rank: int = 0) -> str:
+    return "ckpt_%08d.r%d.lgc" % (int(iteration), int(rank))
+
+
+def list_checkpoints(directory: str, rank: int = 0) -> List[Tuple[int, str]]:
+    """(iteration, path) pairs for this rank, iteration-ascending."""
+    out: List[Tuple[int, str]] = []
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return out
+    for name in names:
+        m = _NAME_RE.match(name)
+        if m and int(m.group(2)) == int(rank):
+            out.append((int(m.group(1)), os.path.join(directory, name)))
+    out.sort()
+    return out
+
+
+def _corrupt_in_place(path: str) -> None:
+    """corrupt_checkpoint fault: deterministically flip payload bytes of a
+    just-written snapshot so restore validation must reject it."""
+    with open(path, "r+b") as f:  # graftlint: disable=JG008
+        f.seek(-16, os.SEEK_END)
+        tail = f.read(16)
+        f.seek(-16, os.SEEK_END)
+        f.write(bytes(b ^ 0xFF for b in tail))
+    telemetry.count("faults::injected", 1, category="faults")
+    Log.warning("fault injection: corrupted checkpoint %s" % path)
+
+
+# ---------------------------------------------------------------------------
+# writer
+# ---------------------------------------------------------------------------
+
+class CheckpointWriter:
+    """Owns one run's snapshot stream into ``checkpoint_dir``.
+
+    Knows the run identity (config hash; dataset fingerprint computed on
+    first write), applies ``checkpoint_keep`` pruning, lands write
+    overhead on the ``checkpoint::write`` telemetry span and the
+    ``checkpoint::write``/``checkpoint::bytes`` counters, and honors the
+    ``corrupt_checkpoint`` fault directive.
+    """
+
+    def __init__(self, directory: str, keep: int, cfg_hash: str,
+                 rank: int = 0, fingerprint: Optional[str] = None):
+        self.directory = str(directory)
+        self.keep = max(int(keep), 1)
+        self.cfg_hash = cfg_hash
+        self.rank = int(rank)
+        self.fingerprint = fingerprint
+        self._writes = 0
+        os.makedirs(self.directory, exist_ok=True)
+
+    def write_training_state(self, inner, iteration: int,
+                             extra_state: Optional[Dict] = None) -> str:
+        """Snapshot a live GBDT at an iteration boundary (kind=train).
+
+        The pipeline flush (capture's leading _materialize_pending) is
+        device work the run owes anyway; it happens outside the write
+        span so checkpoint::write measures IO cost only."""
+        arrays, state = inner.capture_training_state()
+        if extra_state:
+            state.update(extra_state)
+        if self.fingerprint is None:
+            self.fingerprint = dataset_fingerprint(inner.train_data)
+        arrays["state_json"] = _text_to_arr(json.dumps(state))
+        return self._write(iteration, arrays, kind="train")
+
+    def write_model_text(self, model_text: str, iteration: int,
+                         extra_meta: Optional[Dict] = None) -> str:
+        """Model-only snapshot (kind=model): the distributed path, where
+        each rank's score shard is reconstructed on resume from the model
+        via the init-score seeding machinery. extra_meta carries small
+        JSON-able host state (the early-stopping patience clock)."""
+        return self._write(iteration, {"model_text": _text_to_arr(
+            model_text)}, kind="model", extra_meta=extra_meta)
+
+    def _write(self, iteration: int, arrays: Dict[str, np.ndarray],
+               kind: str, extra_meta: Optional[Dict] = None) -> str:
+        with telemetry.scope("checkpoint::write", category="io"):
+            meta = {
+                "kind": kind,
+                "rank": self.rank,
+                "config_hash": self.cfg_hash,
+                "data_fingerprint": self.fingerprint or "",
+            }
+            if extra_meta:
+                meta.update(extra_meta)
+            blob = pack_checkpoint(iteration, arrays, meta)
+            path = os.path.join(self.directory,
+                                checkpoint_name(iteration, self.rank))
+            atomic_write_bytes(path, blob)
+        self._writes += 1
+        telemetry.count("checkpoint::write", 1, category="checkpoint")
+        telemetry.count("checkpoint::bytes", len(blob),
+                        category="checkpoint")
+        plan = faults.active()
+        if plan is not None and plan.checkpoint_should_corrupt(self._writes):
+            _corrupt_in_place(path)
+        self._prune()
+        Log.debug("checkpoint written: %s (%d bytes)" % (path, len(blob)))
+        return path
+
+    def _prune(self) -> None:
+        entries = list_checkpoints(self.directory, self.rank)
+        for _, path in entries[:-self.keep]:
+            try:
+                os.remove(path)
+            except OSError:  # pragma: no cover - concurrent prune
+                pass
+
+
+class TrainingSaver:
+    """Post-iteration callback: write a snapshot every ``snapshot_freq``
+    iterations (fires after the early-stopping callback, so a stopping
+    round is never snapshotted past its truncation point).
+
+    ``extra_state_fn`` (optional, -> JSON-able dict) lets the engine fold
+    host-side callback state into the snapshot — the early-stopping best
+    trackers ride it, so a resumed run keeps the same patience clock.
+    """
+
+    def __init__(self, writer: CheckpointWriter, freq: int,
+                 extra_state_fn=None):
+        self.order = 40
+        self.before_iteration = False
+        self.writer = writer
+        self.freq = max(int(freq), 1)
+        self.extra_state_fn = extra_state_fn
+
+    def __call__(self, env) -> None:
+        done = env.iteration + 1
+        if done % self.freq == 0:
+            extra = self.extra_state_fn() if self.extra_state_fn else None
+            self.writer.write_training_state(env.model._booster, done,
+                                             extra_state=extra)

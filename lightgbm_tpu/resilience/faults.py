@@ -1,0 +1,180 @@
+"""Deterministic fault injection for the resilience subsystem.
+
+TPU pods preempt and DCN links flake; the kill/resume/corruption paths in
+checkpoint.py / restore.py / retry.py must be exercised in tier-1 tests,
+not discovered in production. A ``tpu_fault_plan=`` config string describes
+exactly which faults to inject and when — the plan is a pure function of
+the string (no RNG, no clock), so a failing injection test replays
+identically.
+
+Grammar (documented in README "Checkpointing & fault tolerance"):
+
+    plan      := directive ("," directive)*
+    directive := action "@" key "=" int (";" key "=" int)*
+
+    kill@iter=K[;rank=R]          raise TrainingKilled before iteration K
+                                  (0-based: K iterations have completed)
+                                  trains; rank omitted = every rank
+    drop_collective@round=N[;times=T]
+                                  the N-th guarded DCN collective call
+                                  since the run started fails (the round
+                                  counter resets at each train entry);
+                                  T attempts fail
+                                  (default -1 = all attempts, so the
+                                  bounded retry exhausts into a clean
+                                  LightGBMError)
+    corrupt_checkpoint@n=N        the N-th checkpoint this process writes
+                                  is corrupted in place after the atomic
+                                  rename (restore must fall back to the
+                                  previous snapshot)
+
+Like telemetry, the active plan is process-global and config-driven:
+``configure_from_config`` installs the plan for the run that asked for it
+and clears it when a later run configures with an empty plan string.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..telemetry import events as telemetry
+from ..utils.log import LightGBMError, Log
+
+
+class TrainingKilled(LightGBMError):
+    """Raised by a ``kill@iter=K`` fault: simulates a preempted worker."""
+
+
+class FaultInjected(ConnectionError):
+    """Raised in place of a collective's result by ``drop_collective``."""
+
+
+def _parse_int_kv(pairs: List[str], directive: str) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for pair in pairs:
+        if "=" not in pair:
+            raise LightGBMError(
+                "tpu_fault_plan: expected key=int in %r" % directive)
+        k, v = pair.split("=", 1)
+        try:
+            out[k.strip()] = int(v)
+        except ValueError:
+            raise LightGBMError(
+                "tpu_fault_plan: non-integer value in %r" % directive)
+    return out
+
+
+class FaultPlan:
+    """Parsed ``tpu_fault_plan`` string; see the module grammar."""
+
+    def __init__(self, text: str):
+        self.text = text
+        self.kill_iter: Optional[int] = None
+        self.kill_rank: Optional[int] = None
+        self.drop_round: Optional[int] = None
+        self.drop_times: int = -1
+        self._drop_left: int = -1
+        self.corrupt_n: Optional[int] = None
+        for raw in text.replace(" ", ",").split(","):
+            raw = raw.strip()
+            if not raw:
+                continue
+            if "@" not in raw:
+                raise LightGBMError(
+                    "tpu_fault_plan: directive %r has no '@'" % raw)
+            action, _, args = raw.partition("@")
+            kv = _parse_int_kv(args.split(";"), raw)
+            if action == "kill":
+                if "iter" not in kv:
+                    raise LightGBMError("tpu_fault_plan: kill needs iter=")
+                if self.kill_iter is not None:
+                    raise LightGBMError(
+                        "tpu_fault_plan: duplicate kill directive (one "
+                        "per plan; last-wins would be silent)")
+                self.kill_iter = kv["iter"]
+                self.kill_rank = kv.get("rank")
+            elif action == "drop_collective":
+                if "round" not in kv:
+                    raise LightGBMError(
+                        "tpu_fault_plan: drop_collective needs round=")
+                if self.drop_round is not None:
+                    raise LightGBMError(
+                        "tpu_fault_plan: duplicate drop_collective "
+                        "directive (one per plan)")
+                self.drop_round = kv["round"]
+                self.drop_times = kv.get("times", -1)
+                self._drop_left = self.drop_times
+            elif action == "corrupt_checkpoint":
+                if "n" not in kv:
+                    raise LightGBMError(
+                        "tpu_fault_plan: corrupt_checkpoint needs n=")
+                if self.corrupt_n is not None:
+                    raise LightGBMError(
+                        "tpu_fault_plan: duplicate corrupt_checkpoint "
+                        "directive (one per plan)")
+                self.corrupt_n = kv["n"]
+            else:
+                raise LightGBMError(
+                    "tpu_fault_plan: unknown action %r (kill / "
+                    "drop_collective / corrupt_checkpoint)" % action)
+
+    # -- kill ----------------------------------------------------------
+    def kill_point(self, rank: int = 0) -> Optional[int]:
+        """Iteration this rank dies at, or None (used to clamp fused
+        batches so the kill lands exactly on an iteration boundary)."""
+        if self.kill_iter is None:
+            return None
+        if self.kill_rank is not None and self.kill_rank != rank:
+            return None
+        return self.kill_iter
+
+    def check_kill(self, iteration: int, rank: int = 0) -> None:
+        """Raise TrainingKilled before `iteration` (0-based) trains."""
+        kp = self.kill_point(rank)
+        if kp is not None and iteration >= kp:
+            telemetry.count("faults::injected", 1, category="faults")
+            raise TrainingKilled(
+                "fault injection: worker (rank %d) killed before iteration "
+                "%d (tpu_fault_plan=%s)" % (rank, iteration, self.text))
+
+    # -- collectives ---------------------------------------------------
+    def collective_should_drop(self, round_idx: int) -> bool:
+        """True when the `round_idx`-th (1-based) guarded collective call
+        should fail this attempt. ``times=T`` fails the first T attempts
+        (the retry then recovers); the default fails every attempt."""
+        if self.drop_round is None or round_idx != self.drop_round:
+            return False
+        if self.drop_times < 0:
+            return True
+        if self._drop_left > 0:
+            self._drop_left -= 1
+            return True
+        return False
+
+    # -- checkpoints ---------------------------------------------------
+    def checkpoint_should_corrupt(self, write_idx: int) -> bool:
+        """True when the `write_idx`-th (1-based) checkpoint write of this
+        process should be corrupted after its atomic rename."""
+        return self.corrupt_n is not None and write_idx == self.corrupt_n
+
+
+_PLAN: Optional[FaultPlan] = None
+
+
+def configure_from_config(config) -> None:
+    """Install (or clear) the process-global plan from ``tpu_fault_plan=``."""
+    global _PLAN
+    text = str(getattr(config, "tpu_fault_plan", "") or "")
+    if not text:
+        _PLAN = None
+        return
+    _PLAN = FaultPlan(text)
+    Log.warning("fault injection active: tpu_fault_plan=%s" % text)
+
+
+def active() -> Optional[FaultPlan]:
+    return _PLAN
+
+
+def reset() -> None:
+    global _PLAN
+    _PLAN = None

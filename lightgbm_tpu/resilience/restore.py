@@ -1,0 +1,158 @@
+"""Auto-resume: scan ``checkpoint_dir``, validate, continue bit-exactly.
+
+Restore policy, newest snapshot first:
+
+  * corruption (bad magic / CRC / truncation) -> warn, count
+    ``checkpoint::restore_fallback``, fall back to the previous snapshot;
+  * config-hash or dataset-fingerprint mismatch -> the directory belongs
+    to a DIFFERENT run; warn loudly and start fresh (resuming someone
+    else's state bit-exactly would be silently wrong);
+  * a valid matching snapshot -> restore the full training state into the
+    freshly constructed booster (``GBDT.restore_training_state``) and
+    continue from its iteration. The continuation is bit-exact versus an
+    uninterrupted run (tests/test_resilience.py pins byte-identical final
+    model files).
+
+The distributed path stores model-only snapshots per rank; resume there
+re-enters the init-model score-seeding machinery (engine.
+_train_distributed), after the ranks agree — via a retry-guarded
+allgather — on the newest iteration every rank can restore.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from ..telemetry import events as telemetry
+from ..utils.log import Log
+from .checkpoint import (CheckpointError, array_fingerprint, config_hash,
+                         dataset_fingerprint, list_checkpoints,
+                         load_checkpoint)
+
+
+def _scan(directory: str, rank: int, want_cfg: str, want_fp: str,
+          kind: str) -> Optional[Tuple[Dict, Dict]]:
+    """Newest valid matching (meta, arrays), falling back over corrupt
+    snapshots; None when nothing (or only a mismatched run) is there."""
+    for iteration, path in reversed(list_checkpoints(directory, rank)):
+        try:
+            meta, arrays = load_checkpoint(path)
+        except CheckpointError as exc:
+            telemetry.count("checkpoint::restore_fallback", 1,
+                            category="checkpoint")
+            Log.warning("checkpoint %s rejected (%s); falling back to the "
+                        "previous snapshot" % (path, exc))
+            continue
+        if meta.get("kind") != kind:
+            continue
+        if meta.get("config_hash") != want_cfg:
+            Log.warning("checkpoint_dir %s holds snapshots of a different "
+                        "config (hash %s != %s); starting fresh"
+                        % (directory, meta.get("config_hash"), want_cfg))
+            return None
+        if meta.get("data_fingerprint") != want_fp:
+            Log.warning("checkpoint_dir %s holds snapshots of a different "
+                        "dataset (fingerprint mismatch); starting fresh"
+                        % directory)
+            return None
+        return meta, arrays
+    return None
+
+
+def find_restorable(config, train_inner) -> Optional[Tuple[Dict, Dict]]:
+    """Single-host: newest valid full-state snapshot matching this run's
+    config hash + dataset fingerprint, or None."""
+    directory = str(config.checkpoint_dir)
+    if not directory or not os.path.isdir(directory):
+        return None
+    return _scan(directory, rank=0, want_cfg=config_hash(config),
+                 want_fp=dataset_fingerprint(train_inner), kind="train")
+
+
+def resume_booster(booster, found: Tuple[Dict, Dict]) -> int:
+    """Restore a validated snapshot into a freshly constructed Booster;
+    returns the iteration training continues from."""
+    meta, arrays = found
+    with telemetry.scope("checkpoint::restore", category="io"):
+        state = json.loads(arrays["state_json"].tobytes().decode())
+        booster._booster.restore_training_state(arrays, state)
+    telemetry.count("checkpoint::restore", 1, category="checkpoint")
+    iteration = int(meta["iteration"])
+    Log.info("Resumed training from checkpoint at iteration %d "
+             "(checkpoint_dir scan)" % iteration)
+    return iteration
+
+
+def extra_state(found: Tuple[Dict, Dict], key: str):
+    """A host-callback state blob stored beside the training state (the
+    engine's early-stopping trackers ride here), or None."""
+    state = json.loads(found[1]["state_json"].tobytes().decode())
+    return state.get(key)
+
+
+def find_distributed(config, rank: int,
+                     *shard_arrays) -> Optional[Tuple[int, str, Dict]]:
+    """Distributed resume: (agreed_iteration, model_text, meta) or None.
+
+    Each rank scans its own snapshot stream (shared or per-host
+    checkpoint_dir both work — files carry the rank), then the ranks
+    agree on min(newest restorable iteration) so nobody resumes ahead of
+    a peer whose latest snapshot was corrupt.
+    """
+    directory = str(config.checkpoint_dir)
+    want_cfg = config_hash(config)
+    want_fp = array_fingerprint(*shard_arrays)
+    found = (_scan(directory, rank, want_cfg, want_fp, kind="model")
+             if directory and os.path.isdir(directory) else None)
+    local_best = int(found[0]["iteration"]) if found is not None else 0
+    agreed = local_best
+    if int(config.num_machines) > 1:
+        import jax
+        from jax.experimental import multihost_utils
+
+        from .retry import guard
+        if jax.process_count() > 1:
+            gathered = guard(
+                "allgather:checkpoint_agree",
+                multihost_utils.process_allgather,
+                np.asarray([local_best], np.int64))
+            agreed = int(np.asarray(gathered).reshape(-1).min())
+    if agreed <= 0:
+        return None
+    if agreed != local_best:
+        for iteration, path in reversed(list_checkpoints(directory, rank)):
+            if iteration != agreed:
+                continue
+            try:
+                meta, arrays = load_checkpoint(path)
+            except CheckpointError:
+                break
+            if (meta.get("kind") == "model"
+                    and meta.get("config_hash") == want_cfg
+                    and meta.get("data_fingerprint") == want_fp):
+                found = (meta, arrays)
+            break
+        else:
+            found = None
+        if found is None or int(found[0]["iteration"]) != agreed:
+            Log.warning("rank %d has no valid snapshot at the agreed "
+                        "iteration %d; starting fresh on every rank"
+                        % (rank, agreed))
+            # every rank reaches the same conclusion: agreed is the MIN,
+            # so a rank missing it forces min=0 next time — but within
+            # this call ranks already agreed on `agreed`, so a missing
+            # local file must abort the resume consistently. Signal by
+            # resuming from nothing only when agreed came up 0 for all;
+            # here the safe move is a loud error.
+            from ..utils.log import LightGBMError
+            raise LightGBMError(
+                "distributed resume: rank %d lost its snapshot for the "
+                "agreed iteration %d (checkpoint_keep too small?)"
+                % (rank, agreed))
+    telemetry.count("checkpoint::restore", 1, category="checkpoint")
+    Log.info("Resumed distributed training from checkpoint at iteration "
+             "%d (rank %d)" % (agreed, rank))
+    return agreed, found[1]["model_text"].tobytes().decode(), found[0]

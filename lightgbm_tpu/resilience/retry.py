@@ -1,0 +1,172 @@
+"""Timeout / bounded-retry wrapper for host-side DCN collectives.
+
+The synchronous Allreduce rounds the distributed learners depend on
+("A Communication-Efficient Parallel Algorithm for Decision Tree",
+PAPERS.md) assume every rank shows up; before this module, a lost peer
+turned each host collective in ``parallel/multihost.py`` /
+``parallel/distributed.py`` into an infinite hang. ``guard`` runs the
+collective on a watchdog thread with a deadline, retries transient
+failures with exponential backoff + deterministic jitter, and surfaces a
+clean ``LightGBMError`` when the budget is exhausted — a killed training
+job a scheduler can restart (and checkpoint.py can resume) instead of a
+silent stall.
+
+Scope: this guards the HOST-side collectives (binning allgather, metric
+allreduce, boost-from-average sync, resume agreement). In-program mesh
+collectives (psum/all_gather inside jitted growers) are XLA's to fail —
+they abort the program with an XLA distributed-runtime error, which the
+engine already surfaces.
+
+Caveat (documented, inherent): a timed-out collective may still complete
+on the abandoned watchdog thread; a retry after a TRUE partial collective
+can desync the collective sequence across ranks. The guard's job is to
+convert hangs into clean, bounded failures — recovery is checkpoint
+resume, not in-flight repair.
+
+Counters: ``collective::retry`` / ``collective::timeout``. Fault
+injection: ``drop_collective@round=N[;times=T]`` (faults.py) fails the
+N-th guarded call deterministically.
+"""
+from __future__ import annotations
+
+import threading
+import zlib
+from typing import Optional
+
+from ..telemetry import events as telemetry
+from ..utils.log import LightGBMError, Log
+from . import faults
+
+
+class CollectiveTimeout(Exception):
+    """A guarded collective missed its deadline (internal; retried)."""
+
+
+class RetryPolicy:
+    """timeout_s=0 disables the watchdog thread (call inline); retries is
+    the number of RE-attempts after the first try."""
+
+    def __init__(self, timeout_s: float = 300.0, retries: int = 2,
+                 backoff_s: float = 0.25):
+        self.timeout_s = float(timeout_s)
+        self.retries = max(int(retries), 0)
+        self.backoff_s = float(backoff_s)
+
+
+_POLICY = RetryPolicy()
+_lock = threading.Lock()
+_round = 0
+
+
+def configure_from_config(config) -> None:
+    """Install the process-global policy from the tpu_collective_* params.
+
+    Also resets the collective round counter: ``drop_collective@round=N``
+    counts guarded collectives SINCE THE RUN STARTED (engine.train
+    configures at entry), so the same plan string injects identically on
+    the second train of a process as on the first."""
+    global _POLICY
+    _POLICY = RetryPolicy(
+        timeout_s=float(getattr(config, "tpu_collective_timeout", 300.0)),
+        retries=int(getattr(config, "tpu_collective_retries", 2)),
+        backoff_s=float(getattr(config, "tpu_collective_backoff", 0.25)))
+    reset_rounds()
+
+
+def policy() -> RetryPolicy:
+    return _POLICY
+
+
+def reset_rounds() -> None:
+    global _round
+    with _lock:
+        _round = 0
+
+
+def _next_round() -> int:
+    global _round
+    with _lock:
+        _round += 1
+        return _round
+
+
+def _backoff_delay(name: str, attempt: int, base: float) -> float:
+    """Exponential backoff with DETERMINISTIC jitter — a hash of
+    (name, attempt), not an RNG draw (JG005: no unseeded randomness), so
+    two ranks retrying the same collective still decorrelate by name."""
+    frac = (zlib.crc32(("%s:%d" % (name, attempt)).encode()) % 997) / 997.0
+    return base * (2.0 ** attempt) * (0.5 + 0.5 * frac)
+
+
+def _call_with_deadline(fn, args, kwargs, timeout_s: float, name: str):
+    if timeout_s <= 0:
+        return fn(*args, **kwargs)
+    result = {}
+
+    def run():
+        try:
+            result["value"] = fn(*args, **kwargs)
+        except BaseException as exc:  # noqa: B036 - relayed to the caller
+            result["error"] = exc
+
+    worker = threading.Thread(target=run, daemon=True,
+                              name="lgbtpu-collective-%s" % name)
+    worker.start()
+    worker.join(timeout_s)
+    if worker.is_alive():
+        # the thread is abandoned (collectives are not cancelable); the
+        # caller decides whether to retry or raise
+        raise CollectiveTimeout(
+            "collective '%s' exceeded %.1fs" % (name, timeout_s))
+    if "error" in result:
+        raise result["error"]
+    return result["value"]
+
+
+# transient failure classes worth retrying: socket/RPC errors surface as
+# OSError/ConnectionError; the JAX distributed runtime raises
+# RuntimeError (XlaRuntimeError) on DCN faults
+_RETRYABLE = (OSError, ConnectionError, TimeoutError, RuntimeError,
+              CollectiveTimeout)
+
+
+def guard(name: str, fn, *args, **kwargs):
+    """Run one host-side collective under the active retry policy.
+
+    Raises LightGBMError — never hangs — after the bounded attempts are
+    exhausted; LightGBMError from `fn` itself propagates unretried.
+    """
+    pol = _POLICY
+    round_idx = _next_round()
+    plan = faults.active()
+    last_err: Optional[BaseException] = None
+    for attempt in range(pol.retries + 1):
+        if plan is not None and plan.collective_should_drop(round_idx):
+            telemetry.count("faults::injected", 1, category="faults")
+            last_err = faults.FaultInjected(
+                "injected drop_collective at round %d" % round_idx)
+        else:
+            try:
+                return _call_with_deadline(fn, args, kwargs, pol.timeout_s,
+                                           name)
+            except LightGBMError:
+                raise
+            except CollectiveTimeout as exc:
+                telemetry.count("collective::timeout", 1,
+                                category="collective")
+                last_err = exc
+            except _RETRYABLE as exc:
+                last_err = exc
+        if attempt < pol.retries:
+            telemetry.count("collective::retry", 1, category="collective")
+            delay = _backoff_delay(name, attempt, pol.backoff_s)
+            Log.warning("collective '%s' failed (%s); retry %d/%d in "
+                        "%.2fs" % (name, last_err, attempt + 1,
+                                   pol.retries, delay))
+            if delay > 0:
+                import time
+                time.sleep(delay)
+    raise LightGBMError(
+        "collective '%s' failed after %d attempt(s): %r (a peer is likely "
+        "gone; restart the job to resume from the last checkpoint)"
+        % (name, pol.retries + 1, last_err))

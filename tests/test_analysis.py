@@ -207,6 +207,34 @@ FIXTURES = {
                 return json.dumps(d)
             """,
     },
+    # JG008 is scoped to the resilience durability paths; its fixtures
+    # carry their own relpath (the "relpath" key overrides the OPS default)
+    "JG008": {
+        "relpath": "lightgbm_tpu/resilience/fake.py",
+        "positive": """
+            import json
+
+            def save_state(path, state):
+                with open(path, "w") as f:         # in-place: torn on kill
+                    json.dump(state, f)
+            """,
+        "negative": """
+            import json
+            import os
+
+            def save_state(path, state):
+                tmp_path = path + ".tmp"
+                with open(tmp_path, "w") as f:
+                    json.dump(state, f)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp_path, path)
+
+            def load_state(path):
+                with open(path) as f:              # reads are never flagged
+                    return json.load(f)
+            """,
+    },
 }
 
 
@@ -214,19 +242,28 @@ def test_every_rule_has_fixtures():
     ids = {r.id for r in all_rules()}
     assert ids == set(FIXTURES), "every JG rule needs fixture snippets"
     assert ids == {"JG001", "JG002", "JG003", "JG004", "JG005", "JG006",
-                   "JG007"}
+                   "JG007", "JG008"}
 
 
 @pytest.mark.parametrize("rule_id", sorted(FIXTURES))
 def test_rule_fires_on_seeded_violation(rule_id):
-    hits = _ids(_lint(FIXTURES[rule_id]["positive"]), rule_id)
+    rp = FIXTURES[rule_id].get("relpath", OPS)
+    hits = _ids(_lint(FIXTURES[rule_id]["positive"], relpath=rp), rule_id)
     assert hits, "%s stayed silent on its seeded violation" % rule_id
 
 
 @pytest.mark.parametrize("rule_id", sorted(FIXTURES))
 def test_rule_silent_on_clean_twin(rule_id):
-    hits = _ids(_lint(FIXTURES[rule_id]["negative"]), rule_id)
+    rp = FIXTURES[rule_id].get("relpath", OPS)
+    hits = _ids(_lint(FIXTURES[rule_id]["negative"], relpath=rp), rule_id)
     assert not hits, "%s false-positived on its clean twin" % rule_id
+
+
+def test_jg008_outside_scope_is_silent():
+    """The same in-place write is fine outside the durability paths (the
+    CLI writing a predictions file is not a checkpoint)."""
+    hits = _ids(_lint(FIXTURES["JG008"]["positive"], relpath=COLD), "JG008")
+    assert hits == []
 
 
 def test_jg002_fixture_counts_and_cold_path():
