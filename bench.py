@@ -138,6 +138,16 @@ def main():
     if expo is not None:
         result["expo_value"] = expo["value"]
         result["expo_vs_baseline"] = expo["vs_baseline"]
+        if "level_value" in expo:
+            # level-program phase keys (PR 7): before/after for the
+            # launch-overhead elimination, plus the measured launch count
+            result["expo_level_value"] = expo["level_value"]
+            result["expo_level_vs_baseline"] = expo["level_vs_baseline"]
+            result["expo_level_programs"] = expo["level_programs"]
+            result["expo_level_fallback_splits"] = \
+                expo["level_fallback_splits"]
+            result["expo_level_launches_per_tree"] = \
+                expo["level_launches_per_tree"]
         print(json.dumps(result), flush=True)
         print("# Expo-like EFB-bundled (%d groups for %d features): rows=%d "
               "iters=%d train=%.1fs -> %.2fM row-iters/s, vs anchor "
@@ -145,6 +155,16 @@ def main():
               % (expo["groups"], expo["features"], expo["rows"],
                  expo["iters"], expo["train_s"], expo["value"],
                  expo["vs_baseline"]), file=sys.stderr)
+        if "level_value" in expo:
+            print("# Expo-like LEVEL-PROGRAM growth (num_leaves=2^d, "
+                  "max_depth=d): train=%.1fs -> %.2fM row-iters/s, vs "
+                  "anchor: %.4f; %.2f device launches/tree "
+                  "(level_programs=%d fallback_splits=%d)"
+                  % (expo["level_train_s"], expo["level_value"],
+                     expo["level_vs_baseline"],
+                     expo["level_launches_per_tree"],
+                     expo["level_programs"],
+                     expo["level_fallback_splits"]), file=sys.stderr)
     allst = None
     if os.environ.get("BENCH_SKIP_ALLSTATE", "") != "1":
         try:
@@ -296,32 +316,79 @@ def run_ltr():
 
 def run_expo():
     """Expo-shaped EFB-bundled throughput (one-hot blocks packed into a
-    handful of byte groups; persist path with in-kernel bundle decode)."""
+    handful of byte groups; persist path with in-kernel bundle decode).
+
+    Two trainings over the same binned dataset:
+
+      * the historical per-split config (num_leaves=255, unbounded
+        depth) — keys ``value``/``vs_baseline``, comparable with every
+        archived BENCH round;
+      * the LEVEL-PROGRAM config (num_leaves=2^d >= the frontier, so
+        the no-bind certificate holds at the root and a tree costs
+        <= max_depth fused level launches instead of ~num_leaves-1
+        split_pass launches — the PR 7 Expo-gap fix) — keys
+        ``level_*``, including the counter-measured launches per tree.
+
+    BENCH_EXPO_LEVEL=0 skips the second training; BENCH_EXPO_DEPTH
+    picks d (default 8: 256-leaf trees, the 255-leaf class).
+    """
     import jax
     import lightgbm_tpu as lgb
     from bench_full import EXPO_SECONDS, make_expo_like
+    from lightgbm_tpu.telemetry import events
     n_rows = int(os.environ.get("BENCH_EXPO_ROWS", 2_000_000))
     n_iters = int(os.environ.get("BENCH_EXPO_ITERS", 96))
     X, y = make_expo_like(n_rows)
     ds = lgb.Dataset(X, y)
     ds.construct()
     inner = ds._inner
+    anchor = 11_000_000 * 500 / EXPO_SECONDS
+
+    def timed_train(params):
+        warm = lgb.train(dict(params), ds, 17, verbose_eval=False)
+        warm._booster._materialize_pending()
+        del warm
+        c0 = events.counts_snapshot()
+        t0 = time.time()
+        bst = lgb.train(dict(params), ds, n_iters, verbose_eval=False)
+        bst._booster._materialize_pending()
+        jax.block_until_ready(bst._booster.train_score.score_device(0))
+        train_s = time.time() - t0
+        c1 = events.counts_snapshot()
+        counts = {k: v - c0.get(k, 0) for k, v in c1.items()}
+        return bst, train_s, counts
+
     params = {"objective": "binary", "num_leaves": 255, "max_bin": 255,
               "verbosity": -1, "metric": "none"}
-    warm = lgb.train(dict(params), ds, 17, verbose_eval=False)
-    warm._booster._materialize_pending()
-    del warm
-    t0 = time.time()
-    bst = lgb.train(dict(params), ds, n_iters, verbose_eval=False)
-    bst._booster._materialize_pending()
-    jax.block_until_ready(bst._booster.train_score.score_device(0))
-    train_s = time.time() - t0
+    _, train_s, _ = timed_train(params)
     throughput = n_rows * n_iters / train_s
-    anchor = 11_000_000 * 500 / EXPO_SECONDS
-    return {"rows": n_rows, "iters": n_iters, "train_s": train_s,
-            "groups": len(inner.groups), "features": inner.num_features,
-            "value": round(throughput / 1e6, 3),
-            "vs_baseline": round(throughput / anchor, 4)}
+    out = {"rows": n_rows, "iters": n_iters, "train_s": train_s,
+           "groups": len(inner.groups), "features": inner.num_features,
+           "value": round(throughput / 1e6, 3),
+           "vs_baseline": round(throughput / anchor, 4)}
+    if os.environ.get("BENCH_EXPO_LEVEL", "1") != "0":
+        d = int(os.environ.get("BENCH_EXPO_DEPTH", 8))
+        params_lv = dict(params, num_leaves=1 << d, max_depth=d)
+        counting = not events.enabled()   # BENCH_TELEMETRY=0 runs: the
+        if counting:                      # launch counters still matter
+            events.enable("timers")
+        _, lv_s, counts = timed_train(params_lv)
+        if counting:
+            events.disable()
+        lv_tp = n_rows * n_iters / lv_s
+        trees = counts.get("tree_learner::persist_scan_trees", 0) \
+            or counts.get("tree_learner::v1_grow_trees", 0) or n_iters
+        out["level_train_s"] = lv_s
+        out["level_value"] = round(lv_tp / 1e6, 3)
+        out["level_vs_baseline"] = round(lv_tp / anchor, 4)
+        out["level_programs"] = counts.get(
+            "tree_learner::level_programs", 0)
+        out["level_fallback_splits"] = counts.get(
+            "tree_learner::level_fallback_splits", 0)
+        out["level_launches_per_tree"] = round(
+            (out["level_programs"] + out["level_fallback_splits"])
+            / max(trees, 1), 2)
+    return out
 
 
 # Allstate anchor: 13,184,290 rows x 4228 one-hot columns, 500 iters in

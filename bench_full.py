@@ -160,12 +160,67 @@ def main():
             int(os.environ.get("BENCHF_YAHOO_ROWS", 473_134)),
             int(os.environ.get("BENCHF_YAHOO_ITERS", 200))))
         print(json.dumps(results[-1]), flush=True)
+    if os.environ.get("BENCHF_SKIP_EXPO", "") != "1":
+        results.append(run_expo_level(
+            int(os.environ.get("BENCHF_EXPO_ROWS", 2_000_000)),
+            int(os.environ.get("BENCHF_EXPO_ITERS", 96))))
+        print(json.dumps(results[-1]), flush=True)
     print(json.dumps({"metric": "bench_full", "results": results}))
 
 
 # Expo anchor: 11M rows x ~700 one-hot features, 500 iters in 138.5s
 # (docs/Experiments.rst:112) => 39.7M row-iters/s
 EXPO_SECONDS = 138.5
+
+
+def run_expo_level(n_rows, n_iters):
+    """Expo-shaped EFB-bundled training through the LEVEL-PROGRAM grower
+    (PR 7): num_leaves = 2^d with max_depth = d so the no-bind
+    certificate holds at the root and a tree costs <= d fused level
+    launches instead of ~num_leaves-1 per-split ones. Reports the
+    ``expo_level_*`` keys BENCH rounds compare before/after on."""
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.telemetry import events
+    d = int(os.environ.get("BENCHF_EXPO_DEPTH", 8))
+    X, y = make_expo_like(n_rows)
+    t0 = time.time()
+    ds = lgb.Dataset(X, y)
+    ds.construct()
+    t_bin = time.time() - t0
+    params = {"objective": "binary", "num_leaves": 1 << d, "max_depth": d,
+              "max_bin": 255, "verbosity": -1, "metric": "none"}
+    warm = lgb.train(dict(params), ds, 17, verbose_eval=False)
+    warm._booster._materialize_pending()
+    del warm
+    counting = not events.enabled()
+    if counting:
+        events.enable("timers")
+    c0 = events.counts_snapshot()
+    t0 = time.time()
+    bst = lgb.train(dict(params), ds, n_iters, verbose_eval=False)
+    bst._booster._materialize_pending()
+    t_train = time.time() - t0
+    c1 = events.counts_snapshot()
+    if counting:
+        events.disable()
+    counts = {k: v - c0.get(k, 0) for k, v in c1.items()}
+    bst._booster._sync_persist_scores()
+    raw = np.asarray(bst._booster.train_score.score_device(0))
+    a = auc(y, raw)
+    trees = counts.get("tree_learner::persist_scan_trees", 0) \
+        or counts.get("tree_learner::v1_grow_trees", 0) or n_iters
+    lv = counts.get("tree_learner::level_programs", 0)
+    fb = counts.get("tree_learner::level_fallback_splits", 0)
+    return {"experiment": "expo_level", "rows": n_rows, "iters": n_iters,
+            "depth": d, "binning_s": round(t_bin, 1),
+            "train_s": round(t_train, 1), "train_auc": round(float(a), 6),
+            "expo_level_programs": lv, "expo_level_fallback_splits": fb,
+            "expo_level_launches_per_tree": round(
+                (lv + fb) / max(trees, 1), 2),
+            "ref_train_s": EXPO_SECONDS,
+            "speedup_vs_ref_cpu": round(
+                EXPO_SECONDS / max(t_train, 1e-9) * (n_iters / 500)
+                * (n_rows / 11_000_000), 3)}
 
 
 def run_allstate(n_rows, n_iters):

@@ -6,7 +6,9 @@ device execution, runs fine on CPU) and asserts invariants on the IR:
 
 * **persist-f32 kernels stay f32** — no ``convert_element_type`` to
   f64 anywhere in the jaxprs of ``hist_window`` (both variants),
-  ``scan_pair``, ``scan_blocks``, or the persist ``split_pass``. This
+  ``scan_pair``, ``scan_blocks``, the persist ``split_pass``, or the
+  batched level-program kernels (``level_pass`` / ``level_seg_hist`` /
+  the wide ``scan_pair`` batch the level split-find feeds). This
   is the machine-checked half of the tie-flip characterization
   (tests/test_known_divergence.py tracks the residual v1-vs-persist
   gap; this audit pins that the persist side cannot silently widen).
@@ -236,6 +238,72 @@ def audit_persist_split_pass() -> AuditResult:
     return res
 
 
+def audit_persist_level_pass() -> AuditResult:
+    """The batched LEVEL program kernels (PR 7) on a toy payload
+    geometry: the multi-leaf ``level_pass`` must trace f64-free and keep
+    the payload ``input_output_aliases`` (the in-place multi-leaf
+    partition contract — one lost alias turns every level into a full
+    payload copy); the batched ``level_seg_hist`` and a wider-than-pair
+    ``scan_pair`` batch (the level split-find shape) must also stay
+    f32. This is the level-program extension of
+    :func:`audit_persist_split_pass` — the level path batches S leaves
+    per launch, so a silent widening or alias loss costs S× more than
+    on the per-split path."""
+    from ..ops.pallas_compat import HAS_PALLAS
+    name = "persist_level_pass"
+    if not HAS_PALLAS:
+        return _skip(name, "pallas unavailable")
+    from ..ops.pallas_grow import make_level_pass, make_level_seg_hist
+    from ..ops.pallas_scan import scan_pair
+    WPA, NP, G, nbw = 8, 1024, 2, 2
+    plan = ((0, 0, 255), (1, 0, 255))
+    S_max, T_max = 4, 16
+    i32 = jnp.int32
+    lp = make_level_pass(WPA, NP, G, plan, nbw, S_max, T_max, C=256)
+    closed = jax.make_jaxpr(lp)(
+        jax.ShapeDtypeStruct((WPA, NP), jnp.uint32),
+        jax.ShapeDtypeStruct((S_max, 16), i32),
+        jax.ShapeDtypeStruct((T_max,), i32),
+        jax.ShapeDtypeStruct((S_max,), i32),
+        jax.ShapeDtypeStruct((), i32))
+    res = _audit_jaxpr(name, closed, strict_f64=True)
+    if not res.ok:
+        return res
+    aliased = False
+    for eqn, _ in iter_eqns(closed.jaxpr):
+        if "pallas_call" in eqn.primitive.name:
+            ioa = eqn.params.get("input_output_aliases") or ()
+            aliased = aliased or bool(tuple(ioa))
+    if not aliased:
+        return AuditResult(
+            name=name, ok=False,
+            detail="level_pass pallas_call lost its payload "
+                   "input_output_aliases (in-place multi-leaf "
+                   "partition broken)")
+    ls = make_level_seg_hist(WPA, NP, G, plan, nbw, S_max, T_max, C=256)
+    closed_s = jax.make_jaxpr(ls)(
+        jax.ShapeDtypeStruct((WPA, NP), jnp.uint32),
+        jax.ShapeDtypeStruct((S_max, 4), i32),
+        jax.ShapeDtypeStruct((T_max,), i32),
+        jax.ShapeDtypeStruct((S_max,), i32),
+        jax.ShapeDtypeStruct((), i32))
+    res_s = _audit_jaxpr(name, closed_s, strict_f64=True)
+    if not res_s.ok:
+        return res_s
+    B, Fp, Wp = 2 * S_max, 8, 128
+    f32 = jnp.float32
+    closed_b = jax.make_jaxpr(scan_pair)(
+        jax.ShapeDtypeStruct((B, 8), f32),
+        jax.ShapeDtypeStruct((B, Fp, Wp), f32),
+        jax.ShapeDtypeStruct((B, Fp, Wp), f32),
+        jax.ShapeDtypeStruct((Fp, Wp), f32),
+        jax.ShapeDtypeStruct((Fp, Wp), f32),
+        jax.ShapeDtypeStruct((Fp, Wp), f32),
+        jax.ShapeDtypeStruct((Fp, Wp), f32),
+        jax.ShapeDtypeStruct((8, Fp), f32))
+    return _audit_jaxpr(name, closed_b, strict_f64=True)
+
+
 def _toy_ensemble(num_class: int = 1):
     """Hand-built 3-tree CompiledEnsemble (two depth buckets, one
     categorical bitset node) — no training required. With num_class=3
@@ -340,6 +408,7 @@ AUDITS: Tuple[Callable[[], AuditResult], ...] = (
     audit_scan_pair,
     audit_scan_blocks,
     audit_persist_split_pass,
+    audit_persist_level_pass,
     audit_predict_traversal,
     audit_predict_donation,
     audit_serve_ladder,

@@ -10,7 +10,15 @@ ladder bound is the only compile multiplier.
 
 Builders that close over loop state legitimately (``make_split_pass``
 called once per payload geometry) are fine because the *call to jit*
-happens once inside the builder, not in the loop.
+happens once inside the builder, not in the loop — but calling the
+BUILDER itself per loop iteration is the same storm wearing a trench
+coat, so the known kernel builders (``make_split_pass``,
+``make_level_pass``, …) are flagged in host loops too. The
+level-parallel grower (PR 7) depends on this: its level/split kernels
+are built once in ``make_persist_grower`` and invoked from inside the
+traced level loop; a builder call drifting into the host per-level or
+per-batch loop would silently reintroduce the ~per-split compile cost
+the level program exists to eliminate.
 """
 from __future__ import annotations
 
@@ -21,6 +29,13 @@ from ..core import Finding, ModuleContext
 from . import register
 
 _COMPILE_CALLS = ("jax.jit", "jax.pmap", "jit")
+
+# kernel BUILDERS: each constructs a jit/pallas_call inside; calling one
+# per loop iteration is a recompile storm one frame removed
+_KERNEL_BUILDERS = (
+    "make_split_pass", "make_level_pass", "make_level_seg_hist",
+    "make_seg_hist", "make_root_hist", "make_persist_grower",
+)
 
 
 @register
@@ -49,6 +64,14 @@ class JitInLoop:
                     self.id, node,
                     "`pallas_call` construction inside a loop re-traces "
                     "the kernel per iteration; build it once and reuse"))
+            elif target is not None \
+                    and target.split(".")[-1] in _KERNEL_BUILDERS:
+                out.append(ctx.finding(
+                    self.id, node,
+                    "`%s(...)` inside a loop rebuilds (and so "
+                    "recompiles) its kernel per iteration; build it "
+                    "once per payload geometry and reuse"
+                    % target.split(".")[-1]))
             elif target in ("functools.partial", "partial") and node.args \
                     and ctx.dotted(node.args[0]) in _COMPILE_CALLS:
                 out.append(ctx.finding(

@@ -212,9 +212,19 @@ class _LoopState(NamedTuple):
     tree: TreeArrays
 
 
+def hist_ft(gc: "GrowConfig"):
+    """Histogram ACCUMULATION dtype: f64 bins when hist_dtype says so
+    (the CPU default — the reference CPU learner's double hist_t), f32
+    otherwise (the accelerator gpu_use_dp=false trade). f64 sums of f32
+    per-row gradients are exact at histogram scales, so f64 bins are
+    summation-order-independent — which is what lets two different
+    growers (v1 and the widened persist emulation) agree bit for bit."""
+    return jnp.float64 if gc.hist_dtype == "f64" else jnp.float32
+
+
 def _hist_masked(layout: DataLayout, grad, hess, mask, total_bins,
                  rows_per_chunk, packed: bool, axis_name=None,
-                 multival: bool = False):
+                 multival: bool = False, dtype=jnp.float32):
     from .histogram import build_histogram
     m = mask.astype(grad.dtype)
     if multival:
@@ -228,12 +238,13 @@ def _hist_masked(layout: DataLayout, grad, hess, mask, total_bins,
                         layout.group_offset[gsafe] + layout.ell_bin)
         h = build_histogram(idx, grad * m, hess * m,
                             total_bins=total_bins + 1,
-                            rows_per_chunk=rows_per_chunk)[:total_bins]
+                            rows_per_chunk=rows_per_chunk,
+                            dtype=dtype)[:total_bins]
     else:
         idx = (_logical_bins(layout.bins, layout, packed)
                + layout.group_offset[None, :])
         h = build_histogram(idx, grad * m, hess * m, total_bins=total_bins,
-                            rows_per_chunk=rows_per_chunk)
+                            rows_per_chunk=rows_per_chunk, dtype=dtype)
     if axis_name is not None:
         h = jax.lax.psum(h, axis_name)
     return h
@@ -683,6 +694,11 @@ def _hist_chunk_contract(bv, vc, W, hist_dtype):
         out = jnp.einsum("rgw,rc->gwc", oh, vq,
                          preferred_element_type=jnp.float32)    # [G, W, 4]
         return out[..., :2] + out[..., 2:]
+    if hist_dtype == "f64":
+        oh = (bv[:, :, None] == jnp.arange(W, dtype=I32)[None, None, :]
+              ).astype(jnp.float64)
+        return jnp.einsum("rgw,rc->gwc", oh, vc.astype(jnp.float64),
+                          preferred_element_type=jnp.float64)
     oh = (bv[:, :, None] == jnp.arange(W, dtype=I32)[None, None, :]
           ).astype(jnp.float32)
     return jnp.einsum("rgw,rc->gwc", oh, vc,
@@ -905,9 +921,10 @@ def _grow_tree_jit(layout: DataLayout, grad: jnp.ndarray, hess: jnp.ndarray,
         return jax.lax.psum(x, axis_name)
 
     # ---- root ----------------------------------------------------------
+    hft = hist_ft(gc)
     root_hist = hist_psum(_hist_masked(
         layout, grad, hess, bag_mask, TB, gc.rows_per_chunk,
-        gc.packed_4bit, None, multival=gc.multival))
+        gc.packed_4bit, None, multival=gc.multival, dtype=hft))
     sum_grad = psum(jnp.sum(grad, dtype=ft))
     sum_hess = psum(jnp.sum(hess, dtype=ft))
     root_count = psum(jnp.sum(bag_mask, dtype=I32))
@@ -950,7 +967,7 @@ def _grow_tree_jit(layout: DataLayout, grad: jnp.ndarray, hess: jnp.ndarray,
         done=jnp.asarray(False),
         fidx=jnp.asarray(0, I32),
         row_leaf=jnp.zeros((n,), I32),
-        leaf_hist=jnp.zeros((L, TB, 2), jnp.float32).at[0].set(root_hist),
+        leaf_hist=jnp.zeros((L, TB, 2), hft).at[0].set(root_hist),
         leaf_sum_grad=jnp.zeros((L,), ft).at[0].set(sum_grad),
         leaf_sum_hess=jnp.zeros((L,), ft).at[0].set(sum_hess),
         leaf_count=jnp.zeros((L,), I32).at[0].set(root_count),
@@ -1011,7 +1028,7 @@ def _grow_tree_jit(layout: DataLayout, grad: jnp.ndarray, hess: jnp.ndarray,
         smaller_mask = in_leaf & (go_left == smaller_is_left)
         hist_smaller = hist_psum(_hist_masked(
             layout, grad, hess, smaller_mask, TB, gc.rows_per_chunk,
-            gc.packed_4bit, None, multival=gc.multival))
+            gc.packed_4bit, None, multival=gc.multival, dtype=hft))
         sm_sum_grad = jnp.where(smaller_is_left, cand.left_sum_grad,
                                 cand.right_sum_grad)
         sm_sum_hess = jnp.where(smaller_is_left, cand.left_sum_hess,
@@ -1253,19 +1270,19 @@ def _hist_chunk_accum(acc, bw, gw, hw, gc: GrowConfig, group_offset, W):
         return acc + _hist_chunk_contract(bw, vc, W, gc.hist_dtype)
     idx = bw + group_offset[None, :]
     C, G = bw.shape
-    fv = jnp.broadcast_to(vc[:, None, :], (C, G, 2))
+    fv = jnp.broadcast_to(vc[:, None, :], (C, G, 2)).astype(acc.dtype)
     return acc.at[idx.reshape(-1)].add(fv.reshape(-1, 2))
 
 
 def _hist_acc_init(gc: GrowConfig, G, W):
     if gc.hist_impl in ("onehot", "pallas"):
-        return jnp.zeros((G, W, 2), jnp.float32)
-    return jnp.zeros((gc.total_bins, 2), jnp.float32)
+        return jnp.zeros((G, W, 2), hist_ft(gc))
+    return jnp.zeros((gc.total_bins, 2), hist_ft(gc))
 
 
 def _hist_acc_finish(acc, gc: GrowConfig, gw_global):
     if gc.hist_impl in ("onehot", "pallas"):
-        return jnp.zeros((gc.total_bins, 2), jnp.float32).at[
+        return jnp.zeros((gc.total_bins, 2), acc.dtype).at[
             gw_global.reshape(-1)].add(acc.reshape(-1, 2), mode="drop")
     return acc
 
@@ -1416,7 +1433,7 @@ def _grow_tree_partitioned_jit(layout: DataLayout, grad: jnp.ndarray,
         rbS=jnp.zeros((SS,), U32),
         leaf_start=jnp.zeros((L,), I32),
         leaf_nrows=jnp.zeros((L,), I32).at[0].set(n),
-        leaf_hist=jnp.zeros((L, TB, 2), jnp.float32).at[0].set(root_hist),
+        leaf_hist=jnp.zeros((L, TB, 2), hist_ft(gc)).at[0].set(root_hist),
         leaf_sum_grad=jnp.zeros((L,), ft).at[0].set(sum_grad),
         leaf_sum_hess=jnp.zeros((L,), ft).at[0].set(sum_hess),
         leaf_count=jnp.zeros((L,), I32).at[0].set(root_count),

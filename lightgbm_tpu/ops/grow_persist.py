@@ -49,11 +49,13 @@ import numpy as np
 from ..telemetry import events as telemetry
 from ..utils.log import Log
 from .grow import TreeArrays
+from .pallas_compat import dynamic_grid_interpret_ok
 from .pallas_grow import (N_SCALARS, S_DB, S_DL, S_LE, S_LS, S_MASK, S_MF,
                           S_MT, S_NB, S_NCH, S_NL, S_S0, S_SH, S_SMALL_L,
                           S_THR, S_WG, make_root_hist, make_split_pass)
 from .pallas_scan import ScanLayout, scan_pair
-from .split import K_MIN_SCORE, SplitParams
+from .split import (K_MIN_SCORE, SplitParams, find_best_split_numerical,
+                    find_best_split_numerical_batch, fix_histogram)
 
 I32 = jnp.int32
 U32 = jnp.uint32
@@ -66,6 +68,30 @@ def _f32r(row):
 
 # payload row count up to which f32 leaf state holds exact integer counts
 EXACT_F32_ROWS = 1 << 24
+
+# deepest max_depth the level-parallel phase takes on: the frontier-slot
+# matrices are sized 2^(max_depth-1) and the no-bind certificate's
+# capacity terms are exact f32 powers of two up to here
+LEVEL_MAX_DEPTH = 16
+
+
+def can_level_grow(gc) -> bool:
+    """Static gate for the level-parallel growth phase.
+
+    The level program batches a whole tree level into one fused
+    partition + one batched split-find, driven by a bounded loop over
+    depths — so it needs a finite max_depth to size the slot matrices.
+    Voting-parallel keeps the per-split path (its per-leaf vote/psum
+    protocol is pairwise); forced splits prescribe a split ORDER, which
+    is exactly what the level batch abstracts away. Leaf-wise
+    (num_leaves-constrained) semantics are preserved dynamically: the
+    in-program no-bind certificate hands the tree to the per-split tail
+    the moment gain-ordered admission could be budget-truncated
+    (see make_persist_grower's level loop)."""
+    return (1 <= int(gc.max_depth) <= LEVEL_MAX_DEPTH
+            and int(gc.num_leaves) >= 4
+            and gc.parallel_mode != "voting"
+            and int(gc.n_forced) == 0)
 
 # group count at or below which the smaller-child histogram accumulates
 # IN the split_pass kernel instead of a separate post-partition seg_hist
@@ -167,15 +193,21 @@ class PersistAssets(NamedTuple):
     #                          #  needs_fix [F] bool, bundled flag)
 
 
-def payload_weight_row(nbw: int, num_scores: int) -> int:
+def payload_weight_row(nbw: int, num_scores: int,
+                       score64: bool = False) -> int:
     """Row index of the optional weight row == live-row count without it
-    (bins | label | rid | grad | hess | score*K [| snapshot*K])."""
+    (bins | label | rid | grad | hess | score*K [| snapshot*K]).
+    score64 doubles the score/snapshot rows (f64 as u32 word pairs — the
+    widened kernel mode's boosting state, matching the v1 f64 score
+    buffer bit for bit)."""
     K = num_scores
-    return nbw + 4 + K + (K if K > 1 else 0)
+    SR = 2 if score64 else 1
+    return nbw + 4 + SR * K + (SR * K if K > 1 else 0)
 
 
 def _payload_geometry(n: int, nbw: int, C: int, CR: int,
-                      num_scores: int = 1, has_weight: bool = False):
+                      num_scores: int = 1, has_weight: bool = False,
+                      score64: bool = False):
     """Payload rows: bins words | label | rid | grad | hess | score*K
     [| snapshot*K when K > 1] [| weight]. nbw comes from the pack plan
     (_payload_plan — nibble-packed narrow groups shrink it below the
@@ -186,9 +218,10 @@ def _payload_geometry(n: int, nbw: int, C: int, CR: int,
     src/boosting/gbdt.cpp:152,338-420), so per-class softmax grads read
     the snapshot while per-class score updates land in the live rows.
     Weighted datasets append one f32 weight row that rides the partition;
-    unweighted payloads pay nothing."""
+    unweighted payloads pay nothing. score64 widens the score rows to
+    u32 pairs (the XLA kernel mode's f64 boosting state)."""
     K = num_scores
-    WP = payload_weight_row(nbw, K) + (1 if has_weight else 0)
+    WP = payload_weight_row(nbw, K, score64) + (1 if has_weight else 0)
     WPA = ((WP + 7) // 8) * 8
     if C <= 0:
         # split_pass VMEM scales with WPA (7 chunk-sized u32 buffers + the
@@ -234,7 +267,8 @@ def _pack_payload(binned: np.ndarray, labels: np.ndarray, n: int,
 def build_assets(dataset, labels: np.ndarray, C: int = 0,
                  CR: int = 16384, num_shards: int = 1,
                  num_scores: int = 1,
-                 use_weight_row: bool = True) -> PersistAssets:
+                 use_weight_row: bool = True,
+                 score64: bool = False) -> PersistAssets:
     """Host-side payload construction (once per dataset).
 
     dataset: BinnedDataset with groups == features, widths <= 256.
@@ -269,9 +303,10 @@ def build_assets(dataset, labels: np.ndarray, C: int = 0,
     weight = dataset.metadata.weight if use_weight_row else None
     weight = None if weight is None else np.asarray(weight)
     has_w = weight is not None
-    WPA, C, NP = _payload_geometry(n, nbw, C, CR, num_scores, has_w)
+    WPA, C, NP = _payload_geometry(n, nbw, C, CR, num_scores, has_w,
+                                   score64)
     K = num_scores
-    weight_row = payload_weight_row(nbw, K)
+    weight_row = payload_weight_row(nbw, K, score64)
     blocks = []
     for k in range(num_shards):
         pay_k = _pack_payload(binned[k * n:(k + 1) * n],
@@ -314,7 +349,7 @@ def build_assets(dataset, labels: np.ndarray, C: int = 0,
         le=jnp.asarray(ls + nb_np),
         mf=jnp.asarray(mf_np),
         geometry=(WPA, NP, G, tuple(plan), nbw, n, C, CR,
-                  num_scores, has_w),
+                  num_scores, has_w, score64),
         efb=(group_of, ls, nb_np, mf_np, needs_fix, bundled,
              mt_np, db_np),
     )
@@ -324,14 +359,18 @@ def build_assets(dataset, labels: np.ndarray, C: int = 0,
 # pure-XLA kernel emulation (CPU fallback + sharding tests)
 # ---------------------------------------------------------------------------
 
-def make_xla_split_pass(WPA: int, NP: int, G: int, plan, nbw: int):
+def make_xla_split_pass(WPA: int, NP: int, G: int, plan, nbw: int,
+                        out_dtype=F32):
     """jnp reference implementation of the split_pass kernel contract:
     same (pay', (gh, hh), n_left) outputs, with the partitioned segment in
     stable original order (left rows first). Row order within a segment is
     an implementation detail both impls are free over — histograms, counts
     and segment CONTENTS are what the grower depends on. Histograms
-    accumulate in f64 so per-shard partial sums + psum match a whole-data
-    sum to f32 round-off (the sharding equivalence tests rely on this)."""
+    accumulate in f64; out_dtype=f64 (the widened kernel mode) hands the
+    f64 values through so the grower's gain ordering matches the v1 f64
+    scan, out_dtype=f32 rounds like the Mosaic kernels (and keeps
+    per-shard partial sums + psum matching a whole-data sum to f32
+    round-off — the sharding equivalence tests rely on this)."""
     grad_row = nbw + 2
 
     def split_pass(pay, scal):
@@ -368,12 +407,13 @@ def make_xla_split_pass(WPA: int, NP: int, G: int, plan, nbw: int):
             bg = ((pay[w] >> U32(sh)) & U32(mk)).astype(I32) + g * 256
             gh = gh.at[bg].add(grad)
             hh = hh.at[bg].add(hess)
-        return pay2, (gh.astype(F32), hh.astype(F32)), nL
+        return pay2, (gh.astype(out_dtype), hh.astype(out_dtype)), nL
 
     return split_pass
 
 
-def make_xla_root_hist(WPA: int, NP: int, G: int, plan, nbw: int, n: int):
+def make_xla_root_hist(WPA: int, NP: int, G: int, plan, nbw: int, n: int,
+                       out_dtype=F32):
     """jnp reference implementation of the root_hist kernel contract
     (f64 accumulation, see make_xla_split_pass)."""
     grad_row = nbw + 2
@@ -390,8 +430,8 @@ def make_xla_root_hist(WPA: int, NP: int, G: int, plan, nbw: int, n: int):
             bg = ((pay[w] >> U32(sh)) & U32(mk)).astype(I32) + g * 256
             gh = gh.at[bg].add(grad)
             hh = hh.at[bg].add(hess)
-        sums = jnp.stack([jnp.sum(grad), jnp.sum(hess)]).astype(F32)
-        return (gh.astype(F32), hh.astype(F32)), sums
+        sums = jnp.stack([jnp.sum(grad), jnp.sum(hess)]).astype(out_dtype)
+        return (gh.astype(out_dtype), hh.astype(out_dtype)), sums
 
     return root_hist
 
@@ -400,12 +440,16 @@ class _PState(NamedTuple):
     s: jnp.ndarray
     done: jnp.ndarray
     pay: jnp.ndarray           # [WPA, NP] u32
-    gh: jnp.ndarray            # [L, TBp] f32 gradient histogram plane
-    hh: jnp.ndarray            # [L, TBp] f32 hessian histogram plane
+    gh: jnp.ndarray            # [L, TBe] EV gradient histogram plane
+    #                          # (TBe = G*256 group planes on the kernel
+    #                          # path, the flat [total_bins] v1 layout in
+    #                          # the widened XLA mode)
+    hh: jnp.ndarray            # [L, TBe] EV hessian histogram plane
     lstate: jnp.ndarray        # [L, 8] ST (f32; f64 when counts can pass
     #                          # 2^24 — EXACT_F32_ROWS / state_dtype)
-    best: jnp.ndarray          # [L, 12] ST
+    best: jnp.ndarray          # [L, 12] EV
     tree: jnp.ndarray          # [L, 8] ST
+    levels: jnp.ndarray        # i32: level programs run for this tree
 
 
 # ---------------------------------------------------------------------------
@@ -556,12 +600,32 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
                         interpret: bool = False, axis_name=None,
                         kernel_impl: str = "pallas",
                         stat_from_scan: bool = False,
-                        state_dtype=None):
+                        state_dtype=None, fix=None,
+                        level_mode: str = "auto"):
     """Build grow/score/gradient closures for one dataset + grow config.
 
     gc: GrowConfig (num_leaves, max_depth, num_features, scan_width used).
     Returns an object with .grow(pay, params, fmask), .apply_scores,
     .fill_grad, .finalize_scores.
+
+    level_mode: "auto" enables the LEVEL-PARALLEL growth phase whenever
+    can_level_grow(gc) holds — an entire tree level (multi-leaf
+    partition, smaller-child histograms, batched best-split find for
+    every frontier child) runs as ONE compiled region per level, driven
+    by a bounded loop over depths, so a tree costs ~max_depth device
+    program launches instead of ~num_leaves-1. Leaf-wise semantics are
+    preserved exactly: frontier leaves admit in gain order, and an
+    in-program NO-BIND certificate (remaining leaf budget >= the
+    depth-limited completion capacity of the positive-gain frontier)
+    hands the tree to the per-split tail the moment best-first admission
+    could be budget-truncated — the tail is the historical per-split
+    loop, so truncated trees match it split for split. "off" forces the
+    per-split path everywhere.
+
+    fix: FixInfo (ops/grow.FixInfo) for EFB-bundled datasets — the
+    widened XLA kernel mode applies Dataset::FixHistogram at histogram
+    STORE time exactly like the v1 grower (the Mosaic path keeps the
+    in-kernel fix residual).
 
     stat_from_scan: leaf counts come from the scan's hessian-derived
     rounding (the reference's cnt_factor recovery,
@@ -583,15 +647,51 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
 
     kernel_impl: "pallas" (TPU Mosaic kernels) or "xla" (the jnp reference
     implementation — CPU fallback and what the 8-device CPU-mesh sharding
-    tests run).
+    tests run). The xla mode is WIDENED: f64 histogram planes in the v1
+    flat [total_bins] layout, f64 leaf state and the v1 f64 split-find
+    (find_best_split_numerical), plus f64 payload score rows — so its
+    split ordering and leaf values match the v1 f64 grower bit for bit
+    (the fix for the historical persist-vs-v1 tie-flip on noise-gain
+    splits). The Mosaic path keeps the f32 fast-path trade
+    (gpu_use_dp=false) unchanged.
     """
+    if kernel_impl == "pallas" and interpret \
+            and not dynamic_grid_interpret_ok():
+        # jax 0.4.x interpret mode cannot discharge the dynamic-grid
+        # split kernels (state-discharge dtype mismatch under x64);
+        # real-TPU Mosaic lowering is unaffected. Fall back loudly —
+        # but the widened XLA mode needs the f64 payload score layout,
+        # which is baked into the assets, so the downgrade is only
+        # possible when the caller built for it.
+        if not (bool(assets.geometry[10])
+                if len(assets.geometry) > 10 else False):
+            raise ValueError(
+                "pallas interpret mode cannot discharge the dynamic-grid "
+                "split kernels on this jax (< 0.5), and these assets "
+                "carry the f32 payload score layout the XLA emulation "
+                "cannot take; decide the downgrade before building "
+                "assets (build_assets(score64=True) + kernel_impl='xla', "
+                "as SerialTreeLearner._persist_kernel_effective does)")
+        Log.warning("pallas interpret mode cannot discharge the "
+                    "dynamic-grid split kernels on this jax (< 0.5); "
+                    "using the XLA kernel emulation")
+        kernel_impl = "xla"
     WPA, NP, G, plan, nbw, n, C, CR = assets.geometry[:8]
     K = assets.geometry[8] if len(assets.geometry) > 8 else 1
     has_w = bool(assets.geometry[9]) if len(assets.geometry) > 9 else False
+    score64 = bool(assets.geometry[10]) \
+        if len(assets.geometry) > 10 else False
+    wide = kernel_impl == "xla"
+    if wide != score64:
+        raise ValueError("persist payload score layout does not match the "
+                         "kernel mode: build_assets(score64=%r) but "
+                         "kernel_impl=%r (the widened XLA mode needs f64 "
+                         "score rows)" % (score64, kernel_impl))
     F = gc.num_features
     L = gc.num_leaves
     W = 256
     TBp = G * W
+    EV = jnp.float64 if wide else F32   # histogram/eval dtype
     # the leaf-state/tree-record matrices carry exact integer counts and
     # payload positions; f32 is integer-exact only to 2^24, so larger
     # payloads switch them to f64 (tiny [L, 8] matrices — the cost is
@@ -600,17 +700,35 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
     # derived count recovery stays f32 (estimate-grade by design, the
     # reference's cnt_factor trade): above 2^24 rows its min_data gating
     # and the bagged stat counts carry ~1e-7 relative rounding on the
-    # largest leaves.
-    ST = state_dtype if state_dtype is not None else (
-        F32 if n < EXACT_F32_ROWS else jnp.float64)
-    if kernel_impl == "xla":
-        split_pass = make_xla_split_pass(WPA, NP, G, plan, nbw)
-        root_hist = make_xla_root_hist(WPA, NP, G, plan, nbw, n)
-        seg_hist = None
+    # largest leaves. The widened XLA mode is f64 throughout (v1 parity
+    # beats the tiny state saving off-TPU).
+    if wide:
+        ST = jnp.float64
     else:
-        from .pallas_grow import make_seg_hist
+        ST = state_dtype if state_dtype is not None else (
+            F32 if n < EXACT_F32_ROWS else jnp.float64)
+    # level-parallel phase sizing: up to S_MAXL splitting leaves per
+    # level program (the widest frontier a depth-bounded tree can
+    # present), 2*S_MAXL children per batched split-find
+    use_level = level_mode != "off" and can_level_grow(gc)
+    md = int(gc.max_depth)
+    S_MAXL = min(1 << max(md - 1, 0), L - 1) if use_level else 1
+    T_MAXL = NP // max(C, 1) + 3 * S_MAXL + 4
+    level_pass = None
+    level_seg = None
+    if kernel_impl == "xla":
+        split_pass = make_xla_split_pass(WPA, NP, G, plan, nbw,
+                                         out_dtype=EV)
+        root_hist = make_xla_root_hist(WPA, NP, G, plan, nbw, n,
+                                       out_dtype=EV)
+        seg_hist = None
+        inpass_hist = True
+    else:
+        from .pallas_grow import (_unpack_hist as _unpack_hist_v,
+                                  make_level_pass, make_level_seg_hist,
+                                  make_seg_hist)
         # every score/snapshot/weight row must ride the partition
-        wp_live = payload_weight_row(nbw, K) + (1 if has_w else 0)
+        wp_live = payload_weight_row(nbw, K, score64) + (1 if has_w else 0)
         # smaller-child histogram placement (geometry heuristic): with
         # few (wide) groups it accumulates IN split_pass — the rows are
         # already in VMEM and the per-split seg_hist launch dominates;
@@ -626,10 +744,22 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
                                   interpret=interpret))
         root_hist = make_root_hist(WPA, NP, G, plan, nbw, n, C=CR,
                                    interpret=interpret)
+        if use_level:
+            # built ONCE here, invoked inside the traced level loop —
+            # never constructed per level (JG004's no-pallas-in-loop)
+            level_pass = make_level_pass(
+                WPA, NP, G, plan, nbw, S_MAXL, T_MAXL, C=C,
+                interpret=interpret, wp_live=wp_live,
+                _skip_hist=not inpass_hist)
+            level_seg = (None if inpass_hist else
+                         make_level_seg_hist(WPA, NP, G, plan, nbw,
+                                             S_MAXL, T_MAXL, C=C,
+                                             interpret=interpret))
     grad_row = nbw + 2
-    score_row = nbw + 4            # class k's score row = score_row + k
-    snap_row = nbw + 4 + K         # class k's snapshot row (K > 1 only)
-    weight_row = payload_weight_row(nbw, K)          # only when has_w
+    SR = 2 if score64 else 1       # payload rows per score value
+    score_row = nbw + 4            # class k's score rows at +SR*k
+    snap_row = nbw + 4 + SR * K    # class k's snapshot rows (K > 1 only)
+    weight_row = payload_weight_row(nbw, K, score64)  # only when has_w
 
     # PV-tree voting-parallel (voting_parallel_tree_learner.cpp:153-344):
     # histogram planes stay shard-LOCAL; per split each shard proposes its
@@ -650,7 +780,53 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
         bin_start=jnp.asarray(win_start_np),
         bin_end=jnp.asarray(win_start_np + nb_np))
     has_fix = bool(needs_fix_np.any())
-    if bundled:
+    if wide:
+        # widened mode keeps the histogram planes in the v1 grower's FLAT
+        # [total_bins] layout: the kernels' [G, 256] group planes gather
+        # through lane_of_bin right after each kernel call, and from
+        # there fix/subtract/eval run the exact v1 ops in the exact v1
+        # order (find_best_split_numerical on f64 — the tie-flip fix)
+        bs_np = np.asarray(meta.bin_start, np.int64)
+        be_np = np.asarray(meta.bin_end, np.int64)
+        TBW = int(be_np.max()) if F else 1
+        lane_np = np.zeros(TBW, np.int64)
+        for f_ in range(F):
+            lane_np[bs_np[f_]:be_np[f_]] = (
+                win_start_np[f_] + np.arange(be_np[f_] - bs_np[f_]))
+        lane_of_bin = jnp.asarray(lane_np.astype(np.int32))
+        TBe = TBW
+        if has_fix and fix is None:
+            raise ValueError("widened persist mode on an EFB-bundled "
+                             "dataset needs the FixInfo (pass fix=)")
+    else:
+        lane_of_bin = None
+        TBe = TBp
+    W_scan = max(int(gc.scan_width), 1)
+
+    def to_flat(plane):
+        """Kernel-layout [..., G*256] plane -> eval-layout [..., TBe]."""
+        if not wide:
+            return plane
+        return jnp.take(plane, lane_of_bin, axis=-1)
+
+    def fix_store(g_pl, h_pl, sgs, shs):
+        """Dataset::FixHistogram at histogram STORE time (v1 order:
+        fix the computed child, then subtract) — widened mode only; the
+        Mosaic kernels repair in-kernel at eval. Accepts [TBe] or
+        [B, TBe] planes with matching scalar/[B] sums."""
+        if not (wide and has_fix):
+            return g_pl, h_pl
+
+        def one(g_, h_, sg_, sh_):
+            hist = fix_histogram(jnp.stack([g_, h_], axis=-1), sg_, sh_,
+                                 fix.mf_global, fix.start, fix.end,
+                                 max_w=W_scan, use_dp=True)
+            return hist[:, 0], hist[:, 1]
+
+        if g_pl.ndim == 1:
+            return one(g_pl, h_pl, sgs, shs)
+        return jax.vmap(one)(g_pl, h_pl, sgs.astype(EV), shs.astype(EV))
+    if bundled and not wide:
         # bundle-native split scan: static per-lane window masks over the
         # [G, 256] group planes, derived ONCE per payload geometry and
         # reused across every level and tree (the per-feature path
@@ -680,17 +856,114 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
                 self.masks = blk_masks0.at[BM_VALID_R:BM_VALID_F + 1] \
                                        .multiply(fm_lane[None])
 
-    def eval_pair(gh, hh, rows, sgs, shs, cnts, depth_child, params,
-                  layout: ScanLayout):
-        """Best splits for two leaves from the per-plane hist tensors
-        (gh/hh: [L, TBp] f32 — separate grad/hess planes so no strided
-        channel slices exist anywhere; a fused gather+pad+channel-slice
-        miscompiles on TPU at large G).
+    def eval_batch_wide(gh, hh, rows, sgs, shs, cnts, depths, params,
+                        fmask):
+        """Widened split-find: the v1 f64 scan, batched over leaves.
 
-        rows: [2] i32 leaf-hist row ids; sgs/shs/cnts: [2] f32 sums.
-        Returns a [2, 12] f32 best-candidate matrix.
+        gh/hh are flat [L, TBe] f64 planes; rows: [B] i32 leaf-hist row
+        ids; sgs/shs/cnts/depths: [B]. Returns [B, 12] f64 BC matrix.
+        Ordering, tie-breaks, count recovery and leaf outputs come from
+        find_best_split_numerical itself, so they match the v1 grower
+        bit for bit given identical histograms."""
+        g2 = gh[rows]                                  # [B, TBe] f64
+        h2 = hh[rows]
+        sgs = sgs.astype(jnp.float64)
+        shs = shs.astype(jnp.float64)
+        nd = cnts.astype(I32)
+        fmask_b = None
+        if voting:
+            # PV-tree proposal/vote in the flat layout: each shard scans
+            # its LOCAL planes with 1/S-scaled thresholds, a psum'd vote
+            # picks the 2k winners, and only winner features' bins go
+            # global. The Mosaic path ships a compact [B, 2k, W] gather
+            # over the wire; this emulation psums a winner-masked plane
+            # — same values, test-grade comms.
+            B = rows.shape[0]
+            Sn_f = jax.lax.psum(jnp.asarray(1.0, jnp.float64), axis_name)
+            Sn_i = Sn_f.astype(I32)
+            local_sg = jnp.sum(g2, axis=1) / jnp.float64(max(F, 1))
+            local_sh = jnp.sum(h2, axis=1) / jnp.float64(max(F, 1)) \
+                + jnp.float64(2e-15)
+            local_cnt = jnp.round(
+                local_sh * nd.astype(jnp.float64)
+                / jnp.maximum(shs, jnp.float64(1e-12))).astype(I32)
+            p_local = params._replace(
+                min_data_in_leaf=jnp.maximum(
+                    params.min_data_in_leaf // jnp.maximum(Sn_i, 1), 1),
+                min_sum_hessian_in_leaf=(
+                    params.min_sum_hessian_in_leaf / Sn_f))
+            lg_all = jax.vmap(lambda g_, h_, sg_, sh_, nd_:
+                              find_best_split_numerical(
+                                  jnp.stack([g_, h_], axis=-1), sg_, sh_,
+                                  nd_, meta, p_local, -jnp.inf, jnp.inf,
+                                  fmask, F, use_mc=False, max_w=W_scan,
+                                  use_dp=True, use_l1=gc.use_l1,
+                                  use_mds=gc.use_mds,
+                                  feat_gains_only=True))(
+                g2, h2, local_sg, local_sh, local_cnt)        # [B, F]
+            neg = jnp.asarray(K_MIN_SCORE, jnp.float64)
+            vl = []
+            for c in range(B):
+                lg_ = lg_all[c]
+                _, ti = jax.lax.top_k(lg_, K_TOP)
+                vl.append(jnp.zeros((F,), I32).at[ti].add(
+                    (lg_[ti] > neg).astype(I32)))
+            votes = jax.lax.psum(jnp.stack(vl), axis_name)     # [B, F]
+            rank_key = votes * F - jnp.arange(F, dtype=I32)[None]
+            _, win_idx = jax.lax.top_k(rank_key, N_WIN)
+            arB = jnp.arange(B, dtype=I32)[:, None]
+            winb = jnp.zeros((B, F), BOOL).at[arB, win_idx].set(True)
+            win_lane = winb[:, meta.feat_id[:TBe]]             # [B, TBe]
+            red = jax.lax.psum(jnp.stack([
+                jnp.where(win_lane, g2, 0.0),
+                jnp.where(win_lane, h2, 0.0)]), axis_name)
+            g2 = jnp.where(win_lane, red[0], g2)
+            h2 = jnp.where(win_lane, red[1], h2)
+            fmask_b = fmask[None, :] & winb                    # [B, F]
+        hist = jnp.stack([g2, h2], axis=-1)                    # [B, TBe, 2]
+        if fmask_b is None:
+            cand = find_best_split_numerical_batch(
+                hist, sgs, shs, nd, meta, params, fmask, F,
+                use_dp=True, use_l1=gc.use_l1, use_mds=gc.use_mds,
+                max_w=W_scan)
+        else:
+            cand = jax.vmap(lambda h_, sg_, sh_, nd_, fm_:
+                            find_best_split_numerical(
+                                h_, sg_, sh_, nd_, meta, params,
+                                -jnp.inf, jnp.inf, fm_, F, use_mc=False,
+                                max_w=W_scan, use_dp=True,
+                                use_l1=gc.use_l1, use_mds=gc.use_mds))(
+                hist, sgs, shs, nd, fmask_b)
+        gain = cand.gain.astype(EV)
+        if gc.max_depth > 0:
+            gain = jnp.where(depths.astype(EV) < gc.max_depth, gain,
+                             jnp.asarray(K_MIN_SCORE, EV))
+        return jnp.stack([
+            gain,
+            cand.feature.astype(EV),
+            cand.threshold.astype(EV),
+            cand.default_left.astype(EV),
+            cand.left_sum_grad.astype(EV), cand.left_sum_hess.astype(EV),
+            cand.right_sum_grad.astype(EV),
+            cand.right_sum_hess.astype(EV),
+            cand.left_count.astype(EV), cand.right_count.astype(EV),
+            cand.left_output.astype(EV), cand.right_output.astype(EV),
+        ], axis=1)                                             # [B, 12]
+
+    def eval_batch(gh, hh, rows, sgs, shs, cnts, depths, params,
+                   layout):
+        """Best splits for a BATCH of leaves from the per-plane hist
+        tensors (gh/hh: [L, TBe] — separate grad/hess planes so no
+        strided channel slices exist anywhere; a fused
+        gather+pad+channel-slice miscompiles on TPU at large G).
+
+        rows: [B] i32 leaf-hist row ids; sgs/shs/cnts/depths: [B].
+        Historically B was the (left, right) pair of one split; the
+        level program feeds every frontier child of a level at once.
+        Returns a [B, 12] EV best-candidate matrix.
         """
-        g2 = gh[rows]                                  # [2, TBp]
+        B = rows.shape[0]
+        g2 = gh[rows]                                  # [B, TBe]
         h2 = hh[rows]
         p32 = params.cast(F32)
         sg = sgs.astype(F32)
@@ -700,14 +973,14 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
         cf = cnt / sh
         gain_shift = sg * sg / (sh + l2)
         mgs = gain_shift + p32.min_gain_to_split.astype(F32)
-        md = p32.min_data_in_leaf.astype(F32)
+        md_ = p32.min_data_in_leaf.astype(F32)
         mh = p32.min_sum_hessian_in_leaf.astype(F32)
 
         def finish(gain_b, best_f, t_b, use_f_b, lg, lh, lc, forced_r):
-            """Shared assembly of the [2, 12] best-candidate matrix."""
+            """Shared assembly of the [B, 12] best-candidate matrix."""
             best_valid = jnp.isfinite(gain_b)
             if gc.max_depth > 0:
-                best_valid &= depth_child < gc.max_depth
+                best_valid &= depths.astype(F32) < gc.max_depth
             rg = sg - lg
             rh = sh - lh
             rc = cnt - lc
@@ -722,7 +995,7 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
                 jnp.where(best_valid, default_left, True).astype(F32),
                 lg, lh, rg, rh,
                 jnp.floor(lc + 0.5), jnp.floor(rc + 0.5),
-                lo, ro], axis=1)                        # [2, 12]
+                lo, ro], axis=1)                        # [B, 12]
 
         if bundled:
             # bundle-native path: scan the [G, 256] group planes directly
@@ -730,18 +1003,18 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
             # tensors; masks come precomputed from the cached layout. The
             # kernel returns per-GROUP results with ABSOLUTE block-lane
             # thresholds; the owner map recovers the feature id.
-            gbB = jnp.pad(g2.reshape(2, G, W),
+            gbB = jnp.pad(g2.reshape(B, G, W),
                           ((0, 0), (0, Gp - G), (0, Wp - W)))
-            hbB = jnp.pad(h2.reshape(2, G, W),
+            hbB = jnp.pad(h2.reshape(B, G, W),
                           ((0, 0), (0, Gp - G), (0, Wp - W)))
             scal9 = jnp.stack([
                 sg, sh, cnt, cf,
-                jnp.broadcast_to(md, (2,)), jnp.broadcast_to(mh, (2,)),
-                mgs, jnp.broadcast_to(l2, (2,)),
+                jnp.broadcast_to(md_, (B,)), jnp.broadcast_to(mh, (B,)),
+                mgs, jnp.broadcast_to(l2, (B,)),
                 shs.astype(F32)], axis=1)
             outB = scan_blocks(scal9, gbB, hbB, layout.masks,
                                do_fix=has_fix, interpret=interpret)
-            gains_g = outB[:, 0, :]                    # [2, Gp]
+            gains_g = outB[:, 0, :]                    # [B, Gp]
             best_g = jnp.argmax(gains_g, axis=1)
 
             def takeg(row):
@@ -770,53 +1043,53 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
                                   / jnp.maximum(sh, F32(1e-12)))
             scal_l = jnp.stack([
                 local_sg, local_sh, local_cnt, local_cnt / local_sh,
-                jnp.broadcast_to(jnp.maximum(jnp.floor(md / Sn), 1.0),
-                                 (2,)),
-                jnp.broadcast_to(mh / Sn, (2,)),
+                jnp.broadcast_to(jnp.maximum(jnp.floor(md_ / Sn), 1.0),
+                                 (B,)),
+                jnp.broadcast_to(mh / Sn, (B,)),
                 local_sg * local_sg / (local_sh + l2)
                 + p32.min_gain_to_split.astype(F32),
-                jnp.broadcast_to(l2, (2,))], axis=1)
-            gb_l = jnp.pad(g2.reshape(2, G, W), pad_f)
-            hb_l = jnp.pad(h2.reshape(2, G, W), pad_f)
+                jnp.broadcast_to(l2, (B,))], axis=1)
+            gb_l = jnp.pad(g2.reshape(B, G, W), pad_f)
+            hb_l = jnp.pad(h2.reshape(B, G, W), pad_f)
             out_l = scan_pair(scal_l, gb_l, hb_l, layout.keep_r,
                               layout.keep_f, valid_r, valid_f, layout.aux,
                               interpret=interpret)
-            local_gains = out_l[:, 0, :][:, :F]        # [2, F]
+            local_gains = out_l[:, 0, :][:, :F]        # [B, F]
             neg = jnp.asarray(K_MIN_SCORE, F32)
             vl = []
-            for c in range(2):
+            for c in range(B):
                 lg_ = local_gains[c]
                 _, ti = jax.lax.top_k(lg_, K_TOP)
                 vl.append(jnp.zeros((F,), I32).at[ti].add(
                     (lg_[ti] > neg).astype(I32)))
-            votes = jax.lax.psum(jnp.stack(vl), axis_name)     # [2, F]
+            votes = jax.lax.psum(jnp.stack(vl), axis_name)     # [B, F]
             # stable ranking: ties keep the smaller feature id; the 2k
             # quota always fills (GlobalVoting, :153-184)
             rank_key = votes * F - jnp.arange(F, dtype=I32)[None]
-            _, win_idx = jax.lax.top_k(rank_key, N_WIN)        # [2, N_WIN]
+            _, win_idx = jax.lax.top_k(rank_key, N_WIN)        # [B, N_WIN]
             # the ACTUAL communication compression: gather only the 2k
             # winners' bin windows, psum that compact buffer, and scatter
-            # back — [2, 2, N_WIN, W] over the wire instead of the full
-            # [2, 2, TBp] planes (CopyLocalHistogram + ReduceScatter,
+            # back — [B, 2, N_WIN, W] over the wire instead of the full
+            # [B, 2, TBp] planes (CopyLocalHistogram + ReduceScatter,
             # voting_parallel_tree_learner.cpp:186-243)
-            g3 = g2.reshape(2, G, W)
-            h3 = h2.reshape(2, G, W)
+            g3 = g2.reshape(B, G, W)
+            h3 = h2.reshape(B, G, W)
             gw = jnp.take_along_axis(g3, win_idx[:, :, None], axis=1)
             hw = jnp.take_along_axis(h3, win_idx[:, :, None], axis=1)
             red = jax.lax.psum(jnp.stack([gw, hw]), axis_name)
-            ar2 = jnp.arange(2, dtype=I32)[:, None]
-            g2 = g3.at[ar2, win_idx].set(red[0]).reshape(2, TBp)
-            h2 = h3.at[ar2, win_idx].set(red[1]).reshape(2, TBp)
-            winb = jnp.zeros((2, F), BOOL).at[ar2, win_idx].set(True)
+            ar2 = jnp.arange(B, dtype=I32)[:, None]
+            g2 = g3.at[ar2, win_idx].set(red[0]).reshape(B, TBp)
+            h2 = h3.at[ar2, win_idx].set(red[1]).reshape(B, TBp)
+            winb = jnp.zeros((B, F), BOOL).at[ar2, win_idx].set(True)
             winp = jnp.pad(winb, ((0, 0), (0, layout.Fp - G)))
             valid_r = valid_r[None] * winp[:, :, None].astype(F32)
             valid_f = valid_f[None] * winp[:, :, None].astype(F32)
-        gb = jnp.pad(g2.reshape(2, G, W), pad_f)
-        hb = jnp.pad(h2.reshape(2, G, W), pad_f)
+        gb = jnp.pad(g2.reshape(B, G, W), pad_f)
+        hb = jnp.pad(h2.reshape(B, G, W), pad_f)
         scal = jnp.stack([
             sg, sh, cnt, cf,
-            jnp.broadcast_to(md, (2,)), jnp.broadcast_to(mh, (2,)),
-            mgs, jnp.broadcast_to(l2, (2,))], axis=1)
+            jnp.broadcast_to(md_, (B,)), jnp.broadcast_to(mh, (B,)),
+            mgs, jnp.broadcast_to(l2, (B,))], axis=1)
         out = scan_pair(scal, gb, hb, layout.keep_r, layout.keep_f,
                         valid_r, valid_f, layout.aux,
                         interpret=interpret)
@@ -835,14 +1108,27 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
         return finish(gain_b, best_f, t_b, use_f_b, lg, lh, lc,
                       layout.forced_right[best_f])
 
+    def evalB(gh, hh, rows, sgs, shs, cnts, depths, params, layout,
+              fmask):
+        """Eval dispatcher: the widened v1 f64 find in xla mode, the
+        fused Mosaic scan kernels otherwise."""
+        if wide:
+            return eval_batch_wide(gh, hh, rows, sgs, shs, cnts, depths,
+                                   params, fmask)
+        return eval_batch(gh, hh, rows, sgs, shs, cnts, depths, params,
+                          layout)
+
     def grow(pay, params: SplitParams, fmask, bag_cnt=None):
         """Grow one tree in place; returns (pay', lstate, tree, num_leaves,
-        root_value). bag_cnt: shard-local in-bag row count from the bag
-        transform (None = every live row in bag)."""
-        layout = (_BlockTreeLayout(fmask) if bundled
-                  else ScanLayout(pad_meta, fmask, F, W, TBp))
+        root_value, stats) where stats = [level_programs,
+        fallback_splits] i32. bag_cnt: shard-local in-bag row count from
+        the bag transform (None = every live row in bag)."""
+        layout = (None if wide else
+                  (_BlockTreeLayout(fmask) if bundled
+                   else ScanLayout(pad_meta, fmask, F, W, TBp)))
         rhist, sums = root_hist(pay)
-        gh0, hh0 = rhist
+        gh0 = to_flat(rhist[0])
+        hh0 = to_flat(rhist[1])
         root_cnt = (jnp.asarray(n, ST) if bag_cnt is None
                     else bag_cnt.astype(ST))
         if axis_name is not None:
@@ -855,23 +1141,26 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
                 hh0 = jax.lax.psum(hh0, axis_name)
         sum_grad = sums[0]
         sum_hess = sums[1]
-        p32 = params.cast(F32)
-        root_out = -sum_grad / (sum_hess + p32.lambda_l2.astype(F32))
-        gh = jnp.zeros((L, TBp), F32).at[0].set(gh0)
-        hh = jnp.zeros((L, TBp), F32).at[0].set(hh0)
+        gh0, hh0 = fix_store(gh0, hh0, sum_grad.astype(EV),
+                             sum_hess.astype(EV))
+        pE = params.cast(EV)
+        root_out = -sum_grad.astype(EV) \
+            / (sum_hess.astype(EV) + pE.lambda_l2.astype(EV))
+        gh = jnp.zeros((L, TBe), EV).at[0].set(gh0)
+        hh = jnp.zeros((L, TBe), EV).at[0].set(hh0)
         lstate = jnp.zeros((L, 8), ST).at[0].set(
             jnp.asarray([0, 0, 0, 0, 0, 0, 0, 0], ST)
             .at[LS_SG].set(sum_grad.astype(ST))
             .at[LS_SH].set(sum_hess.astype(ST))
             .at[LS_CNT].set(root_cnt).at[LS_VAL].set(root_out.astype(ST))
             .at[LS_NROWS].set(jnp.asarray(n, ST)))
-        pair0 = eval_pair(gh, hh, jnp.asarray([0, 0], I32),
-                          jnp.stack([sum_grad, sum_grad]),
-                          jnp.stack([sum_hess, sum_hess]),
-                          jnp.stack([root_cnt, root_cnt]),
-                          jnp.asarray(0, F32), params, layout)
-        best = jnp.full((L, 12), K_MIN_SCORE, F32).at[0].set(pair0[0])
-        # depth gate for the root itself: eval_pair checked depth 1
+        pair0 = evalB(gh, hh, jnp.asarray([0, 0], I32),
+                      jnp.stack([sum_grad, sum_grad]),
+                      jnp.stack([sum_hess, sum_hess]),
+                      jnp.stack([root_cnt, root_cnt]),
+                      jnp.zeros((2,), F32), params, layout, fmask)
+        best = jnp.full((L, 12), K_MIN_SCORE, EV).at[0].set(pair0[0])
+        # depth gate for the root itself: evalB checked depth 1
         state = _PState(
             s=jnp.asarray(1, I32),
             done=jnp.asarray(False),
@@ -881,7 +1170,205 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
             lstate=lstate,
             best=best,
             tree=jnp.zeros((L, 8), ST),
+            levels=jnp.asarray(0, I32),
         )
+
+        # ---- level-parallel phase: one fused program per tree level ----
+        if use_level:
+            arS = jnp.arange(S_MAXL, dtype=I32)
+
+            def level_cond(st: _PState):
+                """Run another batched level only while gain-ordered
+                admission provably cannot be truncated by the leaf
+                budget: remaining budget >= the depth-limited completion
+                capacity sum((2^(md-d_i)) - 1) of the positive-gain
+                frontier. With num_leaves >= 2^max_depth this holds for
+                every level (pure level growth); otherwise the per-split
+                tail takes over exactly where best-first admission could
+                start to differ."""
+                gains = st.best[:, BC_GAIN]
+                alive = jnp.arange(L, dtype=I32) < st.s
+                pos = alive & (gains > 0)
+                cntp = jnp.sum(pos, dtype=I32)
+                depth = st.lstate[:, LS_DEPTH].astype(I32)
+                cap_i = jnp.left_shift(
+                    jnp.asarray(1, I32),
+                    jnp.clip(md - depth, 0, LEVEL_MAX_DEPTH)) - 1
+                cap = jnp.sum(jnp.where(pos, cap_i, 0), dtype=I32)
+                return ((~st.done) & (st.s < L) & (cntp > 0)
+                        & (cntp <= S_MAXL) & ((L - st.s) >= cap))
+
+            def level_body(st: _PState) -> _PState:
+                gains = st.best[:, BC_GAIN]
+                alive = jnp.arange(L, dtype=I32) < st.s
+                pos = alive & (gains > 0)
+                cntp = jnp.sum(pos, dtype=I32)
+                # gain-ordered admission: slot j takes the j-th best
+                # frontier leaf (argsort is stable, so exact ties keep
+                # the smaller leaf id — the per-split argmax rule)
+                key = jnp.where(pos, gains, jnp.asarray(K_MIN_SCORE, EV))
+                order = jnp.argsort(-key).astype(I32)
+                slots = order[:S_MAXL]                     # [S] leaf ids
+                act = arS < cntp
+                bl = st.best[slots]                        # [S, 12]
+                lsb = st.lstate[slots]                     # [S, 8]
+                feat = jnp.maximum(bl[:, BC_FEAT].astype(I32), 0)
+                s0 = lsb[:, LS_START].astype(I32)
+                n_l = jnp.where(act, lsb[:, LS_NROWS].astype(I32), 0)
+                smaller_is_left = bl[:, BC_LCNT] <= bl[:, BC_RCNT]
+                nch = (n_l + C - 1) // C
+                scal_mat = jnp.stack([
+                    nch, s0, n_l,
+                    assets.dec_word[feat], assets.dec_shift[feat],
+                    assets.dec_mask[feat], assets.nb[feat],
+                    assets.mt[feat], assets.db[feat],
+                    bl[:, BC_THR].astype(I32), bl[:, BC_DL].astype(I32),
+                    smaller_is_left.astype(I32),
+                    assets.ls[feat], assets.le[feat], assets.mf[feat],
+                    jnp.zeros_like(n_l)], axis=1).astype(I32)  # [S, 16]
+                if kernel_impl == "xla":
+                    # emulation: the fused multi-leaf partition as a
+                    # dynamic-trip loop of per-slot reference passes
+                    # (semantically ONE level program; the Mosaic path
+                    # below is literally one launch)
+                    def sbody(jj, carry):
+                        payc, gs, hs, cs = carry
+                        pay2_, hist_, nl_ = split_pass(payc, scal_mat[jj])
+                        return (pay2_, gs.at[jj].set(to_flat(hist_[0])),
+                                hs.at[jj].set(to_flat(hist_[1])),
+                                cs.at[jj].set(nl_))
+                    pay2, sm_g, sm_h, n_lefts = jax.lax.fori_loop(
+                        0, cntp, sbody,
+                        (st.pay, jnp.zeros((S_MAXL, TBe), EV),
+                         jnp.zeros((S_MAXL, TBe), EV),
+                         jnp.zeros((S_MAXL,), I32)))
+                    act_h = act & (n_l > 0)
+                else:
+                    steps = jnp.where(n_l > 0, nch + 2, 0)
+                    ends = jnp.cumsum(steps, dtype=I32)
+                    base = ends - steps
+                    so = jnp.minimum(jnp.searchsorted(
+                        ends, jnp.arange(T_MAXL, dtype=I32),
+                        side="right").astype(I32), S_MAXL - 1)
+                    pay2, hist_raw, n_lefts = level_pass(
+                        st.pay, scal_mat, so, base, ends[S_MAXL - 1])
+                    sm_g, sm_h = jax.vmap(_unpack_hist_v)(hist_raw)
+                    # zero-step slots (active leaf, empty shard-local
+                    # segment) leave the kernel's hist/count outputs
+                    # UNDEFINED — the per-split tail's `ran` guard,
+                    # mirrored here before anything is summed or psum'd
+                    act_h = act & (n_l > 0)
+                n_lefts = jnp.where(act_h, n_lefts, 0)
+                if level_seg is not None:
+                    # many-group geometry: batched post-partition
+                    # smaller-child segment histograms (one launch)
+                    start_sm = jnp.where(smaller_is_left, s0,
+                                         s0 + n_lefts)
+                    len_sm = jnp.where(
+                        act, jnp.where(smaller_is_left, n_lefts,
+                                       n_l - n_lefts), 0)
+                    nch_s = (len_sm + C - 1) // C
+                    steps_s = jnp.where(len_sm > 0, nch_s, 0)
+                    ends_s = jnp.cumsum(steps_s, dtype=I32)
+                    base_s = ends_s - steps_s
+                    so_s = jnp.minimum(jnp.searchsorted(
+                        ends_s, jnp.arange(T_MAXL, dtype=I32),
+                        side="right").astype(I32), S_MAXL - 1)
+                    scal_s = jnp.stack(
+                        [nch_s, start_sm, len_sm,
+                         jnp.zeros_like(len_sm)], axis=1).astype(I32)
+                    hist_raw = level_seg(pay2, scal_s, so_s, base_s,
+                                         ends_s[S_MAXL - 1])
+                    sm_g, sm_h = jax.vmap(_unpack_hist_v)(hist_raw)
+                    act_h = act & (len_sm > 0)
+                sm_g = jnp.where(act_h[:, None], sm_g, 0.0)
+                sm_h = jnp.where(act_h[:, None], sm_h, 0.0)
+                if axis_name is not None:
+                    # ONE per-level histogram reduction for every
+                    # splitting leaf at once (the per-split path psums
+                    # per split — the level batch is also the collective
+                    # batching ROADMAP item 2 rides on)
+                    sm_g = jax.lax.psum(sm_g, axis_name)
+                    sm_h = jax.lax.psum(sm_h, axis_name)
+                if stat_from_scan:
+                    left_cnt = bl[:, BC_LCNT].astype(I32)
+                    right_cnt = bl[:, BC_RCNT].astype(I32)
+                else:
+                    left_cnt = (jax.lax.psum(n_lefts, axis_name)
+                                if axis_name is not None else n_lefts)
+                    right_cnt = (jnp.where(act, lsb[:, LS_CNT]
+                                           .astype(I32), 0) - left_cnt)
+                sm_sg = jnp.where(smaller_is_left, bl[:, BC_LSG],
+                                  bl[:, BC_RSG])
+                sm_sh = jnp.where(smaller_is_left, bl[:, BC_LSH],
+                                  bl[:, BC_RSH])
+                sm_g, sm_h = fix_store(sm_g, sm_h, sm_sg, sm_sh)
+                par_g = st.gh[slots]
+                par_h = st.hh[slots]
+                big_g = par_g - sm_g
+                big_h = par_h - sm_h
+                sl = smaller_is_left[:, None]
+                actc = act[:, None]
+                left_g = jnp.where(sl, sm_g, big_g)
+                left_h = jnp.where(sl, sm_h, big_h)
+                right_g = jnp.where(sl, big_g, sm_g)
+                right_h = jnp.where(sl, big_h, sm_h)
+                vgl, vgr, vhl, vhr = jax.lax.optimization_barrier(
+                    (jnp.where(actc, left_g, par_g),
+                     jnp.where(actc, right_g, jnp.zeros_like(right_g)),
+                     jnp.where(actc, left_h, par_h),
+                     jnp.where(actc, right_h, jnp.zeros_like(right_h))))
+                new_ids = jnp.where(act, st.s + arS, L)   # L -> dropped
+                gh = st.gh.at[slots].set(vgl) \
+                          .at[new_ids].set(vgr, mode="drop")
+                hh = st.hh.at[slots].set(vhl) \
+                          .at[new_ids].set(vhr, mode="drop")
+
+                depth_child = lsb[:, LS_DEPTH] + jnp.asarray(1, ST)
+                row_l = jnp.stack([
+                    bl[:, BC_LSG].astype(ST), bl[:, BC_LSH].astype(ST),
+                    left_cnt.astype(ST), bl[:, BC_LOUT].astype(ST),
+                    depth_child, s0.astype(ST), n_lefts.astype(ST),
+                    jnp.zeros_like(depth_child)], axis=1)
+                row_s = jnp.stack([
+                    bl[:, BC_RSG].astype(ST), bl[:, BC_RSH].astype(ST),
+                    right_cnt.astype(ST), bl[:, BC_ROUT].astype(ST),
+                    depth_child, (s0 + n_lefts).astype(ST),
+                    (n_l - n_lefts).astype(ST),
+                    jnp.zeros_like(depth_child)], axis=1)
+                lstate = st.lstate.at[slots].set(
+                    jnp.where(actc, row_l, lsb)) \
+                    .at[new_ids].set(row_s, mode="drop")
+
+                rec = jnp.stack([
+                    slots.astype(ST), bl[:, BC_FEAT].astype(ST),
+                    bl[:, BC_THR].astype(ST), bl[:, BC_DL].astype(ST),
+                    bl[:, BC_GAIN].astype(ST), lsb[:, LS_VAL],
+                    lsb[:, LS_CNT], jnp.zeros_like(lsb[:, LS_VAL])],
+                    axis=1)
+                tree_idx = jnp.where(act, st.s - 1 + arS, L)
+                tree = st.tree.at[tree_idx].set(rec, mode="drop")
+
+                # batched split-find for EVERY new child of the level
+                rows_b = jnp.concatenate(
+                    [slots, jnp.minimum(new_ids, L - 1)])
+                sgs_b = jnp.concatenate([bl[:, BC_LSG], bl[:, BC_RSG]])
+                shs_b = jnp.concatenate([bl[:, BC_LSH], bl[:, BC_RSH]])
+                cnts_b = jnp.concatenate([left_cnt, right_cnt])
+                depths_b = jnp.concatenate([depth_child, depth_child])
+                pairs = evalB(gh, hh, rows_b, sgs_b, shs_b,
+                              cnts_b, depths_b, params,
+                              layout, fmask)              # [2S, 12]
+                best = st.best.at[slots].set(
+                    jnp.where(actc, pairs[:S_MAXL], bl)) \
+                    .at[new_ids].set(pairs[S_MAXL:], mode="drop")
+                return st._replace(
+                    s=st.s + cntp, pay=pay2, gh=gh, hh=hh,
+                    lstate=lstate, best=best, tree=tree,
+                    levels=st.levels + 1)
+
+            state = jax.lax.while_loop(level_cond, level_body, state)
+        s_after_level = state.s
 
         def cond(st: _PState):
             return (~st.done) & (st.s < L)
@@ -932,14 +1419,14 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
             else:
                 sm_g, sm_h = hist_sm
                 ran_h = ran
-            sm_g = jnp.where(ran_h, sm_g, 0.0)
-            sm_h = jnp.where(ran_h, sm_h, 0.0)
+            sm_g = jnp.where(ran_h, to_flat(sm_g), 0.0)
+            sm_h = jnp.where(ran_h, to_flat(sm_h), 0.0)
             n_right = n_l - n_left
             if axis_name is not None and not voting:
                 # per-split histogram reduction
                 # (data_parallel_tree_learner.cpp:163-234); n_left/n_right
                 # stay shard-local for the payload segment geometry.
-                # Voting mode skips this: planes stay local and eval_pair
+                # Voting mode skips this: planes stay local and the eval
                 # psums only the globally voted features' bins
                 sm_g = jax.lax.psum(sm_g, axis_name)
                 sm_h = jax.lax.psum(sm_h, axis_name)
@@ -953,6 +1440,9 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
                             if axis_name is not None else n_left)
                 right_cnt = (jnp.where(do, ls[LS_CNT].astype(I32), 0)
                              - left_cnt)
+            sm_sg = jnp.where(smaller_is_left, bl[BC_LSG], bl[BC_RSG])
+            sm_sh = jnp.where(smaller_is_left, bl[BC_LSH], bl[BC_RSH])
+            sm_g, sm_h = fix_store(sm_g, sm_h, sm_sg, sm_sh)
             par_g = st.gh[l]
             par_h = st.hh[l]
             big_g = par_g - sm_g
@@ -970,12 +1460,13 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
             hh = st.hh.at[l].set(vhl).at[s].set(vhr)
 
             depth_child = (ls[LS_DEPTH] + 1.0).astype(ST)
-            pair = eval_pair(
+            pair = evalB(
                 gh, hh, jnp.stack([l, s]),
                 jnp.stack([bl[BC_LSG], bl[BC_RSG]]),
                 jnp.stack([bl[BC_LSH], bl[BC_RSH]]),
-                jnp.stack([left_cnt, right_cnt]).astype(F32),
-                depth_child, params, layout)
+                jnp.stack([left_cnt, right_cnt]),
+                jnp.stack([depth_child, depth_child]), params, layout,
+                fmask)
             best = st.best.at[l].set(jnp.where(do, pair[0], st.best[l])) \
                           .at[s].set(jnp.where(do, pair[1], st.best[s]))
 
@@ -1013,11 +1504,35 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
                 gh=gh, hh=hh, lstate=lstate, best=best, tree=tree)
 
         final = jax.lax.while_loop(cond, body, state)
-        return final.pay, final.lstate, final.tree, final.s, root_out
+        stats = jnp.stack([final.levels, final.s - s_after_level])
+        return (final.pay, final.lstate, final.tree, final.s, root_out,
+                stats)
+
+    def _read_score(pay, cls=0, base_row=None):
+        """Class `cls` score row(s) as a float vector ([NP]): f64 word
+        pairs in the widened mode (bit-compatible with the v1 f64 score
+        buffer), f32 bitcast otherwise."""
+        r = (score_row if base_row is None else base_row) + SR * cls
+        if score64:
+            return jax.lax.bitcast_convert_type(
+                pay[r:r + 2].T, jnp.float64)
+        return _f32r(pay[r])
+
+    def _write_score(pay, sc, cls=0, base_row=None):
+        r = (score_row if base_row is None else base_row) + SR * cls
+        if score64:
+            w = jax.lax.bitcast_convert_type(
+                sc.astype(jnp.float64), U32).T           # [2, NP]
+        else:
+            w = jax.lax.bitcast_convert_type(sc.astype(F32), U32)[None]
+        return jax.lax.dynamic_update_slice(
+            pay, w, (jnp.asarray(r, I32), jnp.asarray(0, I32)))
 
     def to_tree_arrays(lstate, tree, num_leaves) -> TreeArrays:
-        """The host-facing TreeArrays pytree (models.tree.Tree input)."""
-        ft = F32
+        """The host-facing TreeArrays pytree (models.tree.Tree input).
+        The widened mode hands f64 leaf values/gains through (v1 f64
+        parity); the Mosaic fast path stays f32 (gpu_use_dp=false)."""
+        ft = jnp.float64 if wide else F32
         return TreeArrays(
             num_leaves=num_leaves,
             split_leaf=tree[:L - 1, TR_LEAF].astype(I32),
@@ -1040,12 +1555,32 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
     def apply_scores(pay, lstate, num_leaves, shrink, cls=0):
         """score-row of class `cls` += shrink * leaf_value[leaf_of_position]
         via segment deltas: leaves partition positions into contiguous
-        runs."""
-        row = score_row + cls
+        runs. The widened mode gathers the per-leaf f64 product directly
+        (leaf of a position by searchsorted over live segment starts) so
+        each row's update is the same leaf_value * shrink product — and
+        the same single f64 add — as the v1 score updater."""
         starts = lstate[:, LS_START]
         nrows = lstate[:, LS_NROWS]
-        vals = (lstate[:, LS_VAL] * shrink.astype(ST)).astype(F32)
         live = (nrows > 0) & (jnp.arange(L, dtype=I32) < num_leaves)
+        if score64:
+            vals = lstate[:, LS_VAL] * shrink.astype(ST)
+            key = jnp.where(live, starts, jnp.inf)
+            order = jnp.argsort(key)
+            # searchsorted needs the MASKED starts: dead slots carry raw
+            # start 0 and would break monotonicity at the tail, silently
+            # mapping the last segments onto a dead slot whenever a tree
+            # finishes under the leaf budget
+            sstart = key[order]
+            svals = vals[order]
+            slive = live[order]
+            pos = jnp.arange(NP, dtype=I32).astype(ST)
+            idx = jnp.clip(jnp.searchsorted(sstart, pos, side="right")
+                           - 1, 0, L - 1)
+            upd = jnp.where(slive[idx], svals[idx], 0.0)
+            sc = _read_score(pay, cls)
+            sc = sc + jnp.where(num_leaves > 1, upd, 0.0)
+            return _write_score(pay, sc, cls)
+        vals = (lstate[:, LS_VAL] * shrink.astype(ST)).astype(F32)
         key = jnp.where(live, starts, jnp.inf)
         order = jnp.argsort(key)
         sv = vals[order]
@@ -1055,11 +1590,9 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
         pos = jnp.where(live_o, starts[order].astype(I32), NP)
         upd = jnp.zeros((NP,), F32).at[pos].add(delta, mode="drop")
         cum = jnp.cumsum(upd)
-        sc = jax.lax.bitcast_convert_type(pay[row], F32)
+        sc = _read_score(pay, cls)
         sc = sc + jnp.where(num_leaves > 1, cum, 0.0)
-        return jax.lax.dynamic_update_slice(
-            pay, jax.lax.bitcast_convert_type(sc[None, :], U32),
-            (jnp.asarray(row, I32), jnp.asarray(0, I32)))
+        return _write_score(pay, sc, cls)
 
     def _write_grads(pay, g, h):
         live = jnp.arange(NP, dtype=I32) < n
@@ -1078,9 +1611,21 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
         w = _f32r(pay[weight_row])
         return g * w, h * w
 
+    def _read_scores_block(pay, base_row):
+        """[K, NP] float view of a score/snapshot block."""
+        if score64:
+            return jax.lax.bitcast_convert_type(
+                pay[base_row:base_row + 2 * K].reshape(K, 2, NP)
+                .transpose(0, 2, 1), jnp.float64)
+        return jax.lax.bitcast_convert_type(
+            pay[base_row:base_row + K], F32)
+
     def fill_grad(pay, payload_grad_fn):
         label = jax.lax.bitcast_convert_type(pay[nbw], F32)
-        score = jax.lax.bitcast_convert_type(pay[score_row], F32)
+        # widened mode hands the f64 score through: dtype-following
+        # objectives then compute f64 gradients and _write_grads rounds
+        # once to f32 — the exact v1 gradient pipeline
+        score = _read_score(pay)
         g, h = payload_grad_fn(score, label)
         g, h = _apply_weight(g, h, pay)
         return _write_grads(pay, g, h)
@@ -1089,14 +1634,13 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
         """Copy the live score rows into the snapshot block (iteration
         start): all K class gradients read pre-iteration scores."""
         return jax.lax.dynamic_update_slice(
-            pay, pay[score_row:score_row + K],
+            pay, pay[score_row:score_row + SR * K],
             (jnp.asarray(snap_row, I32), jnp.asarray(0, I32)))
 
     def fill_grad_multi(pay, payload_grad_fn_multi, cls):
         """Class `cls` gradients from the snapshot score block."""
         label = jax.lax.bitcast_convert_type(pay[nbw], F32)
-        scores = jax.lax.bitcast_convert_type(
-            pay[snap_row:snap_row + K], F32)            # [K, NP]
+        scores = _read_scores_block(pay, snap_row)      # [K, NP]
         g, h = payload_grad_fn_multi(scores, label, cls)
         g, h = _apply_weight(g, h, pay)
         return _write_grads(pay, g, h)
@@ -1110,12 +1654,11 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
         if axis_name is not None:
             rid = rid - jax.lax.axis_index(axis_name).astype(I32) * n
         if K == 1:
-            score = jax.lax.bitcast_convert_type(pay[score_row], F32)
-            return jnp.zeros((n,), F32).at[rid].set(
+            score = _read_score(pay)
+            return jnp.zeros((n,), score.dtype).at[rid].set(
                 score, mode="drop", unique_indices=True)
-        scores = jax.lax.bitcast_convert_type(
-            pay[score_row:score_row + K], F32)
-        return jnp.zeros((K, n), F32).at[:, rid].set(
+        scores = _read_scores_block(pay, score_row)
+        return jnp.zeros((K, n), scores.dtype).at[:, rid].set(
             scores, mode="drop", unique_indices=True)
 
     def fill_grad_pos(pay, pos_grad_fn, gargs):
@@ -1125,7 +1668,7 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
         map and gathers the lambdas straight back, skipping the row-order
         round trip of fill_grad_row."""
         rid = pay[nbw + 1].astype(I32)
-        score = _f32r(pay[score_row])
+        score = _read_score(pay)
         live = jnp.arange(NP, dtype=I32) < n
         # pos-mode fns own their weighting (they get the weights through
         # gargs in whatever layout suits them — lambdarank multiplies the
@@ -1152,14 +1695,20 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
         return jax.lax.dynamic_update_slice(
             pay, gh, (jnp.asarray(grad_row, I32), jnp.asarray(0, I32)))
 
+    SDT = jnp.float64 if score64 else F32   # payload score value dtype
+
     def set_scores(pay, score_pos):
         """Write payload-order score rows ([NP] or [K, NP])."""
-        sc = score_pos.astype(F32)
+        sc = score_pos.astype(SDT)
         if sc.ndim == 1:
             sc = sc[None, :]
+        if score64:
+            w = jax.lax.bitcast_convert_type(sc, U32) \
+                .transpose(0, 2, 1).reshape(SR * K, NP)
+        else:
+            w = jax.lax.bitcast_convert_type(sc, U32)
         return jax.lax.dynamic_update_slice(
-            pay, jax.lax.bitcast_convert_type(sc, U32),
-            (jnp.asarray(score_row, I32), jnp.asarray(0, I32)))
+            pay, w, (jnp.asarray(score_row, I32), jnp.asarray(0, I32)))
 
     @jax.jit
     def init_carry(pay, score0_row):
@@ -1167,8 +1716,8 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
         vector ([n] or [K, n], any float dtype). One fused device program
         — the eager op chain costs seconds of dispatch latency under
         remote TPU."""
-        s0 = score0_row.astype(F32).reshape(K, n)
-        sc = jnp.zeros((K, NP), F32).at[:, :n].set(s0)
+        s0 = score0_row.astype(SDT).reshape(K, n)
+        sc = jnp.zeros((K, NP), SDT).at[:, :n].set(s0)
         return set_scores(pay, sc)
 
     class _Grower:
@@ -1190,7 +1739,12 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
     gr.n = n
     gr.nbw = nbw
     gr.K = K
-    gr._eval_pair = eval_pair          # debug/testing hooks
+    gr.score64 = score64
+    gr.wide = wide
+    gr.use_level = use_level
+    gr.S_MAXL = S_MAXL
+    gr._eval_batch = evalB             # debug/testing hooks
+    gr._eval_pair = evalB              # historical alias (B = 2)
     gr._root_hist = root_hist
     gr._pad_meta = pad_meta
     return gr
@@ -1206,7 +1760,10 @@ def make_scan_driver(gr, gc, k: int, grad_fn, grad_mode: str = "payload",
     scatter-through-rid mode); 'row' takes (score_row, *gargs) — the
     objective's standard grad function fed by a per-tree scatter/gather
     through the rid row. Returns fn(pay, fmasks [k, F], wkeys [k, 2]u32,
-    iters [k]i32, params, shrink, gargs) -> (pay', stacked TreeArrays).
+    iters [k]i32, params, shrink, gargs) -> (pay', stacked TreeArrays,
+    stats [2] i32 = summed [level_programs, level_fallback_splits] over
+    the batch — the learner converts them to telemetry counters at
+    finalize time, keeping the dispatch fully async).
 
     bag_fn: optional make_bag_transform closure run between the gradient
     fill and the grow (bagging masks / GOSS weights applied to the payload
@@ -1228,6 +1785,7 @@ def make_scan_driver(gr, gc, k: int, grad_fn, grad_mode: str = "payload",
                 # every class come from the pre-iteration scores)
                 pay = gr.snapshot_scores(pay)
                 outs = []
+                stats = jnp.zeros((2,), jnp.int32)
                 for cls in range(K):
                     pay = gr.fill_grad_multi(pay, grad_fn, cls)
                     bag_cnt = None
@@ -1235,12 +1793,13 @@ def make_scan_driver(gr, gc, k: int, grad_fn, grad_mode: str = "payload",
                         # same window key for every class: one bag per
                         # iteration, as in the reference
                         pay, bag_cnt = bag_fn(pay, wkey, it)
-                    pay, lstate, tree, nl, _root = gr.grow(
+                    pay, lstate, tree, nl, _root, tstats = gr.grow(
                         pay, params, fmask[cls], bag_cnt=bag_cnt)
+                    stats = stats + tstats
                     pay = gr.apply_scores(pay, lstate, nl, shrink, cls)
                     outs.append(gr.to_tree_arrays(lstate, tree, nl))
                 out = jax.tree.map(lambda *xs: jnp.stack(xs), *outs)
-                return pay, out
+                return pay, (out, stats)
             if grad_mode == "pos":
                 pay = gr.fill_grad_pos(pay, grad_fn, gargs)
             elif grad_mode == "row":
@@ -1250,20 +1809,20 @@ def make_scan_driver(gr, gc, k: int, grad_fn, grad_mode: str = "payload",
             bag_cnt = None
             if bag_fn is not None:
                 pay, bag_cnt = bag_fn(pay, wkey, it)
-            pay, lstate, tree, nl, _root = gr.grow(pay, params, fmask,
-                                                   bag_cnt=bag_cnt)
+            pay, lstate, tree, nl, _root, stats = gr.grow(
+                pay, params, fmask, bag_cnt=bag_cnt)
             pay = gr.apply_scores(pay, lstate, nl, shrink)
             out = gr.to_tree_arrays(lstate, tree, nl)
-            return pay, out
-        payK, stacked = jax.lax.scan(body, pay, (fmasks, wkeys, iters),
-                                     length=k)
+            return pay, (out, stats)
+        payK, (stacked, stats_k) = jax.lax.scan(
+            body, pay, (fmasks, wkeys, iters), length=k)
         if K > 1:
             # [k, K, ...] -> [k*K, ...]: trees in (iteration, class) order,
             # the model list layout the booster materializes
             stacked = jax.tree.map(
                 lambda a: a.reshape((a.shape[0] * a.shape[1],)
                                     + a.shape[2:]), stacked)
-        return payK, stacked
+        return payK, stacked, jnp.sum(stats_k, axis=0)
 
     if wrap_jit:
         return telemetry.launch_wrapper(

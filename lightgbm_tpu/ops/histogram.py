@@ -25,9 +25,11 @@ import jax
 import jax.numpy as jnp
 
 
-@functools.partial(jax.jit, static_argnames=("total_bins", "rows_per_chunk"))
+@functools.partial(jax.jit,
+                   static_argnames=("total_bins", "rows_per_chunk", "dtype"))
 def build_histogram(bins_global: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
-                    total_bins: int, rows_per_chunk: int = 0) -> jnp.ndarray:
+                    total_bins: int, rows_per_chunk: int = 0,
+                    dtype=jnp.float32) -> jnp.ndarray:
     """Histogram over all features at once.
 
     Args:
@@ -35,15 +37,19 @@ def build_histogram(bins_global: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarr
       grad, hess: [N] float32 per-row gradient/hessian (0 for masked-out rows).
       total_bins: static total number of global bins.
       rows_per_chunk: rows per scatter chunk; 0 = single shot.
+      dtype: accumulator dtype. f64 sums of f32 per-row values are EXACT
+        (each partial sum fits 53 mantissa bits at histogram scales), so
+        f64 bins are order-independent — the reference CPU learner's
+        double histograms (hist_t, src/treelearner/feature_histogram.hpp).
 
     Returns:
-      [total_bins, 2] float32: sum_grad, sum_hess per global bin.
+      [total_bins, 2] `dtype`: sum_grad, sum_hess per global bin.
     """
     n, g = bins_global.shape
-    vals = jnp.stack([grad, hess], axis=-1)  # [N, 2]
+    vals = jnp.stack([grad, hess], axis=-1).astype(dtype)  # [N, 2]
 
     if rows_per_chunk <= 0 or rows_per_chunk >= n:
-        return _hist_one_shot(bins_global, vals, total_bins)
+        return _hist_one_shot(bins_global, vals, total_bins, dtype)
 
     num_chunks = (n + rows_per_chunk - 1) // rows_per_chunk
     pad = num_chunks * rows_per_chunk - n
@@ -54,20 +60,20 @@ def build_histogram(bins_global: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarr
     vals_c = vals.reshape(num_chunks, rows_per_chunk, 2)
 
     def body(i, acc):
-        return acc + _hist_one_shot(bins_c[i], vals_c[i], total_bins)
+        return acc + _hist_one_shot(bins_c[i], vals_c[i], total_bins, dtype)
 
-    init = jnp.zeros((total_bins, 2), dtype=jnp.float32)
+    init = jnp.zeros((total_bins, 2), dtype=dtype)
     return jax.lax.fori_loop(0, num_chunks, body, init)
 
 
 def _hist_one_shot(bins_global: jnp.ndarray, vals: jnp.ndarray,
-                   total_bins: int) -> jnp.ndarray:
+                   total_bins: int, dtype=jnp.float32) -> jnp.ndarray:
     """One scatter-add over [N, G] -> [total_bins, 2]."""
     n, g = bins_global.shape
     flat_idx = bins_global.reshape(-1)                       # [N*G]
     # each row's (grad, hess) contributes to one bin per group
     flat_vals = jnp.broadcast_to(vals[:, None, :], (n, g, 2)).reshape(-1, 2)
-    hist = jnp.zeros((total_bins, 2), dtype=jnp.float32)
+    hist = jnp.zeros((total_bins, 2), dtype=dtype)
     return hist.at[flat_idx].add(flat_vals)
 
 

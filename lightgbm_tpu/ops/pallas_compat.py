@@ -34,3 +34,25 @@ try:  # pallas ships with jax; guard for exotic builds
 except Exception:  # pragma: no cover
     pl = pltpu = TPUCompilerParams = None
     HAS_PALLAS = False
+
+
+def _jax_version_tuple():
+    try:
+        return tuple(int(x) for x in _jax.__version__.split(".")[:2])
+    except Exception:  # pragma: no cover - exotic version strings
+        return (0, 0)
+
+
+def dynamic_grid_interpret_ok() -> bool:
+    """Whether the Pallas INTERPRETER can discharge the dynamic-grid
+    scalar-prefetch kernels (split_pass / level_pass).
+
+    jax 0.4.x's state-discharge pass rejects them under jax_enable_x64:
+    the aliased-payload update mixes weak-typed literals into a
+    ``lax.dynamic_update_slice`` with mismatched f32/f64 dtypes
+    (jax/_src/state/discharge.py raises TypeError). Real-TPU Mosaic
+    lowering and jax >= 0.5 interpret mode are unaffected. Callers that
+    would run such a kernel with interpret=True on an affected jax should
+    fall back to the XLA kernel emulation (grow_persist does, loudly) and
+    tests skip instead of erroring — tier-1 on old jax stays quiet."""
+    return _jax_version_tuple() >= (0, 5)

@@ -471,6 +471,332 @@ def make_split_pass(WPA: int, NP: int, G: int, plan, nbw: int,
 
 
 # ---------------------------------------------------------------------------
+# level_pass: one launch partitions EVERY splitting leaf of a tree level
+# ---------------------------------------------------------------------------
+
+def make_level_pass(WPA: int, NP: int, G: int, plan, nbw: int,
+                    S_max: int, T_max: int, C: int = 8192,
+                    interpret: bool = False, wp_live: int = 0,
+                    _skip_hist: bool = False):
+    """Multi-leaf split_pass: the level-parallel grower's fused partition.
+
+    One pallas_call partitions the payload segments of up to ``S_max``
+    splitting leaves (slots) and accumulates each slot's smaller-child
+    histogram — the per-split kernel's logic with the slot id derived
+    per grid step from prefetched step tables, so a whole tree level
+    costs ONE device-program launch instead of one per split (the
+    launch/dispatch overhead that dominated EFB-bundled shapes like
+    Expo: ~254 launches per 255-leaf tree).
+
+    Per-slot scalars arrive as one [S_max, 16] i32 matrix in S_* column
+    order (columns 15 unused); ``slot_of_step`` [T_max] and
+    ``base_of_slot`` [S_max] map the flat dynamic grid onto (slot,
+    local step): slot j owns steps [base[j], base[j] + nch_j + 2) and
+    runs init / read / 2-deep-FIFO drain / fin exactly like
+    make_split_pass. Slots' segments are disjoint and the grid is
+    sequential, so the in-place two-ended writeback stays safe; the
+    payload keeps its input_output_aliases (in-place contract).
+
+    Returns fn(pay, scal_mat, slot_of_step, base_of_slot, grid) ->
+    (pay', hist [S_max, G, 16, 64] raw accumulator, n_left [S_max]).
+    Slots with zero steps leave their hist/count outputs UNDEFINED —
+    callers mask by activity.
+    """
+    assert WPA % 8 == 0, "payload row count must be padded to 8"
+    E = C + 128
+    grad_row = nbw + 2
+    WP_LIVE = wp_live or (nbw + 5)
+    assert WP_LIVE <= WPA
+
+    def kernel(sm, so, bo, pay_in, pay_out, hist_out, cnt_ref,
+               hacc, wbuf, obuf, rbuf, slots, st, sem_r, sem_w, sem_rmw,
+               sem_h):
+        i = pl.program_id(0)
+        j = so[i]                       # slot of this step
+        lo = i - bo[j]                  # local step within the slot
+        nch = sm[j, S_NCH]
+        lane = _lane_iota(E)[0]
+
+        @pl.when(i == 0)
+        def _seed():
+            if interpret:
+                # on hardware pay_out IS pay_in (input_output_aliases);
+                # the interpreter does not alias, so seed the output once
+                cpi = pltpu.make_async_copy(pay_in, pay_out, sem_r)
+                cpi.start()
+                cpi.wait()
+
+        @pl.when(lo == 0)
+        def _init():
+            st[0] = sm[j, S_S0]
+            st[1] = sm[j, S_S0] + sm[j, S_NL]
+            st[2] = sm[j, S_S0]
+            st[3] = sm[j, S_S0] + sm[j, S_NL]
+            st[4] = 0
+            st[5] = 0
+            st[6] = 0
+            hacc[...] = jnp.zeros_like(hacc)
+
+        # ---- drain phase first: write FIFO slot (lo-2)%2 ----------------
+        @pl.when((lo >= 2) & (lo < nch + 2))
+        def _drain():
+            p = jax.lax.rem(lo, jnp.int32(2))
+            nL_ = jnp.where(p == 0, st[7], st[9])
+            nR_ = jnp.where(p == 0, st[8], st[10])
+            src_l = jnp.where(p == 0, slots[0], slots[2])
+            src_r = jnp.where(p == 0, slots[1], slots[3])
+
+            lf = st[2]
+            al = _align128(lf)
+            dL = lf - al
+            cp = pltpu.make_async_copy(
+                pay_out.at[:, pl.ds(al, E)], rbuf, sem_rmw)
+            cp.start()
+            cp.wait()
+            sel = (lane >= dL) & (lane < dL + nL_)
+            obuf[:WP_LIVE] = jnp.where(sel[None, :],
+                                       pltpu.roll(src_l, dL, 1),
+                                       rbuf[:WP_LIVE])
+            if WP_LIVE < WPA:
+                obuf[WP_LIVE:] = rbuf[WP_LIVE:]
+            cpw = pltpu.make_async_copy(
+                obuf, pay_out.at[:, pl.ds(al, E)], sem_w)
+            cpw.start()
+            cpw.wait()
+            st[2] = lf + nL_
+            st[4] = st[4] - nL_
+
+            rf = st[3]
+            rs = rf - nR_
+            al2 = _align128(rs)
+            dR = rs - al2
+            cp2 = pltpu.make_async_copy(
+                pay_out.at[:, pl.ds(al2, E)], rbuf, sem_rmw)
+            cp2.start()
+            cp2.wait()
+            sel2 = (lane >= dR) & (lane < dR + nR_)
+            obuf[:WP_LIVE] = jnp.where(sel2[None, :],
+                                       pltpu.roll(src_r, dR + nR_, 1),
+                                       rbuf[:WP_LIVE])
+            if WP_LIVE < WPA:
+                obuf[WP_LIVE:] = rbuf[WP_LIVE:]
+            cpw2 = pltpu.make_async_copy(
+                obuf, pay_out.at[:, pl.ds(al2, E)], sem_w)
+            cpw2.start()
+            cpw2.wait()
+            st[3] = rf - nR_
+            st[5] = st[5] - nR_
+
+        # ---- read + process phase (local steps 0 .. nch-1) --------------
+        @pl.when(lo < nch)
+        def _read():
+            fr = st[0]
+            br = st[1]
+            front_gap = fr - st[2] - st[4]
+            back_gap = st[3] - st[5] - br
+            m = jnp.minimum(jnp.int32(C), jax.lax.sub(br, fr))
+            use_front = front_gap <= back_gap
+            ptr = jnp.where(use_front, fr, br - m)
+            st[0] = jnp.where(use_front, fr + m, fr)
+            st[1] = jnp.where(use_front, br, br - m)
+
+            al = _align128(ptr)
+            cp = pltpu.make_async_copy(
+                pay_out.at[:, pl.ds(al, E)], wbuf, sem_r)
+            cp.start()
+            cp.wait()
+            d = ptr - al
+            w = pltpu.roll(wbuf[...], jax.lax.sub(jnp.int32(E), d), 1)
+            valid = lane < m
+
+            word = w[0, :] * U32(0)
+            for r_ in range(nbw):
+                word = jnp.where(sm[j, S_WG] == r_, w[r_, :], word)
+            b_raw = ((word >> sm[j, S_SH].astype(U32))
+                     & sm[j, S_MASK].astype(U32)).astype(I32)
+            in_r = (b_raw >= sm[j, S_LS]) & (b_raw < sm[j, S_LE])
+            b = jnp.where(in_r, b_raw - sm[j, S_LS], sm[j, S_MF])
+            cmp_left = b <= sm[j, S_THR]
+            is_na = (sm[j, S_MT] == 2) & (b == sm[j, S_NB] - 1)
+            is_zero = (sm[j, S_MT] == 1) & (b == sm[j, S_DB])
+            dlv = (jnp.zeros_like(b) + sm[j, S_DL]) > 0
+            gd = is_na | is_zero
+            go_left = (gd & dlv) | ((~gd) & cmp_left)
+
+            gl = valid & go_left
+            gr = valid & (~go_left)
+            nL = jnp.sum(gl.astype(F32), dtype=F32).astype(I32)
+            nR = m - nL
+            st[6] = st[6] + nL
+
+            hm = (valid & (go_left == (sm[j, S_SMALL_L] > 0))).astype(F32)
+            grad = _f32r(w[grad_row, :]) * hm
+            hess = _f32r(w[grad_row + 1, :]) * hm
+            if not _skip_hist:
+                bins_g = _unpack_group_bins(w, plan)
+                _hist_accum(hacc, bins_g, grad, hess, G)
+
+            wp_rows = w[:WP_LIVE]
+            packedL = _compact(wp_rows, gl, E, to_right=False)
+            packedR = _compact(wp_rows, gr, E, to_right=True)
+
+            pr = jax.lax.rem(lo, jnp.int32(2))
+
+            @pl.when(pr == 0)
+            def _():
+                slots[0] = packedL
+                slots[1] = packedR
+                st[7] = nL
+                st[8] = nR
+
+            @pl.when(pr == 1)
+            def _():
+                slots[2] = packedL
+                slots[3] = packedR
+                st[9] = nL
+                st[10] = nR
+            st[4] = st[4] + nL
+            st[5] = st[5] + nR
+
+        @pl.when(lo == jax.lax.add(nch, jnp.int32(1)))
+        def _fin():
+            cnt_ref[j] = st[6]
+            cph = pltpu.make_async_copy(hacc, hist_out.at[j], sem_h)
+            cph.start()
+            cph.wait()
+
+    E_ = C + 128
+    _vmem_req = min(96 << 20,
+                    7 * WPA * E_ * 4 + G * 16 * 64 * 4 + (20 << 20)
+                    + 3 * WPA * E_ * 4)
+    _cparams = _TPUCompilerParams(vmem_limit_bytes=int(_vmem_req))
+
+    @jax.jit
+    def level_pass(pay, scal_mat, slot_of_step, base_of_slot, grid):
+        with enable_x64(False):
+            pay2, hist, cnt = _call(pay, scal_mat, slot_of_step,
+                                    base_of_slot,
+                                    jnp.maximum(grid, 1).astype(jnp.int32))
+        return pay2, hist, cnt
+
+    def _call(pay, scal_mat, slot_of_step, base_of_slot, grid):
+        return pl.pallas_call(
+            kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=3,
+                grid=(grid,),
+                in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+                out_specs=[
+                    pl.BlockSpec(memory_space=pltpu.ANY),
+                    pl.BlockSpec(memory_space=pltpu.ANY),
+                    pl.BlockSpec((S_max,), lambda i, *s: (i * 0,),
+                                 memory_space=pltpu.SMEM),
+                ],
+                scratch_shapes=[
+                    pltpu.VMEM((G, 16, 64), F32),   # hist accumulator
+                    pltpu.VMEM((WPA, E), U32),      # wbuf
+                    pltpu.VMEM((WPA, E), U32),      # obuf
+                    pltpu.VMEM((WPA, E), U32),      # rbuf
+                    pltpu.VMEM((4, WP_LIVE, E), U32),  # FIFO slots
+                    pltpu.SMEM((12,), I32),         # st
+                    pltpu.SemaphoreType.DMA,
+                    pltpu.SemaphoreType.DMA,
+                    pltpu.SemaphoreType.DMA,
+                    pltpu.SemaphoreType.DMA,
+                ],
+            ),
+            out_shape=[
+                jax.ShapeDtypeStruct((WPA, NP), U32),
+                jax.ShapeDtypeStruct((S_max, G, 16, 64), F32),
+                jax.ShapeDtypeStruct((S_max,), I32),
+            ],
+            input_output_aliases={3: 0},
+            compiler_params=_cparams,
+            interpret=interpret,
+        )(scal_mat, slot_of_step, base_of_slot, pay)
+
+    return level_pass
+
+
+def make_level_seg_hist(WPA: int, NP: int, G: int, plan, nbw: int,
+                        S_max: int, T_max: int, C: int = 16384,
+                        interpret: bool = False):
+    """Batched seg_hist: smaller-child histograms of up to ``S_max``
+    contiguous payload segments in ONE launch (the level-parallel
+    companion of make_seg_hist, used when the group count makes the
+    in-partition histogram accumulation uneconomical).
+
+    Per-slot scalars: [S_max, 4] i32 (nch, start, length, pad); step
+    tables as in make_level_pass. Returns fn(pay, scal_mat,
+    slot_of_step, base_of_slot, grid) -> hist [S_max, G, 16, 64] raw
+    accumulator; zero-length slots leave their plane UNDEFINED.
+    """
+    assert WPA % 8 == 0
+    E = C + 128
+    grad_row = nbw + 2
+
+    def kernel(sm, so, bo, pay_hbm, hist_out, hacc, wbuf, sem_r, sem_h):
+        i = pl.program_id(0)
+        j = so[i]
+        lo = i - bo[j]
+
+        @pl.when(lo == 0)
+        def _init():
+            hacc[...] = jnp.zeros_like(hacc)
+
+        ptr = sm[j, 1] + lo * C
+        m = jnp.minimum(jnp.int32(C), sm[j, 2] - lo * C)
+        al = _align128(ptr)
+        cp = pltpu.make_async_copy(
+            pay_hbm.at[:, pl.ds(al, E)], wbuf, sem_r)
+        cp.start()
+        cp.wait()
+        d = ptr - al
+        w = pltpu.roll(wbuf[...], jax.lax.sub(jnp.int32(E), d), 1)
+        lane = _lane_iota(E)[0]
+        valid = (lane < m).astype(F32)
+        grad = _f32r(w[grad_row, :]) * valid
+        hess = _f32r(w[grad_row + 1, :]) * valid
+        bins_g = _unpack_group_bins(w, plan)
+        _hist_accum(hacc, bins_g, grad, hess, G)
+
+        @pl.when(lo == sm[j, 0] - 1)
+        def _fin():
+            cph = pltpu.make_async_copy(hacc, hist_out.at[j], sem_h)
+            cph.start()
+            cph.wait()
+
+    _vmem_req = min(96 << 20,
+                    2 * WPA * E * 4 + G * 16 * 64 * 4 + (20 << 20))
+    _cparams = _TPUCompilerParams(vmem_limit_bytes=int(_vmem_req))
+
+    @jax.jit
+    def level_seg_hist(pay, scal_mat, slot_of_step, base_of_slot, grid):
+        with enable_x64(False):
+            hist = pl.pallas_call(
+                kernel,
+                grid_spec=pltpu.PrefetchScalarGridSpec(
+                    num_scalar_prefetch=3,
+                    grid=(jnp.maximum(grid, 1).astype(jnp.int32),),
+                    in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+                    out_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+                    scratch_shapes=[
+                        pltpu.VMEM((G, 16, 64), F32),
+                        pltpu.VMEM((WPA, E), U32),
+                        pltpu.SemaphoreType.DMA,
+                        pltpu.SemaphoreType.DMA,
+                    ],
+                ),
+                out_shape=[jax.ShapeDtypeStruct((S_max, G, 16, 64), F32)],
+                compiler_params=_cparams,
+                interpret=interpret,
+            )(scal_mat, slot_of_step, base_of_slot, pay)[0]
+        return hist
+
+    return level_seg_hist
+
+
+# ---------------------------------------------------------------------------
 # seg_hist
 # ---------------------------------------------------------------------------
 

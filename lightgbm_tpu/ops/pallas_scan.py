@@ -181,19 +181,24 @@ def _scan_kernel(scal_ref, gb_ref, hb_ref, keepr_ref, keepf_ref,
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def scan_pair(scal, gb, hb, keep_r, keep_f, valid_r, valid_f, aux,
               interpret: bool = False):
-    """Run the fused scan for both children.
+    """Run the fused scan for a batch of children (one grid step each).
 
-    scal: [2, 8] f32; gb/hb: [2, Fp, Wp] f32; valid masks: [Fp, Wp] f32
-    shared, or [2, Fp, Wp] per child (the voting-parallel win masks);
+    Historically the batch was exactly the (left, right) pair of one
+    split; the level-parallel grower feeds ALL frontier children of a
+    tree level at once — the kernel body is per-child either way, so the
+    batch size is simply the leading dim B.
+
+    scal: [B, 8] f32; gb/hb: [B, Fp, Wp] f32; valid masks: [Fp, Wp] f32
+    shared, or [B, Fp, Wp] per child (the voting-parallel win masks);
     keep masks: [Fp, Wp] f32; aux: [8, Fp] f32 (row 0 = penalty).
-    Returns [2, 8, Fp] f32.
+    Returns [B, 8, Fp] f32.
     """
-    _, Fp, Wp = gb.shape
+    B, Fp, Wp = gb.shape
     if valid_r.ndim == 2:
-        valid_r = jnp.broadcast_to(valid_r, (2, Fp, Wp))
+        valid_r = jnp.broadcast_to(valid_r, (B, Fp, Wp))
     if valid_f.ndim == 2:
-        valid_f = jnp.broadcast_to(valid_f, (2, Fp, Wp))
-    scal = jnp.zeros((2, 1, 128), jnp.float32).at[:, 0, :8].set(scal)
+        valid_f = jnp.broadcast_to(valid_f, (B, Fp, Wp))
+    scal = jnp.zeros((B, 1, 128), jnp.float32).at[:, 0, :8].set(scal)
     # the kernel stages ~12 [Fp, Wp] f32 blocks plus Mosaic temporaries;
     # the default scoped-vmem budget OOMs past ~450 features at Wp=256
     # (v5e carries 128MB of VMEM, so size the limit to the footprint)
@@ -201,7 +206,7 @@ def scan_pair(scal, gb, hb, keep_r, keep_f, valid_r, valid_f, aux,
     return pl.pallas_call(
         _scan_kernel,
         compiler_params=_TPUCompilerParams(vmem_limit_bytes=int(_vmem)),
-        grid=(2,),
+        grid=(B,),
         in_specs=[
             pl.BlockSpec((1, 1, 128), lambda c: (c, c * 0, c * 0)),
             pl.BlockSpec((1, Fp, Wp), lambda c: (c, c * 0, c * 0)),
@@ -213,7 +218,7 @@ def scan_pair(scal, gb, hb, keep_r, keep_f, valid_r, valid_f, aux,
             pl.BlockSpec((8, Fp), lambda c: (c * 0, c * 0)),
         ],
         out_specs=pl.BlockSpec((1, 8, Fp), lambda c: (c, c * 0, c * 0)),
-        out_shape=jax.ShapeDtypeStruct((2, 8, Fp), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((B, 8, Fp), jnp.float32),
         interpret=interpret,
     )(scal, gb, hb, keep_r, keep_f, valid_r, valid_f, aux)
 
@@ -442,16 +447,19 @@ def _scan_blocks_kernel(do_fix, scal_ref, gb_ref, hb_ref, mk_ref, out_ref):
 @functools.partial(jax.jit, static_argnames=("do_fix", "interpret"))
 def scan_blocks(scal, gb, hb, masks, do_fix: bool = False,
                 interpret: bool = False):
-    """Fused bundle-native scan for both children over [G, W] group planes.
+    """Fused bundle-native scan for a BATCH of children over [G, W]
+    group planes (one grid step per child — historically the (left,
+    right) pair of one split; the level-parallel grower feeds every
+    frontier child of a tree level in one call).
 
-    scal: [2, 9] f32 (scan_pair's 8 scalars + the raw hessian sum for the
-    in-kernel fix residual); gb/hb: [2, Gp, Wp] f32 group-block planes;
+    scal: [B, 9] f32 (scan_pair's 8 scalars + the raw hessian sum for the
+    in-kernel fix residual); gb/hb: [B, Gp, Wp] f32 group-block planes;
     masks: [8, Gp, Wp] f32 static stack (BM_* rows) with the per-tree
     feature mask already folded into the valid rows.
-    Returns [2, 8, Gp] f32 per-group results (t in ABSOLUTE block lanes).
+    Returns [B, 8, Gp] f32 per-group results (t in ABSOLUTE block lanes).
     """
-    _, Gp, Wp = gb.shape
-    scal_p = jnp.zeros((2, 1, 128), jnp.float32).at[:, 0, :9].set(
+    B, Gp, Wp = gb.shape
+    scal_p = jnp.zeros((B, 1, 128), jnp.float32).at[:, 0, :9].set(
         scal.astype(jnp.float32))
     # ~14 [Gp, Wp] staging planes + the [Wp, Wp] triangle + fill
     # temporaries; small next to the per-feature kernel's footprint
@@ -460,7 +468,7 @@ def scan_blocks(scal, gb, hb, masks, do_fix: bool = False,
     return pl.pallas_call(
         kern,
         compiler_params=_TPUCompilerParams(vmem_limit_bytes=int(_vmem)),
-        grid=(2,),
+        grid=(B,),
         in_specs=[
             pl.BlockSpec((1, 1, 128), lambda c: (c, c * 0, c * 0)),
             pl.BlockSpec((1, Gp, Wp), lambda c: (c, c * 0, c * 0)),
@@ -469,7 +477,7 @@ def scan_blocks(scal, gb, hb, masks, do_fix: bool = False,
                          lambda c: (c * 0, c * 0, c * 0)),
         ],
         out_specs=pl.BlockSpec((1, 8, Gp), lambda c: (c, c * 0, c * 0)),
-        out_shape=jax.ShapeDtypeStruct((2, 8, Gp), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((B, 8, Gp), jnp.float32),
         interpret=interpret,
     )(scal_p, gb, hb, masks)
 

@@ -657,6 +657,36 @@ def find_best_split_categorical(hist, sum_grad, sum_hess, num_data,
     )
 
 
+def find_best_split_numerical_batch(hist, sum_grad, sum_hess, num_data,
+                                    meta: FeatureMeta, p: SplitParams,
+                                    feature_mask, num_features: int,
+                                    use_dp: bool = True,
+                                    use_l1: bool = True,
+                                    use_mds: bool = True,
+                                    max_w: int = 0):
+    """Best numerical split for a BATCH of leaves — vmap of
+    :func:`find_best_split_numerical` over the leading leaf axis.
+
+    This is the widened split-find of the persist grower's XLA kernel
+    mode (and the batched find of the level-parallel grower): per leaf it
+    reproduces the v1 scan's f64 gain accumulation, count recovery and
+    tie-break rules EXACTLY (same function), so persist-f32-payload runs
+    scored through it order splits identically to the v1 f64 grower —
+    the fix for the historical persist-vs-v1 tie-flip on noise-gain
+    splits (tests/test_known_divergence.py).
+
+    hist: [B, TB, 2]; sum_grad/sum_hess: [B] leaf sums; num_data: [B]
+    i32. Returns a SplitCandidate pytree of [B]-shaped leaves.
+    """
+    one = functools.partial(
+        find_best_split_numerical, meta=meta, p=p,
+        cmin=-jnp.inf, cmax=jnp.inf, feature_mask=feature_mask,
+        num_features=num_features, use_mc=False, max_w=max_w,
+        use_dp=use_dp, use_l1=use_l1, use_mds=use_mds)
+    return jax.vmap(lambda h, sg, sh, nd: one(h, sg, sh, nd))(
+        hist, sum_grad, sum_hess, num_data)
+
+
 def merge_candidates(a: SplitCandidate, b: SplitCandidate) -> SplitCandidate:
     """Pick the better of two candidates (SplitInfo::operator>,
     split_info.hpp:126-153: higher gain wins; equal gain keeps the smaller

@@ -229,25 +229,29 @@ class DataParallelTreeLearner(SerialTreeLearner):
         S = self.num_shards
         mesh = self.mesh
         pay_spec = P(None, AXIS)
-        akey = ("assets_sharded", S)
+        kernel_impl, interpret, score64 = self._persist_kernel_effective()
+        level_mode = self._persist_level_mode()
+        akey = ("assets_sharded", S, score64)
         assets = cache.get(akey)
         if assets is None:
             assets = build_assets(self.dataset, self.dataset.metadata.label,
-                                  num_shards=S)
+                                  num_shards=S, score64=score64)
             assets = assets._replace(pay0=jax.device_put(
                 assets.pay0, NamedSharding(mesh, pay_spec)))
             cache[akey] = assets
-        kernel_impl, interpret = self._persist_kernel_mode()
         stat_from_scan = bag_spec[0] != "none"
         gc = self.grow_config
-        gkey = ("grower_sharded", S, gc, stat_from_scan)
+        gkey = ("grower_sharded", S, gc, stat_from_scan, kernel_impl,
+                level_mode)
         wrapper = cache.get(gkey)
         if wrapper is None:
             inner = make_persist_grower(
                 assets, self.meta, gc, interpret=interpret, axis_name=AXIS,
                 kernel_impl=kernel_impl, stat_from_scan=stat_from_scan,
+                fix=self.fix, level_mode=level_mode,
                 # GLOBAL counts live in the leaf state: pick exactness by
-                # the total row count, not the per-shard one
+                # the total row count, not the per-shard one (the widened
+                # xla mode overrides to f64 internally)
                 state_dtype=(jnp.float32
                              if self.dataset.num_data < EXACT_F32_ROWS
                              else jnp.float64))
@@ -267,7 +271,7 @@ class DataParallelTreeLearner(SerialTreeLearner):
                 check_vma=False))
             cache[gkey] = wrapper
         dkey = ("driver_sharded", S, k, gc, objective.static_fingerprint(),
-                bag_spec)
+                bag_spec, kernel_impl, level_mode)
         driver = cache.get(dkey)
         if driver is None:
             bag_fn = (make_bag_transform(bag_spec, assets.geometry,
@@ -279,8 +283,9 @@ class DataParallelTreeLearner(SerialTreeLearner):
             smapped = shard_map_compat(
                 raw, mesh=mesh,
                 in_specs=(pay_spec, P(), P(), P(), P(), P(), P()),
-                out_specs=(pay_spec, _tree_arrays_spec(gc,
-                                                       row_sharded=False)),
+                out_specs=(pay_spec,
+                           _tree_arrays_spec(gc, row_sharded=False),
+                           P()),
                 check_vma=False)
             driver = telemetry.launch_wrapper(
                 jax.jit(smapped, donate_argnums=(0,)),

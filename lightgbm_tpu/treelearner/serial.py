@@ -115,16 +115,17 @@ def resolve_hist_impl(config: Config) -> str:
     pallas_ok = HAS_PALLAS and backend in ("tpu", "axon")
     if impl == "onehot":
         return impl
-    f32_req = str(config.tpu_hist_dtype).lower() == "f32"
+    f32_req = str(config.tpu_hist_dtype).lower() in ("f32", "f64")
     if impl == "pallas":
         if not pallas_ok:
             Log.warning("tpu_histogram_impl=pallas unavailable on backend "
                         "%s; falling back to onehot" % backend)
             return "onehot"
         if f32_req:
-            Log.warning("tpu_hist_dtype=f32 needs the XLA einsum path; "
+            Log.warning("tpu_hist_dtype=%s needs the XLA einsum path; "
                         "using tpu_histogram_impl=onehot (the Pallas kernel "
-                        "is bf16 hi/lo only)")
+                        "is bf16 hi/lo only)"
+                        % str(config.tpu_hist_dtype).lower())
             return "onehot"
         return impl
     if backend == "cpu":
@@ -327,7 +328,11 @@ class SerialTreeLearner:
         hist_dtype = str(config.tpu_hist_dtype).lower()
         if hist_dtype == "auto":
             import jax
-            hist_dtype = ("f32" if jax.default_backend() == "cpu"
+            # CPU stands in for the reference CPU learner, whose hist_t is
+            # double: f64 bins are exact sums of the f32 per-row gradients
+            # (order-independent), which is also what lets the widened
+            # persist kernel emulation match the v1 grower bit for bit
+            hist_dtype = ("f64" if jax.default_backend() == "cpu"
                           else "bf16x2")
         gc_kwargs = dict(
             total_bins=int(dataset.total_bins),
@@ -530,6 +535,23 @@ class SerialTreeLearner:
             return "pallas", False
         return "xla", True
 
+    def _persist_level_mode(self) -> str:
+        """tpu_level_grow: 'auto' engages the level-parallel phase when
+        can_level_grow(grow_config) holds; 'off' forces per-split."""
+        opt = str(getattr(self.config, "tpu_level_grow", "auto")).lower()
+        return "off" if opt in ("off", "false", "0") else "auto"
+
+    def _persist_kernel_effective(self):
+        """(kernel_impl, interpret, score64) after the old-jax interpret
+        downgrade make_persist_grower would apply — the payload asset
+        layout (f64 score rows in xla mode) must be decided up front."""
+        from ..ops.pallas_compat import dynamic_grid_interpret_ok
+        kernel_impl, interpret = self._persist_kernel_mode()
+        if kernel_impl == "pallas" and interpret \
+                and not dynamic_grid_interpret_ok():
+            kernel_impl = "xla"
+        return kernel_impl, interpret, kernel_impl == "xla"
+
     def _persist_cached(self, objective, k: int, bag_spec=("none",)):
         from ..ops.grow_persist import (build_assets, make_bag_transform,
                                         make_persist_grower,
@@ -541,28 +563,32 @@ class SerialTreeLearner:
         # pos/row grad modes weight through their own args — only the
         # 'payload' fill reads the payload weight row
         use_w_row = objective.persist_grad_mode() == "payload"
-        akey = ("assets", K, use_w_row)
+        kernel_impl, interpret, score64 = self._persist_kernel_effective()
+        level_mode = self._persist_level_mode()
+        akey = ("assets", K, use_w_row, score64)
         assets = cache.get(akey)
         if assets is None:
             assets = build_assets(self.dataset, self.dataset.metadata.label,
-                                  num_scores=K, use_weight_row=use_w_row)
+                                  num_scores=K, use_weight_row=use_w_row,
+                                  score64=score64)
             cache[akey] = assets
-        kernel_impl, interpret = self._persist_kernel_mode()
         stat_from_scan = bag_spec[0] != "none"
         gkey = ("grower", K, use_w_row, self.grow_config,
-                stat_from_scan)
+                stat_from_scan, kernel_impl, level_mode)
         gr = cache.get(gkey)
         if gr is None:
             gr = make_persist_grower(assets, self.meta, self.grow_config,
                                      interpret=interpret,
                                      kernel_impl=kernel_impl,
-                                     stat_from_scan=stat_from_scan)
+                                     stat_from_scan=stat_from_scan,
+                                     fix=self.fix, level_mode=level_mode)
             if assets.efb[5]:          # bundled: block-scan fast path
                 telemetry.count("tree_learner::persist_bundle_blockscan",
                                 category="tree_learner")
             cache[gkey] = gr
         dkey = ("driver", K, use_w_row, k, self.grow_config,
-                objective.static_fingerprint(), bag_spec)
+                objective.static_fingerprint(), bag_spec, kernel_impl,
+                level_mode)
         driver = cache.get(dkey)
         if driver is None:
             bag_fn = (make_bag_transform(bag_spec, assets.geometry)
@@ -596,14 +622,37 @@ class SerialTreeLearner:
         pay = getattr(self, "_persist_carry", None)
         if pay is None:
             pay = gr.init_carry(assets.pay0, jnp.asarray(score0))
-        pay, stacked = driver(pay, jnp.asarray(fmasks),
-                              jnp.asarray(wkeys, jnp.uint32),
-                              jnp.asarray(iters, jnp.int32), self.params,
-                              jnp.asarray(shrink, jnp.float64),
-                              objective.persist_grad_args())
+        pay, stacked, stats = driver(pay, jnp.asarray(fmasks),
+                                     jnp.asarray(wkeys, jnp.uint32),
+                                     jnp.asarray(iters, jnp.int32),
+                                     self.params,
+                                     jnp.asarray(shrink, jnp.float64),
+                                     objective.persist_grad_args())
+        # level-program stats stay a DEVICE array until finalize: the
+        # fast path must not sync per batch just to bump a counter
+        prev = getattr(self, "_level_stats_dev", None)
+        self._level_stats_dev = stats if prev is None else prev + stats
         self._persist_carry = pay
         self._persist_gr = gr
         return stacked
+
+    def flush_level_stats(self):
+        """Convert the accumulated device-side level-program stats into
+        telemetry counters (tree_learner::level_programs /
+        level_fallback_splits). Called at score-finalize time — the
+        first natural host sync after a persist batch."""
+        st = getattr(self, "_level_stats_dev", None)
+        if st is None:
+            return
+        self._level_stats_dev = None
+        import jax
+        v = np.asarray(jax.device_get(st))
+        if v[0]:
+            telemetry.count("tree_learner::level_programs", float(v[0]),
+                            category="tree_learner")
+        if v[1]:
+            telemetry.count("tree_learner::level_fallback_splits",
+                            float(v[1]), category="tree_learner")
 
     def persist_finalize_scores(self):
         """Row-ordered f64 scores from the live carry (None when no carry).
@@ -611,6 +660,7 @@ class SerialTreeLearner:
         pay = getattr(self, "_persist_carry", None)
         if pay is None:
             return None
+        self.flush_level_stats()
         gr = self._persist_gr
         return gr.finalize_scores(pay).astype(jnp.float64)
 

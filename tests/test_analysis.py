@@ -128,15 +128,24 @@ FIXTURES = {
         "positive": """
             import jax
 
-            def train(trees, step):
+            from lightgbm_tpu.ops.pallas_grow import make_level_pass
+
+            def train(trees, step, geo):
                 outs = []
                 for t in trees:
                     f = jax.jit(step)                  # recompile storm
                     outs.append(f(t))
                 return outs
+
+            def grow_levels(levels, geo):
+                for lv in levels:
+                    lp = make_level_pass(*geo)         # builder per level:
+                    lv.run(lp)                         # same storm, hidden
             """,
         "negative": """
             import jax
+
+            from lightgbm_tpu.ops.pallas_grow import make_level_pass
 
             def train(trees, step):
                 f = jax.jit(step)                      # hoisted
@@ -147,6 +156,11 @@ FIXTURES = {
                 def make(c):                           # builder in loop is
                     return jax.jit(lambda x: x + c)    # a def, not a call
                 return outs, [make(c) for c in (1, 2)]
+
+            def grow_levels(levels, geo):
+                lp = make_level_pass(*geo)             # once per geometry
+                for lv in levels:
+                    lv.run(lp)
             """,
     },
     "JG005": {
@@ -434,8 +448,9 @@ def test_audits_all_green():
     results = {r.name: r for r in run_audits()}
     assert set(results) == {
         "hist_window_f32", "scan_pair_f32", "scan_blocks_f32",
-        "persist_split_pass", "predict_traversal_f32",
-        "predict_donation", "serve_ladder_bound"}
+        "persist_split_pass", "persist_level_pass",
+        "predict_traversal_f32", "predict_donation",
+        "serve_ladder_bound"}
     bad = {n: r.detail for n, r in results.items() if not r.ok}
     assert not bad, bad
 

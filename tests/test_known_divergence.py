@@ -1,10 +1,15 @@
-"""Characterization tests for KNOWN, tracked divergences.
+"""Regression pins for HISTORICAL, since-fixed divergences.
 
-These tests pin behavior that is documented as imperfect (CHANGES.md) so a
-regression OR an accidental fix is noticed, instead of the knowledge
-living only in folklore. They assert the IDEAL behavior and carry
-non-strict xfail marks: staying red documents the divergence, going green
-means the underlying cause was fixed and the mark can be dropped.
+These tests pin behavior that was documented as imperfect (CHANGES.md)
+and has since been fixed, so a regression is noticed immediately instead
+of re-entering folklore. The NaN-heavy-integer tie-flip below was a
+non-strict xfail from PR 2 through PR 6; the PR 7 grower refactor widened
+the off-TPU persist kernel emulation to the v1 f64 split-find
+(find_best_split_numerical through find_best_split_numerical_batch, f64
+histogram planes, f64 payload score rows), which makes persist-vs-v1
+split ordering — including the noise-gain ties this test provokes —
+bit-exact. The real-TPU Mosaic path keeps its documented f32
+gpu_use_dp=false trade; this pin covers the emulation path tier-1 runs.
 """
 import numpy as np
 import pytest
@@ -13,20 +18,13 @@ import lightgbm_tpu as lgb
 
 
 @pytest.mark.slow
-@pytest.mark.xfail(
-    strict=False,
-    reason="known pre-existing (CHANGES.md PR 2): the persist path's f32 "
-    "histogram accumulation tie-flips noise-gain splits of NaN-heavy "
-    "integer features vs the v1 grower's f64 ordering; the flip "
-    "compounds through the score cache and can even change the no-split "
-    "stopping iteration")
-def test_persist_f32_vs_v1_f64_tie_flip_nan_integer_features():
-    """Pinned reproduction: 12 integer features with 4 levels, 65% NaN,
-    pure-noise labels, deep trees, 25 iterations. The two paths agree for
-    the first ~12 iterations, then a tie flips and the models diverge
-    completely (one path stops early). If this test ever XPASSes
-    consistently, the f32/f64 ordering divergence was fixed — remove the
-    xfail and fold it into the persist parity suite."""
+def test_persist_vs_v1_f64_tie_stability_nan_integer_features():
+    """Historical reproduction (was a pinned xfail): 12 integer features
+    with 4 levels, 65% NaN, pure-noise labels, deep trees, 25 iterations.
+    The f32 persist path used to tie-flip a noise-gain split around
+    iteration ~12 and diverge completely; the widened f64 kernel
+    emulation orders every split exactly like the v1 grower, so the raw
+    scores now match bit for bit."""
     rng = np.random.default_rng(3)
     n, nf = 8000, 12
     X = rng.integers(0, 4, size=(n, nf)).astype(float)
