@@ -19,6 +19,15 @@ package machine-checks them on every run:
   actually recorded, the serve ladder's compile bound.
 * :mod:`strict` — the strict-numerics test harness (strict dtype
   promotion + debug-nans) the kernel-parity tests run under.
+* the whole-program auditors (:mod:`auditors` registry):
+  :mod:`collective_audit` verifies every rank-role issues the same DCN
+  collective sequence (a collective under a rank-dependent branch is a
+  deadlock finding) and that every site rides the resilience retry
+  guard (lint twin: rule JG009); :mod:`resource_audit` computes static
+  per-kernel VMEM footprints and per-shape HBM tallies over the bench
+  geometries against the :mod:`telemetry.devices` profiles;
+  :mod:`compile_audit` bounds the distinct-compile count across the
+  jitted entry points and fails on unbounded static args.
 
 Gate: ``python -m lightgbm_tpu.analysis`` exits non-zero on any
 unsuppressed finding or failed audit; ``tests/test_analysis.py`` runs
@@ -26,10 +35,11 @@ the same self-scan inside the tier-1 suite.
 """
 from __future__ import annotations
 
+from .auditors import all_auditors, run_all as run_auditors
 from .config import GraftlintConfig, load_config
 from .core import Finding
 from .jaxpr_audit import AuditResult, run_audits
-from .lint import LintReport, run_lint
+from .lint import LintReport, prune_baseline, run_lint
 from .strict import strict_numerics
 
 __all__ = [
@@ -37,7 +47,10 @@ __all__ = [
     "Finding",
     "GraftlintConfig",
     "LintReport",
+    "all_auditors",
     "load_config",
+    "prune_baseline",
+    "run_auditors",
     "run_audits",
     "run_lint",
     "strict_numerics",

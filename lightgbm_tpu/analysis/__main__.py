@@ -3,6 +3,10 @@
 Exit codes: 0 clean (no unsuppressed findings, all audits pass),
 1 findings/audit failures, 2 bad usage or parse errors.
 
+The audit phase runs BOTH engines: the jaxpr audits (traced programs)
+and the whole-program auditors (collective order, VMEM/HBM budgets,
+recompile surface — see :mod:`auditors`).
+
 Common invocations::
 
     python -m lightgbm_tpu.analysis                 # full gate
@@ -10,6 +14,8 @@ Common invocations::
     python -m lightgbm_tpu.analysis --autofix       # apply safe fixes
     python -m lightgbm_tpu.analysis lightgbm_tpu/ops --rules JG003
     python -m lightgbm_tpu.analysis --write-baseline  # re-grandfather
+    python -m lightgbm_tpu.analysis --prune-baseline  # drop stale entries
+    python -m lightgbm_tpu.analysis --budgets         # resource tables
 """
 from __future__ import annotations
 
@@ -17,9 +23,10 @@ import argparse
 import json
 import sys
 
+from . import auditors, collective_audit, compile_audit, resource_audit
 from .config import load_config
 from .jaxpr_audit import run_audits
-from .lint import run_lint, write_baseline
+from .lint import prune_baseline, run_lint, write_baseline
 from .rules import all_rules
 
 
@@ -41,6 +48,12 @@ def _build_parser() -> argparse.ArgumentParser:
     p.add_argument("--write-baseline", action="store_true",
                    help="write a baseline suppressing all current "
                         "findings, then exit 0")
+    p.add_argument("--prune-baseline", action="store_true",
+                   dest="prune_baseline",
+                   help="drop baseline entries no current finding "
+                        "matches (stale suppressions), then exit 0")
+    p.add_argument("--budgets", action="store_true",
+                   help="print the VMEM/HBM budget tables and exit 0")
     p.add_argument("--no-audit", action="store_true",
                    help="skip the jaxpr/HLO audits")
     p.add_argument("--audit-only", action="store_true",
@@ -60,8 +73,23 @@ def main(argv=None) -> int:
         return 0
 
     config = load_config()
+    if args.budgets:
+        print(resource_audit.render_tables(
+            resource_audit.tables(config=config)))
+        return 0
     rule_ids = ([r.strip() for r in args.rules.split(",") if r.strip()]
                 if args.rules else None)
+
+    if (args.write_baseline or args.prune_baseline) \
+            and (args.paths or rule_ids):
+        # a filtered report would mark every out-of-scope baseline entry
+        # stale (prune drops them) or omit it from the rewrite (write
+        # loses it) — both silently destroy grandfathered suppressions
+        print("%s requires a full unfiltered scan: drop --rules and "
+              "path arguments"
+              % ("--write-baseline" if args.write_baseline
+                 else "--prune-baseline"), file=sys.stderr)
+        return 2
 
     report = None
     if not args.audit_only:
@@ -79,9 +107,22 @@ def main(argv=None) -> int:
             print("wrote %d baseline entries to %s"
                   % (n, config.baseline_path()))
             return 0
+        if args.prune_baseline:
+            kept, dropped = prune_baseline(report.findings,
+                                           config.baseline_path())
+            print("pruned %d stale baseline entr%s (%d kept) in %s"
+                  % (dropped, "y" if dropped == 1 else "ies", kept,
+                     config.baseline_path()))
+            return 0
 
-    audits = [] if (args.no_audit or (args.paths and not args.audit_only)) \
-        else run_audits()
+    run_auditors = not (args.no_audit
+                        or (args.paths and not args.audit_only))
+    # with --json the auditor artifacts also feed the payload below:
+    # compute them once and share, instead of re-walking per consumer
+    artifacts = (auditors.compute_artifacts(config)
+                 if run_auditors and args.as_json else None)
+    audits = [] if not run_auditors \
+        else run_audits() + auditors.run_all(config, artifacts=artifacts)
 
     bad_audits = [a for a in audits if not a.ok]
     n_unsup = len(report.unsuppressed) if report else 0
@@ -94,6 +135,17 @@ def main(argv=None) -> int:
             "lint": report.to_dict() if report else None,
             "audits": [a.to_dict() for a in audits],
         }
+        if audits:
+            # the whole-program auditors' full artifacts: the abstract
+            # collective trace, the budget tables, the compile surface
+            art = artifacts or {}
+            payload["collective_trace"] = \
+                collective_audit.extract_repo_trace(
+                    config, artifact=art.get("collective_order"))
+            payload["resource_tables"] = resource_audit.tables(
+                config=config, artifact=art.get("resource_budget"))
+            payload["compile_surface"] = compile_audit.compile_surface(
+                config, artifact=art.get("compile_surface"))
         print(json.dumps(payload, indent=1))
         return exit_code
 

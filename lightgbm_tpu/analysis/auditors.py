@@ -1,0 +1,63 @@
+"""Registry of the whole-program auditors behind the analysis gate.
+
+Three source-level audit engines complement the jaxpr audits
+(:mod:`jaxpr_audit` traces real programs; these reason about the
+source/geometry statically):
+
+* ``collective_order`` — rank-consistent DCN collective sequences +
+  guard coverage (:mod:`collective_audit`);
+* ``resource_budget`` — static VMEM/HBM budgets for the Pallas kernel
+  fleet over the bench shapes (:mod:`resource_audit`);
+* ``compile_surface`` — the analytic distinct-compile bound across the
+  jitted entry points (:mod:`compile_audit`).
+
+Each module exposes ``run(config) -> List[AuditResult]`` (the gate) and
+``check_fixture(payload) -> List[str]`` (the seeded-violation hook the
+fixture tests drive, parametrized over this registry exactly like the
+JG lint rules — an auditor without fixtures fails CI by construction).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from . import collective_audit, compile_audit, resource_audit
+from .config import GraftlintConfig
+from .jaxpr_audit import AuditResult
+
+AUDITORS: Dict[str, object] = {
+    "collective_order": collective_audit,
+    "resource_budget": resource_audit,
+    "compile_surface": compile_audit,
+}
+
+
+def all_auditors() -> Dict[str, object]:
+    return dict(AUDITORS)
+
+
+def compute_artifacts(config: Optional[GraftlintConfig] = None
+                      ) -> Dict[str, object]:
+    """One pass over the repo per auditor, keyed by registry name.
+
+    The --json CLI needs both the pass/fail verdicts AND the full
+    artifacts (trace, tables, surface); computing these here and
+    passing them to :func:`run_all` + the payload builders keeps that
+    to a single walk instead of one per consumer."""
+    profile = resource_audit._resolve_profile(config)
+    kernels, hbm = resource_audit.estimate_all(profile)
+    return {
+        "collective_order": collective_audit.audit_repo(config),
+        "resource_budget": (profile, kernels, hbm),
+        "compile_surface": compile_audit.iter_jit_sites(config),
+    }
+
+
+def run_all(config: Optional[GraftlintConfig] = None,
+            artifacts: Optional[Dict[str, object]] = None
+            ) -> List[AuditResult]:
+    artifacts = artifacts or {}
+    out: List[AuditResult] = []
+    for name in sorted(AUDITORS):
+        out.extend(AUDITORS[name].run(config,
+                                      artifact=artifacts.get(name)))
+    return out

@@ -1,0 +1,499 @@
+"""Collective-order auditor: rank-consistent DCN collective sequences.
+
+The distributed drivers (``parallel/multihost.py``,
+``parallel/distributed.py``) and the resilience resume path issue
+host-side collectives (allgather/allreduce/broadcast/barrier over DCN)
+that every rank must reach in the SAME order: a collective that one
+rank executes and another skips deadlocks the pod until the retry
+guard's deadline fires — on an unguarded call site, forever. The
+classic way to write that bug is a branch on a rank-dependent value::
+
+    if rank == 0:
+        stats = process_allgather(local)    # ranks 1..n never arrive
+
+This module walks the distributed modules symbolically (AST only — no
+network, no devices) and extracts each module's abstract collective
+trace: op kind, call site, the guard label where it is a constant, and
+a payload snippet where derivable. It then verifies rank-consistency:
+
+* a collective under an ``if``/``while``/``for`` whose condition (or
+  iteration space) derives from a rank-dependent value is a finding,
+  UNLESS the two branches of the ``if`` issue identical collective
+  sequences (both-branch symmetry is fine — the ranks still agree);
+* an early exit (``return``/``raise``/``break``/``continue``) inside a
+  rank-dependent branch with collectives still ahead in the function is
+  the same deadlock one hop removed, and is flagged too;
+* every DCN collective call site must be wrapped by the
+  ``resilience/retry.py`` guard (the per-file lint form of this is rule
+  JG009; the audit reports the whole-program count).
+
+Rank-dependence is a small intra-function taint analysis: parameters
+and locals named like a rank (``rank``, ``process_id``, …), values of
+``jax.process_index()``, and anything assigned from an expression that
+mentions one of those. Uniform quantities (``world``,
+``process_count()``, config values) are deliberately NOT tainted —
+every rank computes them identically, so branching on them is safe.
+
+The trace (``extract_repo_trace``) rides the CLI's ``--json`` payload,
+so the item-2 collectives rewrite can diff its before/after collective
+order the way BENCH files diff throughput.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..telemetry import events as telemetry
+from .config import GraftlintConfig, load_config
+from .core import ModuleContext
+from .jaxpr_audit import AuditResult
+
+C_SITES = "analysis::collective_sites"
+C_DIVERGENT = "analysis::collective_divergent"
+C_UNGUARDED = "analysis::collective_unguarded"
+
+# host-side DCN collectives (jax.experimental.multihost_utils): matched
+# by final attribute so both the dotted module form and a bare import
+# resolve. In-program mesh collectives (psum/all_gather inside jitted
+# growers) are XLA's to sequence and are out of scope here.
+COLLECTIVE_KINDS: Dict[str, str] = {
+    "process_allgather": "allgather",
+    "process_allgather_tree": "allgather",
+    "broadcast_one_to_all": "broadcast",
+    "sync_global_devices": "barrier",
+    "assert_equal": "barrier",
+}
+
+# names that ARE a rank on sight; everything else only becomes tainted
+# by assignment from one of these
+_RANK_NAMES = {"rank", "process_id", "process_index", "rank_id",
+               "local_rank"}
+_RANK_CALLS = ("process_index",)
+
+_EXITS = (ast.Return, ast.Raise, ast.Break, ast.Continue)
+
+
+@dataclass
+class CollectiveSite:
+    """One abstract collective call site in a module's trace."""
+
+    kind: str                  # allgather | allreduce | broadcast | ...
+    path: str
+    line: int
+    func: str                  # enclosing function qualname ("" = module)
+    name: str = ""             # guard label when a constant string
+    payload: str = ""          # source snippet of the payload arg
+    guarded: bool = False      # wrapped by resilience_retry.guard
+    conditions: Tuple[str, ...] = ()   # enclosing rank-dependent tests
+    node: Optional[ast.AST] = field(default=None, repr=False, compare=False)
+
+    def to_dict(self) -> dict:
+        return {"kind": self.kind, "path": self.path, "line": self.line,
+                "func": self.func, "name": self.name,
+                "payload": self.payload, "guarded": self.guarded,
+                "rank_dependent": bool(self.conditions),
+                "conditions": list(self.conditions)}
+
+
+@dataclass
+class CollectiveFinding:
+    """One rank-divergence hazard."""
+
+    path: str
+    line: int
+    func: str
+    message: str
+
+    def to_dict(self) -> dict:
+        return {"path": self.path, "line": self.line, "func": self.func,
+                "message": self.message}
+
+
+def _snippet(src: str, node: Optional[ast.AST], limit: int = 60) -> str:
+    if node is None:
+        return ""
+    seg = ast.get_source_segment(src, node) or ""
+    seg = " ".join(seg.split())
+    return seg if len(seg) <= limit else seg[:limit - 1] + "…"
+
+
+class _ModuleAudit:
+    """Trace + findings for one parsed module."""
+
+    def __init__(self, ctx: ModuleContext):
+        self.ctx = ctx
+        self.sites: List[CollectiveSite] = []
+        self.findings: List[CollectiveFinding] = []
+        # callables that ARE collectives: name -> (kind, guarded)
+        self.wrappers: Dict[str, Tuple[str, bool]] = {}
+        self._run()
+
+    # -- classification ------------------------------------------------
+    def _collective_kind(self, call: ast.Call) -> Optional[Tuple[str, bool]]:
+        """(kind, guarded) when `call` is a collective; None otherwise."""
+        target = self.ctx.call_target(call)
+        if target is None:
+            return None
+        leaf = target.split(".")[-1]
+        if leaf == "guard":
+            # resilience_retry.guard(name, fn, *args): kind from the fn
+            # argument when resolvable, else from the label prefix
+            kind = None
+            if len(call.args) >= 2:
+                fn = self.ctx.dotted(call.args[1])
+                if fn is not None and fn.split(".")[-1] in COLLECTIVE_KINDS:
+                    kind = COLLECTIVE_KINDS[fn.split(".")[-1]]
+                elif fn is not None \
+                        and fn.split(".")[-1] in self.wrappers:
+                    kind = self.wrappers[fn.split(".")[-1]][0]
+            if kind is None and call.args \
+                    and isinstance(call.args[0], ast.Constant) \
+                    and isinstance(call.args[0].value, str):
+                kind = call.args[0].value.split(":")[0] or "collective"
+            return (kind, True) if kind is not None else None
+        if leaf in COLLECTIVE_KINDS:
+            return COLLECTIVE_KINDS[leaf], self._inside_guard(call)
+        if leaf in self.wrappers:
+            kind, guarded = self.wrappers[leaf]
+            return kind, guarded
+        return None
+
+    def _inside_guard(self, node: ast.AST) -> bool:
+        """True when `node` sits inside a resilience_retry.guard(...) call
+        (as an argument or in a lambda handed to it)."""
+        cur = self.ctx.parent.get(node)
+        while cur is not None:
+            if isinstance(cur, ast.Call):
+                t = self.ctx.call_target(cur)
+                if t is not None and t.split(".")[-1] == "guard":
+                    return True
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return False
+            cur = self.ctx.parent.get(cur)
+        return False
+
+    # -- taint ---------------------------------------------------------
+    # Call results are a TAINT BARRIER: the output of a collective (or
+    # of any function that internally syncs) is rank-uniform by
+    # construction, and cross-function data flow is out of scope — only
+    # values a rank derives ARITHMETICALLY from its own rank id stay
+    # tainted. A handful of value-transparent builtins pass taint
+    # through (int(cuts[rank]) is still the rank's cut).
+    _TRANSPARENT = {"int", "float", "bool", "abs", "min", "max"}
+
+    def _expr_tainted(self, node: ast.AST, tainted: Set[str]) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in tainted
+        if isinstance(node, ast.Call):
+            t = self.ctx.call_target(node)
+            leaf = (t or "").split(".")[-1]
+            if leaf in _RANK_CALLS:
+                return True
+            if leaf in self._TRANSPARENT:
+                return any(self._expr_tainted(a, tainted)
+                           for a in node.args)
+            return False
+        return any(self._expr_tainted(c, tainted)
+                   for c in ast.iter_child_nodes(node))
+
+    def _taint_function(self, fn: ast.AST) -> Set[str]:
+        tainted: Set[str] = set(_RANK_NAMES)
+        changed = True
+        while changed:
+            changed = False
+            for node in ast.walk(fn):
+                if self.ctx.enclosing_function(node) is not fn:
+                    continue          # nested defs have their own scope
+                targets: List[str] = []
+                value = None
+                if isinstance(node, ast.Assign):
+                    value = node.value
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            targets.append(t.id)
+                elif isinstance(node, (ast.AugAssign, ast.AnnAssign)) \
+                        and isinstance(node.target, ast.Name) \
+                        and node.value is not None:
+                    value = node.value
+                    targets.append(node.target.id)
+                if value is None:
+                    continue
+                for name in targets:
+                    if name not in tainted \
+                            and self._expr_tainted(value, tainted):
+                        tainted.add(name)
+                        changed = True
+        return tainted
+
+    # -- trace walk ----------------------------------------------------
+    def _func_of(self, node: ast.AST) -> str:
+        parts: List[str] = []
+        fn = self.ctx.enclosing_function(node)
+        while fn is not None:
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                parts.append(fn.name)
+            fn = self.ctx.enclosing_function(fn)
+        return ".".join(reversed(parts))
+
+    def _collect_sites(self) -> None:
+        for node in ast.walk(self.ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            info = self._collective_kind(node)
+            if info is None:
+                continue
+            kind, guarded = info
+            target = (self.ctx.call_target(node) or "").split(".")[-1]
+            name, payload = "", ""
+            if target == "guard":
+                if node.args and isinstance(node.args[0], ast.Constant) \
+                        and isinstance(node.args[0].value, str):
+                    name = node.args[0].value
+                if len(node.args) >= 3:
+                    payload = _snippet(self.ctx.source, node.args[2])
+            elif node.args:
+                first = node.args[0]
+                if isinstance(first, ast.Constant) \
+                        and isinstance(first.value, str):
+                    name = first.value
+                    if len(node.args) >= 2:
+                        payload = _snippet(self.ctx.source, node.args[1])
+                else:
+                    payload = _snippet(self.ctx.source, first)
+            self.sites.append(CollectiveSite(
+                kind=kind, path=self.ctx.relpath, line=node.lineno,
+                func=self._func_of(node), name=name, payload=payload,
+                guarded=guarded, node=node))
+
+    def _discover_wrappers(self) -> None:
+        """A module function whose body issues collectives is itself a
+        collective from its callers' point of view (``_pallgather``,
+        ``_allreduce_mean_host``): calling it under a rank-dependent
+        branch diverges just the same. Fixpoint over direct bodies."""
+        changed = True
+        while changed:
+            changed = False
+            for node in ast.walk(self.ctx.tree):
+                if not isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                if node.name in self.wrappers:
+                    continue
+                kinds: List[Tuple[str, bool]] = []
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Call) \
+                            and self.ctx.enclosing_function(sub) is node:
+                        info = self._collective_kind(sub)
+                        if info is not None:
+                            kinds.append(info)
+                if kinds:
+                    # a wrapper named like an op (allreduce/broadcast)
+                    # reports as that op; otherwise the first inner kind
+                    kind = kinds[0][0]
+                    for op in ("allreduce", "allgather", "broadcast",
+                               "barrier"):
+                        if op in node.name:
+                            kind = op
+                            break
+                    self.wrappers[node.name] = (
+                        kind, all(g for _, g in kinds))
+                    changed = True
+
+    # -- rank-consistency ----------------------------------------------
+    def _sites_in(self, node: ast.AST) -> List[CollectiveSite]:
+        body_nodes = set(ast.walk(node))
+        return [s for s in self.sites if s.node in body_nodes]
+
+    def _branch_seq(self, stmts: List[ast.stmt]) -> List[str]:
+        nodes: Set[ast.AST] = set()
+        for st in stmts:
+            nodes.update(ast.walk(st))
+        return [s.kind for s in sorted(
+            (s for s in self.sites if s.node in nodes),
+            key=lambda s: s.line)]
+
+    def _check_consistency(self) -> None:
+        for fn in ast.walk(self.ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            tainted = self._taint_function(fn)
+            fn_sites = [s for s in self.sites
+                        if s.node is not None
+                        and s.node in set(ast.walk(fn))]
+            if not fn_sites:
+                continue
+            for node in ast.walk(fn):
+                if self.ctx.enclosing_function(node) is not fn:
+                    continue          # nested defs analyze separately
+                if isinstance(node, ast.If) \
+                        and self._expr_tainted(node.test, tainted):
+                    self._check_if(fn, node, tainted)
+                elif isinstance(node, ast.While) \
+                        and self._expr_tainted(node.test, tainted):
+                    self._flag_all(node, node.test,
+                                   "while loop on a rank-dependent "
+                                   "condition")
+                elif isinstance(node, ast.For) \
+                        and self._expr_tainted(node.iter, tainted):
+                    self._flag_all(node, node.iter,
+                                   "for loop over a rank-dependent "
+                                   "iteration space")
+
+    def _cond_str(self, test: ast.AST) -> str:
+        return _snippet(self.ctx.source, test, 48)
+
+    def _flag_all(self, scope: ast.AST, test: ast.AST, why: str) -> None:
+        cond = self._cond_str(test)
+        for s in self._sites_in(scope):
+            s.conditions = s.conditions + (cond,)
+            self.findings.append(CollectiveFinding(
+                path=s.path, line=s.line, func=s.func,
+                message="%s '%s' reachable inside a %s (`%s`): ranks "
+                        "disagreeing on it deadlock the collective"
+                        % (s.kind, s.name or s.payload or "collective",
+                           why, cond)))
+
+    def _check_if(self, fn: ast.AST, node: ast.If,
+                  tainted: Set[str]) -> None:
+        cond = self._cond_str(node.test)
+        seq_body = self._branch_seq(node.body)
+        seq_else = self._branch_seq(node.orelse)
+        if seq_body or seq_else:
+            if seq_body == seq_else:
+                return                    # symmetric: ranks still agree
+            for st_list in (node.body, node.orelse):
+                nodes: Set[ast.AST] = set()
+                for st in st_list:
+                    nodes.update(ast.walk(st))
+                for s in self.sites:
+                    if s.node in nodes:
+                        s.conditions = s.conditions + (cond,)
+                        self.findings.append(CollectiveFinding(
+                            path=s.path, line=s.line, func=s.func,
+                            message="%s '%s' is reachable only under "
+                                    "rank-dependent condition `%s`: "
+                                    "ranks taking the other branch "
+                                    "never join it (deadlock)"
+                                    % (s.kind,
+                                       s.name or s.payload or "collective",
+                                       cond)))
+            return
+        # no collectives inside, but an early exit in a rank-dependent
+        # branch desequences every collective still ahead
+        exits = [sub for arm in (node.body, node.orelse) for st in arm
+                 for sub in ast.walk(st) if isinstance(sub, _EXITS)
+                 and self.ctx.enclosing_function(sub)
+                 is self.ctx.enclosing_function(node)]
+        if not exits:
+            return
+        end = node.end_lineno or node.lineno
+        later = [s for s in self.sites
+                 if s.node is not None and s.line > end
+                 and self.ctx.enclosing_function(s.node) is fn]
+        for s in later:
+            self.findings.append(CollectiveFinding(
+                path=s.path, line=s.line, func=s.func,
+                message="early exit under rank-dependent condition `%s` "
+                        "(line %d) lets some ranks skip the %s '%s' "
+                        "issued later in %s (deadlock)"
+                        % (cond, node.lineno, s.kind,
+                           s.name or s.payload or "collective",
+                           s.func or "module scope")))
+
+    def _run(self) -> None:
+        self._discover_wrappers()
+        self._collect_sites()
+        self._check_consistency()
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+def analyze_source(source: str, relpath: str,
+                   config: Optional[GraftlintConfig] = None) -> _ModuleAudit:
+    """Audit one in-memory module (the fixture-test entry point)."""
+    config = config or GraftlintConfig()
+    return _ModuleAudit(ModuleContext(source, relpath, config))
+
+
+def check_fixture(source: str) -> List[str]:
+    """Uniform fixture hook: divergence findings for a source snippet."""
+    audit = analyze_source(source, "lightgbm_tpu/parallel/fixture.py")
+    return [f.message for f in audit.findings]
+
+
+def _audited_files(config: GraftlintConfig) -> List[str]:
+    out = []
+    for frag in config.collective_paths:
+        ap = os.path.join(config.root, frag)
+        if os.path.isfile(ap):
+            out.append(frag)
+            continue
+        if not os.path.isdir(ap):
+            continue
+        for dirpath, dirnames, filenames in os.walk(ap):
+            dirnames[:] = [d for d in sorted(dirnames) if d != "__pycache__"]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    rel = os.path.relpath(os.path.join(dirpath, fn),
+                                          config.root).replace(os.sep, "/")
+                    out.append(rel)
+    return out
+
+
+def audit_repo(config: Optional[GraftlintConfig] = None
+               ) -> Tuple[List[CollectiveSite], List[CollectiveFinding]]:
+    config = config or load_config()
+    sites: List[CollectiveSite] = []
+    findings: List[CollectiveFinding] = []
+    for rel in _audited_files(config):
+        with open(os.path.join(config.root, rel), "r",
+                  encoding="utf-8") as f:
+            src = f.read()
+        audit = _ModuleAudit(ModuleContext(src, rel, config))
+        sites.extend(audit.sites)
+        findings.extend(audit.findings)
+    return sites, findings
+
+
+def extract_repo_trace(config: Optional[GraftlintConfig] = None,
+                       artifact=None) -> dict:
+    """The abstract collective trace for the --json payload."""
+    sites, findings = artifact if artifact is not None \
+        else audit_repo(config)
+    return {"sites": [s.to_dict() for s in sites],
+            "findings": [f.to_dict() for f in findings]}
+
+
+def run(config: Optional[GraftlintConfig] = None,
+        artifact=None) -> List[AuditResult]:
+    """The gate entry point: two AuditResults (order + guard coverage).
+
+    ``artifact`` takes a precomputed :func:`audit_repo` result so the
+    --json CLI path walks the repo once, not once per consumer."""
+    sites, findings = artifact if artifact is not None \
+        else audit_repo(config)
+    telemetry.count(C_SITES, len(sites), category="analysis")
+    unguarded = [s for s in sites if not s.guarded]
+    if findings:
+        telemetry.count(C_DIVERGENT, len(findings), category="analysis")
+    if unguarded:
+        telemetry.count(C_UNGUARDED, len(unguarded), category="analysis")
+    order = AuditResult(
+        name="collective_order",
+        ok=not findings,
+        detail=("%d site(s), rank-consistent" % len(sites))
+        if not findings else "; ".join(
+            "%s:%d %s" % (f.path, f.line, f.message)
+            for f in findings[:3]))
+    guard = AuditResult(
+        name="collective_guarded",
+        ok=not unguarded,
+        detail=("%d DCN site(s) all guarded" % len(sites))
+        if not unguarded else "; ".join(
+            "%s:%d unguarded %s" % (s.path, s.line, s.kind)
+            for s in unguarded[:3]))
+    return [order, guard]

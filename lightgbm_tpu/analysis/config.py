@@ -43,6 +43,15 @@ class GraftlintConfig:
     # (tmp + fsync + os.replace) — the checkpoint/state durability contract
     atomic_write_paths: List[str] = field(default_factory=lambda: [
         "lightgbm_tpu/resilience/"])
+    # collective-order auditor + JG009: files/dirs holding host-side DCN
+    # collective call sites (rank-consistency and guard-wrapping checks)
+    collective_paths: List[str] = field(default_factory=lambda: [
+        "lightgbm_tpu/parallel/", "lightgbm_tpu/resilience/"])
+    # resource auditor: device profile the VMEM/HBM budgets come from
+    # (telemetry/devices.py; "auto" = detect attached accelerator)
+    audit_device: str = "v5e"
+    # compile auditor: ceiling on the analytic distinct-compile bound
+    compile_ceiling: int = 64
     # baseline suppression file, relative to the repo root
     baseline: str = "lightgbm_tpu/analysis/baseline.json"
     root: str = "."

@@ -161,6 +161,41 @@ def write_baseline(findings: List[Finding], path: str) -> int:
     return len(ents)
 
 
+def prune_baseline(findings: List[Finding], path: str) -> Tuple[int, int]:
+    """Drop baseline entries whose (rule, path, source line) no longer
+    matches any CURRENT finding, clamping counts to the matched number.
+    Returns (kept, dropped) entry-count deltas. A stale entry is a free
+    suppression waiting for a regression to hide under — fixing the
+    grandfathered finding must shrink the file, and ``--prune-baseline``
+    makes that mechanical instead of manual."""
+    if not os.path.isfile(path):
+        return 0, 0
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    current: Dict[Tuple[str, str, str], int] = {}
+    for fnd in findings:
+        key = fnd.key()
+        current[key] = current.get(key, 0) + 1
+    kept, dropped = [], 0
+    for ent in data.get("findings", []):
+        key = (ent["rule"], ent["path"], ent["snippet"])
+        have = current.get(key, 0)
+        want = int(ent.get("count", 1))
+        if have <= 0:
+            dropped += want
+            continue
+        if have < want:
+            dropped += want - have
+            ent = dict(ent, count=have)
+        kept.append(ent)
+    if dropped:
+        data["findings"] = kept
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(data, f, indent=1)
+            f.write("\n")
+    return len(kept), dropped
+
+
 # ---------------------------------------------------------------------------
 # autofix
 # ---------------------------------------------------------------------------

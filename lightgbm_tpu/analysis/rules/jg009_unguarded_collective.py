@@ -1,0 +1,76 @@
+"""JG009 — host-side DCN collectives outside the resilience retry guard.
+
+Every host collective (``multihost_utils.process_allgather`` and
+friends) is a synchronous rendezvous: a gone peer turns an unguarded
+call into an infinite hang, which is why ``resilience/retry.py`` exists
+— its ``guard`` runs the collective under a deadline with bounded
+retries and raises a clean ``LightGBMError`` a scheduler can restart.
+The contract (PR 5) is that EVERY DCN collective call site in the
+distributed modules goes through it::
+
+    resilience_retry.guard("allgather:row_counts",
+                           multihost_utils.process_allgather, arr)
+
+This rule flags a *direct call* to a known collective inside the
+configured ``collective_paths`` (parallel/, resilience/ by default).
+Passing the collective as guard's ``fn`` argument is not a call and
+stays silent; so does a call made inside a lambda/closure handed to
+``guard``. The whole-program twin of this rule is
+``analysis/collective_audit.py``'s ``collective_guarded`` audit — the
+lint form exists so a new unguarded site fails with a file:line finding
+(and a fixture) instead of an audit-level summary.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ..core import Finding, ModuleContext
+from . import register
+
+# final-attribute names of the host-side DCN collectives
+_COLLECTIVES = ("process_allgather", "process_allgather_tree",
+                "broadcast_one_to_all", "sync_global_devices")
+
+
+@register
+class UnguardedCollective:
+    id = "JG009"
+    name = "unguarded-collective"
+    description = ("direct DCN collective call bypassing the "
+                   "resilience retry guard hangs forever on a gone peer")
+
+    def check(self, ctx: ModuleContext) -> List[Finding]:
+        if not any(frag in ctx.relpath
+                   for frag in ctx.config.collective_paths):
+            return []
+        out: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            target = ctx.call_target(node)
+            if target is None \
+                    or target.split(".")[-1] not in _COLLECTIVES:
+                continue
+            if self._inside_guard(ctx, node):
+                continue
+            out.append(ctx.finding(
+                self.id, node,
+                "`%s` called directly; wrap it with "
+                "resilience_retry.guard(name, fn, ...) so a gone peer "
+                "raises a bounded-retry error instead of hanging"
+                % target.split(".")[-1]))
+        return out
+
+    def _inside_guard(self, ctx: ModuleContext, node: ast.AST) -> bool:
+        """True when the call happens inside a guard(...) invocation —
+        a lambda or nested closure handed to the guard still runs under
+        its deadline thread."""
+        cur = ctx.parent.get(node)
+        while cur is not None:
+            if isinstance(cur, ast.Call):
+                t = ctx.call_target(cur)
+                if t is not None and t.split(".")[-1] == "guard":
+                    return True
+            cur = ctx.parent.get(cur)
+        return False

@@ -95,6 +95,37 @@ def _log2_ceil(x: int) -> int:
     return n
 
 
+# -- scoped-vmem requests ----------------------------------------------------
+# One formula per kernel family, shared with analysis/resource_audit.py:
+# the kernels run with these limits and the static budget gate checks the
+# same numbers against the device profiles (telemetry/devices.py), so an
+# over-budget geometry fails `python -m lightgbm_tpu.analysis` instead of
+# OOMing the first real-TPU run. The default 16MB scoped-VMEM limit forces
+# small chunks whose cost is pure DMA latency (~5 serialized DMAs per
+# chunk); v5e cores carry 128MB of VMEM, so the limits are sized to each
+# kernel's actual footprint (buffers + Mosaic temporaries scale with E)
+# and C grows instead.
+
+def split_pass_vmem_bytes(WPA: int, E: int, G: int) -> int:
+    """split_pass / level_pass: 7 chunk-sized u32 buffers + the radix
+    hist accumulator + ~3 buffers of compaction temporaries."""
+    return int(min(96 << 20,
+                   7 * WPA * E * 4 + G * 16 * 64 * 4 + (20 << 20)
+                   + 3 * WPA * E * 4))
+
+
+def seg_hist_vmem_bytes(WPA: int, E: int, G: int) -> int:
+    """seg_hist / level_seg_hist / root_hist: one streaming chunk buffer
+    (+1 working copy) + the radix hist accumulator + the [G, E] decoded
+    group-bin planes and one-hot rhs `_hist_accum` materializes per
+    chunk. The decode terms were missing before the static budget gate
+    (analysis/resource_audit.py) flagged the 700-group unbundled shape:
+    at G=700, E=8320 they are 24MB the old request did not cover."""
+    return int(min(96 << 20,
+                   2 * WPA * E * 4 + G * 16 * 64 * 4
+                   + G * E * 4 + 64 * E * 2 + (20 << 20)))
+
+
 def _lane_iota(E: int):
     return jax.lax.broadcasted_iota(I32, (1, E), 1)
 
@@ -407,15 +438,9 @@ def make_split_pass(WPA: int, NP: int, G: int, plan, nbw: int,
         def _fin():
             cnt_ref[0] = st[6]
 
-    # the default 16MB scoped-VMEM limit forces small chunks whose cost is
-    # pure DMA latency (~5 serialized DMAs per chunk); v5e cores carry
-    # 128MB of VMEM, so size the limit to the kernel's actual footprint
-    # (buffers + Mosaic temporaries scale with E) and let C grow instead
     E_ = C + 128
-    _vmem_req = min(96 << 20,
-                    7 * WPA * E_ * 4 + G * 16 * 64 * 4 + (20 << 20)
-                    + 3 * WPA * E_ * 4)
-    _cparams = _TPUCompilerParams(vmem_limit_bytes=int(_vmem_req))
+    _cparams = _TPUCompilerParams(
+        vmem_limit_bytes=split_pass_vmem_bytes(WPA, E_, G))
 
     @jax.jit
     def split_pass(pay, scalars):
@@ -666,10 +691,8 @@ def make_level_pass(WPA: int, NP: int, G: int, plan, nbw: int,
             cph.wait()
 
     E_ = C + 128
-    _vmem_req = min(96 << 20,
-                    7 * WPA * E_ * 4 + G * 16 * 64 * 4 + (20 << 20)
-                    + 3 * WPA * E_ * 4)
-    _cparams = _TPUCompilerParams(vmem_limit_bytes=int(_vmem_req))
+    _cparams = _TPUCompilerParams(
+        vmem_limit_bytes=split_pass_vmem_bytes(WPA, E_, G))
 
     @jax.jit
     def level_pass(pay, scal_mat, slot_of_step, base_of_slot, grid):
@@ -766,9 +789,8 @@ def make_level_seg_hist(WPA: int, NP: int, G: int, plan, nbw: int,
             cph.start()
             cph.wait()
 
-    _vmem_req = min(96 << 20,
-                    2 * WPA * E * 4 + G * 16 * 64 * 4 + (20 << 20))
-    _cparams = _TPUCompilerParams(vmem_limit_bytes=int(_vmem_req))
+    _cparams = _TPUCompilerParams(
+        vmem_limit_bytes=seg_hist_vmem_bytes(WPA, E, G))
 
     @jax.jit
     def level_seg_hist(pay, scal_mat, slot_of_step, base_of_slot, grid):
@@ -841,10 +863,8 @@ def make_seg_hist(WPA: int, NP: int, G: int, plan, nbw: int,
         bins_g = _unpack_group_bins(w, plan)
         _hist_accum(hist_ref, bins_g, grad, hess, G)
 
-    E_ = E
-    _vmem_req = min(96 << 20,
-                    2 * WPA * E_ * 4 + G * 16 * 64 * 4 + (20 << 20))
-    _cparams = _TPUCompilerParams(vmem_limit_bytes=int(_vmem_req))
+    _cparams = _TPUCompilerParams(
+        vmem_limit_bytes=seg_hist_vmem_bytes(WPA, E, G))
 
     @jax.jit
     def seg_hist(pay, start, length):
@@ -926,9 +946,15 @@ def make_root_hist(WPA: int, NP: int, G: int, plan, nbw: int, n: int,
             hist, sums = _call(pay)
         return _unpack_hist(hist), sums
 
+    # the streaming chunk buffer alone (WPA*C u32) outgrows the 16MB
+    # Mosaic default on wide unbundled payloads (~180 words at C=16384)
+    _cparams = _TPUCompilerParams(
+        vmem_limit_bytes=seg_hist_vmem_bytes(WPA, C, G))
+
     def _call(pay):
         return pl.pallas_call(
             kernel,
+            compiler_params=_cparams,
             grid=(nch,),
             in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
             out_specs=[

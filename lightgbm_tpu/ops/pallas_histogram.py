@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 
 from .pallas_compat import HAS_PALLAS, pl  # noqa: F401 — HAS_PALLAS re-exported (kernel tests gate on it)
+from .pallas_compat import TPUCompilerParams as _TPUCompilerParams
 
 
 def _round_up(x: int, m: int) -> int:
@@ -113,6 +114,28 @@ def _select_impl(w: int, G: int, C: int):
     return use_radix, w_pad, min(C, ct)
 
 
+def hist_vmem_plan(w: int, G: int, C: int) -> dict:
+    """Static VMEM plan for :func:`hist_window` at geometry (w, G, C).
+
+    One place derives the impl choice, the grid stripe, and the
+    scoped-vmem limit the kernel requests: the kernel runs with these
+    numbers and ``analysis/resource_audit.py`` gates them against the
+    device profile budgets, so an over-budget geometry fails the static
+    gate instead of OOMing the first real-TPU run. The limit covers the
+    double-buffered in/out blocks plus the one-hot temporaries (the
+    16MB slack is Mosaic's own working set); many-group shapes (a
+    700-feature unbundled dataset) exceed the 16MB Mosaic default,
+    which is why the kernel must size the limit explicitly.
+    """
+    use_radix, w_pad, ct = _select_impl(w, G, C)
+    out_bytes = G * 16 * 16 * 2 * 4 if use_radix else G * w_pad * 2 * 4
+    temp = 3 * 16 * ct * 2 if use_radix else w_pad * ct * 2
+    request = min(100 << 20,
+                  2 * (G * ct * 4 + ct * 8 + out_bytes) + temp + (16 << 20))
+    return {"use_radix": use_radix, "w_pad": w_pad, "ct": ct,
+            "vmem_limit": int(request)}
+
+
 @functools.partial(jax.jit, static_argnames=("w", "interpret"))
 def hist_window(bins_t: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                 w: int, interpret: bool = False) -> jnp.ndarray:
@@ -123,7 +146,9 @@ def hist_window(bins_t: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     w: static bin-width of the output (max group width).
     """
     G, C = bins_t.shape
-    use_radix, w_pad, ct = _select_impl(w, G, C)
+    plan = hist_vmem_plan(w, G, C)
+    use_radix, w_pad, ct = plan["use_radix"], plan["w_pad"], plan["ct"]
+    _cparams = _TPUCompilerParams(vmem_limit_bytes=plan["vmem_limit"])
     kernel = _hist_kernel_radix if use_radix else _hist_kernel
     nst = (C + ct - 1) // ct
     if nst * ct != C:
@@ -144,6 +169,7 @@ def hist_window(bins_t: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     if use_radix:
         out = pl.pallas_call(
             kernel,
+            compiler_params=_cparams,
             grid=(nst,),
             in_specs=[
                 pl.BlockSpec((G, ct), lambda i: (z(i), i)),
@@ -157,6 +183,7 @@ def hist_window(bins_t: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         return out.reshape(G, 256, 2)[:, :w, :]
     out = pl.pallas_call(
         kernel,
+        compiler_params=_cparams,
         grid=(nst,),
         in_specs=[
             pl.BlockSpec((G, ct), lambda i: (z(i), i)),

@@ -45,6 +45,25 @@ def _round_up(x: int, m: int) -> int:
     return (x + m - 1) // m * m
 
 
+def scan_pair_vmem_bytes(Fp: int, Wp: int) -> int:
+    """Scoped-vmem limit :func:`scan_pair` requests at padded geometry
+    (Fp, Wp): ~12 staged [Fp, Wp] f32 blocks + the cumsum stack + Mosaic
+    temporaries. The kernel runs with this number and
+    analysis/resource_audit.py gates it against the device profile, so
+    keep the formula here — one source of truth for both. The default
+    scoped-vmem budget OOMs past ~450 features at Wp=256 (v5e carries
+    128MB of VMEM, so size the limit to the footprint)."""
+    return int(min(100 << 20, 16 * Fp * Wp * 4 + (20 << 20)))
+
+
+def scan_blocks_vmem_bytes(Gp: int, Wp: int) -> int:
+    """Scoped-vmem limit :func:`scan_blocks` requests: ~14 [Gp, Wp]
+    staging planes + the [Wp, Wp] triangle + fill temporaries (small
+    next to the per-feature kernel's footprint). Shared with the
+    resource audit like :func:`scan_pair_vmem_bytes`."""
+    return int(min(100 << 20, 48 * Gp * Wp * 4 + Wp * Wp * 4 + (20 << 20)))
+
+
 def _scan_kernel(scal_ref, gb_ref, hb_ref, keepr_ref, keepf_ref,
                  validr_ref, validf_ref, aux_ref, out_ref):
     # validr/validf arrive as [1, F, W] child blocks
@@ -199,13 +218,10 @@ def scan_pair(scal, gb, hb, keep_r, keep_f, valid_r, valid_f, aux,
     if valid_f.ndim == 2:
         valid_f = jnp.broadcast_to(valid_f, (B, Fp, Wp))
     scal = jnp.zeros((B, 1, 128), jnp.float32).at[:, 0, :8].set(scal)
-    # the kernel stages ~12 [Fp, Wp] f32 blocks plus Mosaic temporaries;
-    # the default scoped-vmem budget OOMs past ~450 features at Wp=256
-    # (v5e carries 128MB of VMEM, so size the limit to the footprint)
-    _vmem = min(100 << 20, 16 * Fp * Wp * 4 + (20 << 20))
+    _vmem = scan_pair_vmem_bytes(Fp, Wp)
     return pl.pallas_call(
         _scan_kernel,
-        compiler_params=_TPUCompilerParams(vmem_limit_bytes=int(_vmem)),
+        compiler_params=_TPUCompilerParams(vmem_limit_bytes=_vmem),
         grid=(B,),
         in_specs=[
             pl.BlockSpec((1, 1, 128), lambda c: (c, c * 0, c * 0)),
@@ -461,13 +477,11 @@ def scan_blocks(scal, gb, hb, masks, do_fix: bool = False,
     B, Gp, Wp = gb.shape
     scal_p = jnp.zeros((B, 1, 128), jnp.float32).at[:, 0, :9].set(
         scal.astype(jnp.float32))
-    # ~14 [Gp, Wp] staging planes + the [Wp, Wp] triangle + fill
-    # temporaries; small next to the per-feature kernel's footprint
-    _vmem = min(100 << 20, 48 * Gp * Wp * 4 + Wp * Wp * 4 + (20 << 20))
+    _vmem = scan_blocks_vmem_bytes(Gp, Wp)
     kern = functools.partial(_scan_blocks_kernel, do_fix)
     return pl.pallas_call(
         kern,
-        compiler_params=_TPUCompilerParams(vmem_limit_bytes=int(_vmem)),
+        compiler_params=_TPUCompilerParams(vmem_limit_bytes=_vmem),
         grid=(B,),
         in_specs=[
             pl.BlockSpec((1, 1, 128), lambda c: (c, c * 0, c * 0)),

@@ -115,6 +115,15 @@ def shard_queries(group_sizes, rank: int, world: int):
             sizes[q0:q1].copy())
 
 
+def _np_grad_args(obj):
+    """An objective's device gradient args materialized as host numpy.
+
+    Setup-time shaping of host-resident metadata — the objective's
+    ``_grad_args`` returns host arrays, so this never syncs a device
+    (the reason it may run inside the per-device setup loop)."""
+    return [None if a is None else np.asarray(a) for a in obj._grad_args()]
+
+
 def _lambdarank_block_gargs(config: Config, label_local, weight_local,
                             qb, dev_cuts, B, NQB, Pmax):
     """Per-local-device lambdarank gradient inputs, padded to the global
@@ -127,23 +136,27 @@ def _lambdarank_block_gargs(config: Config, label_local, weight_local,
     local_dev = len(dev_cuts) - 1
     lab_b, w_b, qidx_b, qval_b, inv_b, ipos_b = [], [], [], [], [], []
     label_gain = None
+    # hoisted conversions: one asarray per input, sliced per device below
+    label_all = np.asarray(label_local, np.float64)
+    weight_all = (np.asarray(weight_local, np.float64)
+                  if weight_local is not None else None)
+    qb_all = np.asarray(qb, np.int64)
     for d in range(local_dev):
         qd0, qd1 = dev_cuts[d], dev_cuts[d + 1]
-        r0, r1 = int(qb[qd0]), int(qb[qd1])
+        r0, r1 = int(qb_all[qd0]), int(qb_all[qd1])
         nq_d, n_d = qd1 - qd0, r1 - r0
 
         class _BMeta:
-            label = np.asarray(label_local[r0:r1], np.float64)
-            weight = (np.asarray(weight_local[r0:r1], np.float64)
-                      if weight_local is not None else None)
-            query_boundaries = (np.asarray(qb[qd0:qd1 + 1]) - r0)
+            label = label_all[r0:r1]
+            weight = (weight_all[r0:r1] if weight_all is not None
+                      else None)
+            query_boundaries = qb_all[qd0:qd1 + 1] - r0
             num_queries = nq_d
             init_score = None
         obj_d = create_objective(config.objective, config)
         obj_d.init(_BMeta(), n_d)
-        (lab, w, qidx, qval, inv, lgain, _disc, _ipos) = [
-            None if a is None else np.asarray(a)
-            for a in obj_d._grad_args()]
+        (lab, w, qidx, qval, inv, lgain, _disc, _ipos) = \
+            _np_grad_args(obj_d)
         label_gain = lgain
         P_d = qidx.shape[1] if nq_d else 0
         qidx_p = np.full((NQB, Pmax), -1, np.int64)
@@ -193,10 +206,12 @@ def _global_array(mesh: Mesh, local_np: np.ndarray):
 
 @telemetry.timed("collective::AllreduceMean(metrics,DCN)",
                  category="collective")
-def _allreduce_mean_host(values: np.ndarray, weights: np.ndarray):
+def _allreduce_mean_host(values, weights):
     """Count-weighted mean across processes via host allgather (used for
     metric aggregation over unequal validation shards; zero-weight ranks
-    contribute nothing but still participate in the collective)."""
+    contribute nothing but still participate in the collective).
+    Returns plain Python floats so per-batch callers need no further
+    host conversion (the JG002 hot-loop contract)."""
     v = _pallgather(
         "allreduce:metrics_values",
         np.asarray(values, np.float64).reshape(1, -1)).reshape(
@@ -206,7 +221,23 @@ def _allreduce_mean_host(values: np.ndarray, weights: np.ndarray):
         np.asarray(weights, np.float64).reshape(1, -1)).reshape(
         jax.process_count(), -1)
     tot = np.sum(w, axis=0)
-    return np.sum(v * w, axis=0) / np.where(tot > 0, tot, 1.0)
+    out = np.sum(v * w, axis=0) / np.where(tot > 0, tot, 1.0)
+    return [float(x) for x in out]
+
+
+def _local_metric_value(metric, vscore, objective, n_valid):
+    """(value, weight) of this rank's validation shard as host floats.
+
+    Rank metrics average per QUERY, so the aggregation weight is the
+    query count there; ``metric.eval`` returns numpy scalars — no
+    device sync happens here, which is what lets the per-batch metric
+    block call this helper from the training loop."""
+    nv = int(n_valid)
+    if nv and getattr(metric, "query_boundaries", None) is not None:
+        nv = max(len(metric.query_boundaries) - 1, 0)
+    val = (float(metric.eval(vscore.reshape(-1), objective)[0])
+           if nv else 0.0)
+    return val, float(nv)
 
 
 class _EarlyStop:
@@ -438,15 +469,16 @@ def train_multihost(config: Config, X_local: np.ndarray,
                    (_global_array(mesh, a) if sp != P() else jnp.asarray(a))
                    for a, sp in zip(gargs_np, garg_specs)]
     else:
-        # row-sharded where row-aligned
+        # row-sharded where row-aligned (args pre-converted to numpy so
+        # the transfer loop itself stays sync-free)
         gargs_g = []
         garg_specs = []
-        for a in objective._grad_args():
+        for a in _np_grad_args(objective):
             if a is None:
                 gargs_g.append(None)
                 garg_specs.append(P())
             elif a.ndim >= 1 and a.shape[0] == n_local:
-                gargs_g.append(_global_array(mesh, padded(np.asarray(a))))
+                gargs_g.append(_global_array(mesh, padded(a)))
                 garg_specs.append(P(AXIS))
             else:
                 Log.fatal("objective %s has gradient inputs that are not "
@@ -692,12 +724,13 @@ def train_multihost(config: Config, X_local: np.ndarray,
         if K > 1:
             fmasks = fmasks.reshape(k, K, -1)
         # goss redraws its sample every iteration (windows = iters, as the
-        # serial persist driver does); bagging windows follow bagging_freq
+        # serial persist driver does); bagging windows follow bagging_freq.
+        # One vmapped fold_in builds all k window keys on device — the
+        # old per-key key_data round-trip was a device sync per iteration
         wwin = 1 if use_goss else freq
-        wkeys = jnp.asarray(np.stack([
-            np.asarray(jax.random.key_data(jax.random.fold_in(
-                base_key, (it + i) // wwin))) for i in range(k)]),
-            jnp.uint32)
+        win_ids = jnp.arange(it, it + k, dtype=jnp.int32) // wwin
+        wkeys = jax.vmap(lambda wi: jax.random.key_data(
+            jax.random.fold_in(base_key, wi)))(win_ids).astype(jnp.uint32)
         keys = jnp.stack([learner._next_extras().key for _ in range(k)])
         its = jnp.arange(it, it + k, dtype=jnp.int32)
         with telemetry.scope("collective::multihost_scan(launch)",
@@ -741,15 +774,10 @@ def train_multihost(config: Config, X_local: np.ndarray,
                         vscore[c] += class_trees[c].predict(Xv)
         it += k
         if metrics and not stopped:
-            nv = (len(y_valid) if y_valid is not None else 0)
-            # rank metrics average per QUERY; aggregate query-weighted
-            if nv and getattr(metrics[0], "query_boundaries",
-                              None) is not None:
-                nv = max(len(metrics[0].query_boundaries) - 1, 0)
-            local = (float(metrics[0].eval(vscore.reshape(-1),
-                                           objective)[0])
-                     if nv else 0.0)
-            agg = float(_allreduce_mean_host([local], [float(nv)])[0])
+            local, nv = _local_metric_value(
+                metrics[0], vscore, objective,
+                len(y_valid) if y_valid is not None else 0)
+            agg = _allreduce_mean_host([local], [nv])[0]
             if rank == 0:
                 Log.info("[%d] valid %s : %g"
                          % (it, metrics[0].names[0], agg))

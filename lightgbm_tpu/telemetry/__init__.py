@@ -14,7 +14,10 @@ real observability layer:
   * :mod:`xplane`  — xplane-proto op-level device profiles
     (``python -m lightgbm_tpu.profile``);
   * :mod:`hostprof`— host-side cProfile / microbench dev helpers behind the
-    top-level ``prof_bin.py`` / ``prof_split.py`` wrappers.
+    top-level ``prof_bin.py`` / ``prof_split.py`` wrappers;
+  * :mod:`devices` — static TPU device profiles (per-core VMEM, per-chip
+    HBM budgets) consumed by the ``analysis/resource_audit`` budget gate
+    and the kernel ``vmem_limit_bytes`` sizing comments.
 
 Enablement: ``tpu_telemetry=off|timers|trace`` config param (plus
 ``telemetry_out=<path>`` for the trace/metrics files), the legacy

@@ -1,0 +1,97 @@
+"""Static TPU device profiles: the per-core/per-chip resource budgets.
+
+One canonical table for the numbers that were previously scattered as
+comments next to individual kernels ("v5e carries 128MB of VMEM", the
+16MB default scoped-vmem limit, HBM per chip). Consumers:
+
+* :mod:`lightgbm_tpu.analysis.resource_audit` — the static VMEM/HBM
+  budget gate checks every Pallas kernel's footprint against the active
+  profile BEFORE a rewrite lands, instead of discovering a
+  scoped-vmem OOM on the first real-TPU run;
+* kernel authors — ``vmem_limit_bytes`` requests must stay under
+  ``profile.vmem_bytes`` (the kernels cap themselves at 96-100MB, sized
+  for the v5e default profile).
+
+The budgets are deliberately conservative fractions of the hardware
+numbers: ``vmem_budget`` leaves headroom for Mosaic's own temporaries
+and ``hbm_budget`` for XLA's allocator slack + the runtime; a kernel or
+dataset plan that fits the budget fits the device.
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Dict
+
+MIB = 1 << 20
+GIB = 1 << 30
+
+# Mosaic's scoped-vmem default when a kernel sets no vmem_limit_bytes
+# (the limit the pallas_grow chunk-sizing comments work around)
+DEFAULT_VMEM_LIMIT = 16 * MIB
+
+
+@dataclass(frozen=True)
+class DeviceProfile:
+    """Per-core VMEM + per-chip HBM capacities and audit budgets."""
+
+    name: str
+    vmem_bytes: int            # VMEM per core
+    hbm_bytes: int             # HBM per chip
+    vmem_headroom: float = 0.9  # fraction a kernel may claim
+    hbm_headroom: float = 0.9   # fraction resident planes may claim
+
+    @property
+    def vmem_budget(self) -> int:
+        return int(self.vmem_bytes * self.vmem_headroom)
+
+    @property
+    def hbm_budget(self) -> int:
+        return int(self.hbm_bytes * self.hbm_headroom)
+
+    def to_dict(self) -> dict:
+        return {"name": self.name, "vmem_bytes": self.vmem_bytes,
+                "hbm_bytes": self.hbm_bytes,
+                "vmem_budget": self.vmem_budget,
+                "hbm_budget": self.hbm_budget}
+
+
+DEVICE_PROFILES: Dict[str, DeviceProfile] = {
+    # the tuning target: every kernel vmem_limit comment assumes v5e
+    "v5e": DeviceProfile("v5e", vmem_bytes=128 * MIB, hbm_bytes=16 * GIB),
+    "v5p": DeviceProfile("v5p", vmem_bytes=128 * MIB, hbm_bytes=95 * GIB),
+    # older generation: much smaller scoped VMEM — kernels that size
+    # their limit near 100MB do NOT fit; the audit reports it per profile
+    "v4": DeviceProfile("v4", vmem_bytes=32 * MIB, hbm_bytes=32 * GIB),
+}
+
+DEFAULT_PROFILE = "v5e"
+
+
+def get_profile(name: str) -> DeviceProfile:
+    try:
+        return DEVICE_PROFILES[name]
+    except KeyError:
+        raise ValueError("unknown device profile %r (have: %s)"
+                         % (name, ", ".join(sorted(DEVICE_PROFILES))))
+
+
+def detect_profile() -> DeviceProfile:
+    """Profile of the attached accelerator, or the default tuning target.
+
+    Pure string matching on ``device_kind`` — never initializes a
+    backend that is not already initialized (the analysis gate runs on
+    CPU machines; touching jax.devices() there is fine, on a multi-host
+    setup mid-init it is not, so the env override wins outright)."""
+    override = os.environ.get("LGBTPU_DEVICE_PROFILE", "")
+    if override:
+        return get_profile(override)
+    try:
+        import jax
+        kind = jax.devices()[0].device_kind.lower()
+    except Exception:
+        return DEVICE_PROFILES[DEFAULT_PROFILE]
+    for name in DEVICE_PROFILES:
+        if name in kind:
+            return DEVICE_PROFILES[name]
+    return DEVICE_PROFILES[DEFAULT_PROFILE]

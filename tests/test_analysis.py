@@ -19,12 +19,13 @@ import textwrap
 
 import pytest
 
-from lightgbm_tpu.analysis import (GraftlintConfig, load_config, run_audits,
+from lightgbm_tpu.analysis import (GraftlintConfig, all_auditors,
+                                   load_config, run_auditors, run_audits,
                                    run_lint)
 from lightgbm_tpu.analysis.config import _parse_table
 from lightgbm_tpu.analysis.lint import (apply_baseline, iter_py_files,
                                         lint_source, load_baseline,
-                                        write_baseline)
+                                        prune_baseline, write_baseline)
 from lightgbm_tpu.analysis.rules import all_rules
 
 OPS = "lightgbm_tpu/ops/fake.py"          # hot path, kernel-bearing
@@ -221,6 +222,36 @@ FIXTURES = {
                 return json.dumps(d)
             """,
     },
+    # JG009 is scoped to the collective paths (parallel/, resilience/)
+    "JG009": {
+        "relpath": "lightgbm_tpu/parallel/fake.py",
+        "positive": """
+            import numpy as np
+            from jax.experimental import multihost_utils
+
+            def sync_counts(n_local):
+                return multihost_utils.process_allgather(   # no guard
+                    np.asarray([n_local], np.int64))
+            """,
+        "negative": """
+            import numpy as np
+            from jax.experimental import multihost_utils
+
+            from lightgbm_tpu.resilience import retry as resilience_retry
+
+            def sync_counts(n_local):
+                return resilience_retry.guard(
+                    "allgather:row_counts",
+                    multihost_utils.process_allgather,
+                    np.asarray([n_local], np.int64))
+
+            def sync_lazy(arr):
+                # a closure handed to guard still runs under its deadline
+                return resilience_retry.guard(
+                    "allgather:lazy",
+                    lambda: multihost_utils.process_allgather(arr))
+            """,
+    },
     # JG008 is scoped to the resilience durability paths; its fixtures
     # carry their own relpath (the "relpath" key overrides the OPS default)
     "JG008": {
@@ -256,7 +287,16 @@ def test_every_rule_has_fixtures():
     ids = {r.id for r in all_rules()}
     assert ids == set(FIXTURES), "every JG rule needs fixture snippets"
     assert ids == {"JG001", "JG002", "JG003", "JG004", "JG005", "JG006",
-                   "JG007", "JG008"}
+                   "JG007", "JG008", "JG009"}
+
+
+def test_jg009_outside_scope_is_silent():
+    """The same direct collective call is fine outside the collective
+    paths (a test helper gathering once at setup is not the hot DCN
+    contract)."""
+    hits = _ids(_lint(FIXTURES["JG009"]["positive"], relpath=COLD),
+                "JG009")
+    assert hits == []
 
 
 @pytest.mark.parametrize("rule_id", sorted(FIXTURES))
@@ -317,6 +357,64 @@ def test_jg007_fix_wraps_long_from_imports(tmp_path):
     from_lines = [ln for ln in fixed.splitlines()
                   if ln.startswith("from ")]
     assert all(len(ln) <= 79 for ln in from_lines), from_lines
+
+
+def test_jg007_autofix_idempotent(tmp_path):
+    """Running --autofix twice must be a byte-for-byte no-op. The pinned
+    regression: `import os` next to `from os import path` — os's only
+    other mention is inside the deletable second import, so pass 1 used
+    to keep it and pass 2 deleted it. Both go in pass 1 now."""
+    pkg = tmp_path / "lightgbm_tpu"
+    pkg.mkdir()
+    mod = pkg / "mod.py"
+    mod.write_text("import os\n"
+                   "from os import path\n"
+                   "from some.rather.deep.package.path import ("
+                   "unused_name_xx, kept_name_aaaaaaaa, "
+                   "kept_name_bbbbbbbb, kept_name_cccccccc)\n"
+                   "\n"
+                   "def f():\n"
+                   "    return (kept_name_aaaaaaaa, kept_name_bbbbbbbb,\n"
+                   "            kept_name_cccccccc)\n")
+    cfg = GraftlintConfig(root=str(tmp_path), baseline="baseline.json")
+    r1 = run_lint(config=cfg, autofix=True)
+    t1 = mod.read_text()
+    assert r1.autofixed == 3                  # os + path + unused_name_xx
+    assert "import os" not in t1 and "unused_name_xx" not in t1
+    r2 = run_lint(config=cfg, autofix=True)
+    assert r2.autofixed == 0
+    assert mod.read_text() == t1, "second --autofix pass changed bytes"
+
+
+def test_prune_baseline_drops_stale_entries(tmp_path):
+    """Stale baseline entries (fixed or deleted findings) are dropped;
+    live ones are kept with counts clamped to what still matches —
+    a stale suppression can't sit around hiding a regression."""
+    src = """
+        import jax.numpy as jnp
+
+        def setup():
+            return jnp.zeros((4,))
+        """
+    findings = _lint(src)
+    bl = str(tmp_path / "b.json")
+    write_baseline(findings, bl)
+    # graft in a stale entry + an overcounted live one
+    data = json.load(open(bl))
+    data["findings"].append({"rule": "JG003", "path": OPS,
+                             "snippet": "gone = jnp.ones((4,))",
+                             "count": 2})
+    data["findings"][0]["count"] += 3        # overcount the live entry
+    json.dump(data, open(bl, "w"))
+    kept, dropped = prune_baseline(_lint(src), bl)
+    assert (kept, dropped) == (1, 5)         # stale 2 + overcount 3
+    pruned = load_baseline(bl)
+    assert sum(pruned.values()) == 1
+    fresh = _lint(src)
+    apply_baseline(fresh, pruned)
+    assert _ids(fresh) == []                 # live entry still suppresses
+    # idempotent: nothing left to prune
+    assert prune_baseline(_lint(src), bl) == (1, 0)
 
 
 def test_write_baseline_keeps_grandfathered(tmp_path):
@@ -506,18 +604,14 @@ def test_repo_self_scan_clean():
     assert report.files_scanned > 60
 
 
-def test_baseline_only_contains_known_grandfathered():
-    """The baseline must shrink, never grow: pin its current contents so
-    a PR that adds entries has to justify itself here."""
+def test_baseline_is_empty():
+    """The baseline must shrink, never grow — and since the PR 8
+    burn-down of the 8 grandfathered JG002 multihost setup-loop syncs it
+    is EMPTY. A PR that adds entries has to justify itself here."""
     cfg = load_config()
     with open(cfg.baseline_path()) as f:
         data = json.load(f)
-    by_rule = {}
-    for ent in data["findings"]:
-        by_rule.setdefault(ent["rule"], 0)
-        by_rule[ent["rule"]] += ent["count"]
-    assert set(by_rule) <= {"JG002"}, by_rule
-    assert sum(by_rule.values()) <= 9, by_rule
+    assert data["findings"] == [], data["findings"]
 
 
 def test_lint_lands_on_telemetry_counters():
@@ -544,7 +638,321 @@ def test_cli_smoke(capsys):
     from lightgbm_tpu.analysis.__main__ import main
     assert main(["--list-rules"]) == 0
     out = capsys.readouterr().out
-    assert "JG001" in out and "JG007" in out
+    assert "JG001" in out and "JG007" in out and "JG009" in out
     # lint-only over one file: exits 0 and prints the summary line
     assert main(["lightgbm_tpu/analysis/lint.py", "--no-audit"]) == 0
     assert "graft-lint:" in capsys.readouterr().out
+    # the budget tables render without running the gate
+    assert main(["--budgets"]) == 0
+    out = capsys.readouterr().out
+    assert "resource budgets" in out and "hist_window" in out
+
+
+# ---------------------------------------------------------------------------
+# whole-program auditors: fixtures enumerated from the registry
+# ---------------------------------------------------------------------------
+#
+# Same contract as the JG rules: every registered auditor needs a
+# seeded-violation payload its check_fixture() flags and a clean twin it
+# stays silent on — an auditor added without fixtures fails here by
+# construction.
+
+AUDITOR_FIXTURES = {
+    "collective_order": {
+        # rank 0 gathers, everyone else never arrives: deadlock
+        "positive": """
+            from jax.experimental import multihost_utils
+
+            from lightgbm_tpu.resilience import retry as resilience_retry
+
+            def sync_stats(rank, stats):
+                if rank == 0:
+                    return resilience_retry.guard(
+                        "allgather:stats",
+                        multihost_utils.process_allgather, stats)
+                return stats
+            """,
+        # unconditional collective; only the logging is rank-dependent
+        "negative": """
+            from jax.experimental import multihost_utils
+
+            from lightgbm_tpu.resilience import retry as resilience_retry
+
+            def sync_stats(rank, stats):
+                agg = resilience_retry.guard(
+                    "allgather:stats",
+                    multihost_utils.process_allgather, stats)
+                if rank == 0:
+                    print(agg)
+                return agg
+            """,
+    },
+    "resource_budget": {
+        # a 4000-group unbundled monster: kernels blow VMEM, planes
+        # blow HBM
+        "positive": {"rows": 50_000_000, "features": 4000,
+                     "groups": 4000, "bundled": False},
+        "negative": {"rows": 1_000_000, "features": 28, "groups": 28,
+                     "bundled": False},
+    },
+    "compile_surface": {
+        # a per-iteration Python int marked static: unbounded recompiles
+        "positive": """
+            import functools
+
+            import jax
+
+            @functools.partial(jax.jit, static_argnames=("n_iter",))
+            def step(x, n_iter):
+                return x * n_iter
+            """,
+        "negative": """
+            import functools
+
+            import jax
+
+            @functools.partial(jax.jit, static_argnames=("interpret",))
+            def step(x, interpret):
+                return x * 2
+            """,
+    },
+}
+
+
+def test_every_auditor_has_fixtures():
+    assert set(AUDITOR_FIXTURES) == set(all_auditors()), \
+        "every registered auditor needs fixture payloads"
+
+
+@pytest.mark.parametrize("name", sorted(AUDITOR_FIXTURES))
+def test_auditor_fires_on_seeded_violation(name):
+    mod = all_auditors()[name]
+    payload = AUDITOR_FIXTURES[name]["positive"]
+    if isinstance(payload, str):
+        payload = textwrap.dedent(payload)
+    hits = mod.check_fixture(payload)
+    assert hits, "%s stayed silent on its seeded violation" % name
+
+
+@pytest.mark.parametrize("name", sorted(AUDITOR_FIXTURES))
+def test_auditor_silent_on_clean_twin(name):
+    mod = all_auditors()[name]
+    payload = AUDITOR_FIXTURES[name]["negative"]
+    if isinstance(payload, str):
+        payload = textwrap.dedent(payload)
+    hits = mod.check_fixture(payload)
+    assert not hits, "%s false-positived on its clean twin: %s" \
+        % (name, hits)
+
+
+def test_collective_auditor_divergence_forms():
+    """Beyond the registry fixture: symmetric branches are rank-safe,
+    early exits under rank branches are not, and derived rank values
+    (cuts[rank]) taint through arithmetic but not through calls."""
+    from lightgbm_tpu.analysis import collective_audit as co
+    symmetric = """
+        from jax.experimental import multihost_utils
+
+        from lightgbm_tpu.resilience import retry as resilience_retry
+
+        def sync(rank, a, b):
+            if rank == 0:
+                out = resilience_retry.guard(
+                    "allgather:x", multihost_utils.process_allgather, a)
+            else:
+                out = resilience_retry.guard(
+                    "allgather:x", multihost_utils.process_allgather, b)
+            return out
+        """
+    assert co.check_fixture(textwrap.dedent(symmetric)) == []
+    early_exit = """
+        from jax.experimental import multihost_utils
+
+        from lightgbm_tpu.resilience import retry as resilience_retry
+
+        def sync(rank, cuts, stats):
+            start = cuts[rank]
+            if start < 0:
+                return None
+            return resilience_retry.guard(
+                "allgather:stats",
+                multihost_utils.process_allgather, stats)
+        """
+    hits = co.check_fixture(textwrap.dedent(early_exit))
+    assert hits and "early exit" in hits[0]
+    call_barrier = """
+        from jax.experimental import multihost_utils
+
+        from lightgbm_tpu.resilience import retry as resilience_retry
+
+        def sync(rank, stats):
+            counts = resilience_retry.guard(
+                "allgather:counts",
+                multihost_utils.process_allgather, stats)
+            if counts.sum() > 0:     # collective result: rank-uniform
+                return resilience_retry.guard(
+                    "allgather:stats",
+                    multihost_utils.process_allgather, stats)
+            return None
+        """
+    assert co.check_fixture(textwrap.dedent(call_barrier)) == []
+
+
+def test_collective_trace_extracts_repo_sites():
+    """The abstract trace covers the known DCN call sites with their
+    guard labels — the artifact the item-2 collectives rewrite diffs."""
+    from lightgbm_tpu.analysis import collective_audit as co
+    trace = co.extract_repo_trace()
+    names = {s["name"] for s in trace["sites"] if s["name"]}
+    assert {"allgather:binning_sizes", "allgather:binning_mappers",
+            "allreduce:metrics_values",
+            "allgather:row_counts"} <= names
+    assert all(s["guarded"] for s in trace["sites"])
+    assert trace["findings"] == []
+
+
+def test_resource_audit_tracks_kernel_formulas():
+    """The request column must come from the kernels' own helpers — if a
+    kernel formula changes, the audit sees the new number without
+    edits here."""
+    from lightgbm_tpu.analysis import resource_audit as ra
+    from lightgbm_tpu.ops.pallas_scan import scan_pair_vmem_bytes
+    from lightgbm_tpu.telemetry.devices import get_profile
+    est = ra.estimate_scan_pair(ra.BENCH_SHAPES["yahoo"],
+                                get_profile("v5e"))
+    assert est.request == scan_pair_vmem_bytes(704, 256)
+    assert est.ok
+
+
+def test_resource_audit_profile_budgets_differ():
+    """v4's 32MB VMEM cannot host the 100MB-class kernel requests the
+    v5e tuning assumes — the per-profile budget check must say so."""
+    from lightgbm_tpu.analysis import resource_audit as ra
+    from lightgbm_tpu.telemetry.devices import get_profile
+    kernels, _ = ra.estimate_all(profile=get_profile("v4"))
+    assert any(not k.ok for k in kernels)
+    kernels5, hbm5 = ra.estimate_all(profile=get_profile("v5e"))
+    assert all(k.ok for k in kernels5) and all(h.ok for h in hbm5)
+
+
+def test_compile_audit_enumerates_known_entry_points():
+    """The AST walk must see the real jit surface: the kernel entry
+    points, the predict runtime's static raw flag, and the factories."""
+    from lightgbm_tpu.analysis import compile_audit as ca
+    surf = ca.compile_surface()
+    funcs = {s["func"] for s in surf["sites"]}
+    assert {"hist_window", "scan_pair", "scan_blocks",
+            "build_histogram"} <= funcs
+    assert any(s["static_nums"] == [1] for s in surf["sites"]
+               if "runtime.py" in s["path"])
+    assert surf["serve_ladder_bound"] == 9     # ceil(log2(65536/256))+1
+    assert surf["total_bound"] <= 64
+    assert all(not s["unbounded"] for s in surf["sites"])
+
+
+def test_auditors_all_green_on_repo():
+    """The whole-program auditors pass on the repo itself — the same
+    results the CLI gate appends to the jaxpr audits."""
+    results = {r.name: r for r in run_auditors()}
+    assert set(results) == {"collective_order", "collective_guarded",
+                            "vmem_budget", "hbm_budget",
+                            "compile_surface"}
+    bad = {n: r.detail for n, r in results.items() if not r.ok}
+    assert not bad, bad
+
+
+def test_cli_gate_json_green(capsys):
+    """`python -m lightgbm_tpu.analysis --json` — the EXACT gate
+    pre-commit runs — exits 0 on the repo, reports all five new audit
+    results, and ships the auditor artifacts in the payload."""
+    from lightgbm_tpu.analysis.__main__ import main
+    code = main(["--json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert code == 0 and payload["exit_code"] == 0
+    audit_names = {a["name"] for a in payload["audits"]}
+    assert {"collective_order", "collective_guarded", "vmem_budget",
+            "hbm_budget", "compile_surface"} <= audit_names
+    assert payload["lint"]["counts"]["unsuppressed"] == 0
+    assert payload["collective_trace"]["findings"] == []
+    assert payload["resource_tables"]["vmem"]
+    assert payload["compile_surface"]["total_bound"] <= 64
+
+
+def test_jg007_skips_imports_sharing_a_line(tmp_path):
+    """An import sharing a source line with other code (or a trailing
+    comment) is not removable: both the usage count and the fix are
+    line-grained, so deleting the line would take the neighbour with
+    it (`import os; x = os.path` used to lose the assignment)."""
+    pkg = tmp_path / "lightgbm_tpu"
+    pkg.mkdir()
+    mod = pkg / "mod.py"
+    text = ("import os; x = os.path\n"
+            "import json  # tooling hook\n"
+            "print(x)\n")
+    mod.write_text(text)
+    cfg = GraftlintConfig(root=str(tmp_path), baseline="baseline.json")
+    report = run_lint(config=cfg, autofix=True)
+    assert _ids(report.findings, "JG007") == []
+    assert report.autofixed == 0
+    assert mod.read_text() == text, "autofix touched a shared line"
+
+
+def test_baseline_rewrites_refuse_filtered_scans(capsys):
+    """--prune-baseline / --write-baseline under --rules or path args
+    exit 2 without touching the file: a filtered report would mark
+    every out-of-scope baseline entry stale and destroy it."""
+    from lightgbm_tpu.analysis.__main__ import main
+    bl = load_config().baseline_path()
+    before = open(bl).read()
+    assert main(["--prune-baseline", "--rules", "JG007"]) == 2
+    assert main(["lightgbm_tpu/ops", "--prune-baseline"]) == 2
+    assert main(["--write-baseline", "--rules", "JG002"]) == 2
+    err = capsys.readouterr().err
+    assert "full unfiltered scan" in err
+    assert open(bl).read() == before
+
+
+def test_compile_audit_sees_nondecorator_partial_sites():
+    """partial(jax.jit, ...) used as an expression (assignment/factory
+    form, not a decorator) is the same recompile surface and must be
+    enumerated — an unregistered static name there fails the gate."""
+    from lightgbm_tpu.analysis.compile_audit import analyze_source
+    src = textwrap.dedent("""
+        import functools
+
+        import jax
+
+        def body(x, n_iter):
+            return x * n_iter
+
+        step = functools.partial(
+            jax.jit, static_argnames=("n_iter",))(body)
+        """)
+    sites = analyze_source(src, "lightgbm_tpu/ops/fixture.py")
+    assert [s.kind for s in sites] == ["call"]
+    assert sites[0].unbounded == ["n_iter"]
+
+
+def test_auditor_artifacts_single_pass_matches_fresh():
+    """compute_artifacts + run_all(artifacts=...) — the --json CLI's
+    single-pass path — must produce the same verdicts and payload as
+    fresh per-consumer computation."""
+    from lightgbm_tpu.analysis import auditors
+    from lightgbm_tpu.analysis import (collective_audit, compile_audit,
+                                       resource_audit)
+    config = load_config()
+    art = auditors.compute_artifacts(config)
+    assert set(art) == set(auditors.all_auditors())
+    cached = auditors.run_all(config, artifacts=art)
+    fresh = auditors.run_all(config)
+    assert [(a.name, a.ok, a.detail) for a in cached] \
+        == [(a.name, a.ok, a.detail) for a in fresh]
+    assert collective_audit.extract_repo_trace(
+        config, artifact=art["collective_order"]) \
+        == collective_audit.extract_repo_trace(config)
+    assert resource_audit.tables(
+        config=config, artifact=art["resource_budget"]) \
+        == resource_audit.tables(config=config)
+    assert compile_audit.compile_surface(
+        config, artifact=art["compile_surface"]) \
+        == compile_audit.compile_surface(config)
