@@ -37,6 +37,11 @@ def _phase_stats(telemetry):
                           "category": cat}
                    for name, (sec, n, cat)
                    in telemetry.events.snapshot_full().items()},
+        "histograms": {k: h.to_dict(with_buckets=False)
+                       for k, h in
+                       telemetry.histograms_snapshot().items()},
+        "dropped_events": telemetry.events.dropped_events(),
+        "histo_saturation": telemetry.histo.saturation_total(),
     }
 
 
@@ -261,6 +266,13 @@ def main():
         result["predict_compiles"] = pred["higgs"]["compiles"]
         result["predict_expo_value"] = pred["expo"]["value"]
         result["predict_expo_compiles"] = pred["expo"]["compiles"]
+        slo = pred.get("poisson")
+        if slo is not None:
+            # serving SLO under the open-loop Poisson load (latency
+            # measured from ARRIVAL, so queueing shows up in the tail)
+            result["predict_p50"] = slo["p50"]
+            result["predict_p99"] = slo["p99"]
+            result["predict_qdepth"] = slo["qdepth_mean"]
         print(json.dumps(result), flush=True)
         for shape in ("higgs", "expo"):
             r = pred[shape]
@@ -268,6 +280,14 @@ def main():
                   "%.2fM rows/s, %d serve compiles (bound %d)"
                   % (shape, r["trees"], r["rows"], r["serve_s"], r["value"],
                      r["compiles"], r["compile_bound"]), file=sys.stderr)
+        if slo is not None:
+            print("# predict[poisson open-loop]: %d requests at %.0f rps "
+                  "-> p50=%.1fms p99=%.1fms queue-wait p99=%.1fms, mean "
+                  "qdepth %.2f (max %d)"
+                  % (slo["requests"], slo["rps"], slo["p50"] * 1e3,
+                     slo["p99"] * 1e3, slo["queue_wait_p99"] * 1e3,
+                     slo["qdepth_mean"], slo["qdepth_max"]),
+                  file=sys.stderr)
     # full per-phase telemetry snapshot (category totals + per-scope table)
     # so BENCH_*.json rounds can archive WHERE the time went
     if bench_telemetry:
@@ -457,7 +477,9 @@ def run_yahoo():
 
 def _predict_one_shape(X, y, params, n_trees, serve_rows, tag):
     """Train a model on the shape, then serve `serve_rows` ragged batches
-    through the bucketed device runtime; rows/sec + compile count."""
+    through the bucketed device runtime; rows/sec + compile count.
+    Returns (stats dict, trained booster) — the Poisson SLO phase reuses
+    the booster instead of paying a second full training."""
     import numpy as np
 
     import lightgbm_tpu as lgb
@@ -488,17 +510,69 @@ def _predict_one_shape(X, y, params, n_trees, serve_rows, tag):
     serve_s = time.time() - t0
     stats = server.stats()   # per-server: correct with telemetry off AND
     #                        # uncontaminated by the other shape's counters
-    return {"rows": served, "serve_s": serve_s, "trees": bst.num_trees(),
-            "value": round(served / serve_s / 1e6, 3),
-            "compiles": int(stats["compiles"]),
-            "compile_bound": server.max_compiles(), "tag": tag}
+    return ({"rows": served, "serve_s": serve_s, "trees": bst.num_trees(),
+             "value": round(served / serve_s / 1e6, 3),
+             "compiles": int(stats["compiles"]),
+             "compile_bound": server.max_compiles(), "tag": tag}, bst)
+
+
+def poisson_open_loop(server, X, rps, n_requests, rng,
+                      batch_lo=None, batch_hi=None):
+    """Open-loop Poisson load over a warmed BatchServer: SLO percentiles.
+
+    OPEN loop means the arrival schedule is drawn up front (exponential
+    inter-arrivals at `rps`) and does NOT slow down when the server
+    falls behind — the honest regime for user-facing latency, where a
+    stalled server accumulates queue instead of throttling its users
+    (the closed-loop rows/sec phases above hide exactly that). Requests
+    are served in arrival order on this thread; a request's latency is
+    measured from its SCHEDULED ARRIVAL (service start minus arrival is
+    its queue wait, recorded by the server), and the queue depth sampled
+    at each service start is how many arrived requests were waiting.
+
+    Returns p50/p99 end-to-end seconds, queue-wait p99, and queue-depth
+    stats — the BENCH json's predict_p50 / predict_p99 / predict_qdepth.
+    """
+    import numpy as np
+    n = len(X)
+    lo = batch_lo if batch_lo is not None else server.min_batch // 2
+    hi = batch_hi if batch_hi is not None else server.min_batch * 4
+    arrivals = np.cumsum(rng.exponential(1.0 / rps, n_requests))
+    sizes = rng.integers(max(lo, 1), max(hi, 2), n_requests)
+    starts = rng.integers(0, max(n - int(sizes.max()), 1), n_requests)
+    lat = np.empty(n_requests)
+    qdepth = np.empty(n_requests, np.int64)
+    t0 = time.perf_counter()
+    for i in range(n_requests):
+        now = time.perf_counter() - t0
+        if now < arrivals[i]:
+            time.sleep(arrivals[i] - now)
+            now = arrivals[i]
+        # arrived-but-unstarted requests, this one included
+        qdepth[i] = int(np.searchsorted(arrivals, now, side="right")) - i
+        k = int(sizes[i])
+        i0 = int(starts[i])
+        server.predict(X[i0:i0 + min(k, n - i0)],
+                       arrival_t=t0 + float(arrivals[i]))
+        lat[i] = (time.perf_counter() - t0) - arrivals[i]
+    stats = server.stats()
+    return {"requests": n_requests, "rps": float(rps),
+            "p50": round(float(np.percentile(lat, 50)), 6),
+            "p99": round(float(np.percentile(lat, 99)), 6),
+            "queue_wait_p99": round(float(stats["queue_wait_p99"]), 6),
+            "qdepth_mean": round(float(qdepth.mean()), 3),
+            "qdepth_max": int(qdepth.max())}
 
 
 def run_predict():
     """Inference-subsystem phase: HIGGS-like dense and Expo-like bundled
     shapes served through predict/ (rows/sec + compile counts in the
-    BENCH json)."""
+    BENCH json), plus the open-loop Poisson SLO phase on the HIGGS
+    model (predict_p50/p99/qdepth keys)."""
+    import numpy as np
+
     from bench_full import make_expo_like
+    from lightgbm_tpu.predict import BatchServer
     n_rows = int(os.environ.get("BENCH_PREDICT_ROWS", 2_000_000))
     n_trees = int(os.environ.get("BENCH_PREDICT_TREES", 100))
     n_leaves = int(os.environ.get("BENCH_PREDICT_LEAVES", 255))
@@ -506,12 +580,32 @@ def run_predict():
     params = {"objective": "binary", "num_leaves": n_leaves, "max_bin": 255,
               "verbosity": -1, "metric": "none"}
     Xh, yh = make_higgs_like(n_rows)
-    higgs = _predict_one_shape(Xh, yh, params, n_trees, serve_rows, "higgs")
-    del Xh, yh
+    higgs, bst_h = _predict_one_shape(Xh, yh, params, n_trees, serve_rows,
+                                      "higgs")
+    out = {"higgs": higgs}
+    if os.environ.get("BENCH_PREDICT_POISSON", "1") != "0":
+        # SAME trained model, fresh small-bucket server: SLO traffic is
+        # single-user-sized requests, not the throughput phase's 64k-row
+        # slabs (the compiled ensemble tensors are cached on the
+        # booster; only the small ladder buckets compile here)
+        server = BatchServer(bst_h._booster.device_predictor(),
+                             min_batch=256, max_batch=4096)
+        b = server.min_batch
+        while b <= server.max_batch:     # warm every ladder bucket
+            server.predict(Xh[:b])
+            b <<= 1
+        rng = np.random.default_rng(7)
+        out["poisson"] = poisson_open_loop(
+            server, Xh,
+            rps=float(os.environ.get("BENCH_PREDICT_RPS", 50.0)),
+            n_requests=int(os.environ.get("BENCH_PREDICT_POISSON_REQS",
+                                          400)),
+            rng=rng)
+    del Xh, yh, bst_h
     Xe, ye = make_expo_like(min(n_rows, 1_000_000))
-    expo = _predict_one_shape(Xe, ye, params, n_trees, serve_rows // 2,
-                              "expo")
-    return {"higgs": higgs, "expo": expo}
+    out["expo"] = _predict_one_shape(Xe, ye, params, n_trees,
+                                     serve_rows // 2, "expo")[0]
+    return out
 
 
 def run_checkpoint():

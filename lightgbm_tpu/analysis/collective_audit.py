@@ -25,7 +25,14 @@ a payload snippet where derivable. It then verifies rank-consistency:
   the same deadlock one hop removed, and is flagged too;
 * every DCN collective call site must be wrapped by the
   ``resilience/retry.py`` guard (the per-file lint form of this is rule
-  JG009; the audit reports the whole-program count).
+  JG009; the audit reports the whole-program count);
+* every collective site must also RECORD TELEMETRY (the
+  ``collective_observed`` audit): an unobserved collective is invisible
+  to the latency/bytes histograms the pod-scale rewrite measures
+  against. A guarded site is observed by construction — ``guard`` itself
+  records op-kind histograms, a fact :func:`guard_records_telemetry`
+  proves by parsing ``resilience/retry.py`` — and a direct site counts
+  only under an explicit ``telemetry.scope`` / ``@telemetry.timed``.
 
 Rank-dependence is a small intra-function taint analysis: parameters
 and locals named like a rank (``rank``, ``process_id``, …), values of
@@ -53,6 +60,7 @@ from .jaxpr_audit import AuditResult
 C_SITES = "analysis::collective_sites"
 C_DIVERGENT = "analysis::collective_divergent"
 C_UNGUARDED = "analysis::collective_unguarded"
+C_UNOBSERVED = "analysis::collective_unobserved"
 
 # host-side DCN collectives (jax.experimental.multihost_utils): matched
 # by final attribute so both the dotted module form and a bare import
@@ -86,6 +94,7 @@ class CollectiveSite:
     name: str = ""             # guard label when a constant string
     payload: str = ""          # source snippet of the payload arg
     guarded: bool = False      # wrapped by resilience_retry.guard
+    observed: bool = False     # records telemetry (span or histogram)
     conditions: Tuple[str, ...] = ()   # enclosing rank-dependent tests
     node: Optional[ast.AST] = field(default=None, repr=False, compare=False)
 
@@ -93,6 +102,7 @@ class CollectiveSite:
         return {"kind": self.kind, "path": self.path, "line": self.line,
                 "func": self.func, "name": self.name,
                 "payload": self.payload, "guarded": self.guarded,
+                "observed": self.observed,
                 "rank_dependent": bool(self.conditions),
                 "conditions": list(self.conditions)}
 
@@ -171,6 +181,30 @@ class _ModuleAudit:
                     return True
             if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 return False
+            cur = self.ctx.parent.get(cur)
+        return False
+
+    def _inside_telemetry(self, node: ast.AST) -> bool:
+        """True when `node` executes under an explicit telemetry record:
+        a ``with telemetry.scope(...)`` block, or an enclosing function
+        decorated ``@telemetry.timed(...)``. (A histogram ``observe``
+        call NEXT TO a site proves nothing about the site itself, so
+        only enclosing-scope forms count.)"""
+        cur = self.ctx.parent.get(node)
+        while cur is not None:
+            if isinstance(cur, ast.With):
+                for item in cur.items:
+                    if isinstance(item.context_expr, ast.Call):
+                        t = self.ctx.call_target(item.context_expr)
+                        if t is not None \
+                                and t.split(".")[-1] == "scope":
+                            return True
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in cur.decorator_list:
+                    d = dec.func if isinstance(dec, ast.Call) else dec
+                    t = self.ctx.dotted(d)
+                    if t is not None and t.split(".")[-1] == "timed":
+                        return True
             cur = self.ctx.parent.get(cur)
         return False
 
@@ -262,10 +296,18 @@ class _ModuleAudit:
                         payload = _snippet(self.ctx.source, node.args[1])
                 else:
                     payload = _snippet(self.ctx.source, first)
+            # observation: the guard records op-kind latency+bytes
+            # histograms itself (guard_records_telemetry proves it
+            # statically), so every guarded site is observed by
+            # construction; a direct call must sit under an explicit
+            # telemetry span/timed decorator to count
+            observed = ((guarded
+                         and guard_records_telemetry(self.ctx.config))
+                        or self._inside_telemetry(node))
             self.sites.append(CollectiveSite(
                 kind=kind, path=self.ctx.relpath, line=node.lineno,
                 func=self._func_of(node), name=name, payload=payload,
-                guarded=guarded, node=node))
+                guarded=guarded, observed=observed, node=node))
 
     def _discover_wrappers(self) -> None:
         """A module function whose body issues collectives is itself a
@@ -409,6 +451,52 @@ class _ModuleAudit:
 
 
 # ---------------------------------------------------------------------------
+# guard instrumentation proof (collective_observed's base fact)
+# ---------------------------------------------------------------------------
+
+_GUARD_OBS_CACHE: Dict[str, bool] = {}
+
+
+def guard_records_telemetry(config: Optional[GraftlintConfig] = None
+                            ) -> bool:
+    """Statically verify that ``resilience_retry.guard`` itself records
+    telemetry (a histogram ``observe`` or span ``scope``) around the
+    collectives it runs — the fact that makes every guarded site an
+    OBSERVED site. Parsed once per root and cached; if guard ever loses
+    its instrumentation, every guarded collective in the repo flips to
+    unobserved and the ``collective_observed`` audit fails loudly."""
+    root = (config.root if config is not None else ".") or "."
+    cached = _GUARD_OBS_CACHE.get(root)
+    if cached is not None:
+        return cached
+    candidates = [
+        os.path.join(root, "lightgbm_tpu", "resilience", "retry.py"),
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "resilience", "retry.py"),
+    ]
+    ok = False
+    for path in candidates:
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                tree = ast.parse(f.read())
+        except (OSError, SyntaxError):
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node.name == "guard":
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Call):
+                        fn = sub.func
+                        leaf = (fn.attr if isinstance(fn, ast.Attribute)
+                                else getattr(fn, "id", ""))
+                        if leaf in ("observe", "scope"):
+                            ok = True
+        break
+    _GUARD_OBS_CACHE[root] = ok
+    return ok
+
+
+# ---------------------------------------------------------------------------
 # public API
 # ---------------------------------------------------------------------------
 
@@ -478,10 +566,14 @@ def run(config: Optional[GraftlintConfig] = None,
         else audit_repo(config)
     telemetry.count(C_SITES, len(sites), category="analysis")
     unguarded = [s for s in sites if not s.guarded]
+    unobserved = [s for s in sites if not s.observed]
     if findings:
         telemetry.count(C_DIVERGENT, len(findings), category="analysis")
     if unguarded:
         telemetry.count(C_UNGUARDED, len(unguarded), category="analysis")
+    if unobserved:
+        telemetry.count(C_UNOBSERVED, len(unobserved),
+                        category="analysis")
     order = AuditResult(
         name="collective_order",
         ok=not findings,
@@ -496,4 +588,16 @@ def run(config: Optional[GraftlintConfig] = None,
         if not unguarded else "; ".join(
             "%s:%d unguarded %s" % (s.path, s.line, s.kind)
             for s in unguarded[:3]))
-    return [order, guard]
+    # an UNOBSERVED collective is invisible to the latency/bytes
+    # histograms the ROADMAP item-2 rewrite measures against — every
+    # site must record telemetry (the instrumented guard, a span, or a
+    # timed decorator)
+    observed = AuditResult(
+        name="collective_observed",
+        ok=not unobserved,
+        detail=("%d DCN site(s) all record telemetry" % len(sites))
+        if not unobserved else "; ".join(
+            "%s:%d %s records no telemetry (no guard histogram, "
+            "span, or timed scope)" % (s.path, s.line, s.kind)
+            for s in unobserved[:3]))
+    return [order, guard, observed]

@@ -458,6 +458,11 @@ def train(params: Dict[str, Any], train_set: Dataset,
     from .resilience import retry as resilience_retry
     resilience_faults.configure_from_config(cfg0)
     resilience_retry.configure_from_config(cfg0)
+    # crash flight recorder: armed whenever this run can die in a way
+    # worth a postmortem (telemetry on / fault plan / multihost); dumps
+    # land next to the checkpoints (telemetry/flight.py)
+    from .telemetry import flight as telemetry_flight
+    telemetry_flight.configure_from_config(cfg0)
     if int(cfg0.num_machines) > 1:
         if evals_result is not None:
             # NOTE: no local Log import here — a function-local binding
@@ -474,6 +479,15 @@ def train(params: Dict[str, Any], train_set: Dataset,
                 categorical_feature=categorical_feature,
                 learning_rates=learning_rates,
                 keep_training_booster=keep_training_booster)
+        except LightGBMError as exc:
+            # this rank's postmortem; kill / collective-failure sites
+            # dump with a sharper reason and mark the exception so a
+            # generic re-dump doesn't overwrite it (an EARLIER recovered
+            # timeout's dump must not suppress this death's record)
+            if not getattr(exc, "_flight_dumped", False):
+                telemetry_flight.dump(
+                    "train_error:%s" % type(exc).__name__)
+            raise
         finally:
             if telemetry_events.enabled():
                 from .telemetry.export import maybe_export
@@ -589,20 +603,30 @@ def train(params: Dict[str, Any], train_set: Dataset,
 
     final_evals: List = []
     fault_plan = resilience_faults.active()
-    for round_no in range(first_round, last_round):
-        if fault_plan is not None:
-            # deterministic preemption: raises TrainingKilled before this
-            # iteration trains (checkpoints up to here are on disk)
-            fault_plan.check_kill(round_no)
-        registry.fire_pre(env_for(round_no, None))
-        booster.update(fobj=fobj)
-        final_evals = plan.evaluate(booster, feval) if plan.active else []
-        try:
-            registry.fire_post(env_for(round_no, final_evals))
-        except callback.EarlyStopException as stop:
-            booster.best_iteration = stop.best_iteration + 1
-            final_evals = stop.best_score
-            break
+    try:
+        for round_no in range(first_round, last_round):
+            if fault_plan is not None:
+                # deterministic preemption: raises TrainingKilled before
+                # this iteration trains (checkpoints up to here are on
+                # disk; check_kill writes its own flight dump)
+                fault_plan.check_kill(round_no)
+            registry.fire_pre(env_for(round_no, None))
+            booster.update(fobj=fobj)
+            final_evals = plan.evaluate(booster, feval) if plan.active \
+                else []
+            try:
+                registry.fire_post(env_for(round_no, final_evals))
+            except callback.EarlyStopException as stop:
+                booster.best_iteration = stop.best_iteration + 1
+                final_evals = stop.best_score
+                break
+    except LightGBMError as exc:
+        # a failed run leaves its flight record even when the failure
+        # site didn't dump one itself; sites that did (kill, collective
+        # exhaustion) mark the exception so their sharper reason wins
+        if not getattr(exc, "_flight_dumped", False):
+            telemetry_flight.dump("train_error:%s" % type(exc).__name__)
+        raise
 
     booster.best_score = collections.defaultdict(collections.OrderedDict)
     for entry in final_evals:

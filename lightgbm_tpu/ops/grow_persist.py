@@ -1825,7 +1825,14 @@ def make_scan_driver(gr, gc, k: int, grad_fn, grad_mode: str = "payload",
         return payK, stacked, jnp.sum(stats_k, axis=0)
 
     if wrap_jit:
+        # histogram= streams each program invocation's host wall into
+        # the log-bucketed registry: one sample per compiled k-iteration
+        # program (the level phase fuses every tree level into it), so
+        # the launch-cost DISTRIBUTION across the run is queryable —
+        # p99 outliers here are recompiles/host stalls the scalar
+        # total would average away
         return telemetry.launch_wrapper(
             jax.jit(run, donate_argnums=(0,)),
-            "ops::persist_scan(launch)", category="ops", k=k)
+            "ops::persist_scan(launch)", category="ops",
+            histogram="ops::persist_program_wall", k=k)
     return run

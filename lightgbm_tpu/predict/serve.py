@@ -18,6 +18,8 @@ fans out across chips; input buffers are donated on accelerator backends
 from __future__ import annotations
 
 
+import time
+
 import numpy as np
 
 import jax
@@ -25,11 +27,15 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..telemetry import events as telemetry
+from ..telemetry import histo as telemetry_histo
+from ..telemetry.histo import Histogram
 from .runtime import TPUPredictor, _next_pow2
 
 C_SERVE_COMPILE = "predict::serve_compile"
 C_SERVE_HIT = "predict::serve_bucket_hit"
 C_SERVE_SHARDED = "predict::serve_sharded_batches"
+H_E2E = "predict::e2e_latency"
+H_QUEUE = "predict::queue_wait"
 
 ROWS_AXIS = "rows"
 
@@ -62,6 +68,13 @@ class BatchServer:
         self._compiled_buckets = set()
         self._bucket_hits = 0
         self._sharded_batches = 0
+        # SLO histograms, same instance-local rule: per-request
+        # end-to-end latency and queue wait (arrival -> service start,
+        # when the caller supplies arrival_t — the open-loop Poisson
+        # bench does). Mirrored into the global registry when telemetry
+        # is on so they ride the metrics/prom exports.
+        self._h_e2e = Histogram(H_E2E, unit="s", category="predict")
+        self._h_queue = Histogram(H_QUEUE, unit="s", category="predict")
 
     # ------------------------------------------------------------------
     def bucket_rows(self, n: int) -> int:
@@ -100,26 +113,54 @@ class BatchServer:
         return self.predictor.predict_padded(self._place(Xp), n,
                                              raw_score=raw_score)
 
-    def predict(self, X, raw_score: bool = False) -> np.ndarray:
+    def predict(self, X, raw_score: bool = False,
+                arrival_t: float = None) -> np.ndarray:
         """Serve one request of any size; rows beyond max_batch stream in
-        max_batch chunks."""
+        max_batch chunks.
+
+        ``arrival_t`` (a ``time.perf_counter()`` timestamp) marks when
+        the request entered the system: the gap to service start is the
+        request's QUEUE WAIT, and end-to-end latency is measured from
+        arrival rather than from service start — the numbers an SLO is
+        written against. Omitted, queue wait records as 0 and e2e is
+        pure service time."""
+        t_start = time.perf_counter()
+        q_wait = max(t_start - arrival_t, 0.0) \
+            if arrival_t is not None else 0.0
         X = np.ascontiguousarray(np.asarray(X, dtype=np.float64))
         if X.ndim == 1:
             X = X.reshape(1, -1)
         if X.shape[0] <= self.max_batch:
-            return self._serve_chunk(X, raw_score)
-        outs = [self._serve_chunk(X[i:i + self.max_batch], raw_score)
-                for i in range(0, X.shape[0], self.max_batch)]
-        return np.concatenate(outs, axis=0)
+            out = self._serve_chunk(X, raw_score)
+        else:
+            outs = [self._serve_chunk(X[i:i + self.max_batch], raw_score)
+                    for i in range(0, X.shape[0], self.max_batch)]
+            out = np.concatenate(outs, axis=0)
+        e2e = time.perf_counter() - (arrival_t if arrival_t is not None
+                                     else t_start)
+        self._h_queue.record(q_wait)
+        self._h_e2e.record(e2e)
+        telemetry_histo.observe(H_QUEUE, q_wait, unit="s",
+                                category="predict")
+        telemetry_histo.observe(H_E2E, e2e, unit="s", category="predict")
+        return out
 
     # ------------------------------------------------------------------
     def stats(self) -> dict:
         """Per-server serving stats (telemetry-independent; the same
-        figures also land on the telemetry counters when enabled)."""
+        figures also land on the telemetry counters/histograms when
+        enabled). `latency`/`queue_wait` carry the full histogram dicts;
+        the p50/p99 shortcuts are what the bench SLO keys read."""
         return {
             "buckets_compiled": sorted(self._compiled_buckets),
             "compiles": len(self._compiled_buckets),
             "compile_bound": self.max_compiles(),
             "bucket_hits": self._bucket_hits,
             "sharded_batches": self._sharded_batches,
+            "requests": self._h_e2e.count,
+            "latency_p50": self._h_e2e.percentile(0.50),
+            "latency_p99": self._h_e2e.percentile(0.99),
+            "queue_wait_p99": self._h_queue.percentile(0.99),
+            "latency": self._h_e2e.to_dict(with_buckets=False),
+            "queue_wait": self._h_queue.to_dict(with_buckets=False),
         }

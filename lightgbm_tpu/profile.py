@@ -7,6 +7,15 @@ dev script is now a thin wrapper over this entry point.
 
 Usage: python -m lightgbm_tpu.profile [--shape NAME] [rows] [iters]
                                       [key=value ...]
+       python -m lightgbm_tpu.profile --merge DIR [--out PATH] [--json]
+
+``--merge DIR`` does no training: it merges the rank-suffixed Chrome
+traces a multihost run left in DIR (``telemetry_out=`` writes
+``out.rN.json`` per rank) into ONE Perfetto-loadable
+``merged.trace.json`` with rank-tagged pids, aligning the per-rank host
+clocks via the recorded collective barrier spans
+(:mod:`lightgbm_tpu.telemetry.merge`). ``--json`` prints the merge
+summary as JSON instead of text.
 
 ``--shape`` (or ``shape=NAME``) picks the benchmark workload the bench
 suite also trains: ``higgs`` (default), ``expo`` (EFB-bundled one-hot —
@@ -60,6 +69,7 @@ def _make_shape(shape: str, rows: int):
 
 
 def _phase_stats(events):
+    from lightgbm_tpu.telemetry import histo
     return {
         "categories": {k: round(v, 3)
                        for k, v in events.category_totals().items()},
@@ -68,7 +78,49 @@ def _phase_stats(events):
                    for name, (sec, n, cat)
                    in events.snapshot_full().items()},
         "counters": {k: v for k, v in events.counts_snapshot().items()},
+        "histograms": {k: h.to_dict(with_buckets=False)
+                       for k, h in histo.histograms_snapshot().items()},
+        # silent truncation is a lie in a snapshot: say what was dropped
+        "dropped_events": events.dropped_events(),
+        "histo_saturation": histo.saturation_total(),
     }
+
+
+def _main_merge(argv) -> int:
+    """--merge DIR [--out PATH] [--json]: no jax import, no training."""
+    from lightgbm_tpu.telemetry import merge as trace_merge
+    i = argv.index("--merge")
+    if i + 1 >= len(argv):
+        print("--merge needs a directory of rank traces", file=sys.stderr)
+        return 2
+    directory = argv[i + 1]
+    out = None
+    if "--out" in argv:
+        j = argv.index("--out")
+        if j + 1 >= len(argv):
+            print("--out needs a path", file=sys.stderr)
+            return 2
+        out = argv[j + 1]
+    try:
+        summary = trace_merge.merge_dir(directory, out)
+    except (trace_merge.MergeError, OSError) as exc:
+        print("merge failed: %s" % exc, file=sys.stderr)
+        return 2
+    if "--json" in argv:
+        print(json.dumps(summary, sort_keys=True))
+    else:
+        print("merged %d rank(s) -> %s (%d events)"
+              % (len(summary["ranks"]), summary["out"],
+                 summary["events"]))
+        for r in summary["ranks"]:
+            print("  rank %d: clock offset %+.1fus, %d barrier span(s)"
+                  % (r, summary["clock_offsets_us"][str(r)],
+                     summary["barrier_spans"][r]))
+        if summary["dropped_events"]:
+            print("  !! %d trace event(s) were dropped at record time "
+                  "across ranks (timelines truncated)"
+                  % summary["dropped_events"])
+    return 0
 
 
 def main(argv=None) -> int:
@@ -76,6 +128,8 @@ def main(argv=None) -> int:
     if any(a in ("-h", "--help") for a in argv):
         print(__doc__)
         return 0
+    if "--merge" in argv:
+        return _main_merge(argv)
     shape = "higgs"
     if "--shape" in argv:
         i = argv.index("--shape")

@@ -132,9 +132,20 @@ class FaultPlan:
         kp = self.kill_point(rank)
         if kp is not None and iteration >= kp:
             telemetry.count("faults::injected", 1, category="faults")
-            raise TrainingKilled(
+            # the injected death leaves the same postmortem a real
+            # preemption would: flight dump next to the checkpoints
+            from ..telemetry import flight as telemetry_flight
+            telemetry_flight.note("kill", iteration=iteration, rank=rank,
+                                  plan=self.text)
+            telemetry_flight.dump("injected_kill@iter=%d" % iteration,
+                                  rank=rank)
+            err = TrainingKilled(
                 "fault injection: worker (rank %d) killed before iteration "
                 "%d (tpu_fault_plan=%s)" % (rank, iteration, self.text))
+            # tells engine.train's generic LightGBMError handler that
+            # THIS failure already wrote its (sharper-reasoned) dump
+            err._flight_dumped = True
+            raise err
 
     # -- collectives ---------------------------------------------------
     def collective_should_drop(self, round_idx: int) -> bool:

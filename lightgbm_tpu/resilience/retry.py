@@ -30,10 +30,13 @@ N-th guarded call deterministically.
 from __future__ import annotations
 
 import threading
+import time
 import zlib
 from typing import Optional
 
 from ..telemetry import events as telemetry
+from ..telemetry import flight as telemetry_flight
+from ..telemetry import histo as telemetry_histo
 from ..utils.log import LightGBMError, Log
 from . import faults
 
@@ -130,14 +133,41 @@ _RETRYABLE = (OSError, ConnectionError, TimeoutError, RuntimeError,
               CollectiveTimeout)
 
 
+def _payload_bytes(args, kwargs) -> int:
+    """Best-effort payload size of a guarded call: the arrays/buffers the
+    collective ships (np.ndarray.nbytes, bytes length). Guard labels name
+    the op; the histograms want the bytes next to the latency."""
+    total = 0
+    for a in list(args) + list(kwargs.values()):
+        nb = getattr(a, "nbytes", None)
+        if nb is not None:
+            total += int(nb)
+        elif isinstance(a, (bytes, bytearray)):
+            total += len(a)
+    return total
+
+
 def guard(name: str, fn, *args, **kwargs):
     """Run one host-side collective under the active retry policy.
 
     Raises LightGBMError — never hangs — after the bounded attempts are
     exhausted; LightGBMError from `fn` itself propagates unretried.
+
+    Observability contract (the collective_observed audit pins this):
+    every guarded call records op-kind-tagged latency + payload-bytes
+    into the streaming histograms (``collective::<kind>::latency`` /
+    ``::bytes``, telemetry/histo.py) and a flight-recorder event, so the
+    DCN distributions the ROADMAP item-2 quantization/voting rewrite
+    needs are queryable per collective kind — and a dying rank's last
+    collectives are in its flight dump.
     """
     pol = _POLICY
     round_idx = _next_round()
+    # guard labels are "<kind>:<site>" (allgather:row_counts); the kind
+    # keys the histograms so every DCN op of a kind shares one
+    # distribution regardless of call site
+    kind = name.split(":", 1)[0] or "collective"
+    nbytes = _payload_bytes(args, kwargs)
     plan = faults.active()
     last_err: Optional[BaseException] = None
     for attempt in range(pol.retries + 1):
@@ -146,17 +176,50 @@ def guard(name: str, fn, *args, **kwargs):
             last_err = faults.FaultInjected(
                 "injected drop_collective at round %d" % round_idx)
         else:
+            t0 = time.perf_counter()
             try:
-                return _call_with_deadline(fn, args, kwargs, pol.timeout_s,
-                                           name)
+                result = _call_with_deadline(fn, args, kwargs,
+                                             pol.timeout_s, name)
             except LightGBMError:
                 raise
             except CollectiveTimeout as exc:
                 telemetry.count("collective::timeout", 1,
                                 category="collective")
+                # failed attempts COUNT toward the latency distribution
+                # (deadline-clamped here, elapsed-to-error below): a run
+                # where 10% of allreduces hit the deadline and recover on
+                # retry must not report a milliseconds p99
+                telemetry_histo.observe(
+                    "collective::%s::latency" % kind,
+                    time.perf_counter() - t0,
+                    unit="s", category="collective")
+                telemetry_flight.note("collective_timeout", name=name,
+                                      op=kind, round=round_idx,
+                                      attempt=attempt,
+                                      deadline_s=pol.timeout_s)
+                # the postmortem seam: a rank wedged on a gone peer dumps
+                # its recent history BEFORE the retry/backoff dance, so
+                # even a kill -9 during the backoff leaves a record
+                telemetry_flight.dump("collective_timeout:%s" % name)
                 last_err = exc
             except _RETRYABLE as exc:
+                telemetry_histo.observe(
+                    "collective::%s::latency" % kind,
+                    time.perf_counter() - t0,
+                    unit="s", category="collective")
                 last_err = exc
+            else:
+                dt = time.perf_counter() - t0
+                telemetry_histo.observe(
+                    "collective::%s::latency" % kind, dt,
+                    unit="s", category="collective")
+                telemetry_histo.observe(
+                    "collective::%s::bytes" % kind, float(nbytes),
+                    unit="bytes", category="collective")
+                telemetry_flight.note("collective", name=name, op=kind,
+                                      round=round_idx, dur=dt,
+                                      bytes=nbytes)
+                return result
         if attempt < pol.retries:
             telemetry.count("collective::retry", 1, category="collective")
             delay = _backoff_delay(name, attempt, pol.backoff_s)
@@ -164,9 +227,13 @@ def guard(name: str, fn, *args, **kwargs):
                         "%.2fs" % (name, last_err, attempt + 1,
                                    pol.retries, delay))
             if delay > 0:
-                import time
                 time.sleep(delay)
-    raise LightGBMError(
+    telemetry_flight.note("collective_failed", name=name, op=kind,
+                          round=round_idx, error=repr(last_err))
+    telemetry_flight.dump("collective_failed:%s" % name)
+    err = LightGBMError(
         "collective '%s' failed after %d attempt(s): %r (a peer is likely "
         "gone; restart the job to resume from the last checkpoint)"
         % (name, pol.retries + 1, last_err))
+    err._flight_dumped = True       # this failure's dump is already best
+    raise err

@@ -17,7 +17,17 @@ real observability layer:
     top-level ``prof_bin.py`` / ``prof_split.py`` wrappers;
   * :mod:`devices` — static TPU device profiles (per-core VMEM, per-chip
     HBM budgets) consumed by the ``analysis/resource_audit`` budget gate
-    and the kernel ``vmem_limit_bytes`` sizing comments.
+    and the kernel ``vmem_limit_bytes`` sizing comments;
+  * :mod:`histo`  — log-bucketed fixed-memory mergeable streaming
+    histograms (p50/p95/p99/p99.9): per-collective DCN latency+bytes,
+    persist program wall, serving latency/queue-wait;
+  * :mod:`merge`  — cross-rank Chrome-trace merge with barrier-span
+    clock alignment (``python -m lightgbm_tpu.profile --merge DIR``);
+  * :mod:`flight` — crash flight recorder: bounded ring of recent
+    telemetry, dumped atomically on LightGBMError / collective timeout /
+    injected kill;
+  * :mod:`promexport` — Prometheus text-exposition snapshots
+    (``telemetry_out=<path>.prom`` enables a periodic atomic flush).
 
 Enablement: ``tpu_telemetry=off|timers|trace`` config param (plus
 ``telemetry_out=<path>`` for the trace/metrics files), the legacy
@@ -25,20 +35,23 @@ Enablement: ``tpu_telemetry=off|timers|trace`` config param (plus
 ``LIGHTGBM_TPU_TELEMETRY=timers|trace``. The default is OFF and every
 instrumentation point is a no-op behind one integer check.
 """
-from . import events
+from . import events, flight, histo
 from .events import (OFF, TIMERS, TRACE, add, configure, configure_from_config,
                      count, counts_snapshot, device_wait, disable, enable,
                      enabled, events_snapshot, iteration_records, mode, reset,
                      scope, snapshot, timed, tracing)
 from .export import (format_report, maybe_export, print_report,
-                     write_chrome_trace, write_metrics_jsonl)
+                     rank_suffixed, write_chrome_trace, write_metrics_jsonl)
+from .histo import Histogram, histograms_snapshot, observe
 from .monitor import TrainingMonitor
 
 __all__ = [
-    "OFF", "TIMERS", "TRACE", "TrainingMonitor", "add", "configure",
-    "configure_from_config", "count", "counts_snapshot", "device_wait",
-    "disable", "enable", "enabled", "events", "events_snapshot",
-    "format_report", "iteration_records", "maybe_export", "mode",
-    "print_report", "reset", "scope", "snapshot", "timed", "tracing",
-    "write_chrome_trace", "write_metrics_jsonl",
+    "OFF", "TIMERS", "TRACE", "Histogram", "TrainingMonitor", "add",
+    "configure", "configure_from_config", "count", "counts_snapshot",
+    "device_wait", "disable", "enable", "enabled", "events",
+    "events_snapshot", "flight", "format_report", "histo",
+    "histograms_snapshot", "iteration_records", "maybe_export", "mode",
+    "observe", "print_report", "rank_suffixed", "reset", "scope",
+    "snapshot", "timed", "tracing", "write_chrome_trace",
+    "write_metrics_jsonl",
 ]

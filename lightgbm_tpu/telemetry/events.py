@@ -57,6 +57,11 @@ _tls = threading.local()
 _out_path: Optional[str] = None
 _exported = False
 _compile_hook_on = False
+# flight-recorder sinks (telemetry/flight.py): None when disarmed, so the
+# hot path pays one is-None check; armed, every span exit / counter bump
+# also lands in the crash ring buffer regardless of TRACE vs TIMERS mode
+_flight_span: Optional[Callable] = None
+_flight_count: Optional[Callable] = None
 
 # perf_counter offset -> unix epoch, so trace timestamps are absolute
 _EPOCH = time.time() - time.perf_counter()
@@ -153,6 +158,14 @@ def set_out_path(path: Optional[str]) -> None:
     _out_path = path
 
 
+def set_flight_sinks(span_sink: Optional[Callable],
+                     count_sink: Optional[Callable]) -> None:
+    """Install/remove the flight-recorder sinks (flight.arm/disarm)."""
+    global _flight_span, _flight_count
+    _flight_span = span_sink
+    _flight_count = count_sink
+
+
 def reset() -> None:
     global _dropped, _exported
     with _lock:
@@ -166,6 +179,11 @@ def reset() -> None:
         del _iter_records[:]
         _dropped = 0
         _exported = False
+    # the histogram registry and the flight ring are part of the same
+    # run-scoped state (bench phases reset between workloads)
+    from . import flight, histo
+    histo.reset()
+    flight.reset()
 
 
 # ---------------------------------------------------------------------------
@@ -190,6 +208,8 @@ def count(name: str, inc: float = 1.0, category: str = "count") -> None:
     with _lock:
         _counts[name] += inc
         _count_cat.setdefault(name, category)
+    if _flight_count is not None:
+        _flight_count(name, inc, category)
 
 
 def _stack() -> list:
@@ -252,6 +272,8 @@ def scope(name: str, category: str = "misc", sync_value=None, **tags):
             _cat.setdefault(name, category)
         if _mode == TRACE:
             _record_event(name, category, t0, t1, parent, tags or None)
+        if _flight_span is not None:
+            _flight_span(name, category, t0 + _EPOCH, elapsed)
 
 
 def timed(name: str, category: str = "misc") -> Callable:
@@ -276,7 +298,8 @@ def _is_tracer(x) -> bool:
 
 
 def launch_wrapper(fn, name: str, category: str = "ops",
-                   tracer_arg: Optional[int] = None, **tags) -> Callable:
+                   tracer_arg: Optional[int] = None,
+                   histogram: Optional[str] = None, **tags) -> Callable:
     """Wrap a jitted callable in a launch-cost span (OFF: one int compare).
 
     Dispatch is async, so the span measures LAUNCH cost; device time shows
@@ -284,16 +307,30 @@ def launch_wrapper(fn, name: str, category: str = "ops",
     names a positional argument, the span name gains a ``(trace)`` /
     ``(launch)`` suffix depending on whether that argument is a jax Tracer
     — i.e. the call is being traced into an outer jit (the fused
-    K-iteration scans), costing trace-construction once per compile."""
+    K-iteration scans), costing trace-construction once per compile.
+
+    ``histogram`` additionally streams each (non-traced) invocation's
+    wall into the named log-bucketed histogram (telemetry/histo.py), so
+    per-program launch-time DISTRIBUTIONS are queryable, not just
+    totals — the persist level-program driver records here."""
     @functools.wraps(fn)
     def wrapper(*a, **k):
         if _mode == OFF:
             return fn(*a, **k)
         n = name
+        traced = False
         if tracer_arg is not None:
-            n += "(trace)" if _is_tracer(a[tracer_arg]) else "(launch)"
-        with scope(n, category=category, **tags):
-            return fn(*a, **k)
+            traced = _is_tracer(a[tracer_arg])
+            n += "(trace)" if traced else "(launch)"
+        t0 = time.perf_counter()
+        try:
+            with scope(n, category=category, **tags):
+                return fn(*a, **k)
+        finally:
+            if histogram is not None and not traced:
+                from . import histo
+                histo.observe(histogram, time.perf_counter() - t0,
+                              unit="s", category=category)
     return wrapper
 
 

@@ -1,0 +1,259 @@
+"""Streaming log-bucketed histograms: fixed memory, mergeable, quantiles.
+
+The scalar counters in :mod:`events` answer "how much total / how many
+times" but not a single percentile question — and the ROADMAP's next two
+perf items are *gated* on distribution answers (per-collective DCN
+latency under quantization/voting, serving p50/p99 under an open-loop
+load). This module is the backing store for those answers:
+
+  * **log-bucketed**: bucket ``i`` covers ``[lo * growth^i, lo *
+    growth^(i+1))``, so a fixed array of a few hundred int counts spans
+    nanoseconds to gigaseconds (or bytes to terabytes) with a bounded
+    RELATIVE quantile error of ``growth - 1`` (default 5%);
+  * **fixed memory**: recording is O(1) and allocation-free after
+    construction; a histogram never grows, no matter how many billions
+    of samples stream through — values past the range land in explicit
+    ``underflow`` / ``overflow`` saturation counters instead of bending
+    the layout (surfaced by the text report so silent truncation is
+    visible);
+  * **mergeable**: two histograms with the same layout merge by integer
+    bucket addition — exactly associative and commutative, so per-rank /
+    per-phase histograms combine in any order (the cross-rank trace
+    merge and multi-file BENCH tooling rely on this);
+  * **quantiles**: ``percentile(q)`` walks the cumulative counts and
+    returns the geometric midpoint of the target bucket, clamped to the
+    observed ``[min, max]`` — the clamp makes the extreme quantiles of
+    small samples exact.
+
+A process-global registry mirrors the :mod:`events` counter tables:
+``observe(name, value)`` is a no-op behind one int compare when
+telemetry is OFF, and ``histograms_snapshot()`` rides the metrics JSONL
+/ Prometheus exports. Thread safety: one lock guards the registry and
+all recording (record is a few adds — contention is negligible next to
+the collectives/requests being measured).
+"""
+from __future__ import annotations
+
+import math
+import threading
+from typing import Dict, List, Optional, Sequence
+
+DEFAULT_LO = 1e-9
+DEFAULT_HI = 1e9
+DEFAULT_GROWTH = 1.05
+QUANTILES = (0.5, 0.95, 0.99, 0.999)
+
+
+class Histogram:
+    """One log-bucketed streaming histogram (see the module doc)."""
+
+    __slots__ = ("name", "unit", "category", "lo", "hi", "growth",
+                 "_log_growth", "num_buckets", "buckets", "count", "total",
+                 "underflow", "overflow", "vmin", "vmax")
+
+    def __init__(self, name: str = "", lo: float = DEFAULT_LO,
+                 hi: float = DEFAULT_HI, growth: float = DEFAULT_GROWTH,
+                 unit: str = "", category: str = "histo"):
+        if not (0.0 < lo < hi):
+            raise ValueError("need 0 < lo < hi (got lo=%r hi=%r)" % (lo, hi))
+        if growth <= 1.0:
+            raise ValueError("growth must be > 1 (got %r)" % growth)
+        self.name = name
+        self.unit = unit
+        self.category = category
+        self.lo = float(lo)
+        self.hi = float(hi)
+        self.growth = float(growth)
+        self._log_growth = math.log(self.growth)
+        self.num_buckets = int(math.ceil(
+            math.log(self.hi / self.lo) / self._log_growth))
+        self.buckets: List[int] = [0] * self.num_buckets
+        self.count = 0
+        self.total = 0.0
+        self.underflow = 0           # v < 0: not log-representable
+        self.overflow = 0            # v >= hi: the layout saturated
+        self.vmin = math.inf
+        self.vmax = -math.inf
+
+    # -- recording -----------------------------------------------------
+    def bucket_index(self, value: float) -> int:
+        """Bucket holding `value` (callers guarantee lo <= value < hi;
+        sub-lo positives clamp into bucket 0 — lo is the resolution
+        floor, not a validity bound)."""
+        if value < self.lo:
+            return 0
+        i = int(math.log(value / self.lo) / self._log_growth)
+        return min(i, self.num_buckets - 1)
+
+    def record(self, value: float) -> None:
+        value = float(value)
+        self.count += 1
+        self.total += value
+        if value < self.vmin:
+            self.vmin = value
+        if value > self.vmax:
+            self.vmax = value
+        if value < 0.0:
+            self.underflow += 1
+        elif value >= self.hi:
+            self.overflow += 1
+        else:
+            # 0 <= v < lo (incl. exact 0: a zero queue wait is a real
+            # observation) clamps into bucket 0 — lo is the resolution
+            # floor, not a validity bound
+            self.buckets[self.bucket_index(value)] += 1
+
+    # -- merging -------------------------------------------------------
+    def same_layout(self, other: "Histogram") -> bool:
+        return (self.lo == other.lo and self.hi == other.hi
+                and self.growth == other.growth
+                and self.num_buckets == other.num_buckets)
+
+    def merge(self, other: "Histogram") -> "Histogram":
+        """In-place, exactly associative/commutative bucket addition."""
+        if not self.same_layout(other):
+            raise ValueError(
+                "cannot merge histograms with different layouts: "
+                "%r vs %r" % ((self.lo, self.hi, self.growth),
+                              (other.lo, other.hi, other.growth)))
+        for i, c in enumerate(other.buckets):
+            if c:
+                self.buckets[i] += c
+        self.count += other.count
+        self.total += other.total
+        self.underflow += other.underflow
+        self.overflow += other.overflow
+        self.vmin = min(self.vmin, other.vmin)
+        self.vmax = max(self.vmax, other.vmax)
+        return self
+
+    def copy(self) -> "Histogram":
+        h = Histogram(self.name, self.lo, self.hi, self.growth,
+                      self.unit, self.category)
+        h.buckets = list(self.buckets)
+        h.count, h.total = self.count, self.total
+        h.underflow, h.overflow = self.underflow, self.overflow
+        h.vmin, h.vmax = self.vmin, self.vmax
+        return h
+
+    # -- quantiles -----------------------------------------------------
+    def percentile(self, q: float) -> float:
+        """q in [0, 1]. Relative error <= growth - 1 inside the layout
+        range; exact at the observed extremes (the min/max clamp). NaN
+        when empty."""
+        if self.count == 0:
+            return math.nan
+        if q <= 0.0:
+            return self.vmin
+        if q >= 1.0:
+            return self.vmax
+        target = q * self.count
+        # rank walk over [underflow][buckets...][overflow]
+        seen = self.underflow
+        if target <= seen:
+            return self.vmin
+        for i, c in enumerate(self.buckets):
+            if not c:
+                continue
+            seen += c
+            if target <= seen:
+                lo_edge = self.lo * self.growth ** i
+                hi_edge = lo_edge * self.growth
+                est = math.sqrt(lo_edge * hi_edge)   # geometric midpoint
+                return min(max(est, self.vmin), self.vmax)
+        return self.vmax
+
+    def quantiles(self, qs: Sequence[float] = QUANTILES) -> Dict[str, float]:
+        return {("p%g" % (q * 100)).replace(".", "_"): self.percentile(q)
+                for q in qs}
+
+    @property
+    def mean(self) -> float:
+        return self.total / self.count if self.count else math.nan
+
+    @property
+    def saturated(self) -> int:
+        """Samples the bucket layout could not place (under + overflow) —
+        nonzero means the quantiles near the affected tail are clamped
+        estimates, and the report says so."""
+        return self.underflow + self.overflow
+
+    # -- (de)serialization ---------------------------------------------
+    def to_dict(self, with_buckets: bool = True) -> dict:
+        d = {"name": self.name, "unit": self.unit,
+             "category": self.category, "lo": self.lo, "hi": self.hi,
+             "growth": self.growth, "count": self.count,
+             "total": self.total, "underflow": self.underflow,
+             "overflow": self.overflow,
+             "min": None if self.count == 0 else self.vmin,
+             "max": None if self.count == 0 else self.vmax}
+        d.update({k: (None if math.isnan(v) else v)
+                  for k, v in self.quantiles().items()})
+        if with_buckets:
+            # sparse {index: count}: merge-across-files friendly and
+            # small for the latency shapes we record
+            d["buckets"] = {str(i): c for i, c in enumerate(self.buckets)
+                            if c}
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Histogram":
+        h = cls(d.get("name", ""), d["lo"], d["hi"], d["growth"],
+                d.get("unit", ""), d.get("category", "histo"))
+        for i, c in (d.get("buckets") or {}).items():
+            h.buckets[int(i)] = int(c)
+        h.count = int(d["count"])
+        h.total = float(d["total"])
+        h.underflow = int(d.get("underflow", 0))
+        h.overflow = int(d.get("overflow", 0))
+        h.vmin = math.inf if d.get("min") is None else float(d["min"])
+        h.vmax = -math.inf if d.get("max") is None else float(d["max"])
+        return h
+
+
+# ---------------------------------------------------------------------------
+# process-global registry (the events-counter pattern)
+# ---------------------------------------------------------------------------
+
+_lock = threading.Lock()
+_histos: Dict[str, Histogram] = {}
+
+
+def observe(name: str, value: float, unit: str = "s",
+            category: str = "histo") -> None:
+    """Record `value` into the named global histogram; no-op when
+    telemetry is OFF (one int compare, like events.count)."""
+    from . import events
+    if events.mode() == events.OFF:
+        return
+    with _lock:
+        h = _histos.get(name)
+        if h is None:
+            h = _histos[name] = Histogram(name, unit=unit,
+                                          category=category)
+        h.record(value)
+
+
+def get(name: str) -> Optional[Histogram]:
+    with _lock:
+        h = _histos.get(name)
+        return h.copy() if h is not None else None
+
+
+def histograms_snapshot() -> Dict[str, Histogram]:
+    """{name: copy} — safe to read/merge without holding the lock."""
+    with _lock:
+        return {k: h.copy() for k, h in _histos.items()}
+
+
+def saturation_total() -> int:
+    """Total samples every registered histogram failed to place — the
+    silent-truncation signal the report and --json surface next to
+    dropped_events()."""
+    with _lock:
+        return sum(h.saturated for h in _histos.values())
+
+
+def reset() -> None:
+    with _lock:
+        _histos.clear()

@@ -1,0 +1,216 @@
+"""Cross-rank Chrome-trace merge: per-rank timelines -> one Perfetto file.
+
+A multihost run leaves one rank-suffixed trace per process
+(``export.rank_suffixed``: ``out.r0.json``, ``out.r1.json``, ...), each
+timestamped by its own host clock. Host clocks skew by milliseconds —
+enough to make cross-rank causality (who stalled the allreduce?)
+unreadable if the files are naively concatenated. This module merges
+them into ONE Chrome-trace/Perfetto JSON:
+
+  * **clock alignment** rides the recorded collective spans: a DCN
+    collective is a rendezvous, so its k-th occurrence of a given name
+    ENDS at (approximately) the same true instant on every rank — the
+    span-end skew between two ranks' matching collective spans IS their
+    clock offset (plus per-call exit jitter, suppressed by taking the
+    median over all matched spans). Rank 0's clock is the reference.
+  * **pid = rank**: each rank's events land in their own Perfetto
+    process lane, named via ``process_name`` metadata events, with the
+    rank's thread ids preserved inside the lane.
+  * **determinism**: input files are discovered in sorted rank order and
+    events are emitted in a total order (timestamp, rank, tid, name), so
+    merging the same inputs twice yields byte-identical output — the
+    merge is diffable CI material, not a best-effort viewer aid.
+
+CLI: ``python -m lightgbm_tpu.profile --merge DIR`` (or explicit file
+arguments) writes ``merged.trace.json`` into DIR.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Dict, List, Optional, Tuple
+
+# barrier-grade spans: category "collective" AND actually a rendezvous.
+# Launch spans ("...(launch)" / "...(trace)") are async dispatches — they
+# end at dispatch-return on each host, not at a cross-rank sync, so their
+# end-skew measures scheduling lag, not clock skew, and they must never
+# anchor the alignment (they are also the most frequent collective spans,
+# so they would dominate the median and shift whole timelines by bogus
+# offsets). The host DCN collectives (Allgather/AllreduceMean/...(DCN))
+# block until every rank arrives — those are the anchors.
+ALIGN_CATEGORIES = ("collective",)
+_NON_RENDEZVOUS_SUFFIXES = ("(launch)", "(trace)")
+
+_RANK_FILE_RE = re.compile(r"\.r(\d+)\.(?:trace\.)?json$")
+
+
+class MergeError(ValueError):
+    """Unusable inputs (no rank traces found, unreadable JSON, ...)."""
+
+
+def discover_rank_traces(directory: str) -> Dict[int, str]:
+    """{rank: path} of the rank-suffixed trace files under `directory`
+    (metrics/flight files are excluded). Validity is sniffed from the
+    file head only — a TRACE-mode rank file can be hundreds of MB, and
+    the full parse happens exactly once, in :func:`merge_paths`."""
+    groups: Dict[str, Dict[int, str]] = {}
+    for name in sorted(os.listdir(directory)):
+        m = _RANK_FILE_RE.search(name)
+        if not m:
+            continue
+        path = os.path.join(directory, name)
+        try:
+            with open(path, "r") as f:
+                head = f.read(4096)
+        except OSError:
+            continue
+        # chrome traces lead with the traceEvents key (json.dump of a
+        # dict writes keys in insertion order); flight dumps and other
+        # JSON neighbours don't carry it at all
+        if '"traceEvents"' not in head:
+            continue
+        rank = int(m.group(1))
+        # group by the basename with the rank suffix removed: merging
+        # rank 0 of one RUN with rank 1 of another would produce a
+        # plausible-looking trace whose barriers never match — refuse
+        # that loudly below instead of emitting garbage
+        base = name[:m.start()]
+        # prefer the plain trace when both x.r0.json and x.r0.trace.json
+        # exist (they are the same data; sorted order visits .json first)
+        groups.setdefault(base, {}).setdefault(rank, path)
+    if len(groups) > 1:
+        raise MergeError(
+            "rank traces from more than one run in the directory "
+            "(basenames: %s) — pass a directory holding one run's "
+            "traces, or merge explicit paths" % ", ".join(sorted(groups)))
+    return next(iter(groups.values())) if groups else {}
+
+
+def _load(path: str) -> dict:
+    with open(path, "r") as f:
+        return json.load(f)
+
+
+def _median(vals: List[float]) -> float:
+    s = sorted(vals)
+    n = len(s)
+    if n == 0:
+        return 0.0
+    return s[n // 2] if n % 2 else 0.5 * (s[n // 2 - 1] + s[n // 2])
+
+
+def _barrier_seq(events: List[dict]) -> List[Tuple[str, int, float]]:
+    """Ordered (name, occurrence_idx, end_ts_us) of this rank's
+    alignment-grade spans. Occurrence indices pair the k-th allreduce of
+    a name on rank A with the k-th on rank B — the ranks execute
+    collectives in the same order (the collective_order audit pins it),
+    so ordinal matching is exact."""
+    seen: Dict[str, int] = {}
+    out: List[Tuple[str, int, float]] = []
+    rows = [e for e in events
+            if e.get("ph") == "X" and e.get("cat") in ALIGN_CATEGORIES
+            and not str(e.get("name", "")).endswith(
+                _NON_RENDEZVOUS_SUFFIXES)]
+    rows.sort(key=lambda e: e.get("ts", 0.0))
+    for e in rows:
+        name = e.get("name", "")
+        k = seen.get(name, 0)
+        seen[name] = k + 1
+        out.append((name, k, float(e["ts"]) + float(e.get("dur", 0.0))))
+    return out
+
+
+def clock_offsets(rank_events: Dict[int, List[dict]]) -> Dict[int, float]:
+    """Per-rank clock corrections (microseconds, added to that rank's
+    timestamps), reference = the lowest rank present. Ranks with no
+    matchable barrier spans keep offset 0 (and the caller's summary says
+    how many spans aligned)."""
+    ranks = sorted(rank_events)
+    if not ranks:
+        return {}
+    ref = ranks[0]
+    ref_ends = {(n, k): t for n, k, t in _barrier_seq(rank_events[ref])}
+    offsets = {ref: 0.0}
+    for r in ranks[1:]:
+        deltas = [ref_ends[(n, k)] - t
+                  for n, k, t in _barrier_seq(rank_events[r])
+                  if (n, k) in ref_ends]
+        offsets[r] = _median(deltas)
+    return offsets
+
+
+def merge_rank_traces(traces: Dict[int, dict]) -> dict:
+    """Merge {rank: loaded chrome trace} into one trace dict."""
+    if not traces:
+        raise MergeError("no rank traces to merge")
+    rank_events = {r: list(t.get("traceEvents", []))
+                   for r, t in traces.items()}
+    offsets = clock_offsets(rank_events)
+    merged: List[dict] = []
+    for r in sorted(traces):
+        off = offsets.get(r, 0.0)
+        merged.append({"ph": "M", "name": "process_name", "pid": r,
+                       "tid": 0, "ts": 0,
+                       "args": {"name": "rank %d" % r}})
+        for e in rank_events[r]:
+            if e.get("ph") == "M":
+                continue
+            e2 = dict(e)
+            e2["pid"] = r
+            if "ts" in e2:
+                e2["ts"] = float(e2["ts"]) + off
+            merged.append(e2)
+    # total order => byte-identical re-merge; metadata events first
+    merged.sort(key=lambda e: (e.get("ph") != "M", e.get("ts", 0.0),
+                               e.get("pid", 0), e.get("tid", 0),
+                               e.get("name", "")))
+    dropped = sum(int((t.get("otherData") or {}).get("dropped_events", 0))
+                  for t in traces.values())
+    return {
+        "traceEvents": merged,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "producer": "lightgbm_tpu.telemetry.merge",
+            "ranks": sorted(traces),
+            "clock_offsets_us": {str(r): offsets.get(r, 0.0)
+                                 for r in sorted(traces)},
+            "dropped_events": dropped,
+        },
+    }
+
+
+def merge_paths(paths: Dict[int, str], out_path: str) -> dict:
+    """Load, merge, and write; returns a summary dict for the CLI."""
+    traces = {r: _load(p) for r, p in paths.items()}
+    merged = merge_rank_traces(traces)
+    d = os.path.dirname(os.path.abspath(out_path))
+    if d:
+        os.makedirs(d, exist_ok=True)
+    # canonical separators + sorted keys: the determinism contract is on
+    # BYTES, so two merges of the same inputs diff empty
+    blob = json.dumps(merged, sort_keys=True, separators=(",", ":"))
+    tmp = os.path.join(d, ".%s.tmp" % os.path.basename(out_path))
+    with open(tmp, "w") as f:
+        f.write(blob)
+    os.replace(tmp, out_path)
+    aligned = {r: len(_barrier_seq(traces[r].get("traceEvents", [])))
+               for r in sorted(traces)}
+    return {"out": out_path, "ranks": sorted(traces),
+            "events": len(merged["traceEvents"]),
+            "clock_offsets_us": merged["otherData"]["clock_offsets_us"],
+            "barrier_spans": aligned,
+            "dropped_events": merged["otherData"]["dropped_events"]}
+
+
+def merge_dir(directory: str, out_path: Optional[str] = None) -> dict:
+    """Merge every rank trace found in `directory`."""
+    paths = discover_rank_traces(directory)
+    if not paths:
+        raise MergeError(
+            "no rank-suffixed trace files (*.rN.json / *.rN.trace.json) "
+            "in %s — multihost runs write them when telemetry_out= is "
+            "set with tpu_telemetry=trace" % directory)
+    if out_path is None:
+        out_path = os.path.join(directory, "merged.trace.json")
+    return merge_paths(paths, out_path)

@@ -107,6 +107,10 @@ class TrainingMonitor:
             rec["num_evals"] = len(evals)
         self.records.append(rec)
         events.record_iteration(rec)
+        # periodic Prometheus snapshot (telemetry_out=...prom): throttled
+        # inside maybe_flush, a no-op for non-.prom out paths
+        from . import promexport
+        promexport.maybe_flush()
         return rec
 
     # -- CallbackEnv protocol ---------------------------------------------

@@ -1,0 +1,111 @@
+"""Prometheus text-exposition snapshot of the telemetry registry.
+
+Long multihost runs want to be *scraped*, not post-processed: a
+node-exporter-style textfile collector (or a sidecar reading the file)
+turns the per-rank snapshot into time series without any agent inside
+the training process. ``telemetry_out=<path>.prom`` activates a periodic
+file flush — :func:`maybe_flush` is called from the per-iteration
+TrainingMonitor and throttled to one write per ``MIN_FLUSH_INTERVAL_S``
+— and the final export writes one last snapshot. Writes are atomic
+(tmp + ``os.replace``) so a scraper never reads a torn file.
+
+Exposition (one metric family per registry table, names prefixed
+``lgbtpu_``):
+
+  * ``lgbtpu_timer_seconds_total`` / ``lgbtpu_timer_calls_total``
+    {name, category} — the span accumulators;
+  * ``lgbtpu_counter_total`` {name} — the unit-less counters;
+  * ``lgbtpu_histo{name, quantile}`` + ``_count``/``_sum`` — summary
+    form of each streaming histogram (quantiles are pre-computed; the
+    log-bucket layout is internal);
+  * ``lgbtpu_histo_saturated_total`` {name} — samples outside the bucket
+    range (the silent-truncation signal);
+  * ``lgbtpu_dropped_events`` — trace-buffer drops.
+
+Multihost ranks flush to rank-suffixed paths (export.rank_suffixed), so
+one scrape config with a glob covers the pod.
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional
+
+from . import events, histo
+
+MIN_FLUSH_INTERVAL_S = 5.0
+_last_flush = 0.0
+
+
+def _esc(label: str) -> str:
+    return (label.replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def render() -> str:
+    """The full registry as Prometheus text exposition (version 0.0.4)."""
+    lines = []
+
+    lines.append("# TYPE lgbtpu_timer_seconds_total counter")
+    lines.append("# TYPE lgbtpu_timer_calls_total counter")
+    for name, (sec, n, cat) in sorted(events.snapshot_full().items()):
+        lbl = '{name="%s",category="%s"}' % (_esc(name), _esc(cat))
+        lines.append("lgbtpu_timer_seconds_total%s %.9g" % (lbl, sec))
+        lines.append("lgbtpu_timer_calls_total%s %d" % (lbl, n))
+
+    lines.append("# TYPE lgbtpu_counter_total counter")
+    for name, v in sorted(events.counts_snapshot().items()):
+        lines.append('lgbtpu_counter_total{name="%s"} %.9g'
+                     % (_esc(name), v))
+
+    lines.append("# TYPE lgbtpu_histo summary")
+    lines.append("# TYPE lgbtpu_histo_saturated_total counter")
+    for name, h in sorted(histo.histograms_snapshot().items()):
+        nm = _esc(name)
+        for q in (0.5, 0.95, 0.99, 0.999):
+            v = h.percentile(q)
+            lines.append('lgbtpu_histo{name="%s",quantile="%g"} %.9g'
+                         % (nm, q, v if v == v else 0.0))
+        lines.append('lgbtpu_histo_sum{name="%s"} %.9g' % (nm, h.total))
+        lines.append('lgbtpu_histo_count{name="%s"} %d' % (nm, h.count))
+        lines.append('lgbtpu_histo_saturated_total{name="%s"} %d'
+                     % (nm, h.saturated))
+
+    lines.append("# TYPE lgbtpu_dropped_events counter")
+    lines.append("lgbtpu_dropped_events %d" % events.dropped_events())
+    return "\n".join(lines) + "\n"
+
+
+def write_prom(path: str) -> str:
+    """Atomically write the snapshot (scrapers must never see a torn
+    file; same tmp+replace contract as the resilience writers)."""
+    d = os.path.dirname(os.path.abspath(path))
+    if d:
+        os.makedirs(d, exist_ok=True)
+    tmp = os.path.join(d, ".%s.tmp" % os.path.basename(path))
+    with open(tmp, "w") as f:
+        f.write(render())
+        f.flush()
+    os.replace(tmp, path)
+    return path
+
+
+def maybe_flush(now: Optional[float] = None) -> Optional[str]:
+    """Periodic flush hook (TrainingMonitor calls this every iteration):
+    writes only when ``telemetry_out`` names a ``.prom`` path, telemetry
+    is enabled, and the throttle interval has elapsed."""
+    global _last_flush
+    if not events.enabled():
+        return None
+    out = events.out_path()
+    if not out or not out.endswith(".prom"):
+        return None
+    t = time.monotonic() if now is None else now
+    if t - _last_flush < MIN_FLUSH_INTERVAL_S:
+        return None
+    _last_flush = t
+    from .export import rank_suffixed
+    try:
+        return write_prom(rank_suffixed(out))
+    except OSError:   # a full disk must not kill the training loop
+        return None

@@ -855,8 +855,8 @@ def test_auditors_all_green_on_repo():
     results the CLI gate appends to the jaxpr audits."""
     results = {r.name: r for r in run_auditors()}
     assert set(results) == {"collective_order", "collective_guarded",
-                            "vmem_budget", "hbm_budget",
-                            "compile_surface"}
+                            "collective_observed", "vmem_budget",
+                            "hbm_budget", "compile_surface"}
     bad = {n: r.detail for n, r in results.items() if not r.ok}
     assert not bad, bad
 
@@ -870,8 +870,9 @@ def test_cli_gate_json_green(capsys):
     payload = json.loads(capsys.readouterr().out)
     assert code == 0 and payload["exit_code"] == 0
     audit_names = {a["name"] for a in payload["audits"]}
-    assert {"collective_order", "collective_guarded", "vmem_budget",
-            "hbm_budget", "compile_surface"} <= audit_names
+    assert {"collective_order", "collective_guarded",
+            "collective_observed", "vmem_budget", "hbm_budget",
+            "compile_surface"} <= audit_names
     assert payload["lint"]["counts"]["unsuppressed"] == 0
     assert payload["collective_trace"]["findings"] == []
     assert payload["resource_tables"]["vmem"]
