@@ -28,21 +28,149 @@ REF_THROUGHPUT = REF_ROWS * REF_ITERS / REF_SECONDS   # 22.01M row-iters/s
 from lightgbm_tpu.data.synth import make_higgs_like  # noqa: E402,F401
 
 
-def _phase_stats(telemetry):
-    """Per-category seconds + the per-scope table for one bench phase."""
+BENCH_SCHEMA_VERSION = 1
+
+
+def _phase_stats(telemetry, work=None):
+    """One phase's telemetry snapshot + the archived roofline card —
+    the shared layout lives in telemetry/perfmodel.phase_snapshot (the
+    profile CLI archives the identical structure)."""
+    from lightgbm_tpu.telemetry import perfmodel
+    return perfmodel.phase_snapshot(work=work)
+
+
+def _git_sha():
+    import subprocess
+    try:
+        return subprocess.run(
+            ["git", "rev-parse", "HEAD"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=10,
+        ).stdout.strip()
+    except Exception:
+        return ""
+
+
+def build_meta(repeats=1, spread=None):
+    """The self-describing ``meta`` block every recorded round carries:
+    schema version, git SHA, device profile, jax version, the active
+    BENCH_* knobs, and the median-of-k repeat count + per-key relative
+    spread. Rounds become comparable ARTIFACTS instead of bare numbers —
+    the perf sentinel (analysis/perf_gate.py) keys its comparability
+    lineages and noise bands off exactly this block."""
+    import platform
+
+    import jax
+    from lightgbm_tpu.telemetry.devices import detect_profile
+    try:
+        devs = jax.devices()
+        kind, plat, count = devs[0].device_kind, devs[0].platform, len(devs)
+    except Exception:
+        kind, plat, count = "unknown", "unknown", 0
     return {
-        "categories": {k: round(v, 3)
-                       for k, v in telemetry.events.category_totals().items()},
-        "scopes": {name: {"seconds": round(sec, 3), "count": n,
-                          "category": cat}
-                   for name, (sec, n, cat)
-                   in telemetry.events.snapshot_full().items()},
-        "histograms": {k: h.to_dict(with_buckets=False)
-                       for k, h in
-                       telemetry.histograms_snapshot().items()},
-        "dropped_events": telemetry.events.dropped_events(),
-        "histo_saturation": telemetry.histo.saturation_total(),
+        "schema": BENCH_SCHEMA_VERSION,
+        "git_sha": _git_sha(),
+        "device": {"kind": kind, "platform": plat, "count": count,
+                   "profile": detect_profile().to_dict()},
+        "jax": jax.__version__,
+        "python": platform.python_version(),
+        "knobs": {k: v for k, v in sorted(os.environ.items())
+                  if k.startswith("BENCH")},
+        "repeats": int(repeats),
+        "spread": {k: round(float(v), 4)
+                   for k, v in sorted((spread or {}).items())},
     }
+
+
+def _median_merge(runs):
+    """Element-wise median of repeated phase dicts + per-key relative
+    spread ((max-min)/|median|) for the numeric keys present in every
+    run. Non-numeric / unstable keys keep the first run's value."""
+    import statistics
+    merged = dict(runs[0])
+    spread = {}
+    for k, v0 in runs[0].items():
+        if isinstance(v0, bool) or not isinstance(v0, (int, float)):
+            continue
+        vals = [r[k] for r in runs
+                if isinstance(r.get(k), (int, float))
+                and not isinstance(r.get(k), bool)]
+        if len(vals) != len(runs):
+            continue
+        med = statistics.median(vals)
+        merged[k] = med if isinstance(v0, int) and med == int(med) \
+            else round(float(med), 6)
+        spread[k] = (max(vals) - min(vals)) / max(abs(med), 1e-12)
+    return merged, spread
+
+
+def _repeat_phase(fn, repeats, reset=None):
+    """(median-merged phase dict, per-key spread) over `repeats` runs.
+
+    ``reset`` (telemetry.reset when telemetry is on) runs before EVERY
+    repeat so the phase snapshot taken afterwards covers the LAST run
+    only — without it, repeated phases would archive k runs' accumulated
+    wall against a single run's work geometry, and the roofline card
+    would divide a 1-run model by a k-run denominator."""
+    runs = []
+    for _ in range(max(repeats, 1)):
+        if reset is not None:
+            reset()
+        runs.append(fn())
+    if len(runs) == 1:
+        return runs[0], {}
+    return _median_merge(runs)
+
+
+def _copy_spread(spread_out, phase_spread, mapping=None, **kw):
+    """Record a phase's per-key spread under the BENCH result key names
+    (``meta.spread`` speaks the same vocabulary as ``parsed``).
+    ``mapping`` takes src keys that are not identifiers (the predict
+    phase's dotted ``poisson.p99`` style)."""
+    for src, dst in dict(mapping or {}, **kw).items():
+        if src in phase_spread:
+            spread_out[dst] = phase_spread[src]
+
+
+def _median_merge_nested(runs, subkeys):
+    """Median-merge for phases returning nested dicts (predict): each
+    named sub-dict medians element-wise; spreads come back keyed
+    ``sub.key``. Top-level non-dict values keep the first run's."""
+    merged = dict(runs[0])
+    spread = {}
+    for sub in subkeys:
+        subruns = [r[sub] for r in runs if isinstance(r.get(sub), dict)]
+        if len(subruns) != len(runs):
+            continue
+        m, s = _median_merge(subruns)
+        merged[sub] = m
+        for k, v in s.items():
+            spread["%s.%s" % (sub, k)] = v
+    return merged, spread
+
+
+def _extra_params():
+    """BENCH_PARAMS="k=v,k=v": extra training params merged into EVERY
+    bench phase (e.g. ``tpu_persist_scan=force,num_leaves=63`` records
+    a comparable round on a box without the default fast-path gates —
+    the knob lands in meta.knobs, so such rounds open their own
+    comparability lineage instead of polluting the default one)."""
+    raw = os.environ.get("BENCH_PARAMS", "")
+    out = {}
+    for tok in raw.split(","):
+        tok = tok.strip()
+        if not tok:
+            continue
+        k, _, v = tok.partition("=")
+        out[k.strip()] = v.strip()
+    return out
+
+
+def _phase_params(base):
+    """One phase's params: the phase defaults + the BENCH_PARAMS knob."""
+    p = dict(base)
+    p.update(_extra_params())
+    return p
 
 
 def main():
@@ -63,6 +191,11 @@ def main():
     if bench_telemetry:
         telemetry.enable("timers")
     phase_snaps = {}
+    # BENCH_REPEATS=k: run every timed phase k times, report the per-key
+    # MEDIAN, and record the relative spread into meta.spread — the perf
+    # sentinel widens its noise band to the recorded spread
+    repeats = int(os.environ.get("BENCH_REPEATS", 1))
+    spread_out = {}
 
     X, y = make_higgs_like(n_rows)
     t_bin0 = time.time()
@@ -70,8 +203,11 @@ def main():
     ds.construct()
     t_bin = time.time() - t_bin0
 
-    params = {"objective": "binary", "num_leaves": num_leaves,
-              "max_bin": max_bin, "verbosity": -1, "metric": "none"}
+    params = _phase_params({"objective": "binary",
+                            "num_leaves": num_leaves,
+                            "max_bin": max_bin, "verbosity": -1,
+                            "metric": "none"})
+    num_leaves = int(params["num_leaves"])
 
     # warmup: compile the grower AND the fused 16-iteration scan on the
     # full-size problem (compiles are one-time costs; steady state is what
@@ -80,26 +216,38 @@ def main():
     warm._booster._materialize_pending()
     del warm
 
-    if bench_telemetry:   # opted out: never touch the process-global registry
-        telemetry.reset()   # steady state only: drop binning/warmup compiles
-    t0 = time.time()
-    booster = lgb.train(dict(params), ds, n_iters, verbose_eval=False)
-    # force the async pipeline to finish: materialize every pending device
-    # tree and block on the score buffer
-    booster._booster._materialize_pending()
-    import jax
-    jax.block_until_ready(booster._booster.train_score.score_device(0))
-    train_s = time.time() - t0
-    if bench_telemetry:
-        phase_snaps["higgs"] = _phase_stats(telemetry)
+    def _timed_higgs():
+        if bench_telemetry:   # opted out: never touch the global registry
+            telemetry.reset()   # steady state: drop binning/warmup compiles
+        t0 = time.time()
+        booster = lgb.train(dict(params), ds, n_iters, verbose_eval=False)
+        # force the async pipeline to finish: materialize every pending
+        # device tree and block on the score buffer
+        booster._booster._materialize_pending()
+        import jax
+        jax.block_until_ready(booster._booster.train_score.score_device(0))
+        train_s = time.time() - t0
+        throughput = n_rows * n_iters / train_s
+        return {"train_s": train_s,
+                "value": round(throughput / 1e6, 3),
+                "vs_baseline": round(throughput / REF_THROUGHPUT, 4)}
 
-    throughput = n_rows * n_iters / train_s
-    vs_baseline = throughput / REF_THROUGHPUT
+    reset_fn = telemetry.reset if bench_telemetry else None
+    higgs, higgs_spread = _repeat_phase(_timed_higgs, repeats,
+                                        reset=reset_fn)
+    train_s = higgs["train_s"]
+    if bench_telemetry:
+        phase_snaps["higgs"] = _phase_stats(
+            telemetry, work={"phase": "higgs", "rows": n_rows,
+                             "iters": n_iters, "num_leaves": num_leaves})
+    _copy_spread(spread_out, higgs_spread, value="value",
+                 vs_baseline="vs_baseline")
+
     result = {
         "metric": "higgs_like_train_throughput",
-        "value": round(throughput / 1e6, 3),
+        "value": higgs["value"],
         "unit": "Mrow_iters_per_sec",
-        "vs_baseline": round(vs_baseline, 4),
+        "vs_baseline": higgs["vs_baseline"],
     }
     if bench_telemetry:
         result["phases"] = phase_snaps["higgs"]["categories"]
@@ -117,9 +265,15 @@ def main():
         try:
             if bench_telemetry:
                 telemetry.reset()
-            ltr = run_ltr()
+            ltr, ltr_spread = _repeat_phase(run_ltr, repeats, reset=reset_fn)
             if bench_telemetry:
-                phase_snaps["ltr"] = _phase_stats(telemetry)
+                phase_snaps["ltr"] = _phase_stats(
+                    telemetry, work={"phase": "ltr", "rows": ltr["rows"],
+                                     "iters": ltr["iters"],
+                                     "num_leaves":
+                                         ltr.get("num_leaves", 255)})
+            _copy_spread(spread_out, ltr_spread, value="ranking_value",
+                         vs_baseline="ranking_vs_baseline")
         except Exception as exc:
             print("# MS-LTR phase failed: %r" % exc, file=sys.stderr)
     if ltr is not None:
@@ -135,9 +289,18 @@ def main():
         try:
             if bench_telemetry:
                 telemetry.reset()
-            expo = run_expo()
+            expo, expo_spread = _repeat_phase(run_expo, repeats, reset=reset_fn)
             if bench_telemetry:
-                phase_snaps["expo"] = _phase_stats(telemetry)
+                phase_snaps["expo"] = _phase_stats(
+                    telemetry, work={"phase": "expo",
+                                     "rows": expo["rows"],
+                                     "iters": expo["iters"],
+                                     "num_leaves":
+                                         expo.get("num_leaves", 255)})
+            _copy_spread(spread_out, expo_spread, value="expo_value",
+                         vs_baseline="expo_vs_baseline",
+                         level_value="expo_level_value",
+                         level_vs_baseline="expo_level_vs_baseline")
         except Exception as exc:
             print("# expo phase failed: %r" % exc, file=sys.stderr)
     if expo is not None:
@@ -175,9 +338,17 @@ def main():
         try:
             if bench_telemetry:
                 telemetry.reset()
-            allst = run_allstate()
+            allst, allst_spread = _repeat_phase(run_allstate, repeats, reset=reset_fn)
             if bench_telemetry:
-                phase_snaps["allstate"] = _phase_stats(telemetry)
+                phase_snaps["allstate"] = _phase_stats(
+                    telemetry, work={"phase": "allstate",
+                                     "rows": allst["rows"],
+                                     "iters": allst["iters"],
+                                     "num_leaves":
+                                         allst.get("num_leaves", 255)})
+            _copy_spread(spread_out, allst_spread,
+                         value="allstate_value",
+                         vs_baseline="allstate_vs_baseline")
         except Exception as exc:
             print("# allstate phase failed: %r" % exc, file=sys.stderr)
     if allst is not None:
@@ -195,9 +366,16 @@ def main():
         try:
             if bench_telemetry:
                 telemetry.reset()
-            yah = run_yahoo()
+            yah, yah_spread = _repeat_phase(run_yahoo, repeats, reset=reset_fn)
             if bench_telemetry:
-                phase_snaps["yahoo_ltr"] = _phase_stats(telemetry)
+                phase_snaps["yahoo_ltr"] = _phase_stats(
+                    telemetry, work={"phase": "yahoo_ltr",
+                                     "rows": yah["rows"],
+                                     "iters": yah["iters"],
+                                     "num_leaves":
+                                         yah.get("num_leaves", 255)})
+            _copy_spread(spread_out, yah_spread, value="yahoo_value",
+                         vs_baseline="yahoo_vs_baseline")
         except Exception as exc:
             print("# yahoo phase failed: %r" % exc, file=sys.stderr)
     if yah is not None:
@@ -213,9 +391,14 @@ def main():
         try:
             if bench_telemetry:
                 telemetry.reset()
-            vote = run_voting()
+            vote, vote_spread = _repeat_phase(run_voting, repeats, reset=reset_fn)
             if bench_telemetry:
-                phase_snaps["voting"] = _phase_stats(telemetry)
+                phase_snaps["voting"] = _phase_stats(
+                    telemetry, work={"phase": "voting",
+                                     "rows": vote["rows"],
+                                     "iters": vote["iters"]})
+            _copy_spread(spread_out, vote_spread, value="voting_value",
+                         vs_baseline="voting_vs_baseline")
         except Exception as exc:
             print("# voting phase failed: %r" % exc, file=sys.stderr)
     if vote is not None:
@@ -233,9 +416,16 @@ def main():
         try:
             if bench_telemetry:
                 telemetry.reset()
-            ckpt = run_checkpoint()
+            ckpt, ckpt_spread = _repeat_phase(run_checkpoint, repeats,
+                                              reset=reset_fn)
             if bench_telemetry:
-                phase_snaps["checkpoint"] = _phase_stats(telemetry)
+                phase_snaps["checkpoint"] = _phase_stats(
+                    telemetry, work={"phase": "checkpoint",
+                                     "rows": ckpt["rows"],
+                                     "iters": ckpt["iters"]})
+            _copy_spread(spread_out, ckpt_spread,
+                         overhead_frac="checkpoint_overhead_frac",
+                         write_s="checkpoint_write_s")
         except Exception as exc:
             print("# checkpoint phase failed: %r" % exc, file=sys.stderr)
     if ckpt is not None:
@@ -256,9 +446,28 @@ def main():
         try:
             if bench_telemetry:
                 telemetry.reset()
-            pred = run_predict()
+            # predict returns nested per-shape dicts: repeat by hand and
+            # median-merge each sub-dict (spread keys come back dotted)
+            runs = []
+            for _ in range(max(repeats, 1)):
+                if reset_fn is not None:
+                    reset_fn()
+                runs.append(run_predict())
+            if len(runs) == 1:
+                pred, pred_spread = runs[0], {}
+            else:
+                pred, pred_spread = _median_merge_nested(
+                    runs, ("higgs", "expo", "poisson"))
             if bench_telemetry:
-                phase_snaps["predict"] = _phase_stats(telemetry)
+                phase_snaps["predict"] = _phase_stats(
+                    telemetry, work={"phase": "predict",
+                                     "rows": pred["higgs"]["rows"]})
+            _copy_spread(spread_out, pred_spread, {
+                "higgs.value": "predict_value",
+                "expo.value": "predict_expo_value",
+                "poisson.p50": "predict_p50",
+                "poisson.p99": "predict_p99",
+                "poisson.qdepth_mean": "predict_qdepth"})
         except Exception as exc:
             print("# predict phase failed: %r" % exc, file=sys.stderr)
     if pred is not None:
@@ -288,6 +497,14 @@ def main():
                      slo["p99"] * 1e3, slo["queue_wait_p99"] * 1e3,
                      slo["qdepth_mean"], slo["qdepth_max"]),
                   file=sys.stderr)
+    # the self-describing meta block rides the LAST printed json line —
+    # the one last-JSON-line parsers archive as `parsed` — so every
+    # recorded round is a comparable artifact (schema version, git SHA,
+    # device profile, jax version, BENCH_* knobs, repeat count + spread)
+    # instead of bare numbers; the perf sentinel keys its lineages and
+    # noise bands off this block
+    result["meta"] = build_meta(repeats=repeats, spread=spread_out)
+    print(json.dumps(result), flush=True)
     # full per-phase telemetry snapshot (category totals + per-scope table)
     # so BENCH_*.json rounds can archive WHERE the time went
     if bench_telemetry:
@@ -313,12 +530,14 @@ def run_ltr():
     import lightgbm_tpu as lgb
     from bench_full import make_ltr_like
     n_iters = int(os.environ.get("BENCH_LTR_ITERS", 160))
-    X, y, group = make_ltr_like(n_rows=LTR_ROWS)
+    X, y, group = make_ltr_like(
+        n_rows=int(os.environ.get("BENCH_LTR_ROWS", LTR_ROWS)))
     n_rows = len(y)
     ds = lgb.Dataset(X, y, group=group)
     ds.construct()
-    params = {"objective": "lambdarank", "num_leaves": 255, "max_bin": 255,
-              "verbosity": -1, "metric": "none"}
+    params = _phase_params({"objective": "lambdarank", "num_leaves": 255,
+                            "max_bin": 255, "verbosity": -1,
+                            "metric": "none"})
     warm = lgb.train(dict(params), ds, 17, verbose_eval=False)
     warm._booster._materialize_pending()
     del warm
@@ -330,6 +549,7 @@ def run_ltr():
     train_s = time.time() - t0
     throughput = n_rows * n_iters / train_s
     return {"rows": n_rows, "iters": n_iters, "train_s": train_s,
+            "num_leaves": int(params["num_leaves"]),
             "value": round(throughput / 1e6, 3),
             "vs_baseline": round(throughput / LTR_THROUGHPUT, 4)}
 
@@ -378,12 +598,14 @@ def run_expo():
         counts = {k: v - c0.get(k, 0) for k, v in c1.items()}
         return bst, train_s, counts
 
-    params = {"objective": "binary", "num_leaves": 255, "max_bin": 255,
-              "verbosity": -1, "metric": "none"}
+    params = _phase_params({"objective": "binary", "num_leaves": 255,
+                            "max_bin": 255, "verbosity": -1,
+                            "metric": "none"})
     _, train_s, _ = timed_train(params)
     throughput = n_rows * n_iters / train_s
     out = {"rows": n_rows, "iters": n_iters, "train_s": train_s,
            "groups": len(inner.groups), "features": inner.num_features,
+           "num_leaves": int(params["num_leaves"]),
            "value": round(throughput / 1e6, 3),
            "vs_baseline": round(throughput / anchor, 4)}
     if os.environ.get("BENCH_EXPO_LEVEL", "1") != "0":
@@ -431,8 +653,9 @@ def run_allstate():
     ds = lgb.Dataset(X, y)
     ds.construct()
     inner = ds._inner
-    params = {"objective": "binary", "num_leaves": 255, "max_bin": 255,
-              "verbosity": -1, "metric": "none"}
+    params = _phase_params({"objective": "binary", "num_leaves": 255,
+                            "max_bin": 255, "verbosity": -1,
+                            "metric": "none"})
     warm = lgb.train(dict(params), ds, 17, verbose_eval=False)
     warm._booster._materialize_pending()
     del warm
@@ -444,6 +667,7 @@ def run_allstate():
     throughput = n_rows * n_iters / train_s
     return {"rows": n_rows, "iters": n_iters, "train_s": train_s,
             "groups": len(inner.groups), "features": inner.num_features,
+            "num_leaves": int(params["num_leaves"]),
             "value": round(throughput / 1e6, 3),
             "vs_baseline": round(throughput / ALLSTATE_THROUGHPUT, 4)}
 
@@ -458,8 +682,9 @@ def run_yahoo():
     X, y, group = make_yahoo_like(n_rows)
     ds = lgb.Dataset(X, y, group=group)
     ds.construct()
-    params = {"objective": "lambdarank", "num_leaves": 255, "max_bin": 255,
-              "verbosity": -1, "metric": "none"}
+    params = _phase_params({"objective": "lambdarank", "num_leaves": 255,
+                            "max_bin": 255, "verbosity": -1,
+                            "metric": "none"})
     warm = lgb.train(dict(params), ds, 17, verbose_eval=False)
     warm._booster._materialize_pending()
     del warm
@@ -471,6 +696,7 @@ def run_yahoo():
     n = len(y)
     throughput = n * n_iters / train_s
     return {"rows": n, "iters": n_iters, "train_s": train_s,
+            "num_leaves": int(params["num_leaves"]),
             "value": round(throughput / 1e6, 3),
             "vs_baseline": round(throughput / YAHOO_THROUGHPUT, 4)}
 
@@ -577,8 +803,9 @@ def run_predict():
     n_trees = int(os.environ.get("BENCH_PREDICT_TREES", 100))
     n_leaves = int(os.environ.get("BENCH_PREDICT_LEAVES", 255))
     serve_rows = int(os.environ.get("BENCH_PREDICT_SERVE_ROWS", 8_000_000))
-    params = {"objective": "binary", "num_leaves": n_leaves, "max_bin": 255,
-              "verbosity": -1, "metric": "none"}
+    params = _phase_params({"objective": "binary", "num_leaves": n_leaves,
+                            "max_bin": 255, "verbosity": -1,
+                            "metric": "none"})
     Xh, yh = make_higgs_like(n_rows)
     higgs, bst_h = _predict_one_shape(Xh, yh, params, n_trees, serve_rows,
                                       "higgs")
@@ -627,8 +854,9 @@ def run_checkpoint():
     X, y = make_higgs_like(n_rows)
     ds = lgb.Dataset(X, y)
     ds.construct()
-    base = {"objective": "binary", "num_leaves": n_leaves, "max_bin": 255,
-            "verbosity": -1, "metric": "none"}
+    base = _phase_params({"objective": "binary", "num_leaves": n_leaves,
+                          "max_bin": 255, "verbosity": -1,
+                          "metric": "none"})
 
     def _timed_train(params, wipe_dir=None):
         warm = lgb.train(dict(params), ds, 17, verbose_eval=False)
@@ -680,9 +908,10 @@ def run_voting():
     X, y = make_higgs_like(n_rows)
     ds = lgb.Dataset(X, y)
     ds.construct()
-    params = {"objective": "binary", "num_leaves": 255, "max_bin": 255,
-              "verbosity": -1, "metric": "none", "tree_learner": "voting",
-              "top_k": 14}
+    params = _phase_params({"objective": "binary", "num_leaves": 255,
+                            "max_bin": 255, "verbosity": -1,
+                            "metric": "none", "tree_learner": "voting",
+                            "top_k": 14})
     warm = lgb.train(dict(params), ds, 17, verbose_eval=False)
     warm._booster._materialize_pending()
     del warm

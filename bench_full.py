@@ -19,7 +19,7 @@ import time
 
 import numpy as np
 
-from bench import make_higgs_like
+from bench import build_meta, make_higgs_like
 from lightgbm_tpu.data.synth import (make_allstate_like,  # noqa: F401
                                      make_expo_like, make_ltr_like,
                                      make_yahoo_like)
@@ -165,7 +165,11 @@ def main():
             int(os.environ.get("BENCHF_EXPO_ROWS", 2_000_000)),
             int(os.environ.get("BENCHF_EXPO_ITERS", 96))))
         print(json.dumps(results[-1]), flush=True)
-    print(json.dumps({"metric": "bench_full", "results": results}))
+    # the same self-describing meta block bench.py stamps: a bench_full
+    # line is a comparable artifact too (BENCHF_* knobs ride along via
+    # the BENCH prefix match)
+    print(json.dumps({"metric": "bench_full", "results": results,
+                      "meta": build_meta()}))
 
 
 # Expo anchor: 11M rows x ~700 one-hot features, 500 iters in 138.5s

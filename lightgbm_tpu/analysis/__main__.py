@@ -16,6 +16,8 @@ Common invocations::
     python -m lightgbm_tpu.analysis --write-baseline  # re-grandfather
     python -m lightgbm_tpu.analysis --prune-baseline  # drop stale entries
     python -m lightgbm_tpu.analysis --budgets         # resource tables
+    python -m lightgbm_tpu.analysis --perf --json     # perf sentinel
+    python -m lightgbm_tpu.analysis --perf-advisory   # report, never block
 """
 from __future__ import annotations
 
@@ -23,7 +25,8 @@ import argparse
 import json
 import sys
 
-from . import auditors, collective_audit, compile_audit, resource_audit
+from . import (auditors, collective_audit, compile_audit, perf_gate,
+               resource_audit)
 from .config import load_config
 from .jaxpr_audit import run_audits
 from .lint import prune_baseline, run_lint, write_baseline
@@ -54,6 +57,14 @@ def _build_parser() -> argparse.ArgumentParser:
                         "matches (stale suppressions), then exit 0")
     p.add_argument("--budgets", action="store_true",
                    help="print the VMEM/HBM budget tables and exit 0")
+    p.add_argument("--perf", action="store_true",
+                   help="also run the perf-regression sentinel over the "
+                        "BENCH_r*/MULTICHIP_r* round series (gates)")
+    p.add_argument("--perf-advisory", action="store_true",
+                   dest="perf_advisory",
+                   help="run the perf sentinel in advisory mode: report "
+                        "verdicts, never affect the exit code (the "
+                        "pre-commit hook mode)")
     p.add_argument("--no-audit", action="store_true",
                    help="skip the jaxpr/HLO audits")
     p.add_argument("--audit-only", action="store_true",
@@ -124,7 +135,19 @@ def main(argv=None) -> int:
     audits = [] if not run_auditors \
         else run_audits() + auditors.run_all(config, artifacts=artifacts)
 
+    # the perf sentinel is opt-in (--perf gates, --perf-advisory reports
+    # without blocking — the pre-commit mode: a clone with no recorded
+    # rounds must still be able to commit)
+    perf_rep = None
+    perf_results = []
+    if args.perf or args.perf_advisory:
+        perf_rep, _ = perf_gate._resolve_rounds(config)
+        perf_results = perf_gate.run(config, artifact=perf_rep)
+        audits = audits + perf_results
+
     bad_audits = [a for a in audits if not a.ok]
+    if args.perf_advisory and not args.perf:
+        bad_audits = [a for a in bad_audits if a not in perf_results]
     n_unsup = len(report.unsuppressed) if report else 0
     n_parse = len(report.parse_errors) if report else 0
     exit_code = 2 if n_parse else (1 if (n_unsup or bad_audits) else 0)
@@ -135,7 +158,7 @@ def main(argv=None) -> int:
             "lint": report.to_dict() if report else None,
             "audits": [a.to_dict() for a in audits],
         }
-        if audits:
+        if run_auditors:
             # the whole-program auditors' full artifacts: the abstract
             # collective trace, the budget tables, the compile surface
             art = artifacts or {}
@@ -146,6 +169,9 @@ def main(argv=None) -> int:
                 config=config, artifact=art.get("resource_budget"))
             payload["compile_surface"] = compile_audit.compile_surface(
                 config, artifact=art.get("compile_surface"))
+        if perf_rep is not None:
+            payload["perf_tables"] = perf_gate.tables(
+                config, artifact=perf_rep)
         print(json.dumps(payload, indent=1))
         return exit_code
 
@@ -162,10 +188,15 @@ def main(argv=None) -> int:
             print("autofixed %d import statement(s)" % report.autofixed)
     for a in audits:
         status = "SKIP" if a.skipped else ("ok" if a.ok else "FAIL")
+        if (args.perf_advisory and not args.perf
+                and a in perf_results and not a.ok):
+            status = "ADVISORY-FAIL"
         line = "audit %-24s %s" % (a.name, status)
         if a.detail:
             line += "  (%s)" % a.detail
         print(line)
+    if perf_rep is not None:
+        print(perf_gate.render_report(perf_rep))
     if report:
         print("graft-lint: %d file(s), %d finding(s) "
               "(%d suppressed), %d audit failure(s)"

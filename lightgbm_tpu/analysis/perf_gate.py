@@ -1,0 +1,529 @@
+"""Perf-regression sentinel: trajectory verdicts over the bench rounds.
+
+Every archived ``BENCH_r*.json`` / ``MULTICHIP_r*.json`` is a recorded
+measurement; nothing so far *interpreted* them — the stack could record
+a p99 yet could not say "this round is slower than the last one". This
+auditor closes that loop behind the existing gate
+(``python -m lightgbm_tpu.analysis --perf [--json]``):
+
+* **schema validation** — every round parses through
+  :func:`load_round`, which raises :class:`RoundError` with a clear
+  message (round name + what is wrong) instead of a ``KeyError``
+  mid-series. Rounds from index :data:`REQUIRE_META_FROM` on MUST carry
+  the self-describing ``meta`` block bench.py stamps (schema version,
+  git SHA, device profile, jax version, BENCH_* knobs, repeats +
+  per-key spread); earlier rounds are grandfathered as ``legacy``.
+* **trajectory verdicts** — per-key series over the whole round
+  sequence, compared **within a lineage**: rounds are comparable only
+  when their device + workload-knob fingerprint matches (a round
+  recorded on a CPU box must not "regress" a TPU round — it opens a
+  new lineage instead, which the report names). The latest round of
+  each lineage is checked against its predecessor; a headline key
+  moving against its direction by more than the noise band FAILS the
+  gate, improvements are reported, within-band moves pass.
+* **noise bands** — a key's band is the larger of the recorded
+  relative spread from ``BENCH_REPEATS`` median-of-k runs (both
+  rounds' ``meta.spread``) and the configured floor
+  (``[tool.graftlint] perf-band``, default 0.15).
+* **coverage** — headline keys the north-star trajectory is built on
+  (:data:`EXPECTED_KEYS`) absent from EVERY round is exactly the
+  stale-trajectory state ROADMAP item 1 opens with; the sentinel fails
+  and names them rather than passing silently. The multichip series
+  gates on the latest round's ``ok``.
+
+``tables()`` ships the full trajectories + verdicts (and the roofline
+cards when a phase snapshot is archived next to the rounds) as the
+``--json`` ``perf_tables`` payload.
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..telemetry import events as telemetry
+from .config import GraftlintConfig, load_config
+from .jaxpr_audit import AuditResult
+
+C_ROUNDS = "analysis::perf_rounds"
+C_REGRESSED = "analysis::perf_regressed"
+C_MISSING = "analysis::perf_missing_keys"
+
+SCHEMA_VERSION = 1
+# rounds r01..r05 predate the meta block; everything after must carry it
+REQUIRE_META_FROM = 6
+
+# headline keys: direction tells the sentinel what "worse" means
+HIGHER_BETTER = (
+    "value", "vs_baseline", "ranking_value", "ranking_vs_baseline",
+    "expo_value", "expo_vs_baseline", "expo_level_value",
+    "expo_level_vs_baseline", "allstate_value", "allstate_vs_baseline",
+    "yahoo_value", "yahoo_vs_baseline", "voting_value",
+    "voting_vs_baseline", "predict_value", "predict_expo_value",
+)
+LOWER_BETTER = (
+    "predict_p50", "predict_p99", "checkpoint_overhead_frac",
+    "expo_level_launches_per_tree",
+)
+# informational keys (counts, sizes) are tracked but never gate
+# the north-star trajectory keys: absent from EVERY round = the stale
+# state the gate must name loudly (ROADMAP item 1)
+EXPECTED_KEYS = ("value", "ranking_value", "expo_value",
+                 "expo_level_value")
+
+_ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+_MULTICHIP_RE = re.compile(r"MULTICHIP_r(\d+)\.json$")
+_META_REQUIRED = ("schema", "device", "jax")
+
+
+class RoundError(Exception):
+    """A bench round file the sentinel cannot interpret (malformed
+    envelope, missing meta on a post-legacy round, wrong types)."""
+
+
+@dataclass
+class Round:
+    """One validated BENCH_r*.json."""
+
+    index: int
+    path: str
+    parsed: Dict[str, object]
+    meta: Optional[dict] = None
+    legacy: bool = False
+
+    @property
+    def spread(self) -> Dict[str, float]:
+        if not self.meta:
+            return {}
+        return {k: float(v)
+                for k, v in (self.meta.get("spread") or {}).items()}
+
+    def fingerprint(self) -> str:
+        """Comparability lineage: device + workload knobs. Meta-less
+        rounds share the single ``legacy`` lineage (they were recorded
+        on the same driver box with default knobs). Measurement-only
+        knobs (repeat count, telemetry opt-out, output paths, phase
+        skips) do NOT change what is being measured, so they stay out
+        of the fingerprint — flipping BENCH_REPEATS on must not sever
+        the lineage the spread mechanism exists to serve."""
+        if not self.meta:
+            return "legacy"
+        dev = self.meta.get("device") or {}
+        knobs = self.meta.get("knobs") or {}
+        sized = ";".join(
+            "%s=%s" % (k, knobs[k]) for k in sorted(knobs)
+            if not (str(k).endswith("_OUT")
+                    or str(k).startswith("BENCH_SKIP_")
+                    or k in ("BENCH_REPEATS", "BENCH_TELEMETRY")))
+        return "%s|%s" % (dev.get("kind", dev.get("name", "?")), sized)
+
+
+def validate_round(payload: object, name: str, index: int) -> Round:
+    """Envelope + meta validation with clear errors (never KeyError)."""
+    if not isinstance(payload, dict):
+        raise RoundError("%s: round json must be an object, got %s"
+                         % (name, type(payload).__name__))
+    parsed = payload.get("parsed")
+    if not isinstance(parsed, dict):
+        raise RoundError("%s: missing or non-object 'parsed' block "
+                         "(the bench metric line)" % name)
+    meta = payload.get("meta")
+    if meta is None and isinstance(parsed.get("meta"), dict):
+        # bench.py stamps meta INTO its printed metric line; the driver
+        # envelope archives that line under 'parsed'
+        meta = parsed["meta"]
+    if meta is not None:
+        if not isinstance(meta, dict):
+            raise RoundError("%s: 'meta' must be an object, got %s"
+                             % (name, type(meta).__name__))
+        missing = [k for k in _META_REQUIRED if k not in meta]
+        if missing:
+            raise RoundError("%s: meta block is missing %s (a "
+                             "self-describing round records schema/"
+                             "device/jax — re-record with the current "
+                             "bench.py)" % (name, ", ".join(missing)))
+    elif index >= REQUIRE_META_FROM:
+        raise RoundError("%s: rounds from r%02d on must carry the "
+                         "self-describing 'meta' block (schema version, "
+                         "device, knobs); meta-less rounds are only "
+                         "grandfathered up to r%02d"
+                         % (name, REQUIRE_META_FROM,
+                            REQUIRE_META_FROM - 1))
+    return Round(index=index, path=name, parsed=parsed, meta=meta,
+                 legacy=meta is None)
+
+
+def load_round(path: str) -> Round:
+    name = os.path.basename(path)
+    m = _ROUND_RE.search(name)
+    if not m:
+        raise RoundError("%s: not a BENCH_r<NN>.json round file" % name)
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            payload = json.load(f)
+    except (OSError, ValueError) as exc:
+        raise RoundError("%s: unreadable round json (%s)" % (name, exc))
+    return validate_round(payload, name, int(m.group(1)))
+
+
+def discover_rounds(root: str) -> Tuple[List[Round], List[dict],
+                                        List[str]]:
+    """(bench rounds sorted by index, multichip rounds, errors)."""
+    rounds: List[Round] = []
+    errors: List[str] = []
+    for path in sorted(glob.glob(os.path.join(root, "BENCH_r*.json"))):
+        if not _ROUND_RE.search(os.path.basename(path)):
+            continue
+        try:
+            rounds.append(load_round(path))
+        except RoundError as exc:
+            errors.append(str(exc))
+    multichip: List[dict] = []
+    for path in sorted(glob.glob(os.path.join(root,
+                                              "MULTICHIP_r*.json"))):
+        m = _MULTICHIP_RE.search(os.path.basename(path))
+        if not m:
+            continue
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                payload = json.load(f)
+            if not isinstance(payload, dict):
+                raise ValueError("not an object")
+        except (OSError, ValueError) as exc:
+            errors.append("%s: unreadable multichip round (%s)"
+                          % (os.path.basename(path), exc))
+            continue
+        payload = dict(payload, index=int(m.group(1)))
+        multichip.append(payload)
+    rounds.sort(key=lambda r: r.index)
+    multichip.sort(key=lambda d: d["index"])
+    return rounds, multichip, errors
+
+
+def _numeric_keys(parsed: Dict[str, object]) -> Dict[str, float]:
+    out = {}
+    for k, v in parsed.items():
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            continue
+        out[k] = float(v)
+    return out
+
+
+@dataclass
+class Verdict:
+    """One headline key's latest-vs-predecessor comparison."""
+
+    key: str
+    status: str               # ok | improved | REGRESSED | new | missing
+    round: int                # the round being judged (latest of lineage)
+    prev_round: Optional[int] = None
+    value: Optional[float] = None
+    prev_value: Optional[float] = None
+    change: Optional[float] = None     # relative, signed (+ = better)
+    band: Optional[float] = None
+    note: str = ""
+
+    def to_dict(self) -> dict:
+        return {k: v for k, v in self.__dict__.items() if v is not None}
+
+
+@dataclass
+class PerfReport:
+    rounds: List[Round] = field(default_factory=list)
+    multichip: List[dict] = field(default_factory=list)
+    errors: List[str] = field(default_factory=list)
+    verdicts: List[Verdict] = field(default_factory=list)
+    missing_keys: List[str] = field(default_factory=list)
+    lineages: Dict[str, List[int]] = field(default_factory=dict)
+
+    @property
+    def regressions(self) -> List[Verdict]:
+        return [v for v in self.verdicts if v.status == "REGRESSED"]
+
+    @property
+    def improvements(self) -> List[Verdict]:
+        return [v for v in self.verdicts if v.status == "improved"]
+
+
+def evaluate(rounds: List[Round], band_floor: float,
+             multichip: Optional[List[dict]] = None,
+             errors: Optional[List[str]] = None) -> PerfReport:
+    """The sentinel core: pure function of the validated round series
+    (the fixture tests drive exactly this)."""
+    rep = PerfReport(rounds=rounds, multichip=multichip or [],
+                     errors=list(errors or []))
+    for r in rounds:
+        rep.lineages.setdefault(r.fingerprint(), []).append(r.index)
+
+    # coverage: the north-star keys must exist SOMEWHERE in the series
+    seen_keys = set()
+    for r in rounds:
+        seen_keys.update(_numeric_keys(r.parsed))
+    rep.missing_keys = [k for k in EXPECTED_KEYS if k not in seen_keys]
+
+    # latest-vs-predecessor within each lineage
+    by_lineage: Dict[str, List[Round]] = {}
+    for r in rounds:
+        by_lineage.setdefault(r.fingerprint(), []).append(r)
+    for lineage, series in by_lineage.items():
+        if not series:
+            continue
+        latest = series[-1]
+        latest_vals = _numeric_keys(latest.parsed)
+        vals_by_round = [(r, _numeric_keys(r.parsed))
+                         for r in series[:-1]]
+        for key in HIGHER_BETTER + LOWER_BETTER:
+            # the predecessor is the LAST earlier round of this lineage
+            # that actually carried the key — so a key that vanished
+            # keeps gating on every subsequent round (not just the
+            # first one after the crash), and a key skipping one round
+            # still compares against its real previous measurement
+            prev = None
+            prev_vals: Dict[str, float] = {}
+            for r, vals in reversed(vals_by_round):
+                if key in vals:
+                    prev, prev_vals = r, vals
+                    break
+            if key not in latest_vals and prev is None:
+                continue
+            if key not in latest_vals:
+                rep.verdicts.append(Verdict(
+                    key=key, status="missing", round=latest.index,
+                    prev_round=prev.index,
+                    prev_value=prev_vals.get(key),
+                    note="recorded in r%02d but absent from the latest "
+                         "round of this lineage (did the phase crash?)"
+                         % prev.index))
+                continue
+            if prev is None:
+                rep.verdicts.append(Verdict(
+                    key=key, status="new", round=latest.index,
+                    value=latest_vals[key],
+                    note="first round of lineage %r carrying this key"
+                         % lineage))
+                continue
+            new_v, old_v = latest_vals[key], prev_vals[key]
+            band = max(band_floor,
+                       latest.spread.get(key, 0.0),
+                       prev.spread.get(key, 0.0))
+            higher_better = key in HIGHER_BETTER
+            denom = max(abs(old_v), 1e-12)
+            rel = (new_v - old_v) / denom
+            better = rel if higher_better else -rel
+            status = ("REGRESSED" if better < -band
+                      else "improved" if better > band else "ok")
+            rep.verdicts.append(Verdict(
+                key=key, status=status, round=latest.index,
+                prev_round=prev.index, value=new_v, prev_value=old_v,
+                change=round(better, 4), band=round(band, 4)))
+    return rep
+
+
+def _resolve_rounds(config: Optional[GraftlintConfig]):
+    config = config or load_config()
+    root = os.environ.get("LGBTPU_PERF_ROUNDS_DIR") or config.root
+    band = float(getattr(config, "perf_band", 0.15))
+    rounds, multichip, errors = discover_rounds(root)
+    return evaluate(rounds, band, multichip=multichip, errors=errors), root
+
+
+def run(config: Optional[GraftlintConfig] = None,
+        artifact=None) -> List[AuditResult]:
+    """Gate entry point (CLI ``--perf``): three AuditResults —
+    round schema health, the trajectory verdict, multichip health."""
+    rep = artifact if isinstance(artifact, PerfReport) \
+        else _resolve_rounds(config)[0]
+    telemetry.count(C_ROUNDS, len(rep.rounds), category="analysis")
+    out: List[AuditResult] = []
+
+    n_meta = sum(1 for r in rep.rounds if not r.legacy)
+    out.append(AuditResult(
+        name="perf_rounds",
+        ok=not rep.errors,
+        detail=("%d bench round(s) parsed (%d self-describing, %d "
+                "legacy), %d multichip"
+                % (len(rep.rounds), n_meta,
+                   len(rep.rounds) - n_meta, len(rep.multichip)))
+        if not rep.errors else "; ".join(rep.errors[:3]),
+        skipped=not rep.rounds and not rep.errors))
+
+    if not rep.rounds:
+        out.append(AuditResult(name="perf_trajectory", ok=True,
+                               detail="no bench rounds to judge",
+                               skipped=True))
+        return out
+
+    if rep.regressions:
+        telemetry.count(C_REGRESSED, len(rep.regressions),
+                        category="analysis")
+    if rep.missing_keys:
+        telemetry.count(C_MISSING, len(rep.missing_keys),
+                        category="analysis")
+    bad_bits = []
+    for v in rep.regressions:
+        bad_bits.append("%s r%02d %.4g -> r%02d %.4g (%.1f%% worse, "
+                        "band %.0f%%)"
+                        % (v.key, v.prev_round, v.prev_value, v.round,
+                           v.value, -100.0 * v.change, 100.0 * v.band))
+    for v in rep.verdicts:
+        # a headline key the lineage used to record but the LATEST
+        # round lacks usually means the phase crashed (bench.py catches
+        # per-phase failures and keeps going) — that must gate, not
+        # pass silently
+        if v.status == "missing":
+            bad_bits.append("%s vanished from r%02d (recorded in "
+                            "r%02d — did the phase crash?)"
+                            % (v.key, v.round, v.prev_round))
+    if rep.missing_keys:
+        bad_bits.append("trajectory keys never recorded in ANY round: "
+                        + ", ".join(rep.missing_keys)
+                        + " (record a bench round with the level path "
+                          "engaged)")
+    ok_detail = ("%d verdict(s) across %d lineage(s): %d improved, %d "
+                 "within band, %d new"
+                 % (len(rep.verdicts), len(rep.lineages),
+                    len(rep.improvements),
+                    sum(1 for v in rep.verdicts if v.status == "ok"),
+                    sum(1 for v in rep.verdicts if v.status == "new")))
+    out.append(AuditResult(
+        name="perf_trajectory",
+        ok=not bad_bits,
+        detail="; ".join(bad_bits[:4]) if bad_bits else ok_detail))
+
+    if rep.multichip:
+        latest = rep.multichip[-1]
+        mc_ok = bool(latest.get("ok")) and latest.get("rc", 1) == 0
+        out.append(AuditResult(
+            name="perf_multichip",
+            ok=mc_ok,
+            detail=("latest multichip round r%02d: %s devices, ok=%s"
+                    % (latest["index"], latest.get("n_devices", "?"),
+                       latest.get("ok")))))
+    return out
+
+
+def check_fixture(payload) -> List[str]:
+    """Uniform fixture hook: failures for a synthetic round series
+    (list of {index, parsed[, meta]} dicts [+ {'band': x} config])."""
+    band = 0.15
+    rounds: List[Round] = []
+    for item in payload:
+        if "band" in item and "parsed" not in item:
+            band = float(item["band"])
+            continue
+        rounds.append(validate_round(
+            {"parsed": item["parsed"], "meta": item.get("meta")},
+            "BENCH_r%02d.json" % item["index"], item["index"]))
+    rep = evaluate(rounds, band)
+    out = ["%s: r%02d %.4g -> r%02d %.4g beyond band"
+           % (v.key, v.prev_round, v.prev_value, v.round, v.value)
+           for v in rep.regressions]
+    out.extend("%s vanished from r%02d" % (v.key, v.round)
+               for v in rep.verdicts if v.status == "missing")
+    out.extend("missing: %s" % k for k in rep.missing_keys)
+    return out
+
+
+def _load_phase_snaps(root: str) -> Tuple[Optional[dict], Optional[str]]:
+    """The newest archived bench phase snapshot next to the rounds
+    (shared discovery policy: telemetry/perfmodel.find_phase_snapshot)."""
+    from ..telemetry.perfmodel import find_phase_snapshot
+    path = find_phase_snapshot(root)
+    if path is None:
+        return None, None
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            snaps = json.load(f)
+        return (snaps if isinstance(snaps, dict) else None), path
+    except (OSError, ValueError):
+        return None, path
+
+
+def tables(config: Optional[GraftlintConfig] = None,
+           artifact=None) -> dict:
+    """The ``--json`` ``perf_tables`` payload: round summaries, per-key
+    trajectories, verdicts, multichip series, and the roofline cards
+    computed from the newest archived phase snapshot."""
+    config = config or load_config()
+    if isinstance(artifact, PerfReport):
+        rep = artifact
+        root = os.environ.get("LGBTPU_PERF_ROUNDS_DIR") or config.root
+    else:
+        rep, root = _resolve_rounds(config)
+    traj: Dict[str, List[dict]] = {}
+    for r in rep.rounds:
+        for k, v in _numeric_keys(r.parsed).items():
+            traj.setdefault(k, []).append(
+                {"round": r.index, "value": v,
+                 "lineage": r.fingerprint()})
+    payload = {
+        "rounds": [{"index": r.index, "path": os.path.basename(r.path),
+                    "legacy": r.legacy, "lineage": r.fingerprint(),
+                    "meta": r.meta}
+                   for r in rep.rounds],
+        "errors": rep.errors,
+        "lineages": rep.lineages,
+        "trajectories": traj,
+        "verdicts": [v.to_dict() for v in rep.verdicts],
+        "missing_keys": rep.missing_keys,
+        "multichip": [{"index": m["index"], "ok": m.get("ok"),
+                       "rc": m.get("rc"),
+                       "n_devices": m.get("n_devices")}
+                      for m in rep.multichip],
+    }
+    snaps, snap_path = _load_phase_snaps(root)
+    if snaps:
+        from ..telemetry import perfmodel
+        from ..telemetry.devices import get_profile
+        name = getattr(config, "audit_device", "v5e")
+        profile = None if name == "auto" else get_profile(name)
+        cards = []
+        for phase_key, shape_name in perfmodel.PHASE_SHAPES.items():
+            snap = snaps.get(phase_key)
+            if not isinstance(snap, dict):
+                continue
+            if isinstance(snap.get("perf_card"), dict):
+                # archived at record time, on the RECORDING device's
+                # profile — more honest than recomputing against the
+                # configured audit device
+                cards.append(snap["perf_card"])
+            else:
+                cards.append(perfmodel.report_card(
+                    snap, shape_name, profile=profile).to_dict())
+        payload["roofline"] = {"snapshot": os.path.basename(snap_path),
+                               "cards": cards}
+    else:
+        payload["roofline"] = {"snapshot": None, "cards": []}
+    return payload
+
+
+def render_report(rep: PerfReport) -> str:
+    """Human-readable sentinel report (CLI text mode)."""
+    lines = ["perf sentinel: %d round(s), %d lineage(s)"
+             % (len(rep.rounds), len(rep.lineages))]
+    for lineage, idxs in sorted(rep.lineages.items()):
+        lines.append("  lineage %-40s rounds %s"
+                     % (lineage[:40],
+                        ",".join("r%02d" % i for i in idxs)))
+    for v in rep.verdicts:
+        if v.status == "new":
+            lines.append("  %-32s r%02d %-10.4g NEW (%s)"
+                         % (v.key, v.round, v.value, v.note))
+        elif v.status == "missing":
+            lines.append("  %-32s r%02d MISSING (%s)"
+                         % (v.key, v.round, v.note))
+        else:
+            lines.append("  %-32s r%02d %-10.4g -> r%02d %-10.4g "
+                         "%+6.1f%% (band %.0f%%) %s"
+                         % (v.key, v.prev_round, v.prev_value, v.round,
+                            v.value, 100.0 * v.change, 100.0 * v.band,
+                            v.status))
+    for k in rep.missing_keys:
+        lines.append("  !! %s never recorded in any round (stale "
+                     "trajectory)" % k)
+    for e in rep.errors:
+        lines.append("  !! %s" % e)
+    return "\n".join(lines)

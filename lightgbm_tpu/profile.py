@@ -8,6 +8,13 @@ dev script is now a thin wrapper over this entry point.
 Usage: python -m lightgbm_tpu.profile [--shape NAME] [rows] [iters]
                                       [key=value ...]
        python -m lightgbm_tpu.profile --merge DIR [--out PATH] [--json]
+       python -m lightgbm_tpu.profile --perf-card SHAPE [PATH] [--json]
+
+``--perf-card SHAPE [PATH]`` does no training either: it prints the
+roofline report card (achieved-fraction-of-peak + bound category,
+:mod:`lightgbm_tpu.telemetry.perfmodel`) for one bench shape from an
+EXISTING phase-snapshot file or directory (``BENCH_r*_phases.json`` /
+``BENCH_phases.json`` / a ``phases_out=`` snapshot from this CLI).
 
 ``--merge DIR`` does no training: it merges the rank-suffixed Chrome
 traces a multihost run left in DIR (``telemetry_out=`` writes
@@ -68,22 +75,71 @@ def _make_shape(shape: str, rows: int):
                      "yahoo|msltr)" % shape)
 
 
-def _phase_stats(events):
-    from lightgbm_tpu.telemetry import histo
-    return {
-        "categories": {k: round(v, 3)
-                       for k, v in events.category_totals().items()},
-        "scopes": {name: {"seconds": round(sec, 3), "count": n,
-                          "category": cat}
-                   for name, (sec, n, cat)
-                   in events.snapshot_full().items()},
-        "counters": {k: v for k, v in events.counts_snapshot().items()},
-        "histograms": {k: h.to_dict(with_buckets=False)
-                       for k, h in histo.histograms_snapshot().items()},
-        # silent truncation is a lie in a snapshot: say what was dropped
-        "dropped_events": events.dropped_events(),
-        "histo_saturation": histo.saturation_total(),
-    }
+def _phase_stats(events, work=None):
+    """Shared snapshot layout + roofline-card stamping; the path
+    counters ride along so fast-path engagement stays visible."""
+    from lightgbm_tpu.telemetry import perfmodel
+    return perfmodel.phase_snapshot(work=work, include_counters=True)
+
+
+def _main_perf_card(argv) -> int:
+    """--perf-card SHAPE [PATH] [--json]: the roofline report card for
+    one bench shape from an EXISTING phase-snapshot file (or a directory
+    holding one) — no training, no re-run, no accelerator needed. PATH
+    defaults to ./BENCH_phases.json; a directory picks the newest
+    ``BENCH_r*_phases.json`` (falling back to ``BENCH_phases.json``).
+    The device profile comes from the attached accelerator or the
+    ``LGBTPU_DEVICE_PROFILE`` override (telemetry/devices.py)."""
+    import os
+
+    from lightgbm_tpu.telemetry import perfmodel
+    i = argv.index("--perf-card")
+    if i + 1 >= len(argv) or argv[i + 1].startswith("-"):
+        print("--perf-card needs a shape (higgs|expo|allstate|yahoo|"
+              "msltr)", file=sys.stderr)
+        return 2
+    shape = argv[i + 1].lower()
+    rest = [a for a in argv[i + 2:] if not a.startswith("-")]
+    path = rest[0] if rest else "."
+    if os.path.isdir(path):
+        found = perfmodel.find_phase_snapshot(path)
+        if found is None:
+            print("no BENCH_r*_phases.json / BENCH_phases.json in %s"
+                  % path, file=sys.stderr)
+            return 2
+        path = found
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            snaps = json.load(f)
+    except (OSError, ValueError) as exc:
+        print("cannot read phase snapshot %s: %s" % (path, exc),
+              file=sys.stderr)
+        return 2
+    if not isinstance(snaps, dict):
+        print("phase snapshot %s is not a JSON object (got %s)"
+              % (path, type(snaps).__name__), file=sys.stderr)
+        return 2
+    # the snapshot is keyed by bench phase name; find the one that maps
+    # to the requested shape (bench: higgs/ltr/expo/... ; profile CLI:
+    # the shape name itself)
+    snap = None
+    for phase_key, shape_name in perfmodel.PHASE_SHAPES.items():
+        if shape_name == shape and isinstance(snaps.get(phase_key),
+                                              dict):
+            snap = snaps[phase_key]
+            break
+    if snap is None:
+        print("no phase in %s maps to shape %r (have: %s)"
+              % (path, shape, ", ".join(sorted(snaps))),
+              file=sys.stderr)
+        return 2
+    card = perfmodel.report_card(snap, shape)
+    if "--json" in argv:
+        print(json.dumps(card.to_dict(), sort_keys=True))
+    else:
+        print(perfmodel.render_cards([card]))
+        print("  (snapshot: %s)" % path)
+    return 0
 
 
 def _main_merge(argv) -> int:
@@ -130,6 +186,8 @@ def main(argv=None) -> int:
         return 0
     if "--merge" in argv:
         return _main_merge(argv)
+    if "--perf-card" in argv:
+        return _main_perf_card(argv)
     shape = "higgs"
     if "--shape" in argv:
         i = argv.index("--shape")
@@ -196,9 +254,15 @@ def main(argv=None) -> int:
         # the bench's BENCH_phases.json layout, keyed by shape, plus the
         # path counters (persist_scan_trees vs v1_grow_trees) so fast-path
         # engagement is visible next to the attribution
+        try:
+            nl = int(params.get("num_leaves", 255))
+        except (TypeError, ValueError):
+            nl = 255
         with open(phases_out, "w") as f:
-            json.dump({shape: _phase_stats(events)}, f, indent=1,
-                      sort_keys=True)
+            json.dump({shape: _phase_stats(
+                events, work={"phase": shape, "rows": n_rows,
+                              "iters": iters, "num_leaves": nl})},
+                f, indent=1, sort_keys=True)
         print("telemetry phase snapshot written to %s" % phases_out,
               file=sys.stderr)
 

@@ -33,13 +33,20 @@ DEFAULT_VMEM_LIMIT = 16 * MIB
 
 @dataclass(frozen=True)
 class DeviceProfile:
-    """Per-core VMEM + per-chip HBM capacities and audit budgets."""
+    """Per-core VMEM + per-chip HBM capacities, audit budgets, and the
+    roofline peaks (:mod:`perfmodel` divides measured rates by these)."""
 
     name: str
     vmem_bytes: int            # VMEM per core
     hbm_bytes: int             # HBM per chip
     vmem_headroom: float = 0.9  # fraction a kernel may claim
     hbm_headroom: float = 0.9   # fraction resident planes may claim
+    # roofline peaks (datasheet numbers, per chip). peak_flops is the
+    # dense bf16 MXU rate; the f32 paths the histogram/scan kernels run
+    # land near half of it, which perfmodel accounts for itself.
+    peak_flops: float = 0.0        # bf16 FLOP/s per chip
+    hbm_bw_bytes: float = 0.0      # HBM bytes/s per chip
+    ici_bw_bytes: float = 0.0      # interconnect bytes/s per chip
 
     @property
     def vmem_budget(self) -> int:
@@ -53,16 +60,31 @@ class DeviceProfile:
         return {"name": self.name, "vmem_bytes": self.vmem_bytes,
                 "hbm_bytes": self.hbm_bytes,
                 "vmem_budget": self.vmem_budget,
-                "hbm_budget": self.hbm_budget}
+                "hbm_budget": self.hbm_budget,
+                "peak_flops": self.peak_flops,
+                "hbm_bw_bytes": self.hbm_bw_bytes,
+                "ici_bw_bytes": self.ici_bw_bytes}
 
 
 DEVICE_PROFILES: Dict[str, DeviceProfile] = {
     # the tuning target: every kernel vmem_limit comment assumes v5e
-    "v5e": DeviceProfile("v5e", vmem_bytes=128 * MIB, hbm_bytes=16 * GIB),
-    "v5p": DeviceProfile("v5p", vmem_bytes=128 * MIB, hbm_bytes=95 * GIB),
+    "v5e": DeviceProfile("v5e", vmem_bytes=128 * MIB, hbm_bytes=16 * GIB,
+                         peak_flops=197e12, hbm_bw_bytes=819e9,
+                         ici_bw_bytes=200e9),
+    "v5p": DeviceProfile("v5p", vmem_bytes=128 * MIB, hbm_bytes=95 * GIB,
+                         peak_flops=459e12, hbm_bw_bytes=2765e9,
+                         ici_bw_bytes=600e9),
     # older generation: much smaller scoped VMEM — kernels that size
     # their limit near 100MB do NOT fit; the audit reports it per profile
-    "v4": DeviceProfile("v4", vmem_bytes=32 * MIB, hbm_bytes=32 * GIB),
+    "v4": DeviceProfile("v4", vmem_bytes=32 * MIB, hbm_bytes=32 * GIB,
+                        peak_flops=275e12, hbm_bw_bytes=1228e9,
+                        ici_bw_bytes=300e9),
+    # host fallback: rounds recorded on CPU boxes (no accelerator) still
+    # get a roofline verdict — a generous desktop-class envelope so the
+    # bound CLASSIFICATION is meaningful even if the fraction is coarse
+    "cpu": DeviceProfile("cpu", vmem_bytes=16 * MIB, hbm_bytes=16 * GIB,
+                         peak_flops=1e12, hbm_bw_bytes=50e9,
+                         ici_bw_bytes=10e9),
 }
 
 DEFAULT_PROFILE = "v5e"

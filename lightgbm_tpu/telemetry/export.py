@@ -151,8 +151,12 @@ def maybe_export(out: Optional[str] = None):
     return trace_path, metrics_path
 
 
-def format_report(snap=None) -> str:
-    """Sorted-by-time table, like Timer::Print (common.h:1059)."""
+def format_report(snap=None, perf_cards=None) -> str:
+    """Sorted-by-time table, like Timer::Print (common.h:1059).
+
+    ``perf_cards`` (a list of :class:`perfmodel.ShapeCard`) appends the
+    roofline "perf report card" table — callers that know the workload
+    geometry (bench, profile --perf-card) pass the cards they built."""
     if snap is None:
         snap = events.snapshot_full()
     lines = []
@@ -169,6 +173,11 @@ def format_report(snap=None) -> str:
                             100.0 * sec / max(total, 1e-12), cat))
         lines.append("  %-*s %10.3fs" % (width, "(sum)", total))
     lines.extend(histogram_report_lines())
+    if perf_cards:
+        from . import perfmodel
+        card_text = perfmodel.render_cards(perf_cards)
+        if card_text:
+            lines.append(card_text)
     # silent-truncation visibility: a trace that dropped events or a
     # histogram that saturated is an INCOMPLETE record, and the report
     # must say so rather than present clipped numbers as the whole story

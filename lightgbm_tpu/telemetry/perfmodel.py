@@ -1,0 +1,290 @@
+"""Roofline attribution: achieved-fraction-of-peak per bench shape.
+
+PR 9 made the stack *record* (streaming histograms at every hot seam,
+per-compiled-program wall, phase snapshots); this module *interprets*:
+given one bench phase's telemetry snapshot it answers the two questions
+every perf PR must answer before touching a kernel — "what fraction of
+the hardware peak did this shape achieve?" and "which resource binds:
+compute, HBM bandwidth, comms, or the host?". This is the
+continuous-roofline practice of "GPU-acceleration for Large-scale Tree
+Boosting" (PAPERS.md), where per-kernel achieved-vs-peak fractions
+drove the optimization order.
+
+Two halves, deliberately decoupled so tests can pin them:
+
+* :func:`work_model` — a pure, hand-computable analytic tally of the
+  HBM bytes and FLOPs one training phase moves (histogram builds with
+  the parent-minus-smaller halving, per-node plane write+scan), as a
+  function of the static bench geometry
+  (:mod:`lightgbm_tpu.analysis.resource_audit` ``BENCH_SHAPES``);
+* :func:`report_card` — combines that model with a MEASURED phase
+  snapshot (the ``BENCH_phases.json`` layout: category totals, scope
+  table, histograms — ``ops::persist_program_wall`` is the compiled-
+  program wall, ``collective::*::latency`` the DCN time) and the
+  :mod:`devices` peak specs into a :class:`ShapeCard`: the achieved
+  fraction of the binding resource's peak plus a bound category.
+
+Bound taxonomy::
+
+  comms    DCN collective time dominates the phase wall
+  host     most wall is OUTSIDE the compiled programs (python driver,
+           numpy objective, binning) — optimizing kernels won't help
+  hbm      the byte tally at peak HBM bandwidth exceeds the FLOP tally
+           at peak compute: the kernels stream memory
+  compute  the reverse: the kernels are ALU-bound
+
+Measurement caveat: ``ops::persist_program_wall`` records the HOST wall
+of each program call, so a fully async dispatch (device work consumed
+at a later sync point) undercounts program time and the card leans
+``host`` — which is still the actionable verdict (the wall is not being
+spent waiting on kernels). Rounds whose driver blocks per call (the
+lambdarank host-grad path, real-TPU sync points) measure true program
+time.
+
+The cards render as a "perf report card" table (``render_cards``, also
+appended to :func:`export.format_report` when cards are passed), ship
+in ``analysis --perf --json`` as ``perf_tables.roofline``, and are
+archived per phase into the bench phase snapshot under ``perf_card``.
+"""
+from __future__ import annotations
+
+import math
+import os
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from .devices import DeviceProfile, detect_profile
+
+# the f32/VPU paths the histogram + scan kernels actually run reach
+# about half the dense-bf16 MXU datasheet peak
+F32_DERATE = 0.5
+# bound-classification thresholds (fractions of the phase wall)
+COMMS_BOUND_FRAC = 0.4
+HOST_BOUND_FRAC = 0.5
+
+# phase-snapshot key -> (bench shape name, default iters) for the five
+# bench shapes; bench.py stamps the real rows/iters into
+# snapshot["work"],
+# these defaults cover snapshots archived before that existed
+PHASE_SHAPES: Dict[str, str] = {
+    "higgs": "higgs", "ltr": "msltr", "expo": "expo",
+    "allstate": "allstate", "yahoo_ltr": "yahoo",
+    # the profile CLI keys its snapshot by the shape name itself
+    "msltr": "msltr", "yahoo": "yahoo",
+}
+DEFAULT_ITERS: Dict[str, int] = {
+    "higgs": 500, "msltr": 160, "expo": 96, "allstate": 64, "yahoo": 120,
+}
+
+PROGRAM_WALL_HISTO = "ops::persist_program_wall"
+
+
+@dataclass
+class ShapeCard:
+    """One bench shape's roofline verdict."""
+
+    shape: str
+    profile: str
+    rows: int
+    iters: int
+    wall_s: float              # whole-phase host wall (category sum)
+    program_s: float           # wall inside compiled programs
+    comms_s: float             # wall inside DCN collectives
+    model_bytes: float         # analytic HBM traffic of the phase
+    model_flops: float         # analytic FLOP tally of the phase
+    t_hbm: float               # model_bytes at peak HBM bandwidth
+    t_compute: float           # model_flops at derated peak compute
+    achieved_frac: float       # binding-resource model time / wall
+    bound: str                 # compute | hbm | comms | host
+
+    def to_dict(self) -> dict:
+        return {"shape": self.shape, "profile": self.profile,
+                "rows": self.rows, "iters": self.iters,
+                "wall_s": round(self.wall_s, 3),
+                "program_s": round(self.program_s, 3),
+                "comms_s": round(self.comms_s, 3),
+                "model_bytes": self.model_bytes,
+                "model_flops": self.model_flops,
+                "t_hbm": round(self.t_hbm, 4),
+                "t_compute": round(self.t_compute, 4),
+                "achieved_frac": round(self.achieved_frac, 4),
+                "bound": self.bound}
+
+
+def work_model(rows: int, groups: int, features: int, iters: int,
+               num_leaves: int = 255,
+               depth: Optional[int] = None) -> Dict[str, float]:
+    """Analytic HBM-byte + FLOP tally for `iters` boosting iterations.
+
+    Hand-computable on paper (the roofline tests pin exactly that):
+
+    * each tree scans the root over all ``rows``, then — with the
+      parent-minus-smaller halving — each deeper level touches ~half
+      the rows again: ``rows_scanned = rows * (1 + (depth-1)/2)``;
+    * a scanned row streams its binned groups (1 byte each) plus the
+      f32 grad/hess pair (8 bytes) and costs 2 FLOPs per group
+      (unpack-accumulate into the histogram planes);
+    * every grown node writes its ``groups * 256``-bin (grad, hess)
+      f32 plane once and the split scan reads it back
+      (``2 * num_leaves - 1`` nodes/tree), at ~8 FLOPs per
+      (node, feature, bin) for the prefix-scan + gain evaluation.
+    """
+    if depth is None:
+        depth = max(1, int(math.ceil(math.log2(max(num_leaves, 2)))))
+    nodes = 2 * num_leaves - 1
+    rows_scanned = rows * (1.0 + 0.5 * (depth - 1))
+    hist_bytes = rows_scanned * (groups + 8)
+    plane_bytes = nodes * groups * 256 * 2 * 4 * 2
+    flops = rows_scanned * groups * 2 + nodes * features * 256 * 8
+    return {"bytes": float(iters) * (hist_bytes + plane_bytes),
+            "flops": float(iters) * flops,
+            "rows_scanned": rows_scanned, "depth": depth, "nodes": nodes}
+
+
+def _measured(snapshot: dict):
+    """(wall_s, program_s, comms_s) from a phase-snapshot dict."""
+    cats = snapshot.get("categories") or {}
+    wall = float(sum(cats.values()))
+    histos = snapshot.get("histograms") or {}
+    pw = histos.get(PROGRAM_WALL_HISTO)
+    if pw and pw.get("count"):
+        program = float(pw.get("total", 0.0))
+    else:
+        # v1/fallback paths record no per-program histogram: the "ops"
+        # category self-time is the closest compiled-program proxy
+        program = float(cats.get("ops", 0.0))
+    comms = 0.0
+    for name, h in histos.items():
+        if name.startswith("collective::") and name.endswith("::latency"):
+            comms += float(h.get("total", 0.0))
+    if not comms:
+        comms = float(cats.get("collective", 0.0))
+    return wall, program, comms
+
+
+def report_card(snapshot: dict, shape_name: str,
+                profile: Optional[DeviceProfile] = None,
+                rows: Optional[int] = None,
+                iters: Optional[int] = None,
+                num_leaves: int = 255) -> ShapeCard:
+    """The roofline verdict for one phase snapshot (pure function of
+    its inputs — synthetic snapshots pin the math in tier-1)."""
+    from ..analysis.resource_audit import BENCH_SHAPES
+    shape = BENCH_SHAPES[shape_name]
+    work = snapshot.get("work") or {}
+    rows = int(rows if rows is not None else work.get("rows", shape.rows))
+    iters = int(iters if iters is not None
+                else work.get("iters", DEFAULT_ITERS.get(shape_name, 100)))
+    num_leaves = int(work.get("num_leaves", num_leaves))
+    profile = profile or detect_profile()
+    model = work_model(rows, shape.groups, shape.features, iters,
+                       num_leaves=num_leaves)
+    wall, program, comms = _measured(snapshot)
+    t_hbm = model["bytes"] / max(profile.hbm_bw_bytes, 1.0)
+    t_compute = model["flops"] / max(profile.peak_flops * F32_DERATE, 1.0)
+    device_model_s = max(t_hbm, t_compute)
+    # fraction of peak INSIDE the compiled programs; when nearly no wall
+    # was spent there (host-bound runs), a noise-level program_s would
+    # make the division meaningless — fall back to the phase wall
+    denom = program if program > 0.05 * wall else wall
+    frac = device_model_s / denom if denom > 0.0 else 0.0
+    if wall > 0.0 and comms > COMMS_BOUND_FRAC * wall:
+        bound = "comms"
+    elif wall > 0.0 and program < HOST_BOUND_FRAC * wall:
+        bound = "host"
+    else:
+        bound = "hbm" if t_hbm >= t_compute else "compute"
+    return ShapeCard(shape=shape_name, profile=profile.name, rows=rows,
+                     iters=iters, wall_s=wall, program_s=program,
+                     comms_s=comms, model_bytes=model["bytes"],
+                     model_flops=model["flops"], t_hbm=t_hbm,
+                     t_compute=t_compute, achieved_frac=frac, bound=bound)
+
+
+def find_phase_snapshot(root: str) -> Optional[str]:
+    """The newest archived bench phase snapshot in `root`:
+    ``BENCH_r<NN>_phases.json`` with the highest round number, falling
+    back to plain ``BENCH_phases.json``. The ONE archive-layout policy
+    both ``profile --perf-card`` and ``analysis --perf`` read through
+    (numeric sort — r100 beats r99, which lexicographic glob order
+    would not)."""
+    import glob
+    import re
+    best: Optional[str] = None
+    best_n = -1
+    for path in glob.glob(os.path.join(root, "BENCH_r*_phases.json")):
+        m = re.search(r"BENCH_r(\d+)_phases\.json$",
+                      os.path.basename(path))
+        if m and int(m.group(1)) > best_n:
+            best, best_n = path, int(m.group(1))
+    if best is not None:
+        return best
+    plain = os.path.join(root, "BENCH_phases.json")
+    return plain if os.path.isfile(plain) else None
+
+
+def phase_snapshot(work: Optional[dict] = None,
+                   include_counters: bool = False) -> dict:
+    """One phase's telemetry snapshot in the BENCH_phases.json layout
+    (category totals, per-scope table, histograms, truncation signals)
+    — the ONE definition bench.py and the profile CLI both archive.
+
+    ``work`` stamps the phase's actual geometry ({"phase", "rows",
+    "iters"[, "num_leaves"]}) so downstream readers (:func:`report_card`,
+    ``profile --perf-card``) need no guessing; when the phase maps to a
+    bench shape the roofline card is archived right next to the
+    measurements."""
+    from . import events, histo
+    d = {
+        "categories": {k: round(v, 3)
+                       for k, v in events.category_totals().items()},
+        "scopes": {name: {"seconds": round(sec, 3), "count": n,
+                          "category": cat}
+                   for name, (sec, n, cat)
+                   in events.snapshot_full().items()},
+        "histograms": {k: h.to_dict(with_buckets=False)
+                       for k, h in histo.histograms_snapshot().items()},
+        # silent truncation is a lie in a snapshot: say what was dropped
+        "dropped_events": events.dropped_events(),
+        "histo_saturation": histo.saturation_total(),
+    }
+    if include_counters:
+        d["counters"] = dict(events.counts_snapshot())
+    if work:
+        d["work"] = dict(work)
+        shape_name = PHASE_SHAPES.get(work.get("phase", ""))
+        if shape_name:
+            d["perf_card"] = report_card(d, shape_name).to_dict()
+    return d
+
+
+def cards_from_phases(phase_snaps: dict,
+                      profile: Optional[DeviceProfile] = None
+                      ) -> List[ShapeCard]:
+    """Report cards for every phase-snapshot key that maps to one of
+    the five bench shapes (the BENCH_phases.json layout)."""
+    profile = profile or detect_profile()
+    cards: List[ShapeCard] = []
+    for phase_key, shape_name in PHASE_SHAPES.items():
+        snap = phase_snaps.get(phase_key)
+        if isinstance(snap, dict):
+            cards.append(report_card(snap, shape_name, profile=profile))
+    return cards
+
+
+def render_cards(cards: List[ShapeCard]) -> str:
+    """The "perf report card" table (text CLI + format_report)."""
+    if not cards:
+        return ""
+    lines = ["[LightGBM-TPU] [Info] perf report card (roofline: "
+             "achieved fraction of %s peak; bound = binding resource)"
+             % (cards[0].profile if cards else "?")]
+    lines.append("  %-10s %10s %9s %9s %9s %9s %8s  %s"
+                 % ("shape", "wall(s)", "prog(s)", "comms(s)",
+                    "t_hbm(s)", "t_comp(s)", "of-peak", "bound"))
+    for c in cards:
+        lines.append("  %-10s %10.3f %9.3f %9.3f %9.3f %9.3f %7.1f%%  %s"
+                     % (c.shape, c.wall_s, c.program_s, c.comms_s,
+                        c.t_hbm, c.t_compute,
+                        100.0 * c.achieved_frac, c.bound))
+    return "\n".join(lines)

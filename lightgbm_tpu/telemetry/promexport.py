@@ -18,6 +18,16 @@ Exposition (one metric family per registry table, names prefixed
   * ``lgbtpu_histo{name, quantile}`` + ``_count``/``_sum`` — summary
     form of each streaming histogram (quantiles are pre-computed; the
     log-bucket layout is internal);
+  * ``lgbtpu_histo_dist_bucket{name, le}`` + ``_count``/``_sum`` — the
+    SAME histograms in native cumulative-bucket form, because summary
+    quantiles can be neither ``rate()``d nor aggregated across ranks:
+    ``histogram_quantile(0.99, sum(rate(
+    lgbtpu_histo_dist_bucket[5m])) by (le))`` works, as do average
+    queries over ``_sum``/``_count``. The fine log layout is coarsened
+    onto a fixed ladder of edges (every ``BUCKET_STRIDE``-th layout
+    edge — a function of the layout, never the data) so every rank
+    emits the IDENTICAL le set, the precondition for summing classic
+    histograms;
   * ``lgbtpu_histo_saturated_total`` {name} — samples outside the bucket
     range (the silent-truncation signal);
   * ``lgbtpu_dropped_events`` — trace-buffer drops.
@@ -35,6 +45,11 @@ from . import events, histo
 
 MIN_FLUSH_INTERVAL_S = 5.0
 _last_flush = 0.0
+# _dist bucket ladder: one cumulative le line per this many fine log
+# buckets — a fixed function of the histogram LAYOUT (not the data), so
+# every rank exposes the identical le set and sum() by (le) stays a
+# valid histogram. growth 1.05^15 ≈ 2.08x spacing between edges.
+BUCKET_STRIDE = 15
 
 
 def _esc(label: str) -> str:
@@ -59,8 +74,10 @@ def render() -> str:
                      % (_esc(name), v))
 
     lines.append("# TYPE lgbtpu_histo summary")
+    lines.append("# TYPE lgbtpu_histo_dist histogram")
     lines.append("# TYPE lgbtpu_histo_saturated_total counter")
-    for name, h in sorted(histo.histograms_snapshot().items()):
+    snap = histo.histograms_snapshot()
+    for name, h in sorted(snap.items()):
         nm = _esc(name)
         for q in (0.5, 0.95, 0.99, 0.999):
             v = h.percentile(q)
@@ -70,6 +87,34 @@ def render() -> str:
         lines.append('lgbtpu_histo_count{name="%s"} %d' % (nm, h.count))
         lines.append('lgbtpu_histo_saturated_total{name="%s"} %d'
                      % (nm, h.saturated))
+    # native-histogram form of the SAME data: pre-computed quantile
+    # gauges cannot be rate()d or aggregated across ranks, cumulative
+    # le-buckets can (histogram_quantile over sum(rate(_bucket)) by le).
+    # Classic Prometheus histograms require IDENTICAL bucket sets on
+    # every series being summed, so the ~850 fine log buckets are
+    # coarsened onto a FIXED ladder: every BUCKET_STRIDE-th layout edge
+    # (a pure function of lo/growth, never of the data — all ranks
+    # emit the same le set). Cumulative counts at the emitted edges
+    # stay exact; quantile interpolation error is bounded by the
+    # ladder spacing (growth^stride ≈ 2x). Underflow (v < 0) counts
+    # below every edge; overflow only in the mandatory +Inf == _count.
+    for name, h in sorted(snap.items()):
+        nm = _esc(name)
+        cum = h.underflow
+        next_edge = BUCKET_STRIDE
+        for i, c in enumerate(h.buckets):
+            cum += c
+            if i + 1 == next_edge:
+                le = h.lo * h.growth ** (i + 1)
+                lines.append('lgbtpu_histo_dist_bucket'
+                             '{name="%s",le="%.9g"} %d' % (nm, le, cum))
+                next_edge += BUCKET_STRIDE
+        lines.append('lgbtpu_histo_dist_bucket{name="%s",le="+Inf"} %d'
+                     % (nm, h.count))
+        lines.append('lgbtpu_histo_dist_sum{name="%s"} %.9g'
+                     % (nm, h.total))
+        lines.append('lgbtpu_histo_dist_count{name="%s"} %d'
+                     % (nm, h.count))
 
     lines.append("# TYPE lgbtpu_dropped_events counter")
     lines.append("lgbtpu_dropped_events %d" % events.dropped_events())

@@ -417,6 +417,35 @@ def test_prom_snapshot_written_and_parseable(tmp_path):
         in text
     assert "lgbtpu_histo_count" in text and "lgbtpu_dropped_events" \
         in text
+    # native-histogram form: cumulative le-buckets (rate()/average
+    # queries + cross-rank histogram_quantile need these, the summary
+    # quantile gauges cannot provide them)
+    assert "# TYPE lgbtpu_histo_dist histogram" in text
+    histo.observe("other::latency", 3.5)   # very different value range
+
+    def _les(name):
+        pre = 'lgbtpu_histo_dist_bucket{name="%s"' % name
+        return [ln.split('le="')[1].split('"')[0]
+                for ln in promexport.render().splitlines()
+                if ln.startswith(pre)]
+    bucket_lines = [ln for ln in text.splitlines()
+                    if ln.startswith('lgbtpu_histo_dist_bucket'
+                                     '{name="predict::e2e_latency"')]
+    assert bucket_lines, "per-histogram _bucket lines missing"
+    counts = [int(ln.rsplit(" ", 1)[1]) for ln in bucket_lines]
+    assert counts == sorted(counts), "cumulative buckets must be " \
+        "monotone"
+    assert bucket_lines[-1].rsplit(" ", 1) == [
+        'lgbtpu_histo_dist_bucket{name="predict::e2e_latency",'
+        'le="+Inf"}', "1"]
+    assert 'lgbtpu_histo_dist_count{name="predict::e2e_latency"} 1' \
+        in text
+    assert 'lgbtpu_histo_dist_sum{name="predict::e2e_latency"}' in text
+    # the le ladder is a function of the LAYOUT, not the data — every
+    # histogram (and so every rank) exposes the identical edge set,
+    # the precondition for sum(rate(_bucket)) by (le) aggregation
+    assert _les("predict::e2e_latency") == _les("other::latency")
+    assert len(_les("other::latency")) > 10
     # every sample line is NAME{labels} VALUE with a float-parseable value
     for line in text.splitlines():
         if line.startswith("#") or not line:
