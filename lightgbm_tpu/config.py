@@ -232,6 +232,9 @@ PARAMS: List[_P] = [
     _P("tpu_collective_timeout", float, 300.0, lo=0.0),  # DCN host-
     _P("tpu_collective_retries", int, 2, lo=0),          # collective guard
     _P("tpu_collective_backoff", float, 0.25, lo=0.0),   # (resilience/retry)
+    _P("tpu_collective_soft_timeout", float, 0.0, lo=0.0),  # straggler
+    #                                        # watchdog soft deadline
+    #                                        # (0 = auto: timeout / 4)
 ]
 
 _BY_NAME: Dict[str, _P] = {p.name: p for p in PARAMS}

@@ -281,7 +281,9 @@ def _train_distributed(params, train_set, num_boost_round, valid_sets,
                                 "DataFrame; pass column indices with "
                                 "num_machines > 1")
         cat_idx = sorted(set(int(c) for c in cat) | set(cat_idx))
-    world = int(cfg.num_machines)
+    # world=1 is a legal mesh here: the small end of an elastic resume
+    # (engine.train routes a matching single-host run into this driver)
+    world = max(int(cfg.num_machines), 1)
     if grp is not None:
         # ranking: shard whole queries, never splitting one across ranks
         from .parallel.multihost import shard_queries
@@ -327,24 +329,51 @@ def _train_distributed(params, train_set, num_boost_round, valid_sets,
     # resume re-enters the init-model machinery below, so every rank's
     # score shard is reconstructed from the checkpointed model's raw
     # predictions rather than recomputed from scratch
+    from .resilience import reshard as resilience_reshard
     from .resilience import restore as resilience_restore
     from .resilience.checkpoint import (CheckpointWriter, array_fingerprint,
                                         config_hash)
     y_local = None if y is None else y[idx]
+    # the dataset-GLOBAL fingerprint (pre-shard rows): the identity that
+    # survives a mesh resize, unlike the shard-local one below
+    global_fp = array_fingerprint(X, y)
     resume_iter = 0
     ck_text = None
     es_resume = None
     ck_orig_init = None
+    resume_man = None
     if str(cfg.checkpoint_dir):
-        found = resilience_restore.find_distributed(cfg, rank, X[idx],
-                                                    y_local)
-        if found is not None:
-            resume_iter, ck_text, ck_meta = found
-            es_resume = ck_meta.get("early_stopping")
-            # iterations of the ORIGINAL init model (if any) embedded in
-            # the checkpoint — propagated across resume chains so the
-            # round-space <-> tree-list accounting stays right
-            ck_orig_init = int(ck_meta.get("n_init", 0))
+        man = resilience_reshard.load_manifest(str(cfg.checkpoint_dir))
+        if resilience_reshard.manifest_matches(man, config_hash(cfg),
+                                               global_fp):
+            # a matching manifest pins this run's binning for EVERY
+            # generation: once a run has hopped meshes, even a same-mesh
+            # resume must keep the SOURCE bin boundaries — re-deriving
+            # them from this mesh's local samples would silently break
+            # the bit-exact continuation
+            resume_man = man
+        if resume_man is not None and int(man.get("world", 1)) != world:
+            # this run's snapshots, written by a DIFFERENT mesh size:
+            # elastic resume (agreement on iteration + source layout)
+            found = resilience_reshard.find_elastic(cfg, rank, world,
+                                                    global_fp)
+            if found is not None:
+                resume_iter, ck_text, ck_meta, _man = found
+                es_resume = ck_meta.get("early_stopping")
+                ck_orig_init = int(ck_meta.get("n_init", 0))
+                from .telemetry import events as telemetry_events
+                telemetry_events.count("resilience::reshard_rows",
+                                       len(idx), category="resilience")
+        else:
+            found = resilience_restore.find_distributed(
+                cfg, rank, X[idx], y_local, global_fp=global_fp)
+            if found is not None:
+                resume_iter, ck_text, ck_meta = found
+                es_resume = ck_meta.get("early_stopping")
+                # iterations of the ORIGINAL init model (if any) embedded
+                # in the checkpoint — propagated across resume chains so
+                # the round-space <-> tree-list accounting stays right
+                ck_orig_init = int(ck_meta.get("n_init", 0))
     model_str = _load_init_model(init_model)
     if ck_text is not None:
         if model_str is not None:
@@ -385,7 +414,12 @@ def _train_distributed(params, train_set, num_boost_round, valid_sets,
         writer = CheckpointWriter(
             str(cfg.checkpoint_dir), keep=int(cfg.checkpoint_keep),
             cfg_hash=config_hash(cfg), rank=rank,
-            fingerprint=array_fingerprint(X[idx], y_local))
+            fingerprint=array_fingerprint(X[idx], y_local),
+            global_fingerprint=global_fp, world=world)
+        assignment = ("pre_partition" if bool(cfg.pre_partition)
+                      else "query_blocks" if grp is not None
+                      else "round_robin")
+        manifest_state = {"written": False}
 
         def snapshot_hook(it_done, new_trees, ds_, es_state=None):
             # every rank holds the identical trees; each writes its own
@@ -400,6 +434,21 @@ def _train_distributed(params, train_set, num_boost_round, valid_sets,
                     _stump(ds_), init_models + list(new_trees),
                     num_init_iteration=n_init),
                 it_done, extra_meta=extra)
+            # the mesh-layout manifest rides beside the shards (once):
+            # world size, row assignment, the global fingerprint, and
+            # the global BinMappers — everything a DIFFERENT mesh size
+            # needs to resume this run bit-exactly. Written AFTER the
+            # first snapshot of this generation: a manifest must never
+            # describe a world no snapshot in the directory has yet (a
+            # crash in that window would brick the next resume)
+            if not manifest_state["written"]:
+                resilience_reshard.ensure_manifest(
+                    writer.directory,
+                    resilience_reshard.build_manifest(
+                        config_hash(cfg), global_fp, world, len(X),
+                        ds_.bin_mappers, assignment=assignment,
+                        group_sizes=grp))
+                manifest_state["written"] = True
     result_info = {}
     trees, _mappers, ds, _score = train_multihost(
         cfg, X[idx], y_local,
@@ -410,7 +459,9 @@ def _train_distributed(params, train_set, num_boost_round, valid_sets,
         group_local=glocal, group_valid=gvalid,
         init_score_local=isc_local, init_score_valid=isc_valid,
         start_iteration=resume_iter, snapshot_hook=snapshot_hook,
-        es_resume=es_resume, result_info=result_info)
+        es_resume=es_resume, result_info=result_info,
+        mappers_override=(resilience_reshard.manifest_mappers(resume_man)
+                          if resume_man is not None else None))
     models_all = init_models + trees
     best_iter = result_info.get("early_stop_best_iter")
     if best_iter is not None:
@@ -463,7 +514,46 @@ def train(params: Dict[str, Any], train_set: Dataset,
     # land next to the checkpoints (telemetry/flight.py)
     from .telemetry import flight as telemetry_flight
     telemetry_flight.configure_from_config(cfg0)
-    if int(cfg0.num_machines) > 1:
+    # elastic resume onto world=1: a single-host run whose checkpoint_dir
+    # holds a MATCHING multi-host run (mesh manifest: same config hash +
+    # dataset-global fingerprint, world > 1) continues through the
+    # distributed driver — the same sharded grower / stateless-hash
+    # bagging the source mesh used, which is what keeps the resumed
+    # model bit-exact (resilience/reshard.py)
+    elastic_world = None
+    if int(cfg0.num_machines) <= 1 and str(cfg0.checkpoint_dir):
+        from .resilience import reshard as resilience_reshard
+        from .resilience.checkpoint import array_fingerprint, config_hash
+        _man = resilience_reshard.load_manifest(str(cfg0.checkpoint_dir))
+        if (_man is not None and int(_man.get("world", 1)) > 1
+                and resilience_reshard.manifest_matches(
+                    _man, config_hash(cfg0))):
+            try:
+                # fingerprint-only load; _train_distributed re-loads with
+                # the caller's categorical coercion (reusing this pass
+                # could change cat_idx) — the double load is confined to
+                # elastic-resume startup
+                _X0, _y0, _w0, _c0, _g0 = _distributed_raw(train_set, cfg0)
+                if resilience_reshard.manifest_matches(
+                        _man, config_hash(cfg0),
+                        array_fingerprint(_X0, _y0)):
+                    elastic_world = int(_man["world"])
+                else:
+                    Log.warning(
+                        "checkpoint_dir holds an elastic world=%d run of "
+                        "this config but a DIFFERENT dataset; staying on "
+                        "the single-host driver" % int(_man["world"]))
+            except LightGBMError:
+                # raw rows unavailable (freed / sparse input): the
+                # distributed driver could not train anyway
+                Log.warning("checkpoint_dir holds an elastic manifest but "
+                            "the raw rows are unavailable for resharding; "
+                            "staying on the single-host driver")
+    if int(cfg0.num_machines) > 1 or elastic_world is not None:
+        if elastic_world is not None:
+            Log.info("Elastic resume: continuing a world=%d run on "
+                     "world=1 through the distributed driver"
+                     % elastic_world)
         if evals_result is not None:
             # NOTE: no local Log import here — a function-local binding
             # would shadow the module-level Log for the whole function

@@ -107,6 +107,10 @@ def _default_allgather(payload: bytes) -> List[bytes]:
     resilience retry guard: a gone peer raises a bounded-retry
     LightGBMError instead of hanging the binning phase forever."""
     import jax
+    if jax.process_count() == 1:
+        # world=1 (the small end of an elastic resume): no peers, no
+        # distributed runtime — the gather of one is the local blob
+        return [payload]
     from jax.experimental import multihost_utils
 
     arr = np.frombuffer(payload, dtype=np.uint8)

@@ -71,7 +71,12 @@ __all__ = ["init_network", "shard_rows", "train_multihost"]
 def _pallgather(name: str, arr: np.ndarray) -> np.ndarray:
     """process_allgather under the resilience retry guard: DCN-side host
     collectives get a deadline + bounded retries instead of hanging
-    forever on a gone peer (resilience/retry.py)."""
+    forever on a gone peer (resilience/retry.py). Single-process runs
+    (the world=1 end of an elastic resume) short-circuit to the stacked
+    local value — there is no peer to gather from and no distributed
+    runtime to ask."""
+    if jax.process_count() == 1:
+        return np.asarray(arr)[None, ...]
     from jax.experimental import multihost_utils
     return resilience_retry.guard(name, multihost_utils.process_allgather,
                                   arr)
@@ -275,7 +280,8 @@ def train_multihost(config: Config, X_local: np.ndarray,
                     init_score_valid: Optional[np.ndarray] = None,
                     start_iteration: int = 0,
                     snapshot_hook=None,
-                    es_resume=None, result_info=None):
+                    es_resume=None, result_info=None,
+                    mappers_override=None):
     """Distributed training entry; returns the (identical-on-every-rank)
     list of host Trees plus the shared BinMappers for model IO.
 
@@ -314,24 +320,33 @@ def train_multihost(config: Config, X_local: np.ndarray,
     world = max(int(config.num_machines), 1)
 
     # ---- distributed binning -----------------------------------------
-    cnt = int(config.bin_construct_sample_cnt)
-    if sample_override is not None:
-        sample = sample_override
+    if mappers_override is not None:
+        # elastic resume: binning restored from the mesh manifest — the
+        # source run's bin boundaries, NOT boundaries re-derived from
+        # this (differently-sharded) mesh's local samples, keep the
+        # resumed model bit-exact (resilience/reshard.py)
+        mappers = list(mappers_override)
     else:
-        # random sample over the local rows (dataset_loader.cpp:762-823
-        # samples across the whole shard); taking the file head instead
-        # biases the bin boundaries on ordered (time/label-sorted) data
-        rng = np.random.default_rng(int(config.data_random_seed))
-        k = min(len(X_local), cnt)
-        if k < len(X_local):
-            idx = np.sort(rng.choice(len(X_local), size=k, replace=False))
-            sample = X_local[idx]
+        cnt = int(config.bin_construct_sample_cnt)
+        if sample_override is not None:
+            sample = sample_override
         else:
-            sample = X_local
-    mappers = distributed_bin_mappers(
-        np.ascontiguousarray(sample, np.float64), len(X_local), config,
-        categorical_features=categorical_features,
-        rank=rank, world=world)
+            # random sample over the local rows (dataset_loader.cpp:
+            # 762-823 samples across the whole shard); taking the file
+            # head instead biases the bin boundaries on ordered
+            # (time/label-sorted) data
+            rng = np.random.default_rng(int(config.data_random_seed))
+            k = min(len(X_local), cnt)
+            if k < len(X_local):
+                idx = np.sort(rng.choice(len(X_local), size=k,
+                                         replace=False))
+                sample = X_local[idx]
+            else:
+                sample = X_local
+        mappers = distributed_bin_mappers(
+            np.ascontiguousarray(sample, np.float64), len(X_local), config,
+            categorical_features=categorical_features,
+            rank=rank, world=world)
     ds = BinnedDataset.from_matrix_with_mappers(
         X_local, config, mappers, label=y_local, weight=weight_local)
     if group_local is not None:
@@ -701,7 +716,8 @@ def train_multihost(config: Config, X_local: np.ndarray,
     # batch clamping must be IDENTICAL on every rank (the fused scan is
     # one global-mesh collective program; mismatched k desyncs psum);
     # only the raise itself is rank-filtered
-    kill_clamp = (fault_plan.kill_iter if fault_plan is not None else None)
+    kill_clamp = (fault_plan.clamp_iter() if fault_plan is not None
+                  else None)
     snap_freq = int(config.snapshot_freq)
     stopped = False
     while it < end_round and not stopped:

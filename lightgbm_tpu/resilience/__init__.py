@@ -1,24 +1,33 @@
-"""Resilience subsystem: survive preemptions and DCN faults.
+"""Resilience subsystem: survive preemptions, stragglers, and DCN faults.
 
-Four pieces (see docs/COMPONENTS.md "Resilience"):
+Five pieces (see docs/COMPONENTS.md "Resilience"):
 
   * :mod:`checkpoint` — atomic (tmp + fsync + rename), CRC-checksummed
     full-training-state snapshots every ``snapshot_freq`` iterations into
-    ``checkpoint_dir`` (``checkpoint_keep`` prunes);
+    ``checkpoint_dir`` (``checkpoint_keep`` prunes; orphaned ``.tmp``
+    files from killed writers are swept at saver startup);
   * :mod:`restore` — auto-resume that validates checksums + dataset
-    fingerprint + config hash, falls back over corrupt snapshots, and
-    continues training bit-exactly;
+    fingerprint (shard-local AND dataset-global) + config hash, falls
+    back over corrupt snapshots, and continues training bit-exactly;
+  * :mod:`reshard` — ELASTIC resume onto a different mesh size: the
+    mesh-layout manifest written beside the per-rank shards, the
+    (iteration, source-layout) agreement across the new ranks, and the
+    shard/global/shard re-slicing algebra;
   * :mod:`retry` — timeout/backoff/jitter guard for the host-side DCN
     collectives (bounded retries; a gone peer becomes a clean
-    ``LightGBMError``, not a hang);
+    ``LightGBMError``, not a hang) with a soft-deadline straggler
+    watchdog (``collective::stall`` + flight dump before the hard
+    deadline decides);
   * :mod:`faults` — deterministic ``tpu_fault_plan=`` injection
     (``kill@iter=`` / ``drop_collective@round=`` /
-    ``corrupt_checkpoint@n=``) so all of the above is tier-1-testable.
+    ``corrupt_checkpoint@n=`` / ``stall@round=`` / ``resize@iter=``)
+    so all of the above is tier-1-testable.
 """
 from .checkpoint import (CheckpointError, CheckpointWriter, TrainingSaver,
                          atomic_write_bytes, atomic_write_text, config_hash,
                          dataset_fingerprint)
-from .faults import FaultPlan, TrainingKilled
+from .faults import FaultPlan, TrainingKilled, TrainingResized
+from .reshard import find_elastic, load_manifest
 from .restore import find_restorable, resume_booster
 from .retry import RetryPolicy, guard
 
@@ -26,5 +35,6 @@ __all__ = [
     "CheckpointError", "CheckpointWriter", "TrainingSaver",
     "atomic_write_bytes", "atomic_write_text", "config_hash",
     "dataset_fingerprint", "FaultPlan", "TrainingKilled",
+    "TrainingResized", "find_elastic", "load_manifest",
     "find_restorable", "resume_booster", "RetryPolicy", "guard",
 ]

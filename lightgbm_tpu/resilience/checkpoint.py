@@ -50,14 +50,19 @@ _NAME_RE = re.compile(r"^ckpt_(\d+)\.r(\d+)\.lgc$")
 
 # params that must not invalidate a resume: where the run writes its
 # checkpoints, how long it runs, what telemetry/faults ride along, and the
-# IO/network addressing — none of them shape the training computation
+# IO/network addressing — none of them shape the training computation.
+# num_machines is volatile BY DESIGN: the mesh size shapes the data
+# layout, not the global computation, and elastic resume
+# (resilience/reshard.py) restores a run onto a different world size —
+# the layout itself is validated via the mesh manifest, not the hash.
 _VOLATILE_PARAMS = frozenset({
     "checkpoint_dir", "checkpoint_keep", "snapshot_freq", "num_iterations",
     "tpu_fault_plan", "tpu_telemetry", "telemetry_out", "verbosity",
     "output_model", "input_model", "output_result", "config", "task",
-    "data", "valid", "machines", "machine_list_filename",
+    "data", "valid", "machines", "machine_list_filename", "num_machines",
     "local_listen_port", "time_out", "tpu_collective_timeout",
     "tpu_collective_retries", "tpu_collective_backoff",
+    "tpu_collective_soft_timeout",
 })
 
 
@@ -143,10 +148,15 @@ def _fsync_dir(directory: str) -> None:
 
 def atomic_write_bytes(path: str, data: bytes) -> None:
     """tmp + flush + fsync + rename: a crash mid-write never leaves a
-    torn file at `path` (the invariant JG008 lints for)."""
+    torn file at `path` (the invariant JG008 lints for). The tmp name is
+    pid-unique: two ranks writing the same shared-directory target (the
+    mesh manifest) must not steal each other's tmp out from under the
+    rename — last `os.replace` wins, which is fine when both wrote the
+    same identity."""
     directory = os.path.dirname(os.path.abspath(path))
     tmp_path = os.path.join(directory,
-                            ".%s.tmp" % os.path.basename(path))
+                            ".%s.%d.tmp" % (os.path.basename(path),
+                                            os.getpid()))
     with open(tmp_path, "wb") as f:
         f.write(data)
         f.flush()
@@ -269,14 +279,58 @@ class CheckpointWriter:
     """
 
     def __init__(self, directory: str, keep: int, cfg_hash: str,
-                 rank: int = 0, fingerprint: Optional[str] = None):
+                 rank: int = 0, fingerprint: Optional[str] = None,
+                 global_fingerprint: Optional[str] = None,
+                 world: int = 1):
         self.directory = str(directory)
         self.keep = max(int(keep), 1)
         self.cfg_hash = cfg_hash
         self.rank = int(rank)
         self.fingerprint = fingerprint
+        # dataset-GLOBAL fingerprint (pre-shard rows): survives a mesh
+        # resize, unlike the shard-local `fingerprint` — elastic resume
+        # matches on it (resilience/reshard.py)
+        self.global_fingerprint = global_fingerprint
+        self.world = max(int(world), 1)
         self._writes = 0
         os.makedirs(self.directory, exist_ok=True)
+        self._sweep_orphaned_tmp()
+
+    # a foreign dot-tmp younger than this may be another rank's LIVE
+    # in-flight write on a shared directory; older ones are orphans
+    _TMP_SWEEP_AGE_S = 300.0
+
+    def _sweep_orphaned_tmp(self) -> None:
+        """A kill mid-write leaves `.<name>.<pid>.tmp` behind forever
+        (the atomic rename never happened); sweep them at saver startup.
+        Own-rank tmps go unconditionally (this rank has exactly one
+        writer); foreign ones (another rank's snapshots, the shared
+        manifest) only once they are old enough to be provably dead —
+        a shared directory may have live writers. A concurrent rank
+        sweeping the same orphan is fine: losing the unlink race is
+        success."""
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return
+        own = ".r%d.lgc" % self.rank
+        import time
+        now = time.time()
+        for name in names:
+            if not (name.startswith(".") and name.endswith(".tmp")):
+                continue
+            path = os.path.join(self.directory, name)
+            if own not in name:
+                try:
+                    if now - os.path.getmtime(path) < self._TMP_SWEEP_AGE_S:
+                        continue
+                except OSError:
+                    continue
+            try:
+                os.remove(path)
+                Log.debug("swept orphaned checkpoint tmp file: %s" % name)
+            except OSError:
+                pass
 
     def write_training_state(self, inner, iteration: int,
                              extra_state: Optional[Dict] = None) -> str:
@@ -290,6 +344,9 @@ class CheckpointWriter:
             state.update(extra_state)
         if self.fingerprint is None:
             self.fingerprint = dataset_fingerprint(inner.train_data)
+        if self.global_fingerprint is None:
+            # single-host: the local shard IS the whole dataset
+            self.global_fingerprint = self.fingerprint
         arrays["state_json"] = _text_to_arr(json.dumps(state))
         return self._write(iteration, arrays, kind="train")
 
@@ -308,8 +365,10 @@ class CheckpointWriter:
             meta = {
                 "kind": kind,
                 "rank": self.rank,
+                "world": self.world,
                 "config_hash": self.cfg_hash,
                 "data_fingerprint": self.fingerprint or "",
+                "global_fingerprint": self.global_fingerprint or "",
             }
             if extra_meta:
                 meta.update(extra_meta)
@@ -321,6 +380,10 @@ class CheckpointWriter:
         telemetry.count("checkpoint::write", 1, category="checkpoint")
         telemetry.count("checkpoint::bytes", len(blob),
                         category="checkpoint")
+        # a later permanent peer loss reports "resumable at iteration K"
+        # instead of a generic collective failure (resilience/retry.py)
+        from . import retry as resilience_retry
+        resilience_retry.set_resume_hint(iteration, self.world)
         plan = faults.active()
         if plan is not None and plan.checkpoint_should_corrupt(self._writes):
             _corrupt_in_place(path)

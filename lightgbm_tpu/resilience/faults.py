@@ -27,6 +27,18 @@ Grammar (documented in README "Checkpointing & fault tolerance"):
                                   is corrupted in place after the atomic
                                   rename (restore must fall back to the
                                   previous snapshot)
+    stall@round=N;secs=S[;rank=R] the N-th guarded DCN collective call
+                                  sleeps S seconds before executing (a
+                                  straggler peer): the retry guard's soft
+                                  deadline must emit ``collective::stall``
+                                  + a flight-recorder dump before the
+                                  hard deadline decides the call's fate
+    resize@iter=K;world=W         raise TrainingResized (a TrainingKilled
+                                  subclass carrying ``target_world=W``)
+                                  before iteration K on every rank: a
+                                  scheduler shrinking/growing the pod —
+                                  the run resumes elastically on a
+                                  W-rank mesh (resilience/reshard.py)
 
 Like telemetry, the active plan is process-global and config-driven:
 ``configure_from_config`` installs the plan for the run that asked for it
@@ -42,6 +54,17 @@ from ..utils.log import LightGBMError, Log
 
 class TrainingKilled(LightGBMError):
     """Raised by a ``kill@iter=K`` fault: simulates a preempted worker."""
+
+
+class TrainingResized(TrainingKilled):
+    """Raised by a ``resize@iter=K;world=W`` fault: the pod was resized.
+
+    Carries ``target_world`` so a driving harness (or operator) knows
+    which mesh size the elastic resume should come back on."""
+
+    def __init__(self, message: str, target_world: int):
+        super().__init__(message)
+        self.target_world = int(target_world)
 
 
 class FaultInjected(ConnectionError):
@@ -74,6 +97,11 @@ class FaultPlan:
         self.drop_times: int = -1
         self._drop_left: int = -1
         self.corrupt_n: Optional[int] = None
+        self.stall_round: Optional[int] = None
+        self.stall_secs: int = 0
+        self.stall_rank: Optional[int] = None
+        self.resize_iter: Optional[int] = None
+        self.resize_world: Optional[int] = None
         for raw in text.replace(" ", ",").split(","):
             raw = raw.strip()
             if not raw:
@@ -112,12 +140,40 @@ class FaultPlan:
                         "tpu_fault_plan: duplicate corrupt_checkpoint "
                         "directive (one per plan)")
                 self.corrupt_n = kv["n"]
+            elif action == "stall":
+                if "round" not in kv or "secs" not in kv:
+                    raise LightGBMError(
+                        "tpu_fault_plan: stall needs round= and secs=")
+                if self.stall_round is not None:
+                    raise LightGBMError(
+                        "tpu_fault_plan: duplicate stall directive "
+                        "(one per plan)")
+                if kv["secs"] < 0:
+                    raise LightGBMError(
+                        "tpu_fault_plan: stall secs= must be >= 0")
+                self.stall_round = kv["round"]
+                self.stall_secs = kv["secs"]
+                self.stall_rank = kv.get("rank")
+            elif action == "resize":
+                if "iter" not in kv or "world" not in kv:
+                    raise LightGBMError(
+                        "tpu_fault_plan: resize needs iter= and world=")
+                if self.resize_iter is not None:
+                    raise LightGBMError(
+                        "tpu_fault_plan: duplicate resize directive "
+                        "(one per plan)")
+                if kv["world"] < 1:
+                    raise LightGBMError(
+                        "tpu_fault_plan: resize world= must be >= 1")
+                self.resize_iter = kv["iter"]
+                self.resize_world = kv["world"]
             else:
                 raise LightGBMError(
                     "tpu_fault_plan: unknown action %r (kill / "
-                    "drop_collective / corrupt_checkpoint)" % action)
+                    "drop_collective / corrupt_checkpoint / stall / "
+                    "resize)" % action)
 
-    # -- kill ----------------------------------------------------------
+    # -- kill / resize -------------------------------------------------
     def kill_point(self, rank: int = 0) -> Optional[int]:
         """Iteration this rank dies at, or None (used to clamp fused
         batches so the kill lands exactly on an iteration boundary)."""
@@ -127,14 +183,39 @@ class FaultPlan:
             return None
         return self.kill_iter
 
+    def clamp_iter(self) -> Optional[int]:
+        """Earliest iteration ANY rank stops at (kill or resize), rank-
+        filters ignored: batch clamping must be identical on every rank
+        (a rank-dependent batch shape desyncs the fused-scan psum)."""
+        points = [p for p in (self.kill_iter, self.resize_iter)
+                  if p is not None]
+        return min(points) if points else None
+
     def check_kill(self, iteration: int, rank: int = 0) -> None:
-        """Raise TrainingKilled before `iteration` (0-based) trains."""
+        """Raise TrainingKilled/TrainingResized before `iteration`
+        (0-based) trains. A resize fires on EVERY rank (the scheduler
+        resizes the pod, not one worker) and wins when it lands first."""
+        from ..telemetry import flight as telemetry_flight
+        rp = self.resize_iter
         kp = self.kill_point(rank)
+        if rp is not None and iteration >= rp and (kp is None or rp <= kp):
+            telemetry.count("faults::injected", 1, category="faults")
+            telemetry_flight.note("resize", iteration=iteration, rank=rank,
+                                  world=self.resize_world, plan=self.text)
+            telemetry_flight.dump("injected_resize@iter=%d" % iteration,
+                                  rank=rank)
+            err = TrainingResized(
+                "fault injection: mesh resized before iteration %d — "
+                "resumable at iteration <= %d on a world=%d mesh "
+                "(tpu_fault_plan=%s)" % (iteration, iteration,
+                                         self.resize_world, self.text),
+                target_world=self.resize_world)
+            err._flight_dumped = True
+            raise err
         if kp is not None and iteration >= kp:
             telemetry.count("faults::injected", 1, category="faults")
             # the injected death leaves the same postmortem a real
             # preemption would: flight dump next to the checkpoints
-            from ..telemetry import flight as telemetry_flight
             telemetry_flight.note("kill", iteration=iteration, rank=rank,
                                   plan=self.text)
             telemetry_flight.dump("injected_kill@iter=%d" % iteration,
@@ -160,6 +241,19 @@ class FaultPlan:
             self._drop_left -= 1
             return True
         return False
+
+    def collective_stall_secs(self, round_idx: int) -> float:
+        """Seconds the `round_idx`-th (1-based) guarded collective should
+        sleep before executing on this rank (0.0 = no stall). The sleep
+        happens on the guard's watchdog thread, so the soft/hard
+        deadlines see a genuine straggler."""
+        if self.stall_round is None or round_idx != self.stall_round:
+            return 0.0
+        if self.stall_rank is not None:
+            from ..telemetry.export import process_index
+            if process_index() != self.stall_rank:
+                return 0.0
+        return float(self.stall_secs)
 
     # -- checkpoints ---------------------------------------------------
     def checkpoint_should_corrupt(self, write_idx: int) -> bool:

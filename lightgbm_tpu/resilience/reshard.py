@@ -1,0 +1,361 @@
+"""Elastic resume: restore a run onto a DIFFERENT mesh size.
+
+A preemptible pod rarely comes back with the shape it died with: the
+scheduler hands back fewer (or more) hosts, and the per-rank snapshot
+streams written by the old mesh no longer line up with the new ranks.
+Before this module a 4-rank run could resume only on 4 ranks — the
+shard-local dataset fingerprints made any other world size look like a
+foreign run (fresh start, work lost). This module closes exactly that
+gap (ROADMAP item 5, "elastic resume onto a different mesh size").
+
+Three pieces:
+
+* **Mesh-layout manifest** (``elastic.manifest.json``, written atomically
+  beside the per-rank shards): the run identity (config hash +
+  dataset-GLOBAL fingerprint — the pre-shard rows, unlike the shard-local
+  fingerprint each snapshot also carries), the world size, the row
+  assignment (``round_robin`` rows / ``query_blocks`` ranking /
+  ``pre_partition``), and the serialized global BinMappers. The mappers
+  matter: distributed binning derives bin boundaries from per-rank
+  samples, so a resumed run re-binning under a different world would
+  silently train a DIFFERENT model — the manifest pins the source run's
+  binning for every future mesh.
+
+* **Elastic restore** (:func:`find_elastic`): each new rank scans the
+  OLD mesh's snapshot streams (every rank's model text is identical, so
+  any valid source shard restores the run), then the new ranks agree —
+  via a retry-guarded allgather — on (min restorable iteration, manifest
+  CRC): everyone rebuilds from the same snapshot generation of the same
+  source layout, or nobody does. Scores/bag state need no shard
+  surgery: scores reseed from the restored model's raw predictions on
+  each NEW shard, and the bagging/GOSS draws hash dataset-GLOBAL row
+  ids at absolute iteration windows — both are mesh-size invariant by
+  construction, which is what makes the resumed model bit-exact.
+
+* **Re-slicing helpers** (:func:`slice_for_rank` /
+  :func:`assemble_global` / :func:`reslice_local`): the pure layout
+  algebra — old shards -> global row order -> new shards — reusing
+  ``parallel.multihost.shard_rows`` / ``shard_queries`` so the manifest
+  and the training loop can never disagree on who owns which row.
+
+Counters: ``resilience::reshard_resume`` / ``resilience::reshard_rows``
+/ ``resilience::reshard_manifest``.
+"""
+from __future__ import annotations
+
+import json
+import os
+import zlib
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..telemetry import events as telemetry
+from ..utils.log import LightGBMError, Log
+from .checkpoint import (CheckpointError, atomic_write_text, config_hash,
+                         list_checkpoints, load_checkpoint)
+
+MANIFEST_NAME = "elastic.manifest.json"
+MANIFEST_FORMAT = "lightgbm_tpu.elastic/1"
+
+
+# ---------------------------------------------------------------------------
+# mesh-layout manifest
+# ---------------------------------------------------------------------------
+
+def build_manifest(cfg_hash: str, global_fp: str, world: int, n_rows: int,
+                   mappers, assignment: str = "round_robin",
+                   group_sizes=None) -> Dict:
+    """The run's mesh-layout manifest. ``mappers`` may be BinMapper
+    objects or their ``to_state()`` dicts; ``group_sizes`` (ranking)
+    records the query layout ``slice_for_rank`` re-slices by."""
+    states = [m if isinstance(m, dict) else m.to_state() for m in mappers]
+    man = {
+        "format": MANIFEST_FORMAT,
+        "config_hash": str(cfg_hash),
+        "global_fingerprint": str(global_fp),
+        "world": int(world),
+        "n_rows": int(n_rows),
+        "assignment": str(assignment),
+        "mappers": states,
+    }
+    if group_sizes is not None:
+        man["group_sizes"] = [int(g) for g in group_sizes]
+    return man
+
+
+def manifest_path(directory: str) -> str:
+    return os.path.join(directory, MANIFEST_NAME)
+
+
+def load_manifest(directory: str) -> Optional[Dict]:
+    """The directory's manifest, or None (missing / unparseable — an
+    unparseable manifest is warned about, not fatal: the same-mesh
+    resume path still works without one)."""
+    path = manifest_path(directory)
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            man = json.load(f)
+    except OSError:
+        return None
+    except ValueError:
+        Log.warning("elastic manifest %s is unparseable; ignoring it "
+                    "(different-mesh resume unavailable)" % path)
+        return None
+    if man.get("format") != MANIFEST_FORMAT:
+        Log.warning("elastic manifest %s has unknown format %r; ignoring"
+                    % (path, man.get("format")))
+        return None
+    return man
+
+
+def ensure_manifest(directory: str, manifest: Dict) -> bool:
+    """Write the manifest (atomically) unless an identical-identity one
+    is already there; returns True when it wrote. A changed world (an
+    elastic resume now writing the NEW mesh's snapshots) overwrites, so
+    the directory always describes its newest snapshot generation."""
+    cur = load_manifest(directory)
+    if cur is not None and all(
+            cur.get(k) == manifest.get(k)
+            for k in ("config_hash", "global_fingerprint", "world",
+                      "assignment", "n_rows")):
+        return False
+    os.makedirs(directory, exist_ok=True)
+    atomic_write_text(manifest_path(directory),
+                      json.dumps(manifest, sort_keys=True))
+    telemetry.count("resilience::reshard_manifest", 1,
+                    category="resilience")
+    Log.debug("elastic manifest written: %s (world=%d)"
+              % (manifest_path(directory), int(manifest["world"])))
+    return True
+
+
+def manifest_crc(manifest: Dict) -> int:
+    """Stable digest of the SOURCE LAYOUT the ranks must agree on (the
+    second lane of the agreement allgather)."""
+    blob = json.dumps(manifest, sort_keys=True).encode()
+    return zlib.crc32(blob) & 0xFFFFFFFF
+
+
+def manifest_matches(manifest: Optional[Dict], cfg_hash: str,
+                     global_fp: Optional[str] = None) -> bool:
+    if manifest is None:
+        return False
+    if manifest.get("config_hash") != cfg_hash:
+        return False
+    return global_fp is None or manifest.get("global_fingerprint") == global_fp
+
+
+def manifest_mappers(manifest: Dict) -> List:
+    """The source run's global BinMappers — every mesh size must bin
+    identically for the resumed model to stay bit-exact."""
+    from ..data.bin_mapper import BinMapper
+    return [BinMapper.from_state(st) for st in manifest["mappers"]]
+
+
+# ---------------------------------------------------------------------------
+# layout algebra: old shards -> global row order -> new shards
+# ---------------------------------------------------------------------------
+
+def slice_for_rank(manifest: Dict, rank: int, world: int) -> np.ndarray:
+    """GLOBAL row indices rank `rank` of a `world`-rank mesh owns under
+    the manifest's assignment — the same functions the training loop
+    shards with, so manifest and loop cannot drift."""
+    from ..parallel.multihost import shard_queries, shard_rows
+    assignment = manifest.get("assignment", "round_robin")
+    n_rows = int(manifest["n_rows"])
+    if assignment == "round_robin":
+        return shard_rows(n_rows, int(rank), int(world), False)
+    if assignment == "query_blocks":
+        idx, _sizes = shard_queries(manifest["group_sizes"], int(rank),
+                                    int(world))
+        return idx
+    raise LightGBMError(
+        "elastic resume is not available for assignment=%r "
+        "(pre-partitioned rows cannot be re-sliced: each rank's file "
+        "holds only its own shard)" % assignment)
+
+
+def assemble_global(manifest: Dict, shards: List[np.ndarray]) -> np.ndarray:
+    """Reassemble per-source-rank row-aligned state (score / bag /
+    weight shards, one array per source rank, in rank order) into the
+    dataset-global row order."""
+    world = int(manifest["world"])
+    if len(shards) != world:
+        raise LightGBMError(
+            "assemble_global: %d shard(s) for a world=%d manifest"
+            % (len(shards), world))
+    first = np.asarray(shards[0])
+    out = np.empty((int(manifest["n_rows"]),) + first.shape[1:],
+                   dtype=first.dtype)
+    for rank, shard in enumerate(shards):
+        idx = slice_for_rank(manifest, rank, world)
+        shard = np.asarray(shard)
+        if len(shard) != len(idx):
+            raise LightGBMError(
+                "assemble_global: rank %d shard has %d rows, layout "
+                "says %d" % (rank, len(shard), len(idx)))
+        out[idx] = shard
+    return out
+
+
+def reslice_local(manifest: Dict, global_arr: np.ndarray, rank: int,
+                  world: int) -> np.ndarray:
+    """The `rank`-of-`world` shard of a dataset-global row-aligned array
+    (the new mesh's slice of reassembled state). The model-only resume
+    path needs no state surgery (scores reseed from predictions); this
+    algebra serves full-state spill/restore and the layout tests."""
+    return np.asarray(global_arr)[slice_for_rank(manifest, rank, world)]
+
+
+# ---------------------------------------------------------------------------
+# the resume agreement: ONE collective for every resuming rank
+# ---------------------------------------------------------------------------
+
+def agree_generation(config, local_best: int,
+                     layout_crc: int) -> Tuple[int, bool]:
+    """(min iteration across ranks, layout-uniform?) via one retry-
+    guarded allgather of ``[local_best, layout_crc]``.
+
+    Every resuming rank joins THIS collective — same-mesh resume
+    (restore.find_distributed) and elastic resume (find_elastic) alike,
+    manifest visible or not (no manifest sends crc 0). The branch choice
+    between the two paths is made from LOCAL filesystem state, so ranks
+    can disagree on it; sharing one label and payload shape means a
+    split-brain checkpoint_dir surfaces as a clean crc mismatch on every
+    rank instead of two different collectives deadlocking each other."""
+    if int(config.num_machines) <= 1:
+        return int(local_best), True
+    import jax
+
+    from jax.experimental import multihost_utils
+
+    from .retry import guard
+    if jax.process_count() <= 1:
+        return int(local_best), True
+    gathered = guard(
+        "allgather:resume_agree",
+        multihost_utils.process_allgather,
+        np.asarray([int(local_best), int(layout_crc)], np.int64))
+    pairs = np.asarray(gathered).reshape(-1, 2)
+    return (int(pairs[:, 0].min()),
+            bool((pairs[:, 1] == int(layout_crc)).all()))
+
+
+# ---------------------------------------------------------------------------
+# elastic restore
+# ---------------------------------------------------------------------------
+
+def _load_at(directory: str, src_world: int, iteration: int,
+             want_cfg: str, global_fp: str) -> Optional[Tuple[Dict, Dict]]:
+    """A valid model snapshot at exactly `iteration` from ANY source
+    rank (every rank's model text is identical — the first shard that
+    validates wins)."""
+    for src_rank in range(src_world):
+        for it, path in list_checkpoints(directory, src_rank):
+            if it != iteration:
+                continue
+            found = _validated(path, want_cfg, global_fp)
+            if found is not None:
+                return found
+    return None
+
+
+def _validated(path: str, want_cfg: str,
+               global_fp: str) -> Optional[Tuple[Dict, Dict]]:
+    try:
+        meta, arrays = load_checkpoint(path)
+    except CheckpointError as exc:
+        telemetry.count("checkpoint::restore_fallback", 1,
+                        category="checkpoint")
+        Log.warning("checkpoint %s rejected (%s); elastic scan falls "
+                    "back" % (path, exc))
+        return None
+    if meta.get("kind") != "model" or meta.get("config_hash") != want_cfg:
+        return None
+    meta_global = meta.get("global_fingerprint", "")
+    if meta_global and meta_global != global_fp:
+        return None
+    return meta, arrays
+
+
+def _newest_common(directory: str, src_world: int, want_cfg: str,
+                   global_fp: str) -> Tuple[int, Optional[Tuple[Dict, Dict]]]:
+    """(newest restorable iteration, its loaded snapshot) over the OLD
+    mesh's per-rank streams; (0, None) when nothing validates."""
+    iterations = set()
+    for src_rank in range(src_world):
+        iterations.update(it for it, _ in list_checkpoints(directory,
+                                                           src_rank))
+    for iteration in sorted(iterations, reverse=True):
+        found = _load_at(directory, src_world, iteration, want_cfg,
+                         global_fp)
+        if found is not None:
+            return iteration, found
+    return 0, None
+
+
+def find_elastic(config, rank: int, world: int, global_fp: str
+                 ) -> Optional[Tuple[int, str, Dict, Dict]]:
+    """Different-mesh resume: (agreed_iteration, model_text, meta,
+    manifest) or None when the directory holds no matching elastic run
+    (or the manifest's world already equals `world` — that is the
+    ordinary same-mesh resume, ``restore.find_distributed``).
+
+    All new ranks agree on (min restorable iteration, manifest CRC) via
+    a retry-guarded allgather, so every rank rebuilds from the same
+    snapshot generation of the same source layout — a rank seeing a
+    different manifest (split-brain checkpoint_dirs) fails loudly
+    instead of training a franken-model.
+    """
+    directory = str(config.checkpoint_dir)
+    if not directory or not os.path.isdir(directory):
+        return None
+    man = load_manifest(directory)
+    want_cfg = config_hash(config)
+    if not manifest_matches(man, want_cfg, global_fp):
+        if man is not None:
+            Log.warning("elastic manifest in %s belongs to a different "
+                        "run (config/dataset mismatch); ignoring it"
+                        % directory)
+        return None
+    src_world = int(man.get("world", 1))
+    if src_world == int(world):
+        return None
+    if man.get("assignment") == "pre_partition":
+        raise LightGBMError(
+            "elastic resume is not available for pre-partitioned rows "
+            "(pre_partition=true): each rank's file holds only its own "
+            "shard, so a new mesh cannot re-slice the dataset — restart "
+            "on world=%d or repartition the files" % src_world)
+    local_best, found = _newest_common(directory, src_world, want_cfg,
+                                       global_fp)
+    agreed, uniform = agree_generation(config, local_best,
+                                       manifest_crc(man))
+    if not uniform:
+        raise LightGBMError(
+            "elastic resume: ranks disagree on the source mesh layout "
+            "(manifest CRC mismatch across ranks — split-brain "
+            "checkpoint_dir contents, or some ranks cannot read the "
+            "manifest; elastic resume needs a checkpoint_dir every new "
+            "rank can read)")
+    if agreed <= 0:
+        Log.warning("elastic manifest found in %s but no restorable "
+                    "snapshot validates on every rank; starting fresh"
+                    % directory)
+        return None
+    if found is None or int(found[0]["iteration"]) != agreed:
+        found = _load_at(directory, src_world, agreed, want_cfg, global_fp)
+        if found is None:
+            raise LightGBMError(
+                "elastic resume: rank %d has no valid snapshot at the "
+                "agreed iteration %d (checkpoint_keep too small, or the "
+                "checkpoint_dir is not shared across the new mesh?)"
+                % (rank, agreed))
+    meta, arrays = found
+    telemetry.count("resilience::reshard_resume", 1, category="resilience")
+    telemetry.count("checkpoint::restore", 1, category="checkpoint")
+    Log.info("Elastic resume: iteration %d of a world=%d run restored "
+             "onto world=%d (rank %d)"
+             % (agreed, src_world, int(world), rank))
+    return agreed, arrays["model_text"].tobytes().decode(), meta, man

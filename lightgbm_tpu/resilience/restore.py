@@ -24,8 +24,6 @@ import json
 import os
 from typing import Dict, Optional, Tuple
 
-import numpy as np
-
 from ..telemetry import events as telemetry
 from ..utils.log import Log
 from .checkpoint import (CheckpointError, array_fingerprint, config_hash,
@@ -34,9 +32,16 @@ from .checkpoint import (CheckpointError, array_fingerprint, config_hash,
 
 
 def _scan(directory: str, rank: int, want_cfg: str, want_fp: str,
-          kind: str) -> Optional[Tuple[Dict, Dict]]:
+          kind: str,
+          want_global: Optional[str] = None) -> Optional[Tuple[Dict, Dict]]:
     """Newest valid matching (meta, arrays), falling back over corrupt
-    snapshots; None when nothing (or only a mismatched run) is there."""
+    snapshots; None when nothing (or only a mismatched run) is there.
+
+    The fingerprint check is SPLIT (want_fp is shard-local, want_global
+    is the dataset-global one): a shard mismatch with a matching global
+    fingerprint is THIS run laid out over a different mesh — that must
+    surface as a reshard-needed error, never as a silent foreign-run
+    fresh start that throws the work away."""
     for iteration, path in reversed(list_checkpoints(directory, rank)):
         try:
             meta, arrays = load_checkpoint(path)
@@ -54,6 +59,16 @@ def _scan(directory: str, rank: int, want_cfg: str, want_fp: str,
                         % (directory, meta.get("config_hash"), want_cfg))
             return None
         if meta.get("data_fingerprint") != want_fp:
+            if (want_global and meta.get("global_fingerprint")
+                    and meta["global_fingerprint"] == want_global):
+                from ..utils.log import LightGBMError
+                raise LightGBMError(
+                    "checkpoint_dir %s holds THIS run's snapshots under a "
+                    "different mesh layout (world=%s, shard fingerprint "
+                    "mismatch, dataset-global fingerprint match): elastic "
+                    "resume needs the mesh manifest "
+                    "(elastic.manifest.json) — it is missing or corrupt"
+                    % (directory, meta.get("world")))
             Log.warning("checkpoint_dir %s holds snapshots of a different "
                         "dataset (fingerprint mismatch); starting fresh"
                         % directory)
@@ -93,33 +108,44 @@ def extra_state(found: Tuple[Dict, Dict], key: str):
     return state.get(key)
 
 
-def find_distributed(config, rank: int,
-                     *shard_arrays) -> Optional[Tuple[int, str, Dict]]:
-    """Distributed resume: (agreed_iteration, model_text, meta) or None.
+def find_distributed(config, rank: int, *shard_arrays,
+                     global_fp: Optional[str] = None
+                     ) -> Optional[Tuple[int, str, Dict]]:
+    """Distributed SAME-mesh resume: (agreed_iteration, model_text,
+    meta) or None. A different-mesh resume goes through
+    ``reshard.find_elastic`` instead (the engine consults the mesh
+    manifest first); this path raises loudly when it recognizes this
+    run's data under a foreign layout (global fingerprint matches, the
+    shard-local one does not) rather than silently starting fresh.
 
     Each rank scans its own snapshot stream (shared or per-host
     checkpoint_dir both work — files carry the rank), then the ranks
     agree on min(newest restorable iteration) so nobody resumes ahead of
     a peer whose latest snapshot was corrupt.
     """
+    from . import reshard
     directory = str(config.checkpoint_dir)
     want_cfg = config_hash(config)
     want_fp = array_fingerprint(*shard_arrays)
-    found = (_scan(directory, rank, want_cfg, want_fp, kind="model")
+    found = (_scan(directory, rank, want_cfg, want_fp, kind="model",
+                   want_global=global_fp)
              if directory and os.path.isdir(directory) else None)
     local_best = int(found[0]["iteration"]) if found is not None else 0
-    agreed = local_best
-    if int(config.num_machines) > 1:
-        import jax
-        from jax.experimental import multihost_utils
-
-        from .retry import guard
-        if jax.process_count() > 1:
-            gathered = guard(
-                "allgather:checkpoint_agree",
-                multihost_utils.process_allgather,
-                np.asarray([local_best], np.int64))
-            agreed = int(np.asarray(gathered).reshape(-1).min())
+    # the SAME agreement collective the elastic path joins (one label,
+    # one payload shape): a rank that sees a different manifest — or
+    # none — surfaces as a clean layout mismatch, never as two
+    # different collectives deadlocking each other. No manifest = crc 0.
+    man = (reshard.load_manifest(directory)
+           if directory and os.path.isdir(directory) else None)
+    agreed, uniform = reshard.agree_generation(
+        config, local_best, reshard.manifest_crc(man) if man else 0)
+    if not uniform:
+        from ..utils.log import LightGBMError
+        raise LightGBMError(
+            "distributed resume: ranks disagree on the mesh-layout "
+            "manifest (crc mismatch — split-brain checkpoint_dir "
+            "contents, or only some ranks can read "
+            "elastic.manifest.json)")
     if agreed <= 0:
         return None
     if agreed != local_best:

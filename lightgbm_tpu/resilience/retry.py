@@ -47,13 +47,27 @@ class CollectiveTimeout(Exception):
 
 class RetryPolicy:
     """timeout_s=0 disables the watchdog thread (call inline); retries is
-    the number of RE-attempts after the first try."""
+    the number of RE-attempts after the first try. soft_timeout_s is the
+    STRAGGLER watchdog: a collective still running past it emits a
+    ``collective::stall`` event + flight-recorder dump (the postmortem
+    seam) while the call keeps waiting for the hard deadline; 0 = auto
+    (a quarter of the hard deadline)."""
 
     def __init__(self, timeout_s: float = 300.0, retries: int = 2,
-                 backoff_s: float = 0.25):
+                 backoff_s: float = 0.25, soft_timeout_s: float = 0.0):
         self.timeout_s = float(timeout_s)
         self.retries = max(int(retries), 0)
         self.backoff_s = float(backoff_s)
+        self.soft_timeout_s = float(soft_timeout_s)
+
+    def effective_soft_s(self) -> float:
+        """The stall watchdog's deadline: explicit when configured, else
+        a quarter of the hard deadline; 0 disables it (as does a hard
+        deadline of 0 — with no watchdog thread there is nobody to
+        observe the straggler)."""
+        soft = (self.soft_timeout_s if self.soft_timeout_s > 0
+                else self.timeout_s * 0.25)
+        return soft if 0 < soft < self.timeout_s else 0.0
 
 
 _POLICY = RetryPolicy()
@@ -72,8 +86,11 @@ def configure_from_config(config) -> None:
     _POLICY = RetryPolicy(
         timeout_s=float(getattr(config, "tpu_collective_timeout", 300.0)),
         retries=int(getattr(config, "tpu_collective_retries", 2)),
-        backoff_s=float(getattr(config, "tpu_collective_backoff", 0.25)))
+        backoff_s=float(getattr(config, "tpu_collective_backoff", 0.25)),
+        soft_timeout_s=float(getattr(config, "tpu_collective_soft_timeout",
+                                     0.0)))
     reset_rounds()
+    set_resume_hint(None, None)
 
 
 def policy() -> RetryPolicy:
@@ -84,6 +101,33 @@ def reset_rounds() -> None:
     global _round
     with _lock:
         _round = 0
+
+
+# last iteration this process checkpointed (+ the run's world size):
+# a permanently-gone peer then surfaces as "resumable at iteration K on
+# a smaller mesh" instead of a generic collective failure. Set by
+# CheckpointWriter after every successful write, cleared per run.
+_RESUME_HINT: Optional[tuple] = None
+
+
+def set_resume_hint(iteration: Optional[int],
+                    world: Optional[int] = None) -> None:
+    global _RESUME_HINT
+    _RESUME_HINT = ((int(iteration), int(world or 1))
+                    if iteration is not None else None)
+
+
+def _resume_hint_text() -> str:
+    if _RESUME_HINT is None:
+        return "restart the job to resume from the last checkpoint"
+    iteration, world = _RESUME_HINT
+    if world > 1:
+        return ("training is resumable at iteration %d on a smaller "
+                "mesh: rerun with num_machines < %d and the same "
+                "checkpoint_dir (elastic resume, resilience/reshard.py)"
+                % (iteration, world))
+    return ("training is resumable at iteration %d from checkpoint_dir"
+            % iteration)
 
 
 def _next_round() -> int:
@@ -101,13 +145,21 @@ def _backoff_delay(name: str, attempt: int, base: float) -> float:
     return base * (2.0 ** attempt) * (0.5 + 0.5 * frac)
 
 
-def _call_with_deadline(fn, args, kwargs, timeout_s: float, name: str):
+def _call_with_deadline(fn, args, kwargs, timeout_s: float, name: str,
+                        soft_s: float = 0.0, stall_s: float = 0.0):
+    """`stall_s` is the injected straggler sleep (``stall@`` fault): it
+    runs ON the watchdog thread so the soft/hard deadlines observe it
+    exactly like a real slow peer."""
     if timeout_s <= 0:
+        if stall_s > 0:
+            time.sleep(stall_s)
         return fn(*args, **kwargs)
     result = {}
 
     def run():
         try:
+            if stall_s > 0:
+                time.sleep(stall_s)
             result["value"] = fn(*args, **kwargs)
         except BaseException as exc:  # noqa: B036 - relayed to the caller
             result["error"] = exc
@@ -115,7 +167,24 @@ def _call_with_deadline(fn, args, kwargs, timeout_s: float, name: str):
     worker = threading.Thread(target=run, daemon=True,
                               name="lgbtpu-collective-%s" % name)
     worker.start()
-    worker.join(timeout_s)
+    remaining = timeout_s
+    if 0 < soft_s < timeout_s:
+        worker.join(soft_s)
+        if worker.is_alive():
+            # the straggler watchdog: the collective is past its soft
+            # deadline but not yet condemned — record the stall and dump
+            # the flight ring NOW, while this process is still healthy,
+            # so a later hard-deadline death has a pre-crash record
+            telemetry.count("collective::stall", 1, category="collective")
+            telemetry_flight.note("collective_stall", name=name,
+                                  soft_deadline_s=soft_s,
+                                  deadline_s=timeout_s)
+            telemetry_flight.dump("collective_stall:%s" % name)
+            Log.warning("collective '%s' exceeded its %.1fs soft deadline "
+                        "(straggler?); hard deadline in %.1fs"
+                        % (name, soft_s, timeout_s - soft_s))
+            remaining = timeout_s - soft_s
+    worker.join(remaining)
     if worker.is_alive():
         # the thread is abandoned (collectives are not cancelable); the
         # caller decides whether to retry or raise
@@ -176,10 +245,16 @@ def guard(name: str, fn, *args, **kwargs):
             last_err = faults.FaultInjected(
                 "injected drop_collective at round %d" % round_idx)
         else:
+            stall_s = (plan.collective_stall_secs(round_idx)
+                       if plan is not None else 0.0)
+            if stall_s > 0:
+                telemetry.count("faults::injected", 1, category="faults")
             t0 = time.perf_counter()
             try:
                 result = _call_with_deadline(fn, args, kwargs,
-                                             pol.timeout_s, name)
+                                             pol.timeout_s, name,
+                                             soft_s=pol.effective_soft_s(),
+                                             stall_s=stall_s)
             except LightGBMError:
                 raise
             except CollectiveTimeout as exc:
@@ -233,7 +308,7 @@ def guard(name: str, fn, *args, **kwargs):
     telemetry_flight.dump("collective_failed:%s" % name)
     err = LightGBMError(
         "collective '%s' failed after %d attempt(s): %r (a peer is likely "
-        "gone; restart the job to resume from the last checkpoint)"
-        % (name, pol.retries + 1, last_err))
+        "gone; %s)" % (name, pol.retries + 1, last_err,
+                       _resume_hint_text()))
     err._flight_dumped = True       # this failure's dump is already best
     raise err

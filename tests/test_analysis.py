@@ -805,8 +805,11 @@ def test_collective_trace_extracts_repo_sites():
     trace = co.extract_repo_trace()
     names = {s["name"] for s in trace["sites"] if s["name"]}
     assert {"allgather:binning_sizes", "allgather:binning_mappers",
-            "allreduce:metrics_values",
-            "allgather:row_counts"} <= names
+            "allreduce:metrics_values", "allgather:row_counts",
+            # the ONE resume-agreement exchange (reshard.agree_generation)
+            # every resuming rank joins — same-mesh and elastic alike —
+            # guarded and rank-uniform like any other DCN site
+            "allgather:resume_agree"} <= names
     assert all(s["guarded"] for s in trace["sites"])
     assert trace["findings"] == []
 

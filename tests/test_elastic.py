@@ -1,0 +1,333 @@
+"""Elastic resume onto a different mesh size (ISSUE 12 tentpole).
+
+The acceptance contract:
+  * a run trained on world=2, killed at iteration K (``kill@``/
+    ``resize@``), resumed on world=1 produces a final model BIT-EXACT
+    with the uninterrupted run — scores reseed from the restored model,
+    binning comes from the mesh manifest, and the bagging/GOSS draws
+    hash dataset-GLOBAL row ids, all mesh-size invariant;
+  * a world=4 rank finds the same snapshot generation and its row slice
+    through the same manifest;
+  * the layout algebra (old shards -> global rows -> new shards) round-
+    trips exactly;
+  * a world-size change is recognized as THIS run needing reshard, not
+    silently treated as a foreign run (fresh start, work lost).
+
+The real two-process world=2 -> world=1 chaos run is the slow-tier
+sibling in tests/test_chaos.py; everything here is single-process
+tier-1.
+"""
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import engine
+from lightgbm_tpu.resilience import reshard, restore
+from lightgbm_tpu.resilience.checkpoint import (CheckpointWriter,
+                                                array_fingerprint,
+                                                config_hash,
+                                                list_checkpoints,
+                                                load_checkpoint)
+from lightgbm_tpu.resilience.faults import TrainingResized
+from lightgbm_tpu.utils.log import LightGBMError
+
+
+def _make_binary(n=600, nf=5, seed=5):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, nf))
+    y = (X[:, 0] - 0.5 * X[:, 2] + rng.normal(size=n) * 0.3 > 0)
+    return X, y.astype(float)
+
+
+def _fresh_dir(tmp_path, name):
+    d = str(tmp_path / name)
+    shutil.rmtree(d, ignore_errors=True)
+    os.makedirs(d)
+    return d
+
+
+# the distributed-driver params: bagging mid-stream so the resume has
+# real RNG state to keep; num_machines=1 runs the SAME sharded driver
+# single-process (the small end of the elastic family)
+PARAMS = {"objective": "binary", "num_leaves": 7, "verbosity": -1,
+          "min_data_in_leaf": 5, "learning_rate": 0.3,
+          "bagging_fraction": 0.8, "bagging_freq": 2,
+          "snapshot_freq": 4, "num_machines": 1}
+
+
+def _global_fp(X, y):
+    return array_fingerprint(np.ascontiguousarray(X, np.float64),
+                             np.asarray(y, np.float64))
+
+
+def _dtrain(params, X, y, rounds=12):
+    """engine._train_distributed with the run-scoped configuration
+    engine.train would install (fault plan, retry policy) — the tests
+    drive the world=1 end of the driver directly."""
+    from lightgbm_tpu.resilience import faults, retry
+    cfg = lgb.Config(dict(params))
+    faults.configure_from_config(cfg)
+    retry.configure_from_config(cfg)
+    try:
+        return engine._train_distributed(dict(params), lgb.Dataset(X, y),
+                                         rounds, None)
+    finally:
+        faults.reset()
+
+
+def _fabricate_world2(d, model_text, iteration, cfg, gfp, manifest):
+    """Rewrite `d` as the post-kill state of a world=2 run at
+    `iteration`: two rank-tagged model snapshots + a world=2 manifest —
+    byte-wise exactly what two ranks of a 2-host mesh leave behind
+    (every rank's model text is identical by construction)."""
+    shutil.rmtree(d)
+    os.makedirs(d)
+    for rank in range(2):
+        writer = CheckpointWriter(d, keep=3, cfg_hash=config_hash(cfg),
+                                  rank=rank,
+                                  fingerprint="shard-of-rank-%d" % rank,
+                                  global_fingerprint=gfp, world=2)
+        writer.write_model_text(model_text, iteration,
+                                extra_meta={"n_init": 0})
+    man2 = dict(manifest)
+    man2["world"] = 2
+    reshard.ensure_manifest(d, man2)
+
+
+# ---------------------------------------------------------------------------
+# the acceptance pin: world=2 state, killed at K, resumed on world=1
+# and probed from world=4 — bit-exact vs the uninterrupted run
+# ---------------------------------------------------------------------------
+
+def test_elastic_resume_parity(tmp_path):
+    X, y = _make_binary()
+    d = _fresh_dir(tmp_path, "elastic")
+    params = dict(PARAMS, checkpoint_dir=d)
+
+    # (a) uninterrupted reference through the distributed driver
+    ref = _dtrain(params, X, y)
+    model_ref = ref.model_to_string(num_iteration=-1)
+    manifest = reshard.load_manifest(d)
+    assert manifest is not None and manifest["world"] == 1
+    assert manifest["assignment"] == "round_robin"
+
+    # (b) same run, resized (pod shrink) before iteration 8: the resize
+    # verb kills like a preemption but names the mesh it resumes on
+    shutil.rmtree(d)
+    os.makedirs(d)
+    resized = dict(params, tpu_fault_plan="resize@iter=8;world=1")
+    with pytest.raises(TrainingResized) as exc:
+        _dtrain(resized, X, y)
+    assert exc.value.target_world == 1
+    assert "resumable at iteration <= 8" in str(exc.value)
+    snaps = [i for i, _ in list_checkpoints(d, 0)]
+    assert snaps == [4, 8]
+    _meta8, arr8 = load_checkpoint(
+        [p for i, p in list_checkpoints(d, 0) if i == 8][0])
+    model8 = arr8["model_text"].tobytes().decode()
+
+    # (c) rewrite the directory as the equivalent WORLD=2 post-kill
+    # state (rank-tagged shards + world=2 manifest)
+    cfg = lgb.Config(dict(params))
+    gfp = _global_fp(X, y)
+    _fabricate_world2(d, model8, 8, cfg, gfp, manifest)
+
+    # (d) a world=4 rank (none of whose own rank files exist) finds the
+    # same snapshot generation through the manifest and knows its slice
+    cfg4 = lgb.Config(dict(params, num_machines=4))
+    found4 = reshard.find_elastic(cfg4, rank=3, world=4, global_fp=gfp)
+    assert found4 is not None
+    it4, text4, meta4, man4 = found4
+    assert it4 == 8 and text4 == model8 and meta4["world"] == 2
+    np.testing.assert_array_equal(reshard.slice_for_rank(man4, 3, 4),
+                                  np.arange(3, len(X), 4))
+
+    # (e) elastic resume onto world=1 through the PUBLIC API: plain
+    # lgb.train with num_machines unset routes into the distributed
+    # driver via the manifest and finishes bit-exact vs (a)
+    resume_params = {k: v for k, v in params.items()
+                     if k != "num_machines"}
+    res = lgb.train(resume_params, lgb.Dataset(X, y), 12,
+                    verbose_eval=False)
+    assert res.num_trees() == 12
+    assert res.model_to_string(num_iteration=-1) == model_ref
+    # the directory now describes its newest generation: world=1
+    assert reshard.load_manifest(d)["world"] == 1
+
+
+def test_same_mesh_kill_resume_through_driver(tmp_path):
+    """The distributed driver's own kill/resume at world=1 (the
+    degenerate mesh) stays bit-exact — the baseline the elastic path
+    builds on."""
+    X, y = _make_binary()
+    d = _fresh_dir(tmp_path, "same")
+    params = dict(PARAMS, checkpoint_dir=d)
+    model_a = _dtrain(params, X, y).model_to_string(num_iteration=-1)
+    shutil.rmtree(d)
+    os.makedirs(d)
+    killed = dict(params, tpu_fault_plan="kill@iter=8")
+    with pytest.raises(lgb.basic.LightGBMError):
+        _dtrain(killed, X, y)
+    res = _dtrain(params, X, y)
+    assert res.model_to_string(num_iteration=-1) == model_a
+
+
+# ---------------------------------------------------------------------------
+# layout algebra: old shards -> global -> new shards, exactly
+# ---------------------------------------------------------------------------
+
+def test_reshard_roundtrip_rows():
+    man = reshard.build_manifest("cfg", "fp", world=3, n_rows=101,
+                                 mappers=[])
+    rng = np.random.default_rng(0)
+    state = rng.normal(size=(101, 2))
+    shards = [state[reshard.slice_for_rank(man, r, 3)] for r in range(3)]
+    back = reshard.assemble_global(man, shards)
+    np.testing.assert_array_equal(back, state)
+    # re-slice for a LARGER mesh covers every row exactly once
+    seen = np.concatenate([reshard.slice_for_rank(man, r, 5)
+                           for r in range(5)])
+    np.testing.assert_array_equal(np.sort(seen), np.arange(101))
+    np.testing.assert_array_equal(
+        reshard.reslice_local(man, back, 2, 5), state[2::5])
+
+
+def test_reshard_roundtrip_queries():
+    sizes = [4, 7, 2, 9, 5, 3, 6]
+    n = sum(sizes)
+    man = reshard.build_manifest("cfg", "fp", world=2, n_rows=n,
+                                 mappers=[], assignment="query_blocks",
+                                 group_sizes=sizes)
+    state = np.arange(n, dtype=np.float64)
+    shards = [state[reshard.slice_for_rank(man, r, 2)] for r in range(2)]
+    np.testing.assert_array_equal(reshard.assemble_global(man, shards),
+                                  state)
+    # queries never split, any world: each rank's slice is contiguous
+    # and the union is a partition of the row range
+    for world in (1, 2, 3):
+        slices = [reshard.slice_for_rank(man, r, world)
+                  for r in range(world)]
+        for s in slices:
+            if len(s):
+                np.testing.assert_array_equal(s, np.arange(s[0],
+                                                           s[-1] + 1))
+        np.testing.assert_array_equal(np.sort(np.concatenate(slices)),
+                                      np.arange(n))
+
+
+def test_reshard_refuses_pre_partition(tmp_path):
+    man = reshard.build_manifest("cfg", "fp", world=2, n_rows=10,
+                                 mappers=[], assignment="pre_partition")
+    with pytest.raises(LightGBMError):
+        reshard.slice_for_rank(man, 0, 4)
+    # ... and the real resume path refuses the same way, loudly
+    d = _fresh_dir(tmp_path, "prepart")
+    cfg = lgb.Config(dict(PARAMS, checkpoint_dir=d))
+    man2 = reshard.build_manifest(config_hash(cfg), "gfp", world=2,
+                                  n_rows=10, mappers=[],
+                                  assignment="pre_partition")
+    reshard.ensure_manifest(d, man2)
+    with pytest.raises(LightGBMError) as exc:
+        reshard.find_elastic(cfg, 0, 1, "gfp")
+    assert "pre-partitioned" in str(exc.value)
+
+
+def test_assemble_global_validates_shapes():
+    man = reshard.build_manifest("cfg", "fp", world=2, n_rows=10,
+                                 mappers=[])
+    with pytest.raises(LightGBMError):
+        reshard.assemble_global(man, [np.zeros(5)])        # world mismatch
+    with pytest.raises(LightGBMError):
+        reshard.assemble_global(man, [np.zeros(5), np.zeros(3)])
+
+
+# ---------------------------------------------------------------------------
+# manifest mechanics
+# ---------------------------------------------------------------------------
+
+def test_manifest_roundtrip_and_identity(tmp_path):
+    d = _fresh_dir(tmp_path, "man")
+    man = reshard.build_manifest("cfgh", "gfp", world=2, n_rows=50,
+                                 mappers=[])
+    assert reshard.ensure_manifest(d, man)
+    assert not [n for n in os.listdir(d) if n.endswith(".tmp")]
+    back = reshard.load_manifest(d)
+    assert back == man
+    assert reshard.manifest_crc(back) == reshard.manifest_crc(man)
+    # identical identity -> no rewrite; changed world -> rewrite
+    assert not reshard.ensure_manifest(d, dict(man))
+    man4 = dict(man, world=4)
+    assert reshard.ensure_manifest(d, man4)
+    assert reshard.load_manifest(d)["world"] == 4
+    # matching predicate
+    assert reshard.manifest_matches(man, "cfgh", "gfp")
+    assert reshard.manifest_matches(man, "cfgh")          # fp optional
+    assert not reshard.manifest_matches(man, "other", "gfp")
+    assert not reshard.manifest_matches(man, "cfgh", "other")
+    assert not reshard.manifest_matches(None, "cfgh")
+    # an unparseable manifest is ignored, not fatal
+    with open(reshard.manifest_path(d), "w") as f:
+        f.write("{not json")
+    assert reshard.load_manifest(d) is None
+
+
+def test_find_elastic_edges(tmp_path):
+    X, y = _make_binary(n=80)
+    d = _fresh_dir(tmp_path, "edges")
+    cfg = lgb.Config(dict(PARAMS, checkpoint_dir=d))
+    gfp = _global_fp(X, y)
+    # no manifest -> None
+    assert reshard.find_elastic(cfg, 0, 1, gfp) is None
+    # matching manifest, same world -> None (ordinary resume path)
+    man = reshard.build_manifest(config_hash(cfg), gfp, world=1,
+                                 n_rows=len(X), mappers=[])
+    reshard.ensure_manifest(d, man)
+    assert reshard.find_elastic(cfg, 0, 1, gfp) is None
+    # different world but no restorable snapshot -> None (fresh start)
+    man2 = dict(man, world=2)
+    reshard.ensure_manifest(d, man2)
+    assert reshard.find_elastic(cfg, 0, 1, gfp) is None
+    # foreign dataset -> manifest ignored
+    assert reshard.find_elastic(cfg, 0, 1, "feedface") is None
+    # a corrupt newest shard falls back to the older generation
+    writer = CheckpointWriter(d, keep=3, cfg_hash=config_hash(cfg),
+                              rank=0, fingerprint="s0",
+                              global_fingerprint=gfp, world=2)
+    writer.write_model_text("model four", 4)
+    writer.write_model_text("model eight", 8)
+    newest = [p for i, p in list_checkpoints(d, 0) if i == 8][0]
+    with open(newest, "r+b") as f:
+        f.seek(-8, os.SEEK_END)
+        f.write(b"\x00" * 8)
+    found = reshard.find_elastic(cfg, 0, 1, gfp)
+    assert found is not None and found[0] == 4
+    assert found[1] == "model four"
+
+
+# ---------------------------------------------------------------------------
+# the fingerprint-split satellite: a world-size change is THIS run
+# needing reshard, never a silent foreign-run fresh start
+# ---------------------------------------------------------------------------
+
+def test_world_change_without_manifest_raises_not_fresh(tmp_path):
+    X, y = _make_binary(n=80)
+    d = _fresh_dir(tmp_path, "nofan")
+    cfg = lgb.Config(dict(PARAMS, checkpoint_dir=d))
+    gfp = _global_fp(X, y)
+    # snapshots written by a world=2 run (shard-local fingerprints of
+    # ITS shards), manifest lost
+    writer = CheckpointWriter(d, keep=3, cfg_hash=config_hash(cfg),
+                              rank=0, fingerprint="old-world-shard",
+                              global_fingerprint=gfp, world=2)
+    writer.write_model_text("m", 4)
+    shard = X[0::1], y  # this (world=1) rank's shard fingerprint differs
+    with pytest.raises(LightGBMError) as exc:
+        restore.find_distributed(cfg, 0, *shard, global_fp=gfp)
+    assert "mesh manifest" in str(exc.value)
+    # a genuinely foreign dataset (global fingerprint differs too) still
+    # starts fresh silently — that behavior is load-bearing
+    assert restore.find_distributed(cfg, 0, *shard,
+                                    global_fp="feedface") is None

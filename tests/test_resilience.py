@@ -76,13 +76,58 @@ def test_fault_plan_grammar():
     assert FaultPlan("kill@iter=4").kill_point(7) == 4
 
 
+def test_fault_plan_grammar_stall_resize():
+    p = FaultPlan("stall@round=2;secs=3,resize@iter=9;world=4")
+    assert p.stall_round == 2 and p.stall_secs == 3 and p.stall_rank is None
+    assert p.collective_stall_secs(2) == 3.0
+    assert p.collective_stall_secs(1) == 0.0
+    assert p.resize_iter == 9 and p.resize_world == 4
+    # rank-filtered stall: this process is rank 0
+    q = FaultPlan("stall@round=1;secs=2;rank=5")
+    assert q.collective_stall_secs(1) == 0.0
+    # batch clamping sees the earliest stop point, rank filters ignored
+    assert FaultPlan("kill@iter=7;rank=1,resize@iter=5;world=2"
+                     ).clamp_iter() == 5
+    assert FaultPlan("kill@iter=3").clamp_iter() == 3
+    assert FaultPlan("stall@round=1;secs=1").clamp_iter() is None
+
+
+def test_resize_raises_typed_error():
+    from lightgbm_tpu.resilience.faults import TrainingResized
+    from lightgbm_tpu.telemetry import flight
+    flight.disarm()   # check_kill dumps wherever a previous test left
+    #                   the recorder armed (default '.': repo litter)
+    p = FaultPlan("resize@iter=6;world=2")
+    p.check_kill(5)                      # before the resize point: fine
+    with pytest.raises(TrainingResized) as exc:
+        p.check_kill(6, rank=3)          # fires on EVERY rank
+    assert exc.value.target_world == 2
+    assert isinstance(exc.value, TrainingKilled)
+    assert "world=2" in str(exc.value)
+    # when both land on the same run, the earlier point wins
+    pk = FaultPlan("kill@iter=4,resize@iter=8;world=2")
+    with pytest.raises(TrainingKilled) as exc2:
+        pk.check_kill(4)
+    assert not isinstance(exc2.value, TrainingResized)
+
+
 @pytest.mark.parametrize("bad", ["kill", "kill@iter=x", "explode@n=1",
                                  "drop_collective@times=1",
                                  "corrupt_checkpoint@iter=1",
                                  # duplicates would silently last-win
                                  "kill@iter=1,kill@iter=2",
                                  "drop_collective@round=1,"
-                                 "drop_collective@round=5"])
+                                 "drop_collective@round=5",
+                                 # stall/resize mirror the same rules
+                                 "stall@round=1",
+                                 "stall@secs=2",
+                                 "stall@round=1;secs=-1",
+                                 "stall@round=1;secs=2,stall@round=3;secs=1",
+                                 "resize@iter=1",
+                                 "resize@world=2",
+                                 "resize@iter=1;world=0",
+                                 "resize@iter=1;world=2,"
+                                 "resize@iter=3;world=1"])
 def test_fault_plan_rejects_malformed(bad):
     with pytest.raises(LightGBMError):
         FaultPlan(bad)
@@ -442,8 +487,174 @@ def test_retry_policy_from_config():
     try:
         pol = retry.policy()
         assert (pol.timeout_s, pol.retries, pol.backoff_s) == (7.5, 4, 0.0)
+        # soft deadline: auto = a quarter of the hard deadline
+        assert pol.effective_soft_s() == pytest.approx(7.5 / 4)
     finally:
         retry._POLICY = retry.RetryPolicy()
+    cfg2 = lgb.Config({"tpu_collective_timeout": 10.0,
+                       "tpu_collective_soft_timeout": 2.0})
+    retry.configure_from_config(cfg2)
+    try:
+        assert retry.policy().effective_soft_s() == 2.0
+    finally:
+        retry._POLICY = retry.RetryPolicy()
+    # a soft deadline >= the hard one (or timeout 0) disables the watchdog
+    assert retry.RetryPolicy(timeout_s=1.0,
+                             soft_timeout_s=5.0).effective_soft_s() == 0.0
+    assert retry.RetryPolicy(timeout_s=0.0).effective_soft_s() == 0.0
+
+
+# ---------------------------------------------------------------------------
+# straggler watchdog: collective::stall + flight dump BEFORE the hard
+# deadline decides (the ISSUE-12 acceptance pin)
+# ---------------------------------------------------------------------------
+
+def test_stall_fault_emits_stall_event_and_flight_dump(tmp_path):
+    """A stall@ fault longer than the soft deadline but shorter than the
+    hard one: the collective SUCCEEDS, yet collective::stall is counted
+    and a flight record is on disk from before the call returned."""
+    from lightgbm_tpu import telemetry
+    from lightgbm_tpu.telemetry import flight
+    d = _fresh_dir(tmp_path, "stall")
+    telemetry.enable("timers")
+    try:
+        telemetry.reset()
+        flight.reset()
+        flight.arm(dump_dir=d)
+        retry.reset_rounds()
+        faults._PLAN = FaultPlan("stall@round=1;secs=1")
+        retry._POLICY = retry.RetryPolicy(timeout_s=30.0, retries=0,
+                                          backoff_s=0.0,
+                                          soft_timeout_s=0.1)
+        assert retry.guard("allgather:probe", lambda: "ok") == "ok"
+        counts = telemetry.events.counts_snapshot()
+        assert counts.get("collective::stall", 0) == 1, counts
+        assert counts.get("collective::timeout", 0) == 0, counts
+        assert counts.get("faults::injected", 0) == 1, counts
+        dump = flight.last_dump_path()
+        assert dump and os.path.exists(dump)
+        rec = json.load(open(dump))
+        assert rec["reason"].startswith("collective_stall:")
+        stalls = [e for e in rec["events"]
+                  if e["kind"] == "collective_stall"]
+        assert stalls and stalls[0]["soft_deadline_s"] == 0.1
+    finally:
+        faults.reset()
+        retry._POLICY = retry.RetryPolicy()
+        flight.disarm()
+        telemetry.reset()
+        telemetry.disable()
+
+
+def test_stall_past_hard_deadline_still_bounded(tmp_path):
+    """A stall longer than the hard deadline: the soft watchdog fires
+    first (stall counted), then the deadline converts the straggler into
+    the usual bounded timeout error — never a hang."""
+    from lightgbm_tpu import telemetry
+    from lightgbm_tpu.telemetry import flight
+    telemetry.enable("timers")
+    flight.disarm()       # the stall path dumps wherever a previous
+    try:                  # test left the recorder armed
+        telemetry.reset()
+        retry.reset_rounds()
+        faults._PLAN = FaultPlan("stall@round=1;secs=30")
+        retry._POLICY = retry.RetryPolicy(timeout_s=0.4, retries=0,
+                                          backoff_s=0.0,
+                                          soft_timeout_s=0.1)
+        t0 = time.time()
+        with pytest.raises(LightGBMError):
+            retry.guard("allgather:wedge", lambda: "never")
+        assert time.time() - t0 < 5.0
+        counts = telemetry.events.counts_snapshot()
+        assert counts.get("collective::stall", 0) == 1, counts
+        assert counts.get("collective::timeout", 0) == 1, counts
+    finally:
+        faults.reset()
+        retry._POLICY = retry.RetryPolicy()
+        telemetry.reset()
+        telemetry.disable()
+
+
+def test_peer_loss_error_names_resume_point():
+    """After a checkpoint write, a permanently-gone peer surfaces as
+    'resumable at iteration K on a smaller mesh', not a generic failure
+    (the watchdog half of the elastic story)."""
+    retry.reset_rounds()
+    retry._POLICY = retry.RetryPolicy(timeout_s=0.0, retries=0,
+                                      backoff_s=0.0)
+    try:
+        retry.set_resume_hint(24, 4)
+
+        def gone():
+            raise ConnectionError("peer vanished")
+        with pytest.raises(LightGBMError) as exc:
+            retry.guard("allgather:x", gone)
+        assert "resumable at iteration 24 on a smaller mesh" in \
+            str(exc.value)
+        assert "num_machines < 4" in str(exc.value)
+        # single-host hint names the checkpoint, not a mesh
+        retry.reset_rounds()
+        retry.set_resume_hint(8, 1)
+        with pytest.raises(LightGBMError) as exc2:
+            retry.guard("allgather:y", gone)
+        assert "resumable at iteration 8 from checkpoint_dir" in \
+            str(exc2.value)
+    finally:
+        retry.set_resume_hint(None)
+        retry._POLICY = retry.RetryPolicy()
+
+
+# ---------------------------------------------------------------------------
+# checkpoint hygiene: orphaned tmp sweep + concurrent-prune tolerance
+# ---------------------------------------------------------------------------
+
+def test_writer_sweeps_orphaned_tmp_files(tmp_path):
+    """A kill mid-write leaves `.ckpt_*.tmp` behind forever; the next
+    saver startup sweeps them: own-rank orphans unconditionally, foreign
+    ones (another rank's snapshot, the shared manifest) only once old
+    enough to be provably dead — a shared dir may have live writers."""
+    d = _fresh_dir(tmp_path, "tmpsweep")
+    own_orphan = os.path.join(d, ".ckpt_00000004.r0.lgc.1234.tmp")
+    aged_foreign = os.path.join(d, ".elastic.manifest.json.77.tmp")
+    live_foreign = os.path.join(d, ".ckpt_00000002.r7.lgc.99.tmp")
+    for p in (own_orphan, aged_foreign, live_foreign):
+        with open(p, "w") as f:
+            f.write("torn half-write")
+    os.utime(aged_foreign, (time.time() - 3600, time.time() - 3600))
+    keepers = [os.path.join(d, "keep.txt"),
+               os.path.join(d, "tmpnotdot.tmp.txt")]
+    for k in keepers:
+        with open(k, "w") as f:
+            f.write("x")
+    ckpt.CheckpointWriter(d, keep=2, cfg_hash="h", fingerprint="fp")
+    assert not os.path.exists(own_orphan)      # rank 0's own: swept
+    assert not os.path.exists(aged_foreign)    # provably dead: swept
+    assert os.path.exists(live_foreign)        # maybe mid-write: kept
+    assert all(os.path.exists(k) for k in keepers)
+
+
+def test_prune_tolerates_concurrent_delete(tmp_path, monkeypatch):
+    """checkpoint_keep pruning on a shared directory: a concurrent rank
+    removing the same stale snapshot must not crash the writer."""
+    d = _fresh_dir(tmp_path, "prunerace")
+    w = ckpt.CheckpointWriter(d, keep=1, cfg_hash="h", fingerprint="fp")
+    w.write_model_text("m2", 2)
+    real_remove = os.remove
+    raced = {"n": 0}
+
+    def racing_remove(path):
+        # the other rank wins the unlink race on every prune target
+        if path.endswith(".lgc"):
+            raced["n"] += 1
+            real_remove(path)
+            raise FileNotFoundError(path)
+        real_remove(path)
+
+    monkeypatch.setattr(os, "remove", racing_remove)
+    w.write_model_text("m4", 4)          # prunes ckpt_2 under the race
+    monkeypatch.setattr(os, "remove", real_remove)
+    assert raced["n"] >= 1
+    assert [i for i, _ in ckpt.list_checkpoints(d)] == [4]
 
 
 # ---------------------------------------------------------------------------
@@ -591,7 +802,10 @@ def test_two_process_distributed_kill_resume(tmp_path):
         procs.append(subprocess.Popen(
             [sys.executable, str(script), str(r), str(port), outs[r],
              ckdir, refdir],
-            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+            env=env, cwd=str(tmp_path),   # fault-plan flight dumps
+            # with no checkpoint_dir land in the worker's cwd — keep
+            # that litter in tmp, not the repo root
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE))
     for p in procs:
         try:
             _, err = p.communicate(timeout=600)
@@ -607,6 +821,9 @@ def test_two_process_distributed_kill_resume(tmp_path):
     assert r0["res"] == r1["res"]            # ranks agree on the model
     for r in (r0, r1):
         assert "failed after" in r["err"], r["err"]
-    # per-rank snapshot streams: both ranks wrote rank-tagged files
-    ranks = {n.split(".r")[1] for n in os.listdir(ckdir)}
+    # per-rank snapshot streams: both ranks wrote rank-tagged files,
+    # plus the (rank-less) mesh manifest the elastic resume path reads
+    ranks = {n.split(".r")[1] for n in os.listdir(ckdir)
+             if n.endswith(".lgc")}
     assert ranks == {"0.lgc", "1.lgc"}
+    assert os.path.exists(os.path.join(ckdir, "elastic.manifest.json"))
