@@ -16,6 +16,7 @@ Common invocations::
     python -m lightgbm_tpu.analysis --write-baseline  # re-grandfather
     python -m lightgbm_tpu.analysis --prune-baseline  # drop stale entries
     python -m lightgbm_tpu.analysis --budgets         # resource tables
+    python -m lightgbm_tpu.analysis --list-audits     # audit registry
     python -m lightgbm_tpu.analysis --perf --json     # perf sentinel
     python -m lightgbm_tpu.analysis --perf-advisory   # report, never block
 """
@@ -26,8 +27,9 @@ import json
 import sys
 
 from . import (auditors, collective_audit, compile_audit, perf_gate,
-               resource_audit)
+               quant_audit, resource_audit)
 from .config import load_config
+from . import jaxpr_audit
 from .jaxpr_audit import run_audits
 from .lint import prune_baseline, run_lint, write_baseline
 from .rules import all_rules
@@ -73,7 +75,30 @@ def _build_parser() -> argparse.ArgumentParser:
                    help="also print suppressed findings")
     p.add_argument("--list-rules", action="store_true",
                    help="print the rule registry and exit")
+    p.add_argument("--list-audits", action="store_true",
+                   dest="list_audits",
+                   help="print the audit registry (jaxpr audits + "
+                        "whole-program auditors + perf sentinel) and "
+                        "exit")
     return p
+
+
+def _list_audits() -> None:
+    """Mirror of --list-rules for the audit side of the gate: every
+    jaxpr audit, every registered whole-program auditor, and the
+    opt-in perf sentinel, with one-line descriptions."""
+    def first_line(doc):
+        return (doc or "").strip().splitlines()[0] if doc else ""
+    for fn in jaxpr_audit.AUDITS:
+        print("jaxpr    %-18s %s" % (fn.__name__.replace("audit_", ""),
+                                     first_line(fn.__doc__)))
+    for name, mod in sorted(auditors.all_auditors().items()):
+        print("auditor  %-18s %s" % (name, first_line(mod.__doc__)))
+    print("auditor  %-18s %s" % (
+        "perf_sentinel",
+        "Perf-regression sentinel over the BENCH_r*/MULTICHIP_r* "
+        "round series (opt-in: --perf gates, --perf-advisory "
+        "reports)."))
 
 
 def main(argv=None) -> int:
@@ -81,6 +106,9 @@ def main(argv=None) -> int:
     if args.list_rules:
         for rule in all_rules():
             print("%s  %-24s %s" % (rule.id, rule.name, rule.description))
+        return 0
+    if args.list_audits:
+        _list_audits()
         return 0
 
     config = load_config()
@@ -141,7 +169,7 @@ def main(argv=None) -> int:
     perf_rep = None
     perf_results = []
     if args.perf or args.perf_advisory:
-        perf_rep, _ = perf_gate._resolve_rounds(config)
+        perf_rep = perf_gate._resolve_rounds(config)
         perf_results = perf_gate.run(config, artifact=perf_rep)
         audits = audits + perf_results
 
@@ -169,6 +197,11 @@ def main(argv=None) -> int:
                 config=config, artifact=art.get("resource_budget"))
             payload["compile_surface"] = compile_audit.compile_surface(
                 config, artifact=art.get("compile_surface"))
+            # the machine-checkable quantization certificate the
+            # item-2/item-3 quantization PRs must ship green against
+            payload["quant_certificate"] = \
+                quant_audit.certificate_payload(
+                    config, artifact=art.get("quant_certify"))
         if perf_rep is not None:
             payload["perf_tables"] = perf_gate.tables(
                 config, artifact=perf_rep)

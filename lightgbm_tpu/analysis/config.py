@@ -47,6 +47,15 @@ class GraftlintConfig:
     # collective call sites (rank-consistency and guard-wrapping checks)
     collective_paths: List[str] = field(default_factory=lambda: [
         "lightgbm_tpu/parallel/", "lightgbm_tpu/resilience/"])
+    # JG010: ops//predict/ files whose narrowing casts are blessed —
+    # their NARROW_OK tables + input contracts feed the precision-flow
+    # auditor; narrowing anywhere else in the hot paths is a finding
+    narrow_ok_paths: List[str] = field(default_factory=lambda: [
+        "lightgbm_tpu/ops/grow.py",
+        "lightgbm_tpu/ops/grow_persist.py",
+        "lightgbm_tpu/ops/pallas_grow.py",
+        "lightgbm_tpu/ops/pallas_histogram.py",
+        "lightgbm_tpu/ops/pallas_scan.py"])
     # resource auditor: device profile the VMEM/HBM budgets come from
     # (telemetry/devices.py; "auto" = detect attached accelerator)
     audit_device: str = "v5e"

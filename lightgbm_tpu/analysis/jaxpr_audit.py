@@ -26,11 +26,24 @@ device execution, runs fine on CPU) and asserts invariants on the IR:
 
 Each audit returns an :class:`AuditResult`; audits that need pallas
 report ``skipped`` on builds without it instead of failing the gate.
+
+The traversal layer lives in :mod:`dataflow` since PR 13: one shared
+walk covers every sub-jaxpr carrier (``pjit``, ``scan``, ``while``,
+``cond``, ``custom_jvp/vjp``, ``closed_call``) AND the consts closed
+over inside them — the old per-check recursion missed an f64 constant
+captured in a ``custom_jvp`` body because consts are not equation
+outputs.  The f64-free walk, the host-prim-in-loop check, and the
+aliasing checks are now small queries against that engine.  Setting
+``LGBTPU_SEED_CUSTOM_JVP_F64=1`` arms the seeded regression fixture
+(an f64 constant closed over inside a ``jax.custom_jvp`` body) as a
+live audit, flipping the gate to exit 1 — the machine-checked proof
+the detector detects.
 """
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
-from typing import Callable, Iterator, List, Optional, Tuple
+from typing import Callable, List, Optional, Tuple
 
 import numpy as np
 
@@ -38,17 +51,17 @@ import jax
 import jax.numpy as jnp
 
 from ..telemetry import events as telemetry
+from . import dataflow
+from .dataflow import alias_sites, find_f64_consts, iter_eqns  # noqa: F401 — re-exported audit queries
 
 C_AUDIT_FAIL = "analysis::audit_fail"
 
-# primitives that round-trip to the host or move buffers; forbidden
-# inside fori_loop / scan / while bodies on the audited paths
-_HOST_PRIMS = {
-    "pure_callback", "io_callback", "debug_callback", "callback",
-    "infeed", "outfeed", "device_put", "copy_to_host_async",
-}
+# re-exported for the transfer auditor and older callers
+_HOST_PRIMS = dataflow.HOST_PRIMS
 
 _F64 = np.dtype("float64")
+
+SEED_CUSTOM_JVP_ENV = "LGBTPU_SEED_CUSTOM_JVP_F64"
 
 
 @dataclass
@@ -64,33 +77,8 @@ class AuditResult:
 
 
 # ---------------------------------------------------------------------------
-# jaxpr walking
+# jaxpr queries (all on the shared dataflow walk)
 # ---------------------------------------------------------------------------
-
-def _sub_jaxprs(eqn) -> Iterator:
-    for val in eqn.params.values():
-        if hasattr(val, "jaxpr"):          # ClosedJaxpr
-            yield val.jaxpr
-        elif hasattr(val, "eqns"):         # raw Jaxpr
-            yield val
-        elif isinstance(val, (list, tuple)):
-            for v in val:
-                if hasattr(v, "jaxpr"):
-                    yield v.jaxpr
-                elif hasattr(v, "eqns"):
-                    yield v
-
-
-def iter_eqns(jaxpr, loop_depth: int = 0) -> Iterator[Tuple[object, int]]:
-    """(eqn, loop_depth) over a jaxpr and every sub-jaxpr; loop_depth
-    counts enclosing while/scan bodies."""
-    for eqn in jaxpr.eqns:
-        yield eqn, loop_depth
-        inner = loop_depth + (1 if eqn.primitive.name in ("while", "scan")
-                              else 0)
-        for sub in _sub_jaxprs(eqn):
-            yield from iter_eqns(sub, inner)
-
 
 def find_f64_converts(jaxpr) -> List[str]:
     out = []
@@ -129,6 +117,11 @@ def _audit_jaxpr(name: str, closed, forbid_f64: bool = True,
     if forbid_f64:
         finder = find_f64_outputs if strict_f64 else find_f64_converts
         hits = finder(jaxpr)
+        if strict_f64:
+            # consts are not equation outputs: an f64 array closed over
+            # (even one narrowed immediately inside a custom_jvp body)
+            # only shows up on the const walk
+            hits = find_f64_consts(closed) + hits
         if hits:
             problems.append("f64 values in a persist-f32 program: %s"
                             % "; ".join(hits[:3]))
@@ -225,11 +218,8 @@ def audit_persist_split_pass() -> AuditResult:
     res = _audit_jaxpr(name, closed, strict_f64=True)
     if not res.ok:
         return res
-    aliased = False
-    for eqn, _ in iter_eqns(closed.jaxpr):
-        if "pallas_call" in eqn.primitive.name:
-            ioa = eqn.params.get("input_output_aliases") or ()
-            aliased = aliased or bool(tuple(ioa))
+    aliased = any(ioa for prim, ioa in alias_sites(closed.jaxpr)
+                  if "pallas_call" in prim)
     if not aliased:
         return AuditResult(
             name=name, ok=False,
@@ -269,11 +259,8 @@ def audit_persist_level_pass() -> AuditResult:
     res = _audit_jaxpr(name, closed, strict_f64=True)
     if not res.ok:
         return res
-    aliased = False
-    for eqn, _ in iter_eqns(closed.jaxpr):
-        if "pallas_call" in eqn.primitive.name:
-            ioa = eqn.params.get("input_output_aliases") or ()
-            aliased = aliased or bool(tuple(ioa))
+    aliased = any(ioa for prim, ioa in alias_sites(closed.jaxpr)
+                  if "pallas_call" in prim)
     if not aliased:
         return AuditResult(
             name=name, ok=False,
@@ -403,6 +390,40 @@ def audit_serve_ladder() -> AuditResult:
                        detail="; ".join(problems))
 
 
+def build_custom_jvp_f64_fixture():
+    """The satellite regression fixture: an f64 constant closed over
+    inside a ``jax.custom_jvp`` body, narrowed to f32 before use — no
+    equation ever OUTPUTS f64 outside a benign staging ``device_put``,
+    so the old recursive walk passed it while the f64 data silently
+    participated.  Returns the traced ClosedJaxpr."""
+    const64 = np.arange(4, dtype=np.float64) * 1.5
+
+    @jax.custom_jvp
+    def leaky(x):
+        return x * jnp.asarray(const64).astype(jnp.float32)
+
+    @leaky.defjvp
+    def leaky_jvp(primals, tangents):
+        return leaky(primals[0]), tangents[0]
+
+    return jax.make_jaxpr(lambda x: leaky(x) + jnp.float32(1))(
+        jax.ShapeDtypeStruct((4,), jnp.float32))
+
+
+def audit_seeded_custom_jvp_f64() -> AuditResult:
+    """Armed by ``LGBTPU_SEED_CUSTOM_JVP_F64=1``: runs the strict f64
+    audit against the seeded fixture, which MUST fail — proving the
+    const-aware walk sees through custom_jvp call primitives."""
+    res = _audit_jaxpr("seeded_custom_jvp_f64",
+                       build_custom_jvp_f64_fixture(), strict_f64=True)
+    if res.ok:
+        return AuditResult(
+            name="seeded_custom_jvp_f64", ok=False,
+            detail="the seeded f64-const-in-custom_jvp fixture passed "
+                   "the strict f64 audit — the const walk regressed")
+    return res
+
+
 AUDITS: Tuple[Callable[[], AuditResult], ...] = (
     audit_hist_window,
     audit_scan_pair,
@@ -418,8 +439,12 @@ AUDITS: Tuple[Callable[[], AuditResult], ...] = (
 def run_audits(names: Optional[List[str]] = None) -> List[AuditResult]:
     """Run all (or the named) audits; an audit that raises reports as a
     failed result rather than killing the gate."""
+    audits = AUDITS
+    if os.environ.get(SEED_CUSTOM_JVP_ENV, "") not in ("", "0"):
+        # the seeded true-positive: flips the gate to exit 1 on demand
+        audits = audits + (audit_seeded_custom_jvp_f64,)
     out: List[AuditResult] = []
-    for fn in AUDITS:
+    for fn in audits:
         nm = fn.__name__.replace("audit_", "")
         if names and nm not in names and fn.__name__ not in names:
             continue

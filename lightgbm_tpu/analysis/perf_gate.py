@@ -238,6 +238,7 @@ class PerfReport:
     verdicts: List[Verdict] = field(default_factory=list)
     missing_keys: List[str] = field(default_factory=list)
     lineages: Dict[str, List[int]] = field(default_factory=dict)
+    root: str = ""          # where the rounds were discovered
 
     @property
     def regressions(self) -> List[Verdict]:
@@ -322,12 +323,14 @@ def evaluate(rounds: List[Round], band_floor: float,
     return rep
 
 
-def _resolve_rounds(config: Optional[GraftlintConfig]):
+def _resolve_rounds(config: Optional[GraftlintConfig]) -> PerfReport:
     config = config or load_config()
     root = os.environ.get("LGBTPU_PERF_ROUNDS_DIR") or config.root
     band = float(getattr(config, "perf_band", 0.15))
     rounds, multichip, errors = discover_rounds(root)
-    return evaluate(rounds, band, multichip=multichip, errors=errors), root
+    rep = evaluate(rounds, band, multichip=multichip, errors=errors)
+    rep.root = root
+    return rep
 
 
 def run(config: Optional[GraftlintConfig] = None,
@@ -335,11 +338,33 @@ def run(config: Optional[GraftlintConfig] = None,
     """Gate entry point (CLI ``--perf``): three AuditResults —
     round schema health, the trajectory verdict, multichip health."""
     rep = artifact if isinstance(artifact, PerfReport) \
-        else _resolve_rounds(config)[0]
+        else _resolve_rounds(config)
     telemetry.count(C_ROUNDS, len(rep.rounds), category="analysis")
     out: List[AuditResult] = []
 
     n_meta = sum(1 for r in rep.rounds if not r.legacy)
+    no_rounds = not rep.rounds and not rep.errors
+    if no_rounds:
+        # a directory with ZERO BENCH_r* rounds is a RoundError-class
+        # state, reported cleanly instead of passing silently (or
+        # worse, tracebacking): gate mode (--perf) exits 1 — a bench
+        # refresh asked the sentinel to judge nothing — while the
+        # pre-commit advisory mode reports and still exits 0
+        detail = ("no BENCH_r* rounds recorded%s — record a round "
+                  "with bench.py (or point LGBTPU_PERF_ROUNDS_DIR at "
+                  "the archive); the pre-commit hook runs this in "
+                  "--perf-advisory mode, which never blocks"
+                  % (" under %s" % rep.root if rep.root else ""))
+        out.append(AuditResult(name="perf_rounds", ok=False,
+                               detail=detail))
+        out.append(AuditResult(name="perf_trajectory", ok=True,
+                               detail="no bench rounds to judge",
+                               skipped=True))
+        # a multichip-only archive still gets its series judged: the
+        # zero-BENCH-rounds failure must not swallow the one verdict
+        # the directory CAN support
+        out.extend(_multichip_result(rep))
+        return out
     out.append(AuditResult(
         name="perf_rounds",
         ok=not rep.errors,
@@ -347,13 +372,15 @@ def run(config: Optional[GraftlintConfig] = None,
                 "legacy), %d multichip"
                 % (len(rep.rounds), n_meta,
                    len(rep.rounds) - n_meta, len(rep.multichip)))
-        if not rep.errors else "; ".join(rep.errors[:3]),
-        skipped=not rep.rounds and not rep.errors))
+        if not rep.errors else "; ".join(rep.errors[:3])))
 
     if not rep.rounds:
+        # every BENCH round failed to parse: the errors gate above,
+        # but the multichip series (if any) still gets its verdict
         out.append(AuditResult(name="perf_trajectory", ok=True,
                                detail="no bench rounds to judge",
                                skipped=True))
+        out.extend(_multichip_result(rep))
         return out
 
     if rep.regressions:
@@ -393,16 +420,21 @@ def run(config: Optional[GraftlintConfig] = None,
         ok=not bad_bits,
         detail="; ".join(bad_bits[:4]) if bad_bits else ok_detail))
 
-    if rep.multichip:
-        latest = rep.multichip[-1]
-        mc_ok = bool(latest.get("ok")) and latest.get("rc", 1) == 0
-        out.append(AuditResult(
-            name="perf_multichip",
-            ok=mc_ok,
-            detail=("latest multichip round r%02d: %s devices, ok=%s"
-                    % (latest["index"], latest.get("n_devices", "?"),
-                       latest.get("ok")))))
+    out.extend(_multichip_result(rep))
     return out
+
+
+def _multichip_result(rep: PerfReport) -> List[AuditResult]:
+    if not rep.multichip:
+        return []
+    latest = rep.multichip[-1]
+    mc_ok = bool(latest.get("ok")) and latest.get("rc", 1) == 0
+    return [AuditResult(
+        name="perf_multichip",
+        ok=mc_ok,
+        detail=("latest multichip round r%02d: %s devices, ok=%s"
+                % (latest["index"], latest.get("n_devices", "?"),
+                   latest.get("ok"))))]
 
 
 def check_fixture(payload) -> List[str]:
@@ -452,7 +484,8 @@ def tables(config: Optional[GraftlintConfig] = None,
         rep = artifact
         root = os.environ.get("LGBTPU_PERF_ROUNDS_DIR") or config.root
     else:
-        rep, root = _resolve_rounds(config)
+        rep = _resolve_rounds(config)
+        root = rep.root
     traj: Dict[str, List[dict]] = {}
     for r in rep.rounds:
         for k, v in _numeric_keys(r.parsed).items():

@@ -41,3 +41,4 @@ from . import jg006_raw_pallas  # noqa: E402,F401
 from . import jg007_unused_imports  # noqa: E402,F401
 from . import jg008_nonatomic_write  # noqa: E402,F401
 from . import jg009_unguarded_collective  # noqa: E402,F401
+from . import jg010_unblessed_narrowing  # noqa: E402,F401

@@ -1,0 +1,102 @@
+"""JG010 — unblessed narrowing casts in the numeric hot paths.
+
+The precision-flow auditor (analysis/precision_audit.py) verifies
+narrowings in the TRACED programs; this rule is its source-level twin:
+a ``.astype(...)`` or ``jnp.asarray(..., dtype=...)`` narrowing to
+f32/bf16/f16/int8/int16 inside ``ops/`` or ``predict/`` is exactly
+where the next tie-flip gets planted, so new narrowing sites may only
+appear in files listed in the ``[tool.graftlint] narrow-ok-paths``
+allowlist — the modules whose narrowings are blessed (and certified)
+through their ``NARROW_OK`` tables and input contracts.  Everything
+else must either stay wide, move into an allowlisted module, or make
+the deliberate-and-justified case inline
+(``# graftlint: disable=JG010``).
+
+Casts to f64, casts to a dynamic dtype (``x.astype(y.dtype)``), and
+code outside ``ops/``/``predict/`` are not flagged.  The rule is
+SOURCE-BLIND: any static cast TO one of the narrow dtypes fires, even
+when the value being cast is already that narrow or narrower (an
+upcast like ``leaf_f16.astype(jnp.float32)``) — the AST cannot see the
+operand's dtype, and a hot-path file full of casts to the narrow
+dtypes belongs in the allowlist (with its ``NARROW_OK`` blessing)
+anyway; a genuinely-widening one-off earns its inline disable.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from ..core import Finding, ModuleContext
+from . import register
+
+# static narrow targets (f64 -> these, or f32 -> the 16-bit ones, or
+# float -> the quantized int payload dtypes)
+_NARROW = {"float32", "bfloat16", "float16", "int8", "int16"}
+_SCOPE = ("lightgbm_tpu/ops/", "lightgbm_tpu/predict/")
+_FROM_VALUE = {"asarray", "array"}
+_JNP = "jax.numpy."
+
+
+def _narrow_target(ctx: ModuleContext, node: ast.AST) -> Optional[str]:
+    """The narrow dtype a static cast argument names, else None."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value if node.value in _NARROW else None
+    dotted = ctx.dotted(node)
+    if dotted is None:
+        return None
+    leaf = dotted.rsplit(".", 1)[-1]
+    return leaf if leaf in _NARROW else None
+
+
+@register
+class UnblessedNarrowing:
+    id = "JG010"
+    name = "unblessed-narrowing"
+    description = ("`.astype`/`jnp.asarray` narrowing to f32/bf16/f16/"
+                   "int8/int16 in ops//predict/ outside the "
+                   "[tool.graftlint] narrow-ok-paths allowlist (the "
+                   "tie-flip planting ground)")
+
+    def check(self, ctx: ModuleContext) -> List[Finding]:
+        rp = ctx.relpath
+        if not any(frag in rp for frag in _SCOPE):
+            return []
+        if any(frag in rp for frag in ctx.config.narrow_ok_paths):
+            return []
+        out: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dt = self._cast_target(ctx, node)
+            if dt:
+                out.append(ctx.finding(
+                    self.id, node,
+                    "narrowing cast to %s outside the narrow-ok-paths "
+                    "allowlist; keep the value wide, move the site "
+                    "into an allowlisted module with a NARROW_OK "
+                    "blessing, or justify it inline" % dt))
+        return out
+
+    def _cast_target(self, ctx: ModuleContext,
+                     node: ast.Call) -> Optional[str]:
+        # x.astype(<narrow>) / x.astype(dtype=<narrow>)
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "astype":
+            if node.args:
+                return _narrow_target(ctx, node.args[0])
+            for kw in node.keywords:
+                if kw.arg == "dtype":
+                    return _narrow_target(ctx, kw.value)
+            return None
+        # jnp.asarray(x, <narrow>) / jnp.asarray(x, dtype=<narrow>)
+        target = ctx.call_target(node)
+        if target is None or not target.startswith(_JNP):
+            return None
+        if target[len(_JNP):] not in _FROM_VALUE:
+            return None
+        for kw in node.keywords:
+            if kw.arg == "dtype":
+                return _narrow_target(ctx, kw.value)
+        if len(node.args) >= 2:
+            return _narrow_target(ctx, node.args[1])
+        return None

@@ -193,6 +193,23 @@ class PersistAssets(NamedTuple):
     #                          #  needs_fix [F] bool, bundled flag)
 
 
+def persist_input_contract(n: int, g_max: float = 1.0,
+                           h_max: float = 0.25) -> dict:
+    """Value-range contract for the persist driver's traced state (the
+    analysis/dataflow seeder reads this): row counts in ``[0, n]``,
+    per-row gradients capped by the objective, hessians NONNEGATIVE and
+    capped — the invariant every split-gain denominator (``H + lambda``)
+    leans on, and the one the quantization certifier needs to bound the
+    ReduceScatter payload scales (plane sums <= n * cap)."""
+    return {
+        "counts": (0.0, float(n)),
+        "grad": (-float(g_max), float(g_max)),
+        "hess": (0.0, float(h_max)),
+        "grad_plane": (-float(n) * float(g_max), float(n) * float(g_max)),
+        "hess_plane": (0.0, float(n) * float(h_max)),
+    }
+
+
 def payload_weight_row(nbw: int, num_scores: int,
                        score64: bool = False) -> int:
     """Row index of the optional weight row == live-row count without it

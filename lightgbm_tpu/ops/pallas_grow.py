@@ -126,6 +126,25 @@ def seg_hist_vmem_bytes(WPA: int, E: int, G: int) -> int:
                    + G * E * 4 + 64 * E * 2 + (20 << 20)))
 
 
+def grow_input_contract(NP: int, w: int = 256) -> dict:
+    """Value-range contract for the persist/level kernel inputs (read
+    by the analysis/dataflow seeder): payload words are packed u32
+    (bins are group-local indices below ``w`` once unpacked), plan rows
+    address payload columns in ``[-1, NP)`` (-1 = inactive slot), and
+    every leaf/segment count is bounded by the padded payload width."""
+    return {
+        "payload": (0.0, float(2 ** 32 - 1)),
+        "bins": (0.0, float(w - 1)),
+        "plan_rows": (-1.0, float(NP)),
+        "counts": (0.0, float(NP)),
+    }
+
+
+# the grow kernels reuse the histogram kernel's exact bf16 hi/lo trick
+# for their in-payload radix contractions (_hist_accum) — same blessing
+NARROW_OK = (("float32", "bfloat16"),)
+
+
 def _lane_iota(E: int):
     return jax.lax.broadcasted_iota(I32, (1, E), 1)
 

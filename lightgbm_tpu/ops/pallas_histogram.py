@@ -35,6 +35,36 @@ def _round_up(x: int, m: int) -> int:
     return (x + m - 1) // m * m
 
 
+# ---------------------------------------------------------------------------
+# dataflow contracts (read by analysis/{precision,quant}_audit)
+# ---------------------------------------------------------------------------
+
+def hist_input_contract(w: int, rows: int, g_max: float = 1.0,
+                        h_max: float = 0.25) -> dict:
+    """Value-range contract for :func:`hist_window`'s arguments, the
+    seed the analysis/dataflow abstract interpreter starts from:
+    group-local bin indices live in ``[0, w)``, per-row grad/hess are
+    capped by the objective (binary logloss: |g| <= 1, 0 <= h <= 1/4),
+    and any bin's accumulated (grad, hess) sum over ``rows`` rows is
+    therefore capped at ``rows * cap``.  The quantization certifier
+    derives its plane scales from exactly these numbers."""
+    return {
+        "bins_t": (0.0, float(w - 1)),
+        "grad": (-float(g_max), float(g_max)),
+        "hess": (0.0, float(h_max)),
+        "grad_plane": (-float(rows) * float(g_max),
+                       float(rows) * float(g_max)),
+        "hess_plane": (0.0, float(rows) * float(h_max)),
+    }
+
+
+# narrowings this kernel performs ON PURPOSE: the bf16 hi + (x - hi) lo
+# split is exact by construction (hi+lo recovers full f32 through the
+# MXU's f32 accumulation — see the module docstring), so the
+# precision-flow auditor blesses f32->bf16 inside hist_window
+NARROW_OK = (("float32", "bfloat16"),)
+
+
 def _hist_kernel(bins_ref, vals_ref, out_ref):
     """One grid step = one row stripe, all feature groups.
 

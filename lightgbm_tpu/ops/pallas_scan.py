@@ -64,6 +64,29 @@ def scan_blocks_vmem_bytes(Gp: int, Wp: int) -> int:
     return int(min(100 << 20, 48 * Gp * Wp * 4 + Wp * Wp * 4 + (20 << 20)))
 
 
+def scan_input_contract(rows: int, g_max: float = 1.0,
+                        h_max: float = 0.25) -> dict:
+    """Value-range contract for the split-find scan inputs, seeded into
+    the analysis/dataflow interpreter: ``gb``/``hb`` are per-bin
+    (grad, hess) histogram sums, so any entry (and any prefix sum of
+    entries — every row contributes once) is bounded by the per-row
+    caps times ``rows``; hessians are nonnegative; the scalar row
+    carries counts in ``[0, rows]`` and the parent aggregates."""
+    g = float(rows) * float(g_max)
+    h = float(rows) * float(h_max)
+    return {
+        "gb": (-g, g), "hb": (0.0, h),
+        "counts": (0.0, float(rows)),
+        "parent_grad": (-g, g), "parent_hess": (0.0, h),
+    }
+
+
+# the split-find scan stages everything in f32 and never narrows on
+# purpose; an empty blessing table means every narrowing the
+# precision-flow auditor finds here must prove its range
+NARROW_OK = ()
+
+
 def _scan_kernel(scal_ref, gb_ref, hb_ref, keepr_ref, keepf_ref,
                  validr_ref, validf_ref, aux_ref, out_ref):
     # validr/validf arrive as [1, F, W] child blocks

@@ -26,7 +26,7 @@ padded levels are no-ops for rows that already landed.
 """
 from __future__ import annotations
 
-from typing import List, NamedTuple, Tuple
+from typing import List, NamedTuple, Optional, Tuple
 
 import numpy as np
 
@@ -119,6 +119,37 @@ def _pack_bucket(models: List, positions: List[int], depth: int) -> TreeBucket:
         decision_type=decision_type, left=left, right=right,
         leaf_value=leaf_value, cat_offset=cat_offset,
         cat_nwords=cat_nwords, cat_words=cat_words)
+
+
+# the device predictor performs no deliberate float narrowing today;
+# the f16 serving path (ROADMAP item 3) must extend this table when it
+# lands, certified by analysis/quant_audit against quant_spec below
+NARROW_OK = ()
+
+
+def quant_spec(ensemble: Optional[CompiledEnsemble] = None,
+               target: str = "float16", num_trees: int = 500) -> dict:
+    """Declarative quantization spec for the f16 leaf/threshold serving
+    tensors (ROADMAP item 3), the input analysis/quant_audit certifies
+    BEFORE that PR lands.  With a compiled ensemble the caps come from
+    the actual packed tensors; without one they are the documented
+    contract defaults the certifier gates against (per-tree |leaf| <= 1
+    after shrinkage, thresholds within the binned feature span)."""
+    leaf_cap, thr_cap, n_trees = 1.0, 256.0, int(num_trees)
+    if ensemble is not None:
+        leaf_cap = max((float(np.abs(b.leaf_value).max())
+                        for b in ensemble.buckets), default=1.0)
+        thr_cap = max((float(np.abs(b.threshold).max())
+                       for b in ensemble.buckets), default=1.0)
+        n_trees = ensemble.num_trees
+    return {
+        "name": "leaf_%s" % target,
+        "kind": "leaf",
+        "target": target,
+        "leaf_abs_max": leaf_cap,
+        "threshold_abs_max": thr_cap,
+        "num_trees": max(n_trees, 1),
+    }
 
 
 def compile_ensemble(models: List, num_tree_per_iteration: int = 1,

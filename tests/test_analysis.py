@@ -252,6 +252,30 @@ FIXTURES = {
                     lambda: multihost_utils.process_allgather(arr))
             """,
     },
+    # JG010 is scoped to ops//predict/ MINUS the narrow-ok-paths
+    # allowlist; the fixture relpath (ops/fake.py) is not allowlisted
+    "JG010": {
+        "positive": """
+            import jax.numpy as jnp
+            import numpy as np
+
+            def shrink(x, leaves):
+                small = x.astype(jnp.float32)          # unblessed narrow
+                tiny = leaves.astype("bfloat16")       # string form too
+                half = leaves.astype(dtype=jnp.float16)  # kwarg form
+                q = jnp.asarray(x, dtype=jnp.int8)     # quantized payload
+                return small, tiny, half, q
+            """,
+        "negative": """
+            import jax.numpy as jnp
+
+            def widen(x, y):
+                big = x.astype(jnp.float64)            # widening: fine
+                dyn = x.astype(y.dtype)                # dynamic: fine
+                arr = jnp.asarray(x, dtype=jnp.float64)
+                return big, dyn, arr
+            """,
+    },
     # JG008 is scoped to the resilience durability paths; its fixtures
     # carry their own relpath (the "relpath" key overrides the OPS default)
     "JG008": {
@@ -287,7 +311,20 @@ def test_every_rule_has_fixtures():
     ids = {r.id for r in all_rules()}
     assert ids == set(FIXTURES), "every JG rule needs fixture snippets"
     assert ids == {"JG001", "JG002", "JG003", "JG004", "JG005", "JG006",
-                   "JG007", "JG008", "JG009"}
+                   "JG007", "JG008", "JG009", "JG010"}
+
+
+def test_jg010_scope_and_allowlist():
+    """The same narrowing cast is fine outside ops//predict/ (host
+    tooling narrows freely) and inside an allowlisted module (the
+    blessed kernels); predict/ is in scope."""
+    pos = FIXTURES["JG010"]["positive"]
+    assert _ids(_lint(pos, relpath=COLD), "JG010") == []
+    assert _ids(_lint(pos,
+                      relpath="lightgbm_tpu/ops/pallas_histogram.py"),
+                "JG010") == []
+    assert len(_ids(_lint(pos, relpath="lightgbm_tpu/predict/fake.py"),
+                    "JG010")) == 4
 
 
 def test_jg009_outside_scope_is_silent():
@@ -638,7 +675,13 @@ def test_cli_smoke(capsys):
     from lightgbm_tpu.analysis.__main__ import main
     assert main(["--list-rules"]) == 0
     out = capsys.readouterr().out
-    assert "JG001" in out and "JG007" in out and "JG009" in out
+    assert "JG001" in out and "JG007" in out and "JG010" in out
+    # --list-audits mirrors --list-rules for the audit registry
+    assert main(["--list-audits"]) == 0
+    out = capsys.readouterr().out
+    for name in ("hist_window", "precision_flow", "transfer",
+                 "quant_certify", "perf_sentinel"):
+        assert name in out, name
     # lint-only over one file: exits 0 and prints the summary line
     assert main(["lightgbm_tpu/analysis/lint.py", "--no-audit"]) == 0
     assert "graft-lint:" in capsys.readouterr().out
@@ -715,6 +758,31 @@ AUDITOR_FIXTURES = {
             def step(x, interpret):
                 return x * 2
             """,
+    },
+    # f64 gains narrowed to f32 BEFORE the argmax (the tie-flip
+    # geometry) vs a range-proven narrowing feeding plain arithmetic
+    "precision_flow": {
+        "positive": {"program": "tie_flip"},
+        "negative": {"program": "bounded_narrow"},
+    },
+    # a host callback inside a scan body vs the same loop kept on-device
+    "transfer": {
+        "positive": {"program": "callback_in_scan"},
+        "negative": {"program": "clean_scan"},
+    },
+    # int8 at full plane scale blows the split-decision budget; int16
+    # at the higgs geometry certifies (the shipped certificate)
+    "quant_certify": {
+        "positive": {"name": "hist_int8", "kind": "histogram",
+                     "target": "int8", "stochastic": True,
+                     "rows_per_rank": 1_312_500, "ranks": 8,
+                     "bins": 256, "g_max": 1.0, "h_max": 0.25,
+                     "lambda": 1.0},
+        "negative": {"name": "hist_int16", "kind": "histogram",
+                     "target": "int16", "stochastic": True,
+                     "rows_per_rank": 1_312_500, "ranks": 8,
+                     "bins": 256, "g_max": 1.0, "h_max": 0.25,
+                     "lambda": 1.0},
     },
 }
 
@@ -859,9 +927,53 @@ def test_auditors_all_green_on_repo():
     results = {r.name: r for r in run_auditors()}
     assert set(results) == {"collective_order", "collective_guarded",
                             "collective_observed", "vmem_budget",
-                            "hbm_budget", "compile_surface"}
+                            "hbm_budget", "compile_surface",
+                            "precision_flow", "transfer",
+                            "quant_certify"}
     bad = {n: r.detail for n, r in results.items() if not r.ok}
     assert not bad, bad
+
+
+def test_transfer_auditor_flags_large_all_gather():
+    """Beyond the registry fixture: the replicated-intermediate arm —
+    an in-program all_gather whose output exceeds the size threshold
+    is a finding, the same program under a lax threshold is not."""
+    from lightgbm_tpu.analysis import transfer_audit as ta
+    hits = ta.check_fixture({"program": "all_gather_large",
+                             "threshold": 1 << 16})
+    assert hits and "replicated" in hits[0]
+    assert ta.check_fixture({"program": "all_gather_large",
+                             "threshold": 1 << 30}) == []
+
+
+def test_gate_flips_on_seeded_tie_flip(monkeypatch, capsys):
+    """LGBTPU_SEED_TIE_FLIP=1 arms the seeded tie-flip program as a
+    live precision_flow audit: the CLI gate must exit 1."""
+    from lightgbm_tpu.analysis.__main__ import main
+    from lightgbm_tpu.analysis.precision_audit import SEED_TIE_FLIP_ENV
+    monkeypatch.setenv(SEED_TIE_FLIP_ENV, "1")
+    code = main(["--json", "--audit-only"])
+    payload = json.loads(capsys.readouterr().out)
+    assert code == 1 and payload["exit_code"] == 1
+    bad = [a for a in payload["audits"]
+           if a["name"] == "precision_flow" and not a["ok"]]
+    assert bad and "tie_flip" in bad[0]["detail"]
+
+
+def test_gate_flips_on_seeded_custom_jvp_f64(monkeypatch, capsys):
+    """LGBTPU_SEED_CUSTOM_JVP_F64=1 arms the f64-const-in-custom_jvp
+    fixture as a live jaxpr audit: the CLI gate must exit 1 with the
+    const named (the class the pre-dataflow walk missed)."""
+    from lightgbm_tpu.analysis.__main__ import main
+    from lightgbm_tpu.analysis.jaxpr_audit import SEED_CUSTOM_JVP_ENV
+    monkeypatch.setenv(SEED_CUSTOM_JVP_ENV, "1")
+    code = main(["--json", "--audit-only"])
+    payload = json.loads(capsys.readouterr().out)
+    assert code == 1 and payload["exit_code"] == 1
+    bad = [a for a in payload["audits"]
+           if a["name"] == "seeded_custom_jvp_f64"]
+    assert bad and not bad[0]["ok"]
+    assert "const f64" in bad[0]["detail"]
 
 
 def test_cli_gate_json_green(capsys):
@@ -875,11 +987,20 @@ def test_cli_gate_json_green(capsys):
     audit_names = {a["name"] for a in payload["audits"]}
     assert {"collective_order", "collective_guarded",
             "collective_observed", "vmem_budget", "hbm_budget",
-            "compile_surface"} <= audit_names
+            "compile_surface", "precision_flow", "transfer",
+            "quant_certify"} <= audit_names
     assert payload["lint"]["counts"]["unsuppressed"] == 0
     assert payload["collective_trace"]["findings"] == []
     assert payload["resource_tables"]["vmem"]
     assert payload["compile_surface"]["total_bound"] <= 64
+    # the machine-checkable quantization certificate: every spec green,
+    # and the int16 histogram bound within the pinned decision budget
+    qc = payload["quant_certificate"]
+    assert qc["all_ok"]
+    hist16 = [c for c in qc["certificates"]
+              if c["spec"]["name"].startswith("hist_int16")]
+    assert hist16 and all(
+        c["bound"] <= qc["budgets"]["split_decision"] for c in hist16)
 
 
 def test_jg007_skips_imports_sharing_a_line(tmp_path):

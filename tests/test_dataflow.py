@@ -1,0 +1,389 @@
+"""The jaxpr abstract-interpretation engine (analysis/dataflow.py).
+
+Four layers, mirroring the PR 13 acceptance criteria:
+
+* interval/error propagation pinned against HAND-COMPUTED bounds for
+  add/mul/dot/cumsum/select chains (the formulas are part of the
+  engine's contract: one roundoff per op at result magnitude, gamma_K
+  accumulation for contractions);
+* loop handling: exact unroll for short static scans, join-fixpoint
+  convergence on a stable scan body, widening on a divergent one;
+* the custom_jvp-f64-const regression (satellite fix): consts closed
+  over through call primitives are invisible to an equation-output
+  walk and MUST be reported by the const-aware engine;
+* the quantization certificate for the [G, 256] histogram plane:
+  the static split-gain bound must dominate an empirical max over
+  1k random stochastically-quantized payloads at the same geometry.
+"""
+import math
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from lightgbm_tpu.analysis import dataflow as df
+from lightgbm_tpu.analysis import quant_audit as qa
+
+F32 = jnp.float32
+U32 = 2.0 ** -24        # f32 unit roundoff
+
+
+def _mk(fn, *shapes):
+    return jax.make_jaxpr(fn)(*[jax.ShapeDtypeStruct(s, F32)
+                                for s in shapes])
+
+
+# ---------------------------------------------------------------------------
+# interval + error propagation vs hand-computed bounds
+# ---------------------------------------------------------------------------
+
+def test_add_mul_chain_hand_bounds():
+    """x*y + x with x in [0,2], y in [-1,3]: mul lands in [-2,6] with
+    one roundoff at magnitude 6; add lands in [-2,8] adding the
+    propagated error plus one roundoff at magnitude 8."""
+    closed = _mk(lambda x, y: x * y + x, (4,), (4,))
+    rep = df.interpret(closed, in_ranges={0: (0.0, 2.0), 1: (-1.0, 3.0)})
+    out = rep.out_vals[0]
+    assert (out.rng.lo, out.rng.hi) == (-2.0, 8.0)
+    assert out.err == pytest.approx(U32 * 6 + U32 * 8)
+
+
+def test_sub_select_chain_hand_bounds():
+    """where(m, x - y, x) joins both branches; select is exact so the
+    error is the max of the branch errors."""
+    def fn(m, x, y):
+        return jnp.where(m, x - y, x)
+    closed = jax.make_jaxpr(fn)(
+        jax.ShapeDtypeStruct((4,), jnp.bool_),
+        jax.ShapeDtypeStruct((4,), F32),
+        jax.ShapeDtypeStruct((4,), F32))
+    rep = df.interpret(closed, in_ranges={1: (0.0, 1.0), 2: (0.0, 4.0)})
+    out = rep.out_vals[0]
+    # sub: [-4, 1] (err u*4); join with x: [-4, 1]
+    assert (out.rng.lo, out.rng.hi) == (-4.0, 1.0)
+    assert out.err == pytest.approx(U32 * 4)
+
+
+def test_dot_hand_bounds():
+    """[2,8]x[8,3] contraction (K=8) of a in [0,1], b in [-1,1]:
+    range K*hull(a*b) = [-8,8], error K*u*|a||b| for exact inputs."""
+    def fn(a, b):
+        return jax.lax.dot_general(a, b, (((1,), (0,)), ((), ())),
+                                   preferred_element_type=F32)
+    closed = _mk(fn, (2, 8), (8, 3))
+    rep = df.interpret(closed, in_ranges={0: (0.0, 1.0), 1: (-1.0, 1.0)})
+    out = rep.out_vals[0]
+    assert (out.rng.lo, out.rng.hi) == (-8.0, 8.0)
+    assert out.err == pytest.approx(8 * U32)
+
+
+def test_cumsum_hand_bounds():
+    """cumsum of 16 values in [0,1]: partial sums live in [0,16];
+    error is L*u at the output magnitude (gamma_L-style)."""
+    closed = _mk(lambda x: jnp.cumsum(x), (16,))
+    rep = df.interpret(closed, in_ranges={0: (0.0, 1.0)})
+    out = rep.out_vals[0]
+    assert (out.rng.lo, out.rng.hi) == (0.0, 16.0)
+    assert out.err == pytest.approx(16 * U32 * 16)
+
+
+def test_div_needs_nonzero_denominator():
+    """x / h is bounded only when the denominator interval excludes
+    zero — the split-gain H + lambda pattern."""
+    closed = _mk(lambda x, h: x / (h + jnp.float32(1.0)), (4,), (4,))
+    rep = df.interpret(closed, in_ranges={0: (-8.0, 8.0), 1: (0.0, 3.0)})
+    out = rep.out_vals[0]
+    assert (out.rng.lo, out.rng.hi) == (-8.0, 8.0)
+    closed2 = _mk(lambda x, h: x / h, (4,), (4,))
+    rep2 = df.interpret(closed2, in_ranges={0: (-8.0, 8.0),
+                                            1: (-1.0, 3.0)})
+    assert not rep2.out_vals[0].rng.bounded
+
+
+def test_clamp_interval_sound_for_nonpoint_bounds():
+    """clamp with a data-dependent upper bound: the result can land at
+    the BOUND's low end, so [5,5] clamped into hi in [0,10] must
+    include 0 — the monotone min/max formula, not a point-bound
+    shortcut."""
+    def fn(x, hi):
+        return jax.lax.clamp(jnp.float32(0.0), x, hi)
+    closed = _mk(fn, (4,), (4,))
+    rep = df.interpret(closed, in_ranges={0: (5.0, 5.0),
+                                          1: (0.0, 10.0)})
+    out = rep.out_vals[0]
+    assert out.rng.lo == 0.0 and out.rng.hi == 5.0
+
+
+def test_integer_pow_negative_and_zero_exponents():
+    """x ** -2 with x in [2,4] is [1/16, 1/4]; x**0 is exactly 1; a
+    zero-straddling base under a negative power must degrade to TOP,
+    never return the base's range unchanged."""
+    closed = _mk(lambda x: x ** -2, (4,))
+    rep = df.interpret(closed, in_ranges={0: (2.0, 4.0)})
+    out = rep.out_vals[0]
+    assert out.rng.lo == pytest.approx(1.0 / 16.0)
+    assert out.rng.hi == pytest.approx(1.0 / 4.0)
+    closed0 = _mk(lambda x: x ** 0, (4,))
+    rep0 = df.interpret(closed0, in_ranges={0: (2.0, 4.0)})
+    assert (rep0.out_vals[0].rng.lo, rep0.out_vals[0].rng.hi) \
+        == (1.0, 1.0)
+    rep_bad = df.interpret(closed, in_ranges={0: (-1.0, 4.0)})
+    assert not rep_bad.out_vals[0].rng.bounded
+
+
+def test_unknown_primitive_degrades_to_top():
+    """Soundness: a primitive without a rule must produce TOP, never a
+    fabricated bound (sort has no transfer rule)."""
+    closed = _mk(lambda x: jnp.sort(x), (8,))
+    rep = df.interpret(closed, in_ranges={0: (0.0, 1.0)})
+    assert not rep.out_vals[0].rng.bounded or \
+        rep.out_vals[0].rng == df.Interval(0.0, 1.0)
+
+
+# ---------------------------------------------------------------------------
+# loop bodies: exact unroll / fixpoint / widening
+# ---------------------------------------------------------------------------
+
+def _scan_prog(body, length, init=0.0):
+    return jax.make_jaxpr(
+        lambda xs: jax.lax.scan(body, jnp.float32(init), xs))(
+            jax.ShapeDtypeStruct((length,), F32))
+
+
+def test_scan_short_unrolls_exactly():
+    """An additive carry over 8 steps of x in [0,1] proves the TIGHT
+    bound [0,8] — short static scans are unrolled, not widened."""
+    closed = _scan_prog(lambda c, x: (c + x, c), 8)
+    rep = df.interpret(closed, in_ranges={0: (0.0, 1.0)})
+    assert (rep.out_vals[0].rng.lo, rep.out_vals[0].rng.hi) == (0.0, 8.0)
+    assert rep.fixpoint == {"rounds": 8, "converged": True,
+                            "widened": False, "mode": "unrolled"}
+
+
+def test_scan_fixpoint_converges_on_stable_body():
+    """max(c, x) saturates at the element bound: the join-fixpoint
+    reaches [0,1] in two rounds with no widening, on a scan far too
+    long to unroll."""
+    closed = _scan_prog(lambda c, x: (jnp.maximum(c, x), c), 4096)
+    rep = df.interpret(closed, in_ranges={0: (0.0, 1.0)})
+    assert (rep.out_vals[0].rng.lo, rep.out_vals[0].rng.hi) == (0.0, 1.0)
+    assert rep.fixpoint["mode"] == "fixpoint"
+    assert rep.fixpoint["converged"] and not rep.fixpoint["widened"]
+    assert rep.fixpoint["rounds"] <= 3
+
+
+def test_scan_divergent_body_widens():
+    """An additive carry over 4096 steps cannot stabilize: widening
+    must fire and the upper bound goes to +inf (soundly — never a
+    fabricated finite bound), within the iteration cap."""
+    closed = _scan_prog(lambda c, x: (c + x, c), 4096)
+    rep = df.interpret(closed, in_ranges={0: (0.0, 1.0)})
+    out = rep.out_vals[0]
+    assert out.rng.lo == 0.0 and out.rng.hi == math.inf
+    assert rep.fixpoint["widened"]
+    assert rep.fixpoint["rounds"] <= df.FIXPOINT_MAX
+
+
+def test_while_carry_fixpoint():
+    closed = jax.make_jaxpr(
+        lambda x: jax.lax.while_loop(
+            lambda c: c[0] < 10,
+            lambda c: (c[0] + 1, jnp.minimum(c[1], jnp.float32(0.0))),
+            (jnp.int32(0), x)))(jax.ShapeDtypeStruct((), F32))
+    rep = df.interpret(closed, in_ranges={0: (-2.0, 5.0)})
+    # min-carry saturates at [-2, 0] joined with the seed [-2, 5]
+    assert rep.out_vals[1].rng.lo == -2.0
+    assert rep.out_vals[1].rng.hi <= 5.0
+
+
+# ---------------------------------------------------------------------------
+# narrowing sites + the custom_jvp f64-const regression
+# ---------------------------------------------------------------------------
+
+def test_narrow_site_range_proven():
+    def fn(x):
+        return (x * jnp.float64(0.5)).astype(F32)
+    closed = jax.make_jaxpr(fn)(jax.ShapeDtypeStruct((8,), jnp.float64))
+    rep = df.interpret(closed, in_ranges={0: (-1000.0, 1000.0)})
+    (site,) = [s for s in rep.narrowings if not s.weak_src]
+    assert site.src == "float64" and site.dst == "float32"
+    assert site.fits and not site.decision_relevant
+    assert (site.rng.lo, site.rng.hi) == (-500.0, 500.0)
+
+
+def test_narrow_site_feeding_compare_is_decision_relevant():
+    def fn(x):
+        g32 = x.astype(F32)
+        return jnp.max(g32)
+    closed = jax.make_jaxpr(fn)(jax.ShapeDtypeStruct((8,), jnp.float64))
+    rep = df.interpret(closed, in_ranges={0: (0.0, 1.0)})
+    (site,) = [s for s in rep.narrowings if not s.weak_src]
+    assert site.decision_relevant        # the tie-flip geometry
+
+
+def test_narrow_decision_relevance_crosses_pjit():
+    """The tie-flip geometry hidden behind a jit boundary: the compare
+    lives inside the callee, the narrowing outside — the site key must
+    thread through the pjit call and still mark the site."""
+    def fn(x):
+        g32 = x.astype(F32)
+        return jax.jit(lambda y: jnp.argmax(y))(g32)
+    closed = jax.make_jaxpr(fn)(jax.ShapeDtypeStruct((8,), jnp.float64))
+    rep = df.interpret(closed, in_ranges={0: (-10.0, 10.0)})
+    (site,) = [s for s in rep.narrowings if not s.weak_src]
+    assert site.decision_relevant
+
+
+def test_custom_jvp_f64_const_is_found():
+    """The satellite regression: an f64 const closed over inside a
+    custom_jvp body, narrowed before use — no equation outputs f64
+    (beyond benign staging), yet the const IS f64 data in the program.
+    The const-aware engine and find_f64_consts must both see it."""
+    from lightgbm_tpu.analysis.jaxpr_audit import (
+        _audit_jaxpr, build_custom_jvp_f64_fixture)
+    closed = build_custom_jvp_f64_fixture()
+    assert df.find_f64_consts(closed)
+    rep = df.interpret(closed)
+    assert any("const f64" in s for s in rep.f64_sites)
+    res = _audit_jaxpr("fixture", closed, strict_f64=True)
+    assert not res.ok and "const f64" in res.detail
+
+
+def test_f64_const_through_pjit_is_found():
+    c64 = np.linspace(0.0, 1.0, 5)          # float64
+
+    def fn(x):
+        return jax.jit(lambda v: v * jnp.asarray(c64).astype(F32))(x)
+    closed = jax.make_jaxpr(fn)(jax.ShapeDtypeStruct((5,), F32))
+    assert df.find_f64_consts(closed)
+
+
+def test_alias_sites_query():
+    """The donation query the persist audits now use: pjit donation
+    shows up as input_output_aliases on the traced call."""
+    @jax.jit
+    def fn(x):
+        return x * jnp.float32(2.0)
+    closed = jax.make_jaxpr(fn)(jax.ShapeDtypeStruct((4,), F32))
+    assert isinstance(df.alias_sites(closed.jaxpr), list)
+
+
+# ---------------------------------------------------------------------------
+# quantization certificate: static bound vs 1k-payload empirical max
+# ---------------------------------------------------------------------------
+
+def _stochastic_quantize(plane, scale, bits, rng):
+    """Reference stochastic-rounding quantizer: symmetric at the
+    contract scale, unbiased, per-entry error <= step."""
+    levels = (1 << bits) - 2
+    step = 2.0 * scale / levels
+    q = np.floor(plane / step + rng.random(plane.shape))
+    return np.clip(q, -(levels // 2 + 1), levels // 2) * step
+
+
+def _split_gains(g, h, lam):
+    """gain(s) = GL^2/(HL+lam) + GR^2/(HR+lam) - GP^2/(HP+lam) over
+    every split point of a [W] plane pair."""
+    gl, hl = np.cumsum(g)[:-1], np.cumsum(h)[:-1]
+    gp, hp = g.sum(), h.sum()
+    gr, hr = gp - gl, hp - hl
+    return (gl ** 2 / (hl + lam) + gr ** 2 / (hr + lam)
+            - gp ** 2 / (hp + lam))
+
+
+@pytest.mark.parametrize("geometry", ["higgs", "expo"])
+def test_quant_certificate_geometries(geometry):
+    """The shipped certificates: int16 histogram planes at both
+    geometries certify under the pinned split-decision budget."""
+    certs = {c["spec"]["name"]: c for c in qa.compute_artifact()}
+    cert = certs["hist_int16_%s" % geometry]
+    assert cert["ok"]
+    assert cert["bound"] <= qa.SPLIT_DECISION_BUDGET
+    assert cert["margin"] > 1.5
+    # int8 at the same geometry must NOT certify
+    spec8 = dict(cert["spec"], target="int8", name="hist_int8")
+    assert not qa.certify(spec8)["ok"]
+
+
+def test_quant_bound_dominates_empirical_max():
+    """1000 random [2, 256] plane payloads, R ranks, int16 stochastic
+    rounding at the contract scales: the worst observed split-gain
+    perturbation over the certified decision domain must stay below
+    the static bound (with real margin — the bound is a 6.5-sigma
+    Hoeffding envelope)."""
+    W, R, rows, lam = 256, 4, 65536, 1.0
+    g_max, h_max = 1.0, 0.25
+    spec = {"name": "emp", "kind": "histogram", "target": "int16",
+            "stochastic": True, "rows_per_rank": rows, "ranks": R,
+            "bins": W, "g_max": g_max, "h_max": h_max, "lambda": lam}
+    cert = qa.certify(spec)
+    assert cert["ok"]
+    s_g, s_h = rows * g_max, rows * h_max
+    h_floor = qa.H_CHILD_FRAC * R * s_h
+    rng = np.random.default_rng(20260804)
+    worst = 0.0
+    n_checked = 0
+    for _ in range(1000):
+        g_ranks = rng.uniform(-1.0, 1.0, (R, W))
+        g_ranks *= s_g / np.abs(g_ranks).sum(axis=1, keepdims=True)
+        h_ranks = rng.uniform(0.0, 1.0, (R, W))
+        h_ranks *= s_h / h_ranks.sum(axis=1, keepdims=True)
+        gq = sum(_stochastic_quantize(g_ranks[r], s_g, 16, rng)
+                 for r in range(R))
+        hq = sum(_stochastic_quantize(h_ranks[r], s_h, 16, rng)
+                 for r in range(R))
+        g, h = g_ranks.sum(axis=0), h_ranks.sum(axis=0)
+        exact = _split_gains(g, h, lam)
+        quant = _split_gains(gq, hq, lam)
+        hl = np.cumsum(h)[:-1]
+        in_domain = (hl >= h_floor) & ((h.sum() - hl) >= h_floor)
+        if in_domain.any():
+            worst = max(worst,
+                        float(np.abs(exact - quant)[in_domain].max()))
+            n_checked += int(in_domain.sum())
+    assert n_checked > 1000          # the domain is actually exercised
+    assert worst <= cert["gain_perturbation"]
+    assert worst > 0.0               # and the experiment is non-trivial
+
+
+def test_leaf_f16_certificate_tracks_ensemble():
+    from lightgbm_tpu.predict.compile import quant_spec
+    cert = qa.certify(quant_spec())
+    assert cert["ok"] and cert["bound"] == pytest.approx(2.0 ** -11)
+    # a bf16 leaf spec keeps only 8 bits and must fail the budget
+    assert not qa.certify(dict(quant_spec(), target="bfloat16"))["ok"]
+
+
+def test_input_contract_annotations_exist():
+    """The seeder's contract surface: every annotated module exposes
+    ranges the auditors read (hessians nonnegative, bins below w)."""
+    from lightgbm_tpu.ops.grow_persist import persist_input_contract
+    from lightgbm_tpu.ops.pallas_grow import grow_input_contract
+    from lightgbm_tpu.ops.pallas_histogram import hist_input_contract
+    from lightgbm_tpu.ops.pallas_scan import scan_input_contract
+    hc = hist_input_contract(w=256, rows=1000)
+    assert hc["bins_t"] == (0.0, 255.0) and hc["hess"][0] == 0.0
+    pc = persist_input_contract(n=1000)
+    assert pc["hess"][0] == 0.0 and pc["counts"] == (0.0, 1000.0)
+    sc = scan_input_contract(rows=1000)
+    assert sc["hb"][0] == 0.0
+    gc = grow_input_contract(NP=4096)
+    assert gc["plan_rows"] == (-1.0, 4096.0)
+
+
+def test_dataflow_values_counter():
+    from lightgbm_tpu.telemetry import events
+    prev = events.mode()
+    events.enable("timers")
+    events.reset()
+    try:
+        closed = _mk(lambda x: x + x, (4,))
+        df.interpret(closed)
+        counts = events.counts_snapshot()
+        assert counts.get("analysis::dataflow_values", 0) >= 1
+    finally:
+        events.reset()
+        if prev == events.OFF:
+            events.disable()

@@ -497,6 +497,51 @@ def test_perf_cli_fails_on_regressed_series(tmp_path, monkeypatch,
     assert rc == 0 and "ADVISORY-FAIL" in out
 
 
+def test_perf_cli_zero_rounds_reports_cleanly(tmp_path, monkeypatch,
+                                              capsys):
+    """A directory with ZERO BENCH_r* rounds is reported as "no rounds
+    recorded" with a RoundError-style message — gate mode exits 1
+    (judging nothing is a bench-refresh bug), advisory mode exits 0 —
+    never a traceback, never a silent pass."""
+    monkeypatch.setenv("LGBTPU_PERF_ROUNDS_DIR", str(tmp_path))
+    from lightgbm_tpu.analysis.__main__ import main
+    rc = main(["lightgbm_tpu/analysis/perf_gate.py", "--no-audit",
+               "--perf", "--json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 1 and payload["exit_code"] == 1
+    rounds = [a for a in payload["audits"]
+              if a["name"] == "perf_rounds"][0]
+    assert not rounds["ok"] and "rounds recorded" in rounds["detail"]
+    assert str(tmp_path) in rounds["detail"]
+    traj = [a for a in payload["audits"]
+            if a["name"] == "perf_trajectory"][0]
+    assert traj["ok"] and traj["skipped"]
+    # the pre-commit advisory mode reports the same state, exit 0
+    rc = main(["lightgbm_tpu/analysis/perf_gate.py", "--no-audit",
+               "--perf-advisory"])
+    out = capsys.readouterr().out
+    assert rc == 0 and "ADVISORY-FAIL" in out
+    # evaluate()/run() on the empty series also stay exception-free
+    rep = evaluate([], BAND)
+    results = perf_gate.run(artifact=rep)
+    assert any(not r.ok for r in results)
+    # a multichip-only archive still gets its series judged: the
+    # zero-BENCH-rounds failure must not swallow the multichip verdict
+    rep_mc = evaluate([], BAND, multichip=[
+        {"index": 1, "ok": True, "rc": 0, "n_devices": 8}])
+    names = {r.name: r for r in perf_gate.run(artifact=rep_mc)}
+    assert not names["perf_rounds"].ok
+    assert "perf_multichip" in names and names["perf_multichip"].ok
+    # ...and in the sibling state where every BENCH round failed to
+    # PARSE, a failing multichip series must still be reported
+    rep_err = evaluate([], BAND,
+                       multichip=[{"index": 1, "ok": False, "rc": 1}],
+                       errors=["BENCH_r01.json: unreadable round json"])
+    names = {r.name: r for r in perf_gate.run(artifact=rep_err)}
+    assert not names["perf_rounds"].ok
+    assert not names["perf_multichip"].ok
+
+
 def test_profile_perf_card_cli(tmp_path, capsys):
     """profile --perf-card SHAPE reads an archived snapshot — no bench
     re-run, no accelerator."""
