@@ -251,6 +251,17 @@ def main():
     }
     if bench_telemetry:
         result["phases"] = phase_snaps["higgs"]["categories"]
+        # runtime numerics sentinel: the higgs phase's split-margin p01
+        # (numerics::split_margin flushes when the persist path runs —
+        # on a gate-less box use BENCH_PARAMS="tpu_persist_scan=force").
+        # HIGHER_BETTER in the --perf sentinel: a quantization change
+        # that collapses decision margins gates even at equal throughput
+        mh = telemetry.histo.get("numerics::split_margin")
+        if mh is not None and mh.count:
+            # significant figures, not decimal places: the margin layout
+            # reaches down to 1e-9 and a round(., 6) would flatten any
+            # sub-5e-7 p01 to 0.0 — invisible to the HIGHER_BETTER gate
+            result["margin_p01"] = float("%.4g" % mh.percentile(0.01))
     # print the primary metric BEFORE the MS-LTR phase so a hard crash
     # there (OOM kill, TPU fault) can't lose it; the combined line with
     # the ranking keys is re-printed last and shadows this one for

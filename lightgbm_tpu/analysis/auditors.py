@@ -1,6 +1,6 @@
 """Registry of the whole-program auditors behind the analysis gate.
 
-Six source/program-level audit engines complement the jaxpr audits
+Seven source/program-level audit engines complement the jaxpr audits
 (:mod:`jaxpr_audit` traces real programs; these reason about the
 source/geometry/dataflow statically):
 
@@ -18,7 +18,11 @@ source/geometry/dataflow statically):
   (:mod:`transfer_audit`);
 * ``quant_certify`` — static split-gain / leaf-output error bounds for
   the declared int8/int16/f16 quantization specs, shipped as the
-  ``--json`` ``quant_certificate`` artifact (:mod:`quant_audit`).
+  ``--json`` ``quant_certificate`` artifact (:mod:`quant_audit`);
+* ``health_covered`` — every module that builds a persist/level scan
+  driver must flush its device-side ``numerics::*`` health stats
+  (:mod:`health_audit` — the runtime numerics sentinel's coverage
+  gate).
 
 Each module exposes ``run(config) -> List[AuditResult]`` (the gate) and
 ``check_fixture(payload) -> List[str]`` (the seeded-violation hook the
@@ -29,8 +33,9 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional
 
-from . import (collective_audit, compile_audit, precision_audit,
-               quant_audit, resource_audit, transfer_audit)
+from . import (collective_audit, compile_audit, health_audit,
+               precision_audit, quant_audit, resource_audit,
+               transfer_audit)
 from .config import GraftlintConfig
 from .jaxpr_audit import AuditResult
 
@@ -41,6 +46,7 @@ AUDITORS: Dict[str, object] = {
     "precision_flow": precision_audit,
     "transfer": transfer_audit,
     "quant_certify": quant_audit,
+    "health_covered": health_audit,
 }
 
 
@@ -65,6 +71,7 @@ def compute_artifacts(config: Optional[GraftlintConfig] = None
         "precision_flow": precision_audit.compute_artifact(config),
         "transfer": transfer_audit.compute_artifact(config),
         "quant_certify": quant_audit.compute_artifact(config),
+        "health_covered": health_audit.compute_artifact(config),
     }
 
 

@@ -1,0 +1,197 @@
+"""health_covered auditor: every persist/level driver consumer must flush
+the device-side numerics health stats.
+
+The runtime numerics sentinel only works if the device-accumulated
+health vector actually reaches the telemetry registry: a program built
+on ``make_scan_driver`` whose stats output is dropped (or whose owner
+never calls the canonical flush) trains blind — NaN storms and margin
+collapses happen on the chip and nobody ever sees them. Same shape as
+the ``collective_observed`` audit: enumerate the driver-construction
+sites statically, fail on any site with no flush path
+(:func:`telemetry.health.flush_device_stats` directly, or
+``flush_level_stats`` — the learner wrapper around it).
+
+Coverage is inheritance-aware: the sharded learner builds its driver in
+``parallel/learners.py`` but rides the serial learner's
+``train_arrays_scan_persist``/``flush_level_stats`` loop — a driver
+site inside a class is covered when the class OR any base in the
+audited file set flushes. Scope: the graftlint include paths (the
+package itself); drivers built in tests/fixtures are out of scope.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional
+
+from ..telemetry import events as telemetry
+from .config import GraftlintConfig, load_config
+from .jaxpr_audit import AuditResult
+
+C_UNOBSERVED = "analysis::health_unobserved"
+
+# building one of these yields a program whose stats output carries the
+# numerics health vector (ops/grow_persist STATS_LEN layout)
+DRIVER_BUILDERS = ("make_scan_driver",)
+# flush_level_stats is the learner-side wrapper around the canonical
+# telemetry.health.flush_device_stats
+FLUSH_CALLS = ("flush_device_stats", "flush_level_stats")
+
+
+def _call_name(node: ast.Call) -> str:
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return ""
+
+
+def _base_name(node) -> str:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return ""
+
+
+class _ModuleScan:
+    """One module's driver sites, flush calls, and class graph."""
+
+    def __init__(self, source: str, relpath: str):
+        self.relpath = relpath
+        self.sites: List[tuple] = []      # (lineno, builder, class|None)
+        self.module_flushes = False
+        self.classes: Dict[str, dict] = {}  # name -> {bases, flushes}
+        self.error: Optional[str] = None
+        try:
+            tree = ast.parse(source)
+        except SyntaxError as exc:
+            self.error = "%s: unparsable (%s)" % (relpath, exc)
+            return
+        self._walk(tree, None)
+
+    def _walk(self, node, cls: Optional[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                self.classes.setdefault(
+                    child.name,
+                    {"bases": [b for b in map(_base_name, child.bases)
+                               if b], "flushes": False})
+                self._walk(child, child.name)
+                continue
+            if isinstance(child, ast.Call):
+                name = _call_name(child)
+                if name in DRIVER_BUILDERS:
+                    self.sites.append((child.lineno, name, cls))
+                elif name in FLUSH_CALLS:
+                    if cls is None:
+                        self.module_flushes = True
+                    else:
+                        self.classes[cls]["flushes"] = True
+            self._walk(child, cls)
+
+
+def _evaluate(scans: List[_ModuleScan]) -> dict:
+    """Resolve flush coverage over the combined class graph (a class
+    flushes if it or any transitive base — matched BY NAME across the
+    audited set — contains a flush call)."""
+    classes: Dict[str, dict] = {}
+    for sc in scans:
+        classes.update(sc.classes)
+
+    def class_flushes(name: str, seen=None) -> bool:
+        seen = seen or set()
+        if name in seen or name not in classes:
+            return False
+        seen.add(name)
+        info = classes[name]
+        return info["flushes"] or any(class_flushes(b, seen)
+                                      for b in info["bases"])
+
+    findings: List[str] = []
+    sites = 0
+    for sc in scans:
+        if sc.error:
+            findings.append(sc.error)
+            continue
+        for line, builder, cls in sc.sites:
+            sites += 1
+            covered = sc.module_flushes or (cls is not None
+                                            and class_flushes(cls))
+            if not covered:
+                findings.append(
+                    "%s:%d: %s(...) builds a persist/level driver but "
+                    "nothing on its path flushes the numerics::* health "
+                    "stats (call telemetry.health.flush_device_stats — "
+                    "or the learner's flush_level_stats — on the stats "
+                    "vector)" % (sc.relpath, line, builder))
+    return {"driver_sites": sites, "findings": findings}
+
+
+def _audited_files(config: GraftlintConfig) -> List[str]:
+    out: List[str] = []
+    for frag in config.include:
+        ap = os.path.join(config.root, frag)
+        if os.path.isfile(ap) and ap.endswith(".py"):
+            out.append(frag)
+            continue
+        if not os.path.isdir(ap):
+            continue
+        for dirpath, dirnames, filenames in os.walk(ap):
+            dirnames[:] = [d for d in sorted(dirnames)
+                           if d != "__pycache__"]
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                rel = os.path.relpath(os.path.join(dirpath, fn),
+                                      config.root).replace(os.sep, "/")
+                if any(ex in rel for ex in config.exclude):
+                    continue
+                out.append(rel)
+    return out
+
+
+def compute_artifact(config: Optional[GraftlintConfig] = None) -> dict:
+    config = config or load_config()
+    scans: List[_ModuleScan] = []
+    for rel in _audited_files(config):
+        try:
+            with open(os.path.join(config.root, rel), "r",
+                      encoding="utf-8") as f:
+                src = f.read()
+        except OSError:    # pragma: no cover - racing file removal
+            continue
+        # cheap text pre-filter: the class graph only matters for files
+        # that build drivers, flush, or define learner classes
+        if not any(tok in src for tok in
+                   DRIVER_BUILDERS + FLUSH_CALLS + ("TreeLearner",)):
+            continue
+        scans.append(_ModuleScan(src, rel))
+    return _evaluate(scans)
+
+
+def run(config: Optional[GraftlintConfig] = None,
+        artifact=None) -> List[AuditResult]:
+    name = "health_covered"
+    try:
+        art = artifact if isinstance(artifact, dict) \
+            else compute_artifact(config)
+    except Exception as e:      # pragma: no cover - defensive
+        return [AuditResult(name=name, ok=False,
+                            detail="auditor raised: %r" % e)]
+    if art["findings"]:
+        telemetry.count(C_UNOBSERVED, len(art["findings"]),
+                        category="analysis")
+    return [AuditResult(
+        name=name, ok=not art["findings"],
+        detail="; ".join(art["findings"][:3]) if art["findings"]
+        else "%d persist-driver site(s) flush numerics::* health stats"
+             % art["driver_sites"])]
+
+
+def check_fixture(payload: str) -> List[str]:
+    """Uniform fixture hook: findings for a source snippet (a module
+    that builds a scan driver with/without a health-flush path)."""
+    return _evaluate([_ModuleScan(
+        payload, "lightgbm_tpu/treelearner/fixture.py")])["findings"]

@@ -63,11 +63,32 @@ HIGHER_BETTER = (
     "expo_level_vs_baseline", "allstate_value", "allstate_vs_baseline",
     "yahoo_value", "yahoo_vs_baseline", "voting_value",
     "voting_vs_baseline", "predict_value", "predict_expo_value",
+    # split-margin p01 (numerics::split_margin, telemetry/health): a
+    # quantization PR that collapses decision margins gates here even
+    # when throughput holds — the runtime twin of the quant_certify
+    # SPLIT_DECISION_BUDGET
+    "margin_p01",
 )
 LOWER_BETTER = (
     "predict_p50", "predict_p99", "checkpoint_overhead_frac",
     "expo_level_launches_per_tree",
 )
+# headline keys whose PRESENCE depends on a measurement-only knob
+# (margin_p01 only exists when BENCH_TELEMETRY recorded the margin
+# histogram — and measurement-only knobs are deliberately excluded from
+# the lineage fingerprint): these still direction-gate when two rounds
+# both carry them, but vanishing is a recording-mode change, not a
+# phase crash, so the vanish-gate skips them
+MEASUREMENT_CONDITIONAL = ("margin_p01",)
+
+# per-key minimum noise bands: bucket-quantized keys can only move in
+# layout-growth steps. margin_p01 is a quantile of the 2.0-growth
+# split-margin histogram (telemetry/health), so one benign bucket-edge
+# hop reads as a ±50% move — far outside the default 15% band. 0.6
+# lets a single edge hop pass while a genuine collapse (the 100x
+# failure mode the key exists for) still gates.
+KEY_BAND_FLOOR = {"margin_p01": 0.6}
+
 # informational keys (counts, sizes) are tracked but never gate
 # the north-star trajectory keys: absent from EVERY round = the stale
 # state the gate must name loudly (ROADMAP item 1)
@@ -290,6 +311,10 @@ def evaluate(rounds: List[Round], band_floor: float,
                     break
             if key not in latest_vals and prev is None:
                 continue
+            if key not in latest_vals and key in MEASUREMENT_CONDITIONAL:
+                # recorded under a telemetry-on round, absent now: a
+                # measurement-mode flip, not a crashed phase
+                continue
             if key not in latest_vals:
                 rep.verdicts.append(Verdict(
                     key=key, status="missing", round=latest.index,
@@ -308,6 +333,7 @@ def evaluate(rounds: List[Round], band_floor: float,
                 continue
             new_v, old_v = latest_vals[key], prev_vals[key]
             band = max(band_floor,
+                       KEY_BAND_FLOOR.get(key, 0.0),
                        latest.spread.get(key, 0.0),
                        prev.spread.get(key, 0.0))
             higher_better = key in HIGHER_BETTER

@@ -228,13 +228,33 @@ PARAMS: List[_P] = [
     _P("checkpoint_keep", int, 3, lo=1),
     _P("tpu_fault_plan", str, ""),           # deterministic fault injection
     #                                        # (kill@iter= / drop_collective@
-    #                                        # round= / corrupt_checkpoint@n=)
+    #                                        # round= / corrupt_checkpoint@n=
+    #                                        # / stall@ / resize@ /
+    #                                        # corrupt_hist@round=;rank=)
     _P("tpu_collective_timeout", float, 300.0, lo=0.0),  # DCN host-
     _P("tpu_collective_retries", int, 2, lo=0),          # collective guard
     _P("tpu_collective_backoff", float, 0.25, lo=0.0),   # (resilience/retry)
     _P("tpu_collective_soft_timeout", float, 0.0, lo=0.0),  # straggler
     #                                        # watchdog soft deadline
     #                                        # (0 = auto: timeout / 4)
+    # ---- runtime numerics sentinel (telemetry/health, parallel/
+    # fingerprint): the runtime twin of the quant_certify static audit
+    _P("tpu_numerics_stats", str, "auto"),   # auto | off: device-side
+    #                                        # NaN/Inf counters + split-
+    #                                        # margin histogram in the
+    #                                        # persist scan carry
+    _P("tpu_health_abort", str, ""),         # ""=report-only, or all/
+    #                                        # comma list of anomaly kinds
+    #                                        # (nonfinite_metric /
+    #                                        # margin_collapse /
+    #                                        # stall_burst) that abort
+    _P("tpu_divergence_probe", str, "auto"),  # auto | on | off: per-
+    #                                        # iteration cross-rank
+    #                                        # fingerprint compare in the
+    #                                        # distributed loop (auto =
+    #                                        # only with >1 process; on
+    #                                        # forces the world=1 short-
+    #                                        # circuit path too)
 ]
 
 _BY_NAME: Dict[str, _P] = {p.name: p for p in PARAMS}

@@ -514,6 +514,12 @@ def train(params: Dict[str, Any], train_set: Dataset,
     # land next to the checkpoints (telemetry/flight.py)
     from .telemetry import flight as telemetry_flight
     telemetry_flight.configure_from_config(cfg0)
+    # numerics sentinel: install the tpu_health_abort policy and reset
+    # the run-scoped numerics::*/health::* registry state (the flight-
+    # ring pattern — an aborted run's split margins must not leak into
+    # this run's report or collapse baseline)
+    from .telemetry import health as telemetry_health
+    telemetry_health.configure_from_config(cfg0)
     # elastic resume onto world=1: a single-host run whose checkpoint_dir
     # holds a MATCHING multi-host run (mesh manifest: same config hash +
     # dataset-global fingerprint, world > 1) continues through the

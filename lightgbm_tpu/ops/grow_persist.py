@@ -47,13 +47,16 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..telemetry import events as telemetry
+from ..telemetry.health import (H_INF_HIST, H_NAN_GRAD, H_NAN_HESS,
+                                HEALTH_LEN, NUM_HEALTH)
 from ..utils.log import Log
 from .grow import TreeArrays
 from .pallas_compat import dynamic_grid_interpret_ok
 from .pallas_grow import (N_SCALARS, S_DB, S_DL, S_LE, S_LS, S_MASK, S_MF,
                           S_MT, S_NB, S_NCH, S_NL, S_S0, S_SH, S_SMALL_L,
-                          S_THR, S_WG, make_root_hist, make_split_pass)
-from .pallas_scan import ScanLayout, scan_pair
+                          S_THR, S_WG, make_root_hist, make_split_pass,
+                          plane_health)
+from .pallas_scan import ScanLayout, margin_bucket_index, scan_pair
 from .split import (K_MIN_SCORE, SplitParams, find_best_split_numerical,
                     find_best_split_numerical_batch, fix_histogram)
 
@@ -68,6 +71,16 @@ def _f32r(row):
 
 # payload row count up to which f32 leaf state holds exact integer counts
 EXACT_F32_ROWS = 1 << 24
+
+# device stats vector the scan driver returns: [level_programs,
+# level_fallback_splits] + the numerics health vector (NaN-grad/NaN-hess/
+# Inf-hist counts + the split-margin histogram buckets —
+# telemetry/health.py owns the layout). Carried through the scan as i32
+# and flushed ONCE at finalize (serial.flush_level_stats); the health
+# tail is all-zero when the grower is built with health=False
+# (tpu_numerics_stats=off).
+STAT_LEVELS, STAT_FALLBACK = 0, 1
+STATS_LEN = 2 + HEALTH_LEN
 
 # deepest max_depth the level-parallel phase takes on: the frontier-slot
 # matrices are sized 2^(max_depth-1) and the no-bind certificate's
@@ -467,6 +480,9 @@ class _PState(NamedTuple):
     best: jnp.ndarray          # [L, 12] EV
     tree: jnp.ndarray          # [L, 8] ST
     levels: jnp.ndarray        # i32: level programs run for this tree
+    health: jnp.ndarray        # [HEALTH_LEN] i32 numerics health vector
+    #                          # (nan/inf counts + split-margin buckets;
+    #                          # telemetry/health.py layout)
 
 
 # ---------------------------------------------------------------------------
@@ -618,7 +634,8 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
                         kernel_impl: str = "pallas",
                         stat_from_scan: bool = False,
                         state_dtype=None, fix=None,
-                        level_mode: str = "auto"):
+                        level_mode: str = "auto",
+                        health: bool = True):
     """Build grow/score/gradient closures for one dataset + grow config.
 
     gc: GrowConfig (num_leaves, max_depth, num_features, scan_width used).
@@ -643,6 +660,15 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
     widened XLA kernel mode applies Dataset::FixHistogram at histogram
     STORE time exactly like the v1 grower (the Mosaic path keeps the
     in-kernel fix residual).
+
+    health: accumulate the device-side numerics health vector (NaN/Inf
+    counts over gradients/hessians/histogram planes + the log-bucketed
+    split-margin histogram — best gain minus runner-up at every split
+    decision, the geometry the quant_certify budgets protect) in the
+    scan carry next to the level stats: a few fused VPU reductions per
+    split, zero extra launches, zero host syncs (the transfer audit's
+    contract). False zeroes the health tail of the stats vector
+    (tpu_numerics_stats=off — the overhead-pin escape hatch).
 
     stat_from_scan: leaf counts come from the scan's hessian-derived
     rounding (the reference's cnt_factor recovery,
@@ -1177,6 +1203,12 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
                       jnp.stack([root_cnt, root_cnt]),
                       jnp.zeros((2,), F32), params, layout, fmask)
         best = jnp.full((L, 12), K_MIN_SCORE, EV).at[0].set(pair0[0])
+        health0 = jnp.zeros((HEALTH_LEN,), I32)
+        if health:
+            # root planes are the first histogram the run trusts; a NaN
+            # here (poisoned gradients, a broken psum) taints every
+            # split below it
+            health0 = health0.at[H_INF_HIST].add(plane_health(gh0, hh0))
         # depth gate for the root itself: evalB checked depth 1
         state = _PState(
             s=jnp.asarray(1, I32),
@@ -1188,6 +1220,7 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
             best=best,
             tree=jnp.zeros((L, 8), ST),
             levels=jnp.asarray(0, I32),
+            health=health0,
         )
 
         # ---- level-parallel phase: one fused program per tree level ----
@@ -1320,6 +1353,23 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
                 sm_sh = jnp.where(smaller_is_left, bl[:, BC_LSH],
                                   bl[:, BC_RSH])
                 sm_g, sm_h = fix_store(sm_g, sm_h, sm_sg, sm_sh)
+                hv = st.health
+                if health:
+                    # one split-margin per admitted split: slot j's gain
+                    # minus the next-best candidate (the next admitted
+                    # leaf, or 0 when nothing else would split) — the
+                    # decision gap quantization noise must not collapse.
+                    # key[order] is the descending gain-ordered frontier
+                    # the admission itself used; masked planes are
+                    # checked POST-psum so every shard counts the same
+                    # global histogram.
+                    svals = key[order]
+                    marg = (svals[:S_MAXL]
+                            - jnp.maximum(svals[1:S_MAXL + 1],
+                                          jnp.asarray(0.0, EV)))
+                    mb = margin_bucket_index(marg)
+                    hv = hv.at[NUM_HEALTH + mb].add(act.astype(I32)) \
+                           .at[H_INF_HIST].add(plane_health(sm_g, sm_h))
                 par_g = st.gh[slots]
                 par_h = st.hh[slots]
                 big_g = par_g - sm_g
@@ -1382,7 +1432,7 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
                 return st._replace(
                     s=st.s + cntp, pay=pay2, gh=gh, hh=hh,
                     lstate=lstate, best=best, tree=tree,
-                    levels=st.levels + 1)
+                    levels=st.levels + 1, health=hv)
 
             state = jax.lax.while_loop(level_cond, level_body, state)
         s_after_level = state.s
@@ -1460,6 +1510,19 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
             sm_sg = jnp.where(smaller_is_left, bl[BC_LSG], bl[BC_RSG])
             sm_sh = jnp.where(smaller_is_left, bl[BC_LSH], bl[BC_RSH])
             sm_g, sm_h = fix_store(sm_g, sm_h, sm_sg, sm_sh)
+            hv = st.health
+            if health:
+                # split margin = chosen gain minus the best alternative
+                # on the frontier (0 when no alternative would split):
+                # the decision gap the quant_certify budget bounds
+                others = jnp.where(jnp.arange(L, dtype=I32) == l,
+                                   jnp.asarray(K_MIN_SCORE, EV), gains)
+                marg = gains[l] - jnp.maximum(jnp.max(others),
+                                              jnp.asarray(0.0, EV))
+                hv = hv.at[NUM_HEALTH + margin_bucket_index(marg)] \
+                       .add(do.astype(I32)) \
+                       .at[H_INF_HIST].add(
+                           jnp.where(do, plane_health(sm_g, sm_h), 0))
             par_g = st.gh[l]
             par_h = st.hh[l]
             big_g = par_g - sm_g
@@ -1518,10 +1581,13 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
                 jnp.where(do, rec, st.tree[s - 1]))
             return st._replace(
                 s=s + do.astype(I32), done=~do, pay=pay,
-                gh=gh, hh=hh, lstate=lstate, best=best, tree=tree)
+                gh=gh, hh=hh, lstate=lstate, best=best, tree=tree,
+                health=hv)
 
         final = jax.lax.while_loop(cond, body, state)
-        stats = jnp.stack([final.levels, final.s - s_after_level])
+        stats = jnp.concatenate(
+            [jnp.stack([final.levels, final.s - s_after_level]),
+             final.health])
         return (final.pay, final.lstate, final.tree, final.s, root_out,
                 stats)
 
@@ -1618,6 +1684,20 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
         gh = jax.lax.bitcast_convert_type(jnp.stack([g, h]), U32)
         return jax.lax.dynamic_update_slice(
             pay, gh, (jnp.asarray(grad_row, I32), jnp.asarray(0, I32)))
+
+    def grad_health(pay):
+        """[2] i32 non-finite counts over the live (grad, hess) payload
+        rows — the ``numerics::nan_grad``/``nan_hess`` device probe the
+        scan driver folds into the stats vector right after each
+        gradient fill. Shard-LOCAL counts (each shard owns different
+        rows); the driver psums the pair once per batch when sharded so
+        the replicated stats output stays replicated."""
+        live = jnp.arange(NP, dtype=I32) < n
+        g = _f32r(pay[grad_row])
+        h = _f32r(pay[grad_row + 1])
+        return jnp.stack([
+            jnp.sum(live & ~jnp.isfinite(g), dtype=I32),
+            jnp.sum(live & ~jnp.isfinite(h), dtype=I32)])
 
     def _apply_weight(g, h, pay):
         """Per-row weight multiply AFTER the objective's unweighted
@@ -1760,6 +1840,10 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
     gr.wide = wide
     gr.use_level = use_level
     gr.S_MAXL = S_MAXL
+    gr.health = health
+    gr.axis_name = axis_name
+    gr.voting = voting
+    gr.grad_health = grad_health
     gr._eval_batch = evalB             # debug/testing hooks
     gr._eval_pair = evalB              # historical alias (B = 2)
     gr._root_hist = root_hist
@@ -1778,9 +1862,11 @@ def make_scan_driver(gr, gc, k: int, grad_fn, grad_mode: str = "payload",
     objective's standard grad function fed by a per-tree scatter/gather
     through the rid row. Returns fn(pay, fmasks [k, F], wkeys [k, 2]u32,
     iters [k]i32, params, shrink, gargs) -> (pay', stacked TreeArrays,
-    stats [2] i32 = summed [level_programs, level_fallback_splits] over
-    the batch — the learner converts them to telemetry counters at
-    finalize time, keeping the dispatch fully async).
+    stats [STATS_LEN] i32 = summed [level_programs,
+    level_fallback_splits] + the numerics health vector (NaN/Inf
+    counts + split-margin buckets, telemetry/health layout) over the
+    batch — the learner converts them to telemetry counters/histograms
+    at finalize time, keeping the dispatch fully async).
 
     bag_fn: optional make_bag_transform closure run between the gradient
     fill and the grow (bagging masks / GOSS weights applied to the payload
@@ -1792,6 +1878,16 @@ def make_scan_driver(gr, gc, k: int, grad_fn, grad_mode: str = "payload",
     """
 
     K = getattr(gr, "K", 1)
+    use_health = bool(getattr(gr, "health", True))
+
+    def _add_grad_health(stats, pay):
+        """Fold the post-fill gradient probe into the stats vector
+        (non-finite grad/hess counts — numerics::nan_grad/nan_hess)."""
+        if not use_health:
+            return stats
+        gh2 = gr.grad_health(pay)
+        return stats.at[2 + H_NAN_GRAD].add(gh2[0]) \
+                    .at[2 + H_NAN_HESS].add(gh2[1])
 
     def run(pay, fmasks, wkeys, iters, params, shrink, gargs):
         def body(pay, per):
@@ -1802,9 +1898,10 @@ def make_scan_driver(gr, gc, k: int, grad_fn, grad_mode: str = "payload",
                 # every class come from the pre-iteration scores)
                 pay = gr.snapshot_scores(pay)
                 outs = []
-                stats = jnp.zeros((2,), jnp.int32)
+                stats = jnp.zeros((STATS_LEN,), jnp.int32)
                 for cls in range(K):
                     pay = gr.fill_grad_multi(pay, grad_fn, cls)
+                    stats = _add_grad_health(stats, pay)
                     bag_cnt = None
                     if bag_fn is not None:
                         # same window key for every class: one bag per
@@ -1823,11 +1920,18 @@ def make_scan_driver(gr, gc, k: int, grad_fn, grad_mode: str = "payload",
                 pay = gr.fill_grad_row(pay, grad_fn, gargs)
             else:
                 pay = gr.fill_grad(pay, grad_fn)
+            # probe the objective's RAW gradients (pre-bag: a bag zero
+            # cannot launder an Inf into an unremarkable 0, and NaN*0
+            # is NaN anyway)
+            gh2 = gr.grad_health(pay) if use_health else None
             bag_cnt = None
             if bag_fn is not None:
                 pay, bag_cnt = bag_fn(pay, wkey, it)
             pay, lstate, tree, nl, _root, stats = gr.grow(
                 pay, params, fmask, bag_cnt=bag_cnt)
+            if gh2 is not None:
+                stats = stats.at[2 + H_NAN_GRAD].add(gh2[0]) \
+                             .at[2 + H_NAN_HESS].add(gh2[1])
             pay = gr.apply_scores(pay, lstate, nl, shrink)
             out = gr.to_tree_arrays(lstate, tree, nl)
             return pay, (out, stats)
@@ -1839,7 +1943,21 @@ def make_scan_driver(gr, gc, k: int, grad_fn, grad_mode: str = "payload",
             stacked = jax.tree.map(
                 lambda a: a.reshape((a.shape[0] * a.shape[1],)
                                     + a.shape[2:]), stacked)
-        return payK, stacked, jnp.sum(stats_k, axis=0)
+        stats = jnp.sum(stats_k, axis=0)
+        if use_health and getattr(gr, "axis_name", None) is not None:
+            # the gradient probe counted shard-LOCAL rows; one tiny psum
+            # per BATCH keeps the replicated stats output replicated.
+            # Data-parallel margins/inf_hist derive from post-psum
+            # global planes and are already identical on every shard —
+            # but VOTING keeps its histogram planes shard-local, so
+            # there the inf_hist slot is local too and must ride the
+            # same psum (an Inf on one shard's plane would otherwise be
+            # silently dropped by the replicated out-spec)
+            hi = (2 + NUM_HEALTH if getattr(gr, "voting", False)
+                  else 2 + H_INF_HIST)
+            part = jax.lax.psum(stats[2:hi], gr.axis_name)
+            stats = stats.at[2:hi].set(part)
+        return payK, stacked, stats
 
     if wrap_jit:
         # histogram= streams each program invocation's host wall into

@@ -242,6 +242,18 @@ def _hist_accum(hist_ref, bins_g, grad, hess, G: int):
             oh_hi, bv, dn, preferred_element_type=F32)            # [16, 64]
 
 
+def plane_health(g_plane, h_plane):
+    """i32 count of non-finite entries across a (grad, hess) histogram
+    plane pair — the ``numerics::inf_hist`` device probe the persist
+    grower folds into its scan-carried health vector right after each
+    plane lands (post-psum, so sharded ranks count the identical global
+    plane). Any float width, any leading batch dims; pure jnp, so it
+    fuses into the compiled program with zero host syncs."""
+    bad_g = jnp.sum(~jnp.isfinite(g_plane), dtype=I32)
+    bad_h = jnp.sum(~jnp.isfinite(h_plane), dtype=I32)
+    return bad_g + bad_h
+
+
 def _unpack_hist(hist):
     """[G, 16, 64] raw accumulator -> ([G*256] grad, [G*256] hess) f32
     planes (hi*16+lo bin order); runs OUTSIDE the kernel where XLA

@@ -31,6 +31,7 @@ does the tiny cross-feature argmax and builds the SplitCandidate pair.
 from __future__ import annotations
 
 import functools
+import math
 
 import jax
 import jax.numpy as jnp
@@ -85,6 +86,26 @@ def scan_input_contract(rows: int, g_max: float = 1.0,
 # purpose; an empty blessing table means every narrowing the
 # precision-flow auditor finds here must prove its range
 NARROW_OK = ()
+
+
+def margin_bucket_index(margin):
+    """Device-side split-margin bucketing at the ``numerics::split_margin``
+    layout (telemetry/health MARGIN_LO/GROWTH/NB — the single source of
+    truth shared with the host registry histogram).
+
+    The margin — best gain minus runner-up at a split decision, the
+    quantity quantized-histogram noise must not collapse — is the scan
+    kernels' output domain, so its device bucketing lives here next to
+    the gain contract. Same rule as ``histo.Histogram.bucket_index``:
+    ``floor(log(m/lo)/log(growth))``, sub-``lo`` values clamp into
+    bucket 0, the top bucket saturates. All-f32 (the persist fast path
+    is f64-free; the 2x bucket growth dwarfs f32 log roundoff)."""
+    from ..telemetry.health import MARGIN_GROWTH, MARGIN_LO, MARGIN_NB
+    f32 = jnp.float32
+    m = jnp.maximum(margin.astype(f32), jnp.asarray(MARGIN_LO, f32))
+    idx = jnp.floor(jnp.log(m * jnp.asarray(1.0 / MARGIN_LO, f32))
+                    * jnp.asarray(1.0 / math.log(MARGIN_GROWTH), f32))
+    return jnp.clip(idx.astype(jnp.int32), 0, MARGIN_NB - 1)
 
 
 def _scan_kernel(scal_ref, gb_ref, hb_ref, keepr_ref, keepf_ref,

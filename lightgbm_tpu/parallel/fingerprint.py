@@ -1,0 +1,217 @@
+"""Cross-rank divergence fingerprints: catch a desync at the iteration it
+happens, not at the end-of-run bit-exactness check.
+
+The distributed loop's correctness contract is that every rank
+materializes the IDENTICAL model (deterministic merge — the psum'd
+histograms and global stats make every rank take the same splits). When
+that contract breaks — a flaky DCN payload, a bad host, a quantization
+bug the ``quant_certify`` budgets did not cover — today it surfaces only
+as a failed bit-exactness test after the whole run (or never). This
+module derives one cheap fingerprint per boosting iteration on each
+rank and compares them every batch:
+
+  * ``model`` — CRC32 of the iteration's tree text (rank-uniform: the
+    model is replicated by construction);
+  * ``hist``  — CRC32 over the bit patterns of the trees' gain /
+    internal-value / hessian-weight arrays: direct functionals of the
+    psum'd histogram planes, so a corrupted plane flips this component
+    even when the tree STRUCTURE happens to survive;
+  * ``score`` — compensated (Kahan, chunked) sum of the rank's local
+    score shard at the batch boundary. Shards hold different rows, so
+    this column is NEVER compared — it rides along as the per-rank
+    diagnostic the flight dump and the error message show.
+
+The records piggyback on the EXISTING retry-guarded metric-aggregation
+collective (``allreduce:metrics_values`` inside
+``multihost._allreduce_mean_host``) — no new collective sites, so the
+``collective_order``/``collective_observed`` audits and the
+``collective_trace`` pin stay untouched. A mismatch raises
+:class:`DivergenceError` on EVERY rank at the exact iteration, names the
+first divergent component and the minority ranks, dumps the flight ring
+on each rank, and points at the last checkpoint (the retry module's
+resume hint). ``corrupt_hist@round=N;rank=R[;scale=S]``
+(resilience/faults.py) injects a deterministic true positive.
+
+World=1 (the small end of an elastic resume) short-circuits: the
+gathered matrix has one row, the compare trivially passes, and the only
+cost is the local CRC pass.
+"""
+from __future__ import annotations
+
+import struct
+import zlib
+from typing import List, Optional
+
+import numpy as np
+
+from ..resilience import retry as resilience_retry
+from ..telemetry import events as telemetry
+from ..telemetry import flight as telemetry_flight
+from ..utils.log import LightGBMError
+
+# record layout: one float64 row per boosting iteration
+REC_ITER, REC_MODEL, REC_HIST, REC_SCORE = 0, 1, 2, 3
+REC_WIDTH = 4
+# components compared bitwise across ranks, in blame order (the named
+# component is the FIRST divergent one at the earliest iteration)
+COMPARED = ((REC_MODEL, "model"), (REC_HIST, "hist"))
+
+KAHAN_CHUNK = 65536
+
+
+class DivergenceError(LightGBMError):
+    """Two ranks disagree on a rank-uniform fingerprint component."""
+
+    def __init__(self, message: str, iteration: int, component: str,
+                 ranks: Optional[List[int]] = None):
+        super().__init__(message)
+        self.iteration = int(iteration)
+        self.component = component
+        self.ranks = list(ranks or [])
+
+
+def kahan_sum(values) -> float:
+    """Compensated sum of a float array: numpy pairwise partial sums
+    over fixed chunks, Kahan-combined across chunks — deterministic for
+    a given array and accurate to a few ulps regardless of shard
+    length, so the diagnostic column means the same thing at 1e3 and
+    1e9 rows."""
+    a = np.asarray(values, np.float64).reshape(-1)
+    if a.size == 0:
+        return 0.0
+    # vectorized pairwise partial sums per chunk, then a plain-python
+    # Kahan combine over the (few) chunk sums — no per-element work
+    chunk_sums = np.add.reduceat(
+        a, np.arange(0, a.size, KAHAN_CHUNK)).tolist()
+    total = 0.0
+    comp = 0.0
+    for y0 in chunk_sums:
+        y = y0 - comp
+        t = total + y
+        comp = (t - total) - y
+        total = t
+    return total
+
+
+def _crc(data: bytes, crc: int = 0) -> int:
+    return zlib.crc32(data, crc) & 0xFFFFFFFF
+
+
+def tree_fingerprint(trees) -> tuple:
+    """(model_crc, hist_crc) over one iteration's materialized trees.
+
+    model: the serialized tree text (what the model file would hold).
+    hist: raw float bit patterns of gain / internal_value / leaf_weight
+    — per-split functionals of the global histogram planes, invariant
+    to text formatting."""
+    mc = 0
+    hc = 0
+    for t in trees:
+        mc = _crc(t.to_string().encode("utf-8"), mc)
+        nl = int(t.num_leaves)
+        ni = max(nl - 1, 0)
+        # host Tree arrays are contiguous float64 by construction
+        # (models/tree.py); leading slices stay contiguous, so tobytes
+        # is a copy-free host read
+        for arr in (t.split_gain[:ni], t.internal_value[:ni],
+                    t.leaf_weight[:nl]):
+            hc = _crc(arr.tobytes(), hc)
+    return mc, hc
+
+
+def batch_records(start_iteration: int, per_iter_trees, rank: int,
+                  score_sum: Optional[float] = None,
+                  fault_plan=None) -> np.ndarray:
+    """[k, REC_WIDTH] float64 fingerprint rows for one trained batch
+    (iterations ``start_iteration .. start_iteration+k-1``). CRC32
+    values are < 2^32 and exact in float64, so the rows survive the
+    float allgather bit for bit. ``score_sum`` (the Kahan-reduced local
+    score shard) lands on the LAST row only — one D2H per batch, not
+    per iteration. ``fault_plan``: an active ``corrupt_hist@`` fault
+    perturbs this rank's hist component deterministically at the
+    targeted iteration (the injectable true positive)."""
+    k = len(per_iter_trees)
+    out = np.full((k, REC_WIDTH), np.nan, np.float64)
+    for i, trees in enumerate(per_iter_trees):
+        it = start_iteration + i
+        mc, hc = tree_fingerprint(trees)
+        if fault_plan is not None:
+            scale = fault_plan.hist_corruption(it, rank)
+            if scale is not None:
+                telemetry.count("faults::injected", 1, category="faults")
+                telemetry_flight.note("corrupt_hist", iteration=it,
+                                      rank=rank, scale=scale)
+                hc = _crc(struct.pack("<q", int(scale)), hc)
+        out[i, REC_ITER] = it
+        out[i, REC_MODEL] = mc
+        out[i, REC_HIST] = hc
+    if k and score_sum is not None:
+        out[k - 1, REC_SCORE] = score_sum
+    return out
+
+
+def check_gathered(gathered: np.ndarray, rank: int,
+                   dump: bool = True) -> None:
+    """Compare the allgathered fingerprint matrix; raise
+    :class:`DivergenceError` on the first mismatching (iteration,
+    component) — every rank sees the same gathered matrix and raises
+    identically, so every rank leaves its own flight dump.
+
+    ``gathered``: [world, k * REC_WIDTH] (or [world, k, REC_WIDTH]).
+    """
+    g = np.asarray(gathered, np.float64)
+    if g.ndim == 2:
+        g = g.reshape(g.shape[0], -1, REC_WIDTH)
+    world, k = g.shape[0], g.shape[1]
+    telemetry.count("numerics::fingerprint_rounds", 1,
+                    category="numerics")
+    if world <= 1:
+        return
+    for i in range(k):
+        for col, comp in COMPARED:
+            vals = g[:, i, col]
+            if np.all(vals == vals[0]):
+                continue
+            # blame the minority: with world > 2 the outvoted ranks are
+            # almost certainly the broken ones; at world=2 both are named
+            uniq, counts = np.unique(vals, return_counts=True)
+            majority = uniq[np.argmax(counts)]
+            bad = [r for r in range(world) if vals[r] != majority]
+            if len(bad) == world - 1 or world == 2:
+                bad = list(range(world))
+            iteration = int(g[0, i, REC_ITER])
+            telemetry.count("numerics::divergence", 1,
+                            category="numerics")
+            per_rank = {str(r): {"model": int(g[r, i, REC_MODEL]),
+                                 "hist": int(g[r, i, REC_HIST])}
+                        for r in range(world)}
+            # last finite score-shard sum per rank (NaN-safe via v==v;
+            # tolist first so the loop touches only python floats)
+            scores = {}
+            for r, row in enumerate(g[:, :, REC_SCORE].tolist()):
+                finite = [v for v in row if v == v]
+                if finite:
+                    scores[str(r)] = finite[-1]
+            # local_rank makes each rank's otherwise-identical dump
+            # self-identifying (every rank sees the same matrix and
+            # writes its own flight record)
+            telemetry_flight.note("divergence", iteration=iteration,
+                                  component=comp, ranks=bad,
+                                  local_rank=int(rank),
+                                  fingerprints=per_rank,
+                                  score_sums=scores)
+            if dump:
+                telemetry_flight.dump("divergence:%s@iter=%d"
+                                      % (comp, iteration))
+            err = DivergenceError(
+                "cross-rank divergence at iteration %d: component '%s' "
+                "disagrees across ranks (suspect rank(s) %s of %d; "
+                "per-rank score-shard sums: %s). The ranks are no "
+                "longer training the same model — %s" %
+                (iteration, comp, bad, world,
+                 ", ".join("r%s=%r" % kv for kv in sorted(scores.items()))
+                 or "n/a",
+                 resilience_retry._resume_hint_text()),
+                iteration=iteration, component=comp, ranks=bad)
+            err._flight_dumped = True
+            raise err

@@ -241,14 +241,15 @@ class DataParallelTreeLearner(SerialTreeLearner):
             cache[akey] = assets
         stat_from_scan = bag_spec[0] != "none"
         gc = self.grow_config
+        health = self._persist_health_mode()
         gkey = ("grower_sharded", S, gc, stat_from_scan, kernel_impl,
-                level_mode)
+                level_mode, health)
         wrapper = cache.get(gkey)
         if wrapper is None:
             inner = make_persist_grower(
                 assets, self.meta, gc, interpret=interpret, axis_name=AXIS,
                 kernel_impl=kernel_impl, stat_from_scan=stat_from_scan,
-                fix=self.fix, level_mode=level_mode,
+                fix=self.fix, level_mode=level_mode, health=health,
                 # GLOBAL counts live in the leaf state: pick exactness by
                 # the total row count, not the per-shard one (the widened
                 # xla mode overrides to f64 internally)
@@ -271,7 +272,7 @@ class DataParallelTreeLearner(SerialTreeLearner):
                 check_vma=False))
             cache[gkey] = wrapper
         dkey = ("driver_sharded", S, k, gc, objective.static_fingerprint(),
-                bag_spec, kernel_impl, level_mode)
+                bag_spec, kernel_impl, level_mode, health)
         driver = cache.get(dkey)
         if driver is None:
             bag_fn = (make_bag_transform(bag_spec, assets.geometry,

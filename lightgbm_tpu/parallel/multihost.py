@@ -211,23 +211,41 @@ def _global_array(mesh: Mesh, local_np: np.ndarray):
 
 @telemetry.timed("collective::AllreduceMean(metrics,DCN)",
                  category="collective")
-def _allreduce_mean_host(values, weights):
+def _allreduce_mean_host(values, weights, extra=None):
     """Count-weighted mean across processes via host allgather (used for
     metric aggregation over unequal validation shards; zero-weight ranks
     contribute nothing but still participate in the collective).
     Returns plain Python floats so per-batch callers need no further
-    host conversion (the JG002 hot-loop contract)."""
+    host conversion (the JG002 hot-loop contract).
+
+    ``extra`` (a flat float64 vector) PIGGYBACKS on the values gather:
+    the per-batch divergence fingerprints (parallel/fingerprint.py) ride
+    the same retry-guarded collective site instead of adding a new one
+    (the ``collective_trace`` pin holds). With extra, returns
+    ``(means, gathered_extra [world, len(extra)])``; with only extra
+    (no metric values — a metric-less training loop still exchanges
+    fingerprints), the weights gather is skipped on every rank alike."""
+    nv = len(values)
+    row = np.asarray(list(values) + list(extra if extra is not None
+                                         else ()), np.float64)
     v = _pallgather(
         "allreduce:metrics_values",
-        np.asarray(values, np.float64).reshape(1, -1)).reshape(
-        jax.process_count(), -1)
-    w = _pallgather(
-        "allreduce:metrics_weights",
-        np.asarray(weights, np.float64).reshape(1, -1)).reshape(
-        jax.process_count(), -1)
-    tot = np.sum(w, axis=0)
-    out = np.sum(v * w, axis=0) / np.where(tot > 0, tot, 1.0)
-    return [float(x) for x in out]
+        row.reshape(1, -1)).reshape(jax.process_count(), -1)
+    gathered_extra = v[:, nv:]
+    v = v[:, :nv]
+    if nv:
+        w = _pallgather(
+            "allreduce:metrics_weights",
+            np.asarray(weights, np.float64).reshape(1, -1)).reshape(
+            jax.process_count(), -1)
+        tot = np.sum(w, axis=0)
+        out = [float(x) for x in
+               np.sum(v * w, axis=0) / np.where(tot > 0, tot, 1.0)]
+    else:
+        out = []
+    if extra is None:
+        return out
+    return out, gathered_extra
 
 
 def _local_metric_value(metric, vscore, objective, n_valid):
@@ -704,6 +722,21 @@ def train_multihost(config: Config, X_local: np.ndarray,
                           (K, len(y_valid))).astype(np.float64).copy())
 
     # ---- batched boosting loop ---------------------------------------
+    from . import fingerprint as divergence
+    # per-iteration cross-rank divergence fingerprints: 'auto' arms the
+    # probe only when there is a peer to diverge FROM — at
+    # jax.process_count() == 1 (including the elastic-resume small end)
+    # the compare can never fire, so auto skips the per-batch score-
+    # shard D2H and tree CRCs entirely; 'on' forces the full pipeline
+    # through the 1-row short-circuit (what the tier-1 tests drive)
+    probe_opt = str(getattr(config, "tpu_divergence_probe",
+                            "auto")).lower()
+    if probe_opt in ("off", "false", "0"):
+        probe_on = False
+    elif probe_opt in ("on", "force", "1", "true"):
+        probe_on = True
+    else:
+        probe_on = jax.process_count() > 1
     shrink = float(config.learning_rate)
     base_key = jax.random.PRNGKey(int(config.bagging_seed))
     freq = max(int(config.bagging_freq), 1)
@@ -757,6 +790,7 @@ def train_multihost(config: Config, X_local: np.ndarray,
         with telemetry.scope("boosting::MaterializeBatch(D2H+wait)",
                              category="device_wait"):
             host = jax.device_get(stacked)      # ONE transfer per batch
+        batch_trees = []                        # per-ITERATION tree lists
         for i in range(k):
             class_trees = []
             for c in range(K):
@@ -782,6 +816,7 @@ def train_multihost(config: Config, X_local: np.ndarray,
                 stopped = True
                 break
             trees.extend(class_trees)
+            batch_trees.append(class_trees)
             if vscore is not None and vscore.size:
                 if K == 1:
                     vscore += class_trees[0].predict(Xv)
@@ -789,11 +824,42 @@ def train_multihost(config: Config, X_local: np.ndarray,
                     for c in range(K):
                         vscore[c] += class_trees[c].predict(Xv)
         it += k
+        fp_rows = None
+        if probe_on and batch_trees and not stopped:
+            # ONE deliberate batched D2H of the local score shard (the
+            # Kahan-reduced sum is the per-rank diagnostic column; the
+            # tree CRCs below are pure host work over already-
+            # materialized arrays)
+            ssum = divergence.kahan_sum(np.concatenate(
+                [np.asarray(s.data).reshape(-1)   # graftlint: disable=JG002
+                 for s in score.addressable_shards]))
+            fp_rows = divergence.batch_records(
+                it - k, batch_trees, rank=rank, score_sum=ssum,
+                fault_plan=fault_plan).reshape(-1)
+        gathered_fp = None
         if metrics and not stopped:
             local, nv = _local_metric_value(
                 metrics[0], vscore, objective,
                 len(y_valid) if y_valid is not None else 0)
-            agg = _allreduce_mean_host([local], [nv])[0]
+            if fp_rows is not None:
+                # fingerprints piggyback the metric aggregation — the
+                # same guarded collective site, one payload
+                aggs, gathered_fp = _allreduce_mean_host(
+                    [local], [nv], extra=fp_rows)
+                agg = aggs[0]
+            else:
+                agg = _allreduce_mean_host([local], [nv])[0]
+        elif fp_rows is not None:
+            # metric-less loop: the fingerprint exchange still rides the
+            # metrics-values site (empty metric block; rank-uniform
+            # branch — every rank takes it or none does)
+            gathered_fp = _allreduce_mean_host([], [], extra=fp_rows)[1]
+        if gathered_fp is not None:
+            # raises DivergenceError at the exact iteration on EVERY
+            # rank (identical gathered matrix), each with its own
+            # flight dump
+            divergence.check_gathered(gathered_fp, rank=rank)
+        if metrics and not stopped:
             if rank == 0:
                 Log.info("[%d] valid %s : %g"
                          % (it, metrics[0].names[0], agg))

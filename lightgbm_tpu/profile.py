@@ -7,7 +7,8 @@ dev script is now a thin wrapper over this entry point.
 
 Usage: python -m lightgbm_tpu.profile [--shape NAME] [rows] [iters]
                                       [key=value ...]
-       python -m lightgbm_tpu.profile --merge DIR [--out PATH] [--json]
+       python -m lightgbm_tpu.profile --merge DIR [--run NAME]
+                                      [--out PATH] [--json]
        python -m lightgbm_tpu.profile --perf-card SHAPE [PATH] [--json]
 
 ``--perf-card SHAPE [PATH]`` does no training either: it prints the
@@ -143,7 +144,10 @@ def _main_perf_card(argv) -> int:
 
 
 def _main_merge(argv) -> int:
-    """--merge DIR [--out PATH] [--json]: no jax import, no training."""
+    """--merge DIR [--run NAME] [--out PATH] [--json]: no jax import,
+    no training. ``--run`` picks one run's rank files by their trace
+    basename when the directory mixes several runs (the no-flag default
+    still refuses a mixed directory loudly)."""
     from lightgbm_tpu.telemetry import merge as trace_merge
     i = argv.index("--merge")
     if i + 1 >= len(argv):
@@ -157,8 +161,16 @@ def _main_merge(argv) -> int:
             print("--out needs a path", file=sys.stderr)
             return 2
         out = argv[j + 1]
+    run = None
+    if "--run" in argv:
+        j = argv.index("--run")
+        if j + 1 >= len(argv):
+            print("--run needs a trace basename (run fingerprint)",
+                  file=sys.stderr)
+            return 2
+        run = argv[j + 1]
     try:
-        summary = trace_merge.merge_dir(directory, out)
+        summary = trace_merge.merge_dir(directory, out, run=run)
     except (trace_merge.MergeError, OSError) as exc:
         print("merge failed: %s" % exc, file=sys.stderr)
         return 2

@@ -63,6 +63,10 @@ _VOLATILE_PARAMS = frozenset({
     "local_listen_port", "time_out", "tpu_collective_timeout",
     "tpu_collective_retries", "tpu_collective_backoff",
     "tpu_collective_soft_timeout",
+    # the numerics sentinel observes the computation, it never shapes
+    # it — resuming with the probes reconfigured (e.g. ruling out probe
+    # overhead after a crash) must not orphan the checkpoints
+    "tpu_numerics_stats", "tpu_health_abort", "tpu_divergence_probe",
 })
 
 

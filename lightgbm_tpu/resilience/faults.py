@@ -39,6 +39,19 @@ Grammar (documented in README "Checkpointing & fault tolerance"):
                                   scheduler shrinking/growing the pod —
                                   the run resumes elastically on a
                                   W-rank mesh (resilience/reshard.py)
+    corrupt_hist@round=N;rank=R[;scale=S]
+                                  perturb rank R's histogram-functional
+                                  divergence fingerprint at boosting
+                                  round N (0-based), simulating a rank
+                                  whose histogram planes silently
+                                  diverged: the cross-rank probe
+                                  (parallel/fingerprint.py) must detect
+                                  it at exactly round N, name the
+                                  ``hist`` component, and dump the
+                                  flight ring on every rank. scale
+                                  (default 1) folds into the corruption
+                                  deterministically so distinct scales
+                                  produce distinct divergent values
 
 Like telemetry, the active plan is process-global and config-driven:
 ``configure_from_config`` installs the plan for the run that asked for it
@@ -102,6 +115,9 @@ class FaultPlan:
         self.stall_rank: Optional[int] = None
         self.resize_iter: Optional[int] = None
         self.resize_world: Optional[int] = None
+        self.corrupt_hist_round: Optional[int] = None
+        self.corrupt_hist_rank: Optional[int] = None
+        self.corrupt_hist_scale: int = 1
         for raw in text.replace(" ", ",").split(","):
             raw = raw.strip()
             if not raw:
@@ -167,11 +183,23 @@ class FaultPlan:
                         "tpu_fault_plan: resize world= must be >= 1")
                 self.resize_iter = kv["iter"]
                 self.resize_world = kv["world"]
+            elif action == "corrupt_hist":
+                if "round" not in kv or "rank" not in kv:
+                    raise LightGBMError(
+                        "tpu_fault_plan: corrupt_hist needs round= and "
+                        "rank= (one rank must diverge, not all of them)")
+                if self.corrupt_hist_round is not None:
+                    raise LightGBMError(
+                        "tpu_fault_plan: duplicate corrupt_hist "
+                        "directive (one per plan)")
+                self.corrupt_hist_round = kv["round"]
+                self.corrupt_hist_rank = kv["rank"]
+                self.corrupt_hist_scale = kv.get("scale", 1)
             else:
                 raise LightGBMError(
                     "tpu_fault_plan: unknown action %r (kill / "
                     "drop_collective / corrupt_checkpoint / stall / "
-                    "resize)" % action)
+                    "resize / corrupt_hist)" % action)
 
     # -- kill / resize -------------------------------------------------
     def kill_point(self, rank: int = 0) -> Optional[int]:
@@ -254,6 +282,19 @@ class FaultPlan:
             if process_index() != self.stall_rank:
                 return 0.0
         return float(self.stall_secs)
+
+    # -- divergence probe ----------------------------------------------
+    def hist_corruption(self, iteration: int, rank: int) -> Optional[int]:
+        """Scale S when the ``corrupt_hist`` fault targets (boosting
+        round `iteration`, `rank`); None otherwise. The caller
+        (parallel/fingerprint.batch_records) folds S into that rank's
+        histogram fingerprint component — a deterministic stand-in for
+        a rank whose histogram planes diverged."""
+        if (self.corrupt_hist_round is None
+                or iteration != self.corrupt_hist_round
+                or rank != self.corrupt_hist_rank):
+            return None
+        return self.corrupt_hist_scale
 
     # -- checkpoints ---------------------------------------------------
     def checkpoint_should_corrupt(self, write_idx: int) -> bool:

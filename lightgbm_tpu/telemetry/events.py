@@ -212,6 +212,18 @@ def count(name: str, inc: float = 1.0, category: str = "count") -> None:
         _flight_count(name, inc, category)
 
 
+def clear_counts_prefix(prefixes) -> None:
+    """Drop counters whose names start with any of `prefixes` — the
+    per-run scoping hook for run-scoped counter families (the
+    ``numerics::``/``health::`` reset at train arming; everything else
+    stays process-cumulative as before)."""
+    pfx = tuple(prefixes) if not isinstance(prefixes, str) else (prefixes,)
+    with _lock:
+        for k in [k for k in _counts if k.startswith(pfx)]:
+            del _counts[k]
+            _count_cat.pop(k, None)
+
+
 def _stack() -> list:
     st = getattr(_tls, "stack", None)
     if st is None:

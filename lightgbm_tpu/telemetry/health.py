@@ -1,0 +1,243 @@
+"""Runtime numerics sentinel: health-counter layout, flush, anomaly hooks.
+
+PR 13's ``quant_certify`` auditor bounds the split-gain perturbation the
+quantized-histogram path MAY introduce — statically, before any run.
+This module is its runtime twin: the shared state behind the three
+coupled probes that measure how close real training sails to those
+bounds and notice the moment something goes numerically wrong:
+
+  * **device-side health counters** — the persist/level growers
+    accumulate NaN/Inf counts over gradients/hessians/histogram planes
+    and a log-bucketed SPLIT-MARGIN histogram (best gain minus runner-up
+    at every split decision — the quantity quantization noise must not
+    collapse) *inside* the compiled program, carried through the scan
+    next to ``tree_learner::level_*`` and flushed here, once, at
+    finalize (:func:`flush_device_stats`) — zero added host syncs;
+  * **anomaly hooks** — :func:`check_record` runs from
+    ``TrainingMonitor.record`` per iteration: a non-finite eval metric,
+    a margin-histogram collapse against a rolling baseline, or a burst
+    of ``collective::stall`` events each flight-note, bump a
+    ``health::<kind>`` counter, and (``tpu_health_abort=``) optionally
+    abort the run early with a flight dump instead of letting it train
+    garbage to completion;
+  * **per-run scoping** — :func:`configure_from_config` (called at
+    ``engine.train`` arming, right next to the flight-ring reset)
+    clears the ``numerics::*`` registry entries and the rolling
+    baselines, so an aborted run's margins never leak into the next
+    train of the same process.
+
+The margin layout constants here are the single source of truth for the
+DEVICE bucketing (``ops/pallas_scan.margin_bucket_index``) and the host
+registry histogram (``numerics::split_margin``), so the two can never
+drift. Cross-rank divergence fingerprints — the third probe — live in
+:mod:`lightgbm_tpu.parallel.fingerprint` (they are a property of the
+distributed loop, not of the telemetry registry).
+"""
+from __future__ import annotations
+
+import math
+from collections import deque
+from typing import List, Optional
+
+from . import events, histo
+
+# ---------------------------------------------------------------------------
+# device health-vector layout (shared with ops/grow_persist)
+# ---------------------------------------------------------------------------
+
+# split-margin histogram layout: log-bucketed like telemetry/histo.py but
+# with growth 2.0 so a fixed 64-slot i32 vector rides the scan carry
+# (histo's default 1.05 growth would need ~850 slots). Quantile error is
+# bounded by growth - 1 = 2x — margins are compared across ORDERS OF
+# MAGNITUDE (a collapse is a 100x move), so a 2x bucket is plenty.
+MARGIN_LO = 1e-9
+MARGIN_GROWTH = 2.0
+MARGIN_NB = 64
+
+# health slots ahead of the margin buckets in the device vector
+H_NAN_GRAD, H_NAN_HESS, H_INF_HIST = 0, 1, 2
+NUM_HEALTH = 3
+HEALTH_LEN = NUM_HEALTH + MARGIN_NB
+
+MARGIN_HISTO = "numerics::split_margin"
+COUNTER_NAMES = ("numerics::nan_grad", "numerics::nan_hess",
+                 "numerics::inf_hist")
+
+
+def flush_device_stats(health_vec) -> None:
+    """Fold one device-accumulated health vector (``[HEALTH_LEN]`` ints,
+    already on the host) into the telemetry registry: the non-finite
+    counters and the ``numerics::split_margin`` streaming histogram.
+    Called from the persist learner's level-stats flush — the first
+    natural host sync after a batch — never per iteration."""
+    if len(health_vec) < HEALTH_LEN:
+        return
+    for i, name in enumerate(COUNTER_NAMES):
+        v = float(health_vec[i])
+        if v:
+            events.count(name, v, category="numerics")
+    buckets = [int(b) for b in health_vec[NUM_HEALTH:NUM_HEALTH
+                                          + MARGIN_NB]]
+    if any(buckets):
+        histo.merge_counts(MARGIN_HISTO, buckets, lo=MARGIN_LO,
+                           growth=MARGIN_GROWTH, unit="gain",
+                           category="numerics")
+
+
+def margin_bucket_host(margin: float) -> int:
+    """Host-side twin of ``ops/pallas_scan.margin_bucket_index`` — the
+    parity tests pin the two against each other."""
+    m = max(float(margin), MARGIN_LO)
+    i = int(math.floor(math.log(m / MARGIN_LO) / math.log(MARGIN_GROWTH)))
+    return min(max(i, 0), MARGIN_NB - 1)
+
+
+# ---------------------------------------------------------------------------
+# anomaly hooks (TrainingMonitor.record)
+# ---------------------------------------------------------------------------
+
+ANOMALY_KINDS = ("nonfinite_metric", "margin_collapse", "stall_burst")
+
+# margin collapse: current p01 under RATIO x the rolling-median baseline
+# of the last BASELINE_WINDOW healthy p01 readings (>= BASELINE_MIN
+# readings before the comparison arms — a cold histogram is not a
+# baseline). 0.01 = two orders of magnitude, far outside the 2x bucket
+# resolution and the certified quantization perturbation.
+MARGIN_COLLAPSE_RATIO = 0.01
+BASELINE_WINDOW = 8
+BASELINE_MIN = 3
+# collective::stall events within one iteration that count as a burst
+STALL_BURST = 3
+
+_abort = frozenset()
+_baseline: deque = deque(maxlen=BASELINE_WINDOW)
+_last_margin_count = 0
+_last_stall = 0.0
+
+
+def abort_kinds() -> frozenset:
+    return _abort
+
+
+def reset_run() -> None:
+    """Per-run scoping (the flight-ring pattern): clear the rolling
+    anomaly baselines and the ``numerics::*`` / ``health::*`` registry
+    state an earlier (possibly aborted) train left behind.
+
+    ``collective::stall`` is process-CUMULATIVE (it belongs to the
+    resilience layer, not to this run), so the burst detector's
+    reference point re-anchors to its CURRENT value — otherwise a
+    second train in the same process would read the first run's stalls
+    as a fresh burst and (under ``tpu_health_abort``) kill a healthy
+    run at its first iteration."""
+    global _last_margin_count, _last_stall
+    _baseline.clear()
+    _last_margin_count = 0
+    _last_stall = events.counts_snapshot().get("collective::stall", 0.0)
+    histo.reset_prefix("numerics::")
+    events.clear_counts_prefix(("numerics::", "health::"))
+
+
+def configure_from_config(config) -> None:
+    """Install the ``tpu_health_abort=`` policy and reset the per-run
+    numerics state (engine.train arming, next to flight/faults/retry)."""
+    global _abort
+    reset_run()
+    text = str(getattr(config, "tpu_health_abort", "") or "") \
+        .strip().lower()
+    if text in ("", "0", "false", "off", "none"):
+        _abort = frozenset()
+        return
+    if not events.enabled():
+        # the anomaly probes run from TrainingMonitor.record, which is
+        # only attached (and only records) when telemetry is on — an
+        # abort policy on a telemetry-off run would be silently inert
+        from ..utils.log import Log
+        Log.warning("tpu_health_abort=%s has no effect with "
+                    "tpu_telemetry=off: the anomaly probes run from "
+                    "the per-iteration TrainingMonitor; set "
+                    "tpu_telemetry=timers to arm them" % text)
+    if text in ("1", "true", "on", "all"):
+        _abort = frozenset(ANOMALY_KINDS)
+        return
+    kinds = set()
+    for tok in text.replace(";", ",").split(","):
+        tok = tok.strip()
+        if not tok:
+            continue
+        if tok not in ANOMALY_KINDS:
+            from ..utils.log import Log
+            Log.warning("tpu_health_abort: unknown anomaly kind %r "
+                        "(expected %s)" % (tok, "/".join(ANOMALY_KINDS)))
+            continue
+        kinds.add(tok)
+    _abort = frozenset(kinds)
+
+
+def _margin_anomaly() -> Optional[dict]:
+    global _last_margin_count
+    h = histo.get(MARGIN_HISTO)
+    if h is None or h.count == 0 or h.count == _last_margin_count:
+        return None
+    _last_margin_count = h.count
+    p01 = h.percentile(0.01)
+    out = None
+    if len(_baseline) >= BASELINE_MIN:
+        base = sorted(_baseline)[len(_baseline) // 2]
+        if base > 0 and p01 < base * MARGIN_COLLAPSE_RATIO:
+            out = {"kind": "margin_collapse", "p01": p01,
+                   "baseline_p01": base,
+                   "ratio": p01 / base if base else 0.0}
+    if out is None:
+        # only HEALTHY readings extend the baseline: a collapse must
+        # keep firing until the margins recover, not re-anchor on itself
+        _baseline.append(p01)
+    return out
+
+
+def check_record(iteration: int, evals: Optional[list] = None
+                 ) -> List[dict]:
+    """Run the anomaly probes for one monitor record. Each detected
+    anomaly flight-notes, bumps ``health::<kind>``, and — when its kind
+    is in ``tpu_health_abort`` — dumps the flight ring and raises
+    ``LightGBMError`` so the run dies with a postmortem instead of
+    training garbage to completion. Returns the anomaly dicts."""
+    global _last_stall
+    anomalies: List[dict] = []
+    for entry in evals or []:
+        try:
+            val = float(entry[2])
+        except (TypeError, ValueError, IndexError):
+            continue
+        if not math.isfinite(val):
+            anomalies.append({"kind": "nonfinite_metric",
+                              "metric": str(entry[1]), "value": repr(val)})
+    m = _margin_anomaly()
+    if m is not None:
+        anomalies.append(m)
+    stalls = events.counts_snapshot().get("collective::stall", 0.0)
+    if stalls - _last_stall >= STALL_BURST:
+        anomalies.append({"kind": "stall_burst",
+                          "stalls": stalls - _last_stall})
+    _last_stall = stalls
+    if not anomalies:
+        return anomalies
+    from . import flight
+    for a in anomalies:
+        events.count("health::%s" % a["kind"], 1, category="health")
+        flight.note("health_anomaly", iteration=int(iteration), **a)
+    fatal = [a for a in anomalies if a["kind"] in _abort]
+    if fatal:
+        from ..utils.log import LightGBMError
+        reason = "health_abort:%s@iter=%d" % (fatal[0]["kind"],
+                                              int(iteration))
+        flight.dump(reason)
+        err = LightGBMError(
+            "tpu_health_abort: %s anomaly at iteration %d (%s) — "
+            "aborting early; flight record dumped" %
+            (fatal[0]["kind"], int(iteration),
+             ", ".join("%s=%s" % (k, v) for k, v in sorted(
+                 fatal[0].items()) if k != "kind")))
+        err._flight_dumped = True
+        raise err
+    return anomalies

@@ -234,6 +234,55 @@ def observe(name: str, value: float, unit: str = "s",
         h.record(value)
 
 
+def merge_counts(name: str, buckets: Sequence[int], lo: float,
+                 growth: float, unit: str = "",
+                 category: str = "histo") -> None:
+    """Merge PRE-BUCKETED integer counts into the named registry
+    histogram — the flush path for DEVICE-side histograms (the persist
+    grower's split-margin vector), which bucket on the chip with the
+    same ``floor(log(v/lo)/log(growth))`` rule and ship only counts.
+
+    The registry entry takes the caller's layout (``len(buckets)``
+    buckets at ``lo``/``growth``); repeated flushes with the same layout
+    merge by integer addition. min/max/total are reconstructed from
+    bucket edges/midpoints — estimate-grade, exactly like the quantiles
+    themselves. No-op when telemetry is OFF (the observe() gate)."""
+    from . import events
+    if events.mode() == events.OFF:
+        return
+    counts = [int(b) for b in buckets]
+    nb = len(counts)
+    if nb == 0 or not any(counts):
+        return
+    src = Histogram(name, lo=lo, hi=lo * growth ** nb, growth=growth,
+                    unit=unit, category=category)
+    if src.num_buckets != nb:
+        # hi = lo * growth^nb should give exactly nb buckets; fp jitter
+        # in the ceil can land on nb+1 — force the declared layout (the
+        # layout IS the caller's contract, not the float round-trip)
+        src.num_buckets = nb
+        src.buckets = [0] * nb
+    total = 0.0
+    for i, c in enumerate(counts):
+        if not c:
+            continue
+        src.buckets[i] = c
+        lo_edge = lo * growth ** i
+        hi_edge = lo_edge * growth
+        total += c * math.sqrt(lo_edge * hi_edge)
+        if src.vmin == math.inf:
+            src.vmin = lo_edge
+        src.vmax = hi_edge
+    src.count = sum(counts)
+    src.total = total
+    with _lock:
+        h = _histos.get(name)
+        if h is None:
+            _histos[name] = src
+        else:
+            h.merge(src)
+
+
 def get(name: str) -> Optional[Histogram]:
     with _lock:
         h = _histos.get(name)
@@ -257,3 +306,13 @@ def saturation_total() -> int:
 def reset() -> None:
     with _lock:
         _histos.clear()
+
+
+def reset_prefix(prefix: str) -> None:
+    """Drop the registry entries under one name prefix — the per-run
+    scoping hook for run-scoped families (``numerics::*`` resets at
+    train arming like the flight ring, so an aborted run's margins
+    never leak into the next train's report)."""
+    with _lock:
+        for k in [k for k in _histos if k.startswith(prefix)]:
+            del _histos[k]

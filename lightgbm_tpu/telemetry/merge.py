@@ -49,11 +49,19 @@ class MergeError(ValueError):
     """Unusable inputs (no rank traces found, unreadable JSON, ...)."""
 
 
-def discover_rank_traces(directory: str) -> Dict[int, str]:
+def discover_rank_traces(directory: str,
+                         run: Optional[str] = None) -> Dict[int, str]:
     """{rank: path} of the rank-suffixed trace files under `directory`
     (metrics/flight files are excluded). Validity is sniffed from the
     file head only — a TRACE-mode rank file can be hundreds of MB, and
-    the full parse happens exactly once, in :func:`merge_paths`."""
+    the full parse happens exactly once, in :func:`merge_paths`.
+
+    ``run`` selects ONE run's files by its trace basename (the run
+    fingerprint — ``out`` picks ``out.r0.json``/``out.r1.json``) when
+    the directory mixes several runs; without it a mixed directory
+    still REFUSES loudly (merging rank 0 of one run with rank 1 of
+    another yields a plausible-looking trace whose barriers never
+    match)."""
     groups: Dict[str, Dict[int, str]] = {}
     for name in sorted(os.listdir(directory)):
         m = _RANK_FILE_RE.search(name)
@@ -71,19 +79,24 @@ def discover_rank_traces(directory: str) -> Dict[int, str]:
         if '"traceEvents"' not in head:
             continue
         rank = int(m.group(1))
-        # group by the basename with the rank suffix removed: merging
-        # rank 0 of one RUN with rank 1 of another would produce a
-        # plausible-looking trace whose barriers never match — refuse
-        # that loudly below instead of emitting garbage
+        # group by the basename with the rank suffix removed
         base = name[:m.start()]
         # prefer the plain trace when both x.r0.json and x.r0.trace.json
         # exist (they are the same data; sorted order visits .json first)
         groups.setdefault(base, {}).setdefault(rank, path)
+    if run is not None:
+        if run not in groups:
+            raise MergeError(
+                "--run %r matches no rank traces in the directory "
+                "(runs present: %s)"
+                % (run, ", ".join(sorted(groups)) or "none"))
+        return groups[run]
     if len(groups) > 1:
         raise MergeError(
             "rank traces from more than one run in the directory "
             "(basenames: %s) — pass a directory holding one run's "
-            "traces, or merge explicit paths" % ", ".join(sorted(groups)))
+            "traces, select one with --run <basename>, or merge "
+            "explicit paths" % ", ".join(sorted(groups)))
     return next(iter(groups.values())) if groups else {}
 
 
@@ -203,9 +216,11 @@ def merge_paths(paths: Dict[int, str], out_path: str) -> dict:
             "dropped_events": merged["otherData"]["dropped_events"]}
 
 
-def merge_dir(directory: str, out_path: Optional[str] = None) -> dict:
-    """Merge every rank trace found in `directory`."""
-    paths = discover_rank_traces(directory)
+def merge_dir(directory: str, out_path: Optional[str] = None,
+              run: Optional[str] = None) -> dict:
+    """Merge every rank trace found in `directory` (``run`` selects one
+    run's files by basename when the directory mixes several runs)."""
+    paths = discover_rank_traces(directory, run=run)
     if not paths:
         raise MergeError(
             "no rank-suffixed trace files (*.rN.json / *.rN.trace.json) "

@@ -105,6 +105,16 @@ class TrainingMonitor:
             rec["memory"] = mem
         if evals:
             rec["num_evals"] = len(evals)
+        # numerics-health anomaly probes (telemetry/health.py): a
+        # non-finite eval metric, a split-margin collapse against the
+        # rolling baseline, or a collective::stall burst each flight-
+        # note and count health::<kind>; kinds listed in
+        # tpu_health_abort= raise (with a flight dump) INSTEAD of
+        # letting the run train garbage to completion
+        from . import health
+        anomalies = health.check_record(iteration, evals)
+        if anomalies:
+            rec["health"] = sorted({a["kind"] for a in anomalies})
         self.records.append(rec)
         events.record_iteration(rec)
         # periodic Prometheus snapshot (telemetry_out=...prom): throttled

@@ -69,9 +69,29 @@ def render() -> str:
         lines.append("lgbtpu_timer_calls_total%s %d" % (lbl, n))
 
     lines.append("# TYPE lgbtpu_counter_total counter")
-    for name, v in sorted(events.counts_snapshot().items()):
+    counts = events.counts_snapshot()
+    for name, v in sorted(counts.items()):
         lines.append('lgbtpu_counter_total{name="%s"} %.9g'
                      % (_esc(name), v))
+
+    # numerics-health families (telemetry/health.py): emitted with
+    # explicit zeros so dashboards/alerts can pin on the family existing
+    # BEFORE the first anomaly — an absent series is indistinguishable
+    # from a dead exporter
+    from . import health
+    lines.append("# TYPE lgbtpu_health_anomalies_total counter")
+    for kind in health.ANOMALY_KINDS:
+        lines.append('lgbtpu_health_anomalies_total{kind="%s"} %.9g'
+                     % (kind, counts.get("health::%s" % kind, 0.0)))
+    lines.append("# TYPE lgbtpu_health_nonfinite_total counter")
+    for kind, cname in (("grad", "numerics::nan_grad"),
+                        ("hess", "numerics::nan_hess"),
+                        ("hist", "numerics::inf_hist")):
+        lines.append('lgbtpu_health_nonfinite_total{kind="%s"} %.9g'
+                     % (kind, counts.get(cname, 0.0)))
+    lines.append("# TYPE lgbtpu_health_divergence_total counter")
+    lines.append("lgbtpu_health_divergence_total %.9g"
+                 % counts.get("numerics::divergence", 0.0))
 
     lines.append("# TYPE lgbtpu_histo summary")
     lines.append("# TYPE lgbtpu_histo_dist histogram")

@@ -541,6 +541,22 @@ class SerialTreeLearner:
         opt = str(getattr(self.config, "tpu_level_grow", "auto")).lower()
         return "off" if opt in ("off", "false", "0") else "auto"
 
+    def _persist_health_mode(self) -> bool:
+        """tpu_numerics_stats: 'auto' accumulates the device-side
+        numerics health vector (NaN/Inf counters + split-margin
+        histogram) in the persist scan carry WHEN telemetry is on —
+        with telemetry off the flush would drop everything, so the
+        default run pays nothing (the off-mode zero-overhead
+        contract). 'on'/'force' accumulates regardless (the flush
+        still gates on telemetry); 'off' zeroes it."""
+        opt = str(getattr(self.config, "tpu_numerics_stats",
+                          "auto")).lower()
+        if opt in ("off", "false", "0"):
+            return False
+        if opt in ("on", "force", "1", "true"):
+            return True
+        return telemetry.enabled()
+
     def _persist_kernel_effective(self):
         """(kernel_impl, interpret, score64) after the old-jax interpret
         downgrade make_persist_grower would apply — the payload asset
@@ -565,6 +581,7 @@ class SerialTreeLearner:
         use_w_row = objective.persist_grad_mode() == "payload"
         kernel_impl, interpret, score64 = self._persist_kernel_effective()
         level_mode = self._persist_level_mode()
+        health = self._persist_health_mode()
         akey = ("assets", K, use_w_row, score64)
         assets = cache.get(akey)
         if assets is None:
@@ -574,21 +591,22 @@ class SerialTreeLearner:
             cache[akey] = assets
         stat_from_scan = bag_spec[0] != "none"
         gkey = ("grower", K, use_w_row, self.grow_config,
-                stat_from_scan, kernel_impl, level_mode)
+                stat_from_scan, kernel_impl, level_mode, health)
         gr = cache.get(gkey)
         if gr is None:
             gr = make_persist_grower(assets, self.meta, self.grow_config,
                                      interpret=interpret,
                                      kernel_impl=kernel_impl,
                                      stat_from_scan=stat_from_scan,
-                                     fix=self.fix, level_mode=level_mode)
+                                     fix=self.fix, level_mode=level_mode,
+                                     health=health)
             if assets.efb[5]:          # bundled: block-scan fast path
                 telemetry.count("tree_learner::persist_bundle_blockscan",
                                 category="tree_learner")
             cache[gkey] = gr
         dkey = ("driver", K, use_w_row, k, self.grow_config,
                 objective.static_fingerprint(), bag_spec, kernel_impl,
-                level_mode)
+                level_mode, health)
         driver = cache.get(dkey)
         if driver is None:
             bag_fn = (make_bag_transform(bag_spec, assets.geometry)
@@ -637,22 +655,32 @@ class SerialTreeLearner:
         return stacked
 
     def flush_level_stats(self):
-        """Convert the accumulated device-side level-program stats into
-        telemetry counters (tree_learner::level_programs /
-        level_fallback_splits). Called at score-finalize time — the
-        first natural host sync after a persist batch."""
+        """Convert the accumulated device-side stats (level-program
+        counters + the numerics health vector) into telemetry counters
+        and the ``numerics::split_margin`` histogram. Called at
+        score-finalize time — the first natural host sync after a
+        persist batch; the ONLY host-side cost of the runtime numerics
+        sentinel, measured under ``numerics::flush`` (the < 2%
+        overhead pin)."""
         st = getattr(self, "_level_stats_dev", None)
         if st is None:
             return
         self._level_stats_dev = None
         import jax
+        # the device_get may drain the still-running async batch — that
+        # wait is pipeline time (the callers' device_wait spans own it),
+        # not sentinel cost; only the host-side conversion below is the
+        # sentinel's bill, and that is what the < 2% pin measures
         v = np.asarray(jax.device_get(st))
-        if v[0]:
-            telemetry.count("tree_learner::level_programs", float(v[0]),
-                            category="tree_learner")
-        if v[1]:
-            telemetry.count("tree_learner::level_fallback_splits",
-                            float(v[1]), category="tree_learner")
+        with telemetry.scope("numerics::flush", category="numerics"):
+            if v[0]:
+                telemetry.count("tree_learner::level_programs",
+                                float(v[0]), category="tree_learner")
+            if v[1]:
+                telemetry.count("tree_learner::level_fallback_splits",
+                                float(v[1]), category="tree_learner")
+            from ..telemetry import health as telemetry_health
+            telemetry_health.flush_device_stats(v[2:])
 
     def persist_finalize_scores(self):
         """Row-ordered f64 scores from the live carry (None when no carry).

@@ -770,6 +770,26 @@ AUDITOR_FIXTURES = {
         "positive": {"program": "callback_in_scan"},
         "negative": {"program": "clean_scan"},
     },
+    # a module that builds a persist scan driver without any path to
+    # the numerics::* health flush vs the same module flushing
+    "health_covered": {
+        "positive": """
+            from lightgbm_tpu.ops.grow_persist import make_scan_driver
+
+            def build(gr, gc, k, fn):
+                return make_scan_driver(gr, gc, k, fn)
+            """,
+        "negative": """
+            from lightgbm_tpu.ops.grow_persist import make_scan_driver
+            from lightgbm_tpu.telemetry.health import flush_device_stats
+
+            def build_and_train(gr, gc, k, fn, pay, args):
+                driver = make_scan_driver(gr, gc, k, fn)
+                pay, stacked, stats = driver(pay, *args)
+                flush_device_stats(stats[2:])
+                return stacked
+            """,
+    },
     # int8 at full plane scale blows the split-decision budget; int16
     # at the higgs geometry certifies (the shipped certificate)
     "quant_certify": {
@@ -929,7 +949,7 @@ def test_auditors_all_green_on_repo():
                             "collective_observed", "vmem_budget",
                             "hbm_budget", "compile_surface",
                             "precision_flow", "transfer",
-                            "quant_certify"}
+                            "quant_certify", "health_covered"}
     bad = {n: r.detail for n, r in results.items() if not r.ok}
     assert not bad, bad
 
@@ -988,7 +1008,7 @@ def test_cli_gate_json_green(capsys):
     assert {"collective_order", "collective_guarded",
             "collective_observed", "vmem_budget", "hbm_budget",
             "compile_surface", "precision_flow", "transfer",
-            "quant_certify"} <= audit_names
+            "quant_certify", "health_covered"} <= audit_names
     assert payload["lint"]["counts"]["unsuppressed"] == 0
     assert payload["collective_trace"]["findings"] == []
     assert payload["resource_tables"]["vmem"]
