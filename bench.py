@@ -415,6 +415,10 @@ def main():
     if vote is not None:
         result["voting_value"] = vote["value"]
         result["voting_vs_baseline"] = vote["vs_baseline"]
+        for key in ("reduced_feature_frac", "dcn_hist_bytes",
+                    "hist_compress_ratio"):
+            if key in vote:
+                result[key] = vote[key]
         print(json.dumps(result), flush=True)
         print("# voting-parallel (PV-tree persist, %d-device mesh): rows=%d "
               "iters=%d train=%.1fs -> %.2fM row-iters/s (vs the same CPU "
@@ -932,10 +936,30 @@ def run_voting():
     jax.block_until_ready(bst._booster.train_score.score_device(0))
     train_s = time.time() - t0
     throughput = n_rows * n_iters / train_s
-    return {"rows": n_rows, "iters": n_iters, "train_s": train_s,
-            "devices": len(jax.devices()),
-            "value": round(throughput / 1e6, 3),
-            "vs_baseline": round(throughput / REF_THROUGHPUT, 4)}
+    out = {"rows": n_rows, "iters": n_iters, "train_s": train_s,
+           "devices": len(jax.devices()),
+           "value": round(throughput / 1e6, 3),
+           "vs_baseline": round(throughput / REF_THROUGHPUT, 4)}
+    # communication-efficiency keys (ROADMAP item 2): the PV-Tree
+    # pre-selection ratio and the flush-time wire-byte model — present
+    # when the persist path ran with telemetry on; BENCH_PARAMS=
+    # "tpu_hist_quant=int16" records a quantized round (its own
+    # comparability lineage via meta.knobs)
+    tl = bst._booster.tree_learner
+    gr = getattr(tl, "_persist_gr", None)
+    if gr is not None:
+        tl.flush_level_stats()
+        out["reduced_feature_frac"] = round(
+            float(getattr(gr, "reduced_feature_frac", 1.0)), 4)
+        from lightgbm_tpu.telemetry import events as tel_events
+        counts = tel_events.counts_snapshot()
+        dcn = counts.get("collective::dcn_hist_bytes", 0)
+        fullb = counts.get("collective::dcn_hist_bytes_fullwidth", 0)
+        if dcn:
+            out["dcn_hist_bytes"] = int(dcn)
+        if dcn and fullb:
+            out["hist_compress_ratio"] = round(fullb / dcn, 3)
+    return out
 
 
 if __name__ == "__main__":

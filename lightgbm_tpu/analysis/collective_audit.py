@@ -74,6 +74,17 @@ COLLECTIVE_KINDS: Dict[str, str] = {
     "assert_equal": "barrier",
 }
 
+# in-program mesh-collective WRAPPERS (ops/quantize.py): called inside
+# jitted growers with a literal label as the first argument. They are
+# traced into the `mesh_sites` section of the collective trace — the
+# wire-format diff artifact for the quantized-histogram exchange — but
+# stay OUT of the host-side order/guard/observed audits (XLA sequences
+# in-program collectives; the retry guard wraps only host DCN calls).
+MESH_WRAPPERS: Dict[str, str] = {
+    "plane_psum": "psum",
+    "vote_allgather": "allgather",
+}
+
 # names that ARE a rank on sight; everything else only becomes tainted
 # by assignment from one of these
 _RANK_NAMES = {"rank", "process_id", "process_index", "rank_id",
@@ -95,6 +106,7 @@ class CollectiveSite:
     payload: str = ""          # source snippet of the payload arg
     guarded: bool = False      # wrapped by resilience_retry.guard
     observed: bool = False     # records telemetry (span or histogram)
+    mesh: bool = False         # in-program mesh collective (MESH_WRAPPERS)
     conditions: Tuple[str, ...] = ()   # enclosing rank-dependent tests
     node: Optional[ast.AST] = field(default=None, repr=False, compare=False)
 
@@ -102,7 +114,7 @@ class CollectiveSite:
         return {"kind": self.kind, "path": self.path, "line": self.line,
                 "func": self.func, "name": self.name,
                 "payload": self.payload, "guarded": self.guarded,
-                "observed": self.observed,
+                "observed": self.observed, "mesh": self.mesh,
                 "rank_dependent": bool(self.conditions),
                 "conditions": list(self.conditions)}
 
@@ -532,6 +544,67 @@ def _audited_files(config: GraftlintConfig) -> List[str]:
     return out
 
 
+def _mesh_files(config: GraftlintConfig) -> List[str]:
+    out = []
+    for frag in getattr(config, "mesh_collective_paths", []):
+        ap = os.path.join(config.root, frag)
+        if os.path.isfile(ap):
+            out.append(frag)
+    return out
+
+
+def audit_mesh_sites(config: Optional[GraftlintConfig] = None
+                     ) -> List[CollectiveSite]:
+    """In-program mesh-collective sites: every labeled
+    ``plane_psum``/``vote_allgather`` call in the configured grower
+    modules (``mesh-collective-paths``). These run INSIDE jitted SPMD
+    programs — XLA sequences them identically on every shard, so the
+    rank-consistency/guard audits do not apply — but they ARE the wire
+    the quantized-histogram exchange ships on, so they ride the
+    collective trace as ``mesh_sites`` for before/after diffing (and
+    the trace-pin tests). A wrapper call without a literal label lands
+    with ``name=""`` — the pin test treats that as a regression."""
+    config = config or load_config()
+    sites: List[CollectiveSite] = []
+    for rel in _mesh_files(config):
+        with open(os.path.join(config.root, rel), "r",
+                  encoding="utf-8") as f:
+            src = f.read()
+        ctx = ModuleContext(src, rel, config)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            target = ctx.call_target(node)
+            leaf = (target or "").split(".")[-1]
+            if leaf not in MESH_WRAPPERS:
+                continue
+            name = ""
+            if node.args and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                name = node.args[0].value
+            payload = (_snippet(src, node.args[1])
+                       if len(node.args) >= 2 else "")
+            func = ""
+            fn = ctx.enclosing_function(node)
+            parts = []
+            while fn is not None:
+                if isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                    parts.append(fn.name)
+                fn = ctx.enclosing_function(fn)
+            func = ".".join(reversed(parts))
+            # guarded/observed stay honestly False: the retry guard and
+            # the telemetry-span audit are HOST-side facts that do not
+            # apply in-program (XLA sequences these; the flush-time
+            # wire-byte histograms observe them in aggregate). The mesh
+            # flag is what distinguishes them — they never enter the
+            # guard/observed audits.
+            sites.append(CollectiveSite(
+                kind=MESH_WRAPPERS[leaf], path=rel, line=node.lineno,
+                func=func, name=name, payload=payload, mesh=True))
+    return sites
+
+
 def audit_repo(config: Optional[GraftlintConfig] = None
                ) -> Tuple[List[CollectiveSite], List[CollectiveFinding]]:
     config = config or load_config()
@@ -549,11 +622,15 @@ def audit_repo(config: Optional[GraftlintConfig] = None
 
 def extract_repo_trace(config: Optional[GraftlintConfig] = None,
                        artifact=None) -> dict:
-    """The abstract collective trace for the --json payload."""
+    """The abstract collective trace for the --json payload: host-side
+    DCN sites + findings, plus the in-program ``mesh_sites`` (the
+    quantized plane reductions and the PV-Tree vote allgather)."""
     sites, findings = artifact if artifact is not None \
         else audit_repo(config)
     return {"sites": [s.to_dict() for s in sites],
-            "findings": [f.to_dict() for f in findings]}
+            "findings": [f.to_dict() for f in findings],
+            "mesh_sites": [s.to_dict()
+                           for s in audit_mesh_sites(config)]}
 
 
 def run(config: Optional[GraftlintConfig] = None,

@@ -68,6 +68,8 @@ DOMAINS: Dict[str, Tuple[int, str]] = {
     "feat_gains_only": (2, "CEGB feature-gain pre-pass runs both modes"),
     "k": (3, "fused scan batch sizes clamp to {1..8,16} minus "
              "snapshot alignment; bounded by the batch ladder"),
+    "quant": (1, "one certified HistQuant (or None) per learner — "
+                 "resolved from tpu_hist_quant at config time"),
 }
 
 # site-specific domains for static_argnums on functions whose parameter

@@ -47,6 +47,15 @@ class GraftlintConfig:
     # collective call sites (rank-consistency and guard-wrapping checks)
     collective_paths: List[str] = field(default_factory=lambda: [
         "lightgbm_tpu/parallel/", "lightgbm_tpu/resilience/"])
+    # mesh-collective trace: files whose IN-PROGRAM labeled collective
+    # wrappers (ops/quantize.plane_psum / vote_allgather) are extracted
+    # into the collective trace's `mesh_sites` section — the wire-format
+    # diff artifact of the quantized-histogram exchange. These run inside
+    # jitted SPMD programs (XLA sequences them), so the guard/observed
+    # audits do not apply; every site must still carry a literal label.
+    mesh_collective_paths: List[str] = field(default_factory=lambda: [
+        "lightgbm_tpu/ops/grow.py",
+        "lightgbm_tpu/ops/grow_persist.py"])
     # JG010: ops//predict/ files whose narrowing casts are blessed —
     # their NARROW_OK tables + input contracts feed the precision-flow
     # auditor; narrowing anywhere else in the hot paths is a finding
@@ -55,7 +64,8 @@ class GraftlintConfig:
         "lightgbm_tpu/ops/grow_persist.py",
         "lightgbm_tpu/ops/pallas_grow.py",
         "lightgbm_tpu/ops/pallas_histogram.py",
-        "lightgbm_tpu/ops/pallas_scan.py"])
+        "lightgbm_tpu/ops/pallas_scan.py",
+        "lightgbm_tpu/ops/quantize.py"])
     # resource auditor: device profile the VMEM/HBM budgets come from
     # (telemetry/devices.py; "auto" = detect attached accelerator)
     audit_device: str = "v5e"

@@ -68,10 +68,22 @@ HIGHER_BETTER = (
     # when throughput holds — the runtime twin of the quant_certify
     # SPLIT_DECISION_BUDGET
     "margin_p01",
+    # quantized-collective payload reduction (ROADMAP item 2): estimated
+    # full-width bytes over estimated shipped bytes for the histogram
+    # exchanges — the int16 + PV-Tree voting compression the acceptance
+    # criterion pins at >= 3x
+    "hist_compress_ratio",
 )
 LOWER_BETTER = (
     "predict_p50", "predict_p99", "checkpoint_overhead_frac",
     "expo_level_launches_per_tree",
+    # estimated histogram-exchange bytes actually shipped per run
+    # (collective::dcn_hist_bytes) — the payload the quantized wire
+    # format exists to shrink
+    "dcn_hist_bytes",
+    # voting: fraction of features whose planes cross the wire
+    # (2*top_k/F) — the PV-Tree pre-selection ratio
+    "reduced_feature_frac",
 )
 # headline keys whose PRESENCE depends on a measurement-only knob
 # (margin_p01 only exists when BENCH_TELEMETRY recorded the margin
@@ -79,7 +91,12 @@ LOWER_BETTER = (
 # the lineage fingerprint): these still direction-gate when two rounds
 # both carry them, but vanishing is a recording-mode change, not a
 # phase crash, so the vanish-gate skips them
-MEASUREMENT_CONDITIONAL = ("margin_p01",)
+MEASUREMENT_CONDITIONAL = ("margin_p01",
+                           # the wire-byte keys read telemetry counters
+                           # (bench run_voting -> counts_snapshot): a
+                           # BENCH_TELEMETRY=0 round omits them without
+                           # the phase having crashed
+                           "dcn_hist_bytes", "hist_compress_ratio")
 
 # per-key minimum noise bands: bucket-quantized keys can only move in
 # layout-growth steps. margin_p01 is a quantile of the 2.0-growth
@@ -260,6 +277,7 @@ class PerfReport:
     missing_keys: List[str] = field(default_factory=list)
     lineages: Dict[str, List[int]] = field(default_factory=dict)
     root: str = ""          # where the rounds were discovered
+    band: float = 0.15      # the band floor this report was judged at
 
     @property
     def regressions(self) -> List[Verdict]:
@@ -276,7 +294,7 @@ def evaluate(rounds: List[Round], band_floor: float,
     """The sentinel core: pure function of the validated round series
     (the fixture tests drive exactly this)."""
     rep = PerfReport(rounds=rounds, multichip=multichip or [],
-                     errors=list(errors or []))
+                     errors=list(errors or []), band=band_floor)
     for r in rounds:
         rep.lineages.setdefault(r.fingerprint(), []).append(r.index)
 
@@ -455,12 +473,42 @@ def _multichip_result(rep: PerfReport) -> List[AuditResult]:
         return []
     latest = rep.multichip[-1]
     mc_ok = bool(latest.get("ok")) and latest.get("rc", 1) == 0
-    return [AuditResult(
-        name="perf_multichip",
-        ok=mc_ok,
-        detail=("latest multichip round r%02d: %s devices, ok=%s"
-                % (latest["index"], latest.get("n_devices", "?"),
-                   latest.get("ok"))))]
+    # multichip rounds carrying a `parsed` block (MULTICHIP_r07 on:
+    # dcn_hist_bytes / hist_compress_ratio / reduced_feature_frac from
+    # the quantized+voting dry run) direction-gate latest-vs-predecessor
+    # exactly like the bench headline keys — the payload-reduction
+    # trajectory is guarded from the round that first recorded it
+    bad: List[str] = []
+    latest_vals = (_numeric_keys(latest["parsed"])
+                   if isinstance(latest.get("parsed"), dict) else {})
+    prev_vals: Dict[str, float] = {}
+    prev_idx = None
+    for m in rep.multichip[:-1]:
+        if isinstance(m.get("parsed"), dict):
+            prev_vals = _numeric_keys(m["parsed"])
+            prev_idx = m["index"]
+    for key in HIGHER_BETTER + LOWER_BETTER:
+        if key not in latest_vals or key not in prev_vals:
+            continue
+        new_v, old_v = latest_vals[key], prev_vals[key]
+        # the same band floor the bench headline keys were judged at
+        # (plus any per-key bucket-quantization floor)
+        band = max(rep.band, KEY_BAND_FLOOR.get(key, 0.0))
+        rel = (new_v - old_v) / max(abs(old_v), 1e-12)
+        better = rel if key in HIGHER_BETTER else -rel
+        if better < -band:
+            bad.append("%s r%02d %.4g -> r%02d %.4g (%.1f%% worse)"
+                       % (key, prev_idx, old_v, latest["index"], new_v,
+                          -100.0 * better))
+    detail = ("latest multichip round r%02d: %s devices, ok=%s"
+              % (latest["index"], latest.get("n_devices", "?"),
+                 latest.get("ok")))
+    if latest_vals:
+        detail += "; %d payload key(s) tracked" % len(latest_vals)
+    if bad:
+        detail = "; ".join(bad)
+    return [AuditResult(name="perf_multichip", ok=mc_ok and not bad,
+                        detail=detail)]
 
 
 def check_fixture(payload) -> List[str]:

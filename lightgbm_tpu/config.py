@@ -255,6 +255,22 @@ PARAMS: List[_P] = [
     #                                        # only with >1 process; on
     #                                        # forces the world=1 short-
     #                                        # circuit path too)
+    # ---- communication-efficient distributed exchange (ROADMAP item 2)
+    _P("tpu_hist_quant", str, "off"),        # off | int16: quantize the
+    #                                        # cross-device histogram-
+    #                                        # plane reductions to int16
+    #                                        # with rank-uniform seeded
+    #                                        # stochastic rounding; the
+    #                                        # spec must pass the
+    #                                        # quant_certify certificate
+    #                                        # (int8 is refused there)
+    _P("tpu_comm_overlap", str, "auto"),     # auto | off: double-buffer
+    #                                        # the level program's plane
+    #                                        # reductions as two staged
+    #                                        # half-batches (comm of half
+    #                                        # A overlaps compute of half
+    #                                        # B; bit-identical either
+    #                                        # way)
 ]
 
 _BY_NAME: Dict[str, _P] = {p.name: p for p in PARAMS}
@@ -479,6 +495,16 @@ class Config:
         self.tpu_predict_dtype = "f32" if pdt in ("f32", "float32") else "f64"
         if self.tpu_predict_max_batch < self.tpu_predict_min_batch:
             Log.fatal("tpu_predict_max_batch < tpu_predict_min_batch")
+        hq = str(self.tpu_hist_quant).lower()
+        if hq in ("", "false", "0"):
+            hq = "off"
+        # int8 parses here but is refused at learner build by the
+        # quant_certify certificate (parallel/distributed.
+        # resolve_hist_quant) with the bound named in the error
+        if hq not in ("off", "int16", "int8"):
+            Log.fatal("Unknown tpu_hist_quant %s (expected off|int16)"
+                      % self.tpu_hist_quant)
+        self.tpu_hist_quant = hq
         if self.boosting == "rf":
             if not (self.bagging_freq > 0 and 0.0 < self.bagging_fraction < 1.0):
                 Log.fatal("Random forest needs bagging_freq > 0 and "

@@ -42,6 +42,7 @@ import jax
 import jax.numpy as jnp
 
 from ..telemetry import events as telemetry
+from .quantize import plane_psum, quant_tag, vote_allgather
 from .split import (CatLayout, F64, I32, K_EPSILON, K_MIN_SCORE, FeatureMeta,
                     SplitCandidate, SplitParams, _leaf_gain,
                     _leaf_output_unconstrained, acc_dtype,
@@ -347,19 +348,26 @@ def _merge_cands_over_shards(cand, axis_name):
 
 
 def _voting_reduce_hist(hist, feat_gains, meta, gc: GrowConfig, axis_name,
-                        feat_nb, always_mask):
+                        feat_nb, always_mask, quant=None, tag=None):
     """The PV-tree communication step (voting_parallel_tree_learner.cpp):
-    per-shard top-k feature vote (:321 allgather of LightSplitInfo),
-    GlobalVoting by vote count (:153-184), then psum of ONLY the winning
-    features' histogram bins (CopyLocalHistogram + ReduceScatter,
-    :186-243, :344). Returns (hist with winner bins globally summed,
-    winner feature mask) — identical on every shard."""
+    per-shard top-k proposals cross the wire as a SMALL INDEX ALLGATHER
+    (:321's LightSplitInfo exchange — k i32 words per rank, not an
+    [F]-plane vote psum), GlobalVoting ranks by vote count (:153-184),
+    then ONLY the winning features' histogram bins are reduced
+    (CopyLocalHistogram + ReduceScatter, :186-243, :344) — int16
+    stochastic-rounded codes under ``quant``. Returns (hist with winner
+    bins globally summed, winner feature mask) — identical on every
+    shard."""
+    from .pallas_scan import topk_vote_indices
     F = gc.num_features
     k = min(max(gc.top_k, 1), F)
-    _, top_idx = jax.lax.top_k(feat_gains, k)                   # [k]
-    votes_local = jnp.zeros((F,), I32).at[top_idx].add(
-        (feat_gains[top_idx] > K_MIN_SCORE).astype(I32))
-    votes = jax.lax.psum(votes_local, axis_name)                # [F]
+    prop = topk_vote_indices(feat_gains, k,
+                             F, jnp.asarray(K_MIN_SCORE,
+                                            feat_gains.dtype))   # [k]
+    gathered = vote_allgather("allgather:vote_topk", prop,
+                              axis_name)                      # [S, k]
+    votes = jnp.zeros((F,), I32).at[gathered.reshape(-1)].add(
+        1, mode="drop")              # F-sentinel proposals drop out
     n_win = min(2 * k, F)
     # stable vote ranking: ties keep the smaller feature id; the 2k quota
     # is always filled (zero-vote features pad it, as in GlobalVoting)
@@ -367,18 +375,22 @@ def _voting_reduce_hist(hist, feat_gains, meta, gc: GrowConfig, axis_name,
     _, winners = jax.lax.top_k(rank_key, n_win)                 # [n_win]
     win_mask = jnp.zeros((F,), BOOL).at[winners].set(True)
     win_mask = win_mask | always_mask        # categorical: always reduced
-    # psum only the winning features' bin ranges: mask the flat histogram
-    # by bin ownership (bin_to_feat computed from meta.feat_id)
+    # reduce only the winning features' bin ranges: mask the flat
+    # histogram by bin ownership (bin_to_feat from meta.feat_id); the
+    # masked-out lanes are exact zeros, which quantize to exact zeros
     bin_win = win_mask[jnp.clip(meta.feat_id, 0, F - 1)] \
         & (meta.feat_id >= 0)
     masked = hist * bin_win[:, None].astype(hist.dtype)
-    reduced = jax.lax.psum(masked, axis_name)
+    red_g, red_h = plane_psum("psum:vote_planes", masked[:, 0],
+                              masked[:, 1], axis_name, quant, tag)
+    reduced = jnp.stack([red_g, red_h], axis=-1)
     hist_out = jnp.where(bin_win[:, None], reduced, hist)
     return hist_out, win_mask
 
 
 def _make_eval_leaf(meta, params, feature_mask, cat, gc: GrowConfig,
-                    extras: GrowExtras, feat_nb, axis_name=None, fix=None):
+                    extras: GrowExtras, feat_nb, axis_name=None, fix=None,
+                    quant=None):
     """Per-leaf best-split evaluator over a [TB, 2] histogram.
 
     `key` seeds the per-node randomness (extra_trees random thresholds,
@@ -422,9 +434,14 @@ def _make_eval_leaf(meta, params, feature_mask, cat, gc: GrowConfig,
                 fmask & (~meta.is_categorical), num_features=F,
                 use_mc=gc.use_mc, max_w=gc.scan_width, use_dp=gc.use_dp,
                 use_l1=gc.use_l1, use_mds=gc.use_mds, feat_gains_only=True)
+            # the per-node PRNG key is rank-uniform (folded from the
+            # shared tree key by split index), so it doubles as the
+            # quantization rounding seed — unique per eval, identical
+            # on every shard
             hist, win_mask = _voting_reduce_hist(
                 hist, local_gains, meta, gc, axis_name, feat_nb,
-                meta.is_categorical)
+                meta.is_categorical, quant=quant,
+                tag=jnp.asarray(key, jnp.uint32)[0])
             if fix is not None:
                 hist = fix_histogram(hist, sg, sh, fix.mf_global, fix.start,
                                      fix.end, max_w=gc.scan_width,
@@ -522,7 +539,8 @@ def _eval_children(eval_leaf, leaf_hist, l, s, cand, left_cnt, right_cnt,
 
 
 def _make_eval_pair_fused(meta, params, feature_mask, cat, gc: GrowConfig,
-                          axis_name=None, feat_nb=None, num_groups: int = 1):
+                          axis_name=None, feat_nb=None, num_groups: int = 1,
+                          quant=None, extras: GrowExtras = None):
     """Fused Pallas scan-pair evaluator (fast path; see ops/pallas_scan.py).
 
     Built once per tree: dense gather layout + direction masks precompute
@@ -552,6 +570,12 @@ def _make_eval_pair_fused(meta, params, feature_mask, cat, gc: GrowConfig,
                  % jax.lax.psum(1, axis_name)) == shard
         feature_mask = feature_mask & owned
     layout = ScanLayout(meta, feature_mask, F, gc.scan_width, gc.total_bins)
+    # rank-uniform per-TREE seed base for the voting-window rounding:
+    # without the tree key, the same (split, child) would reuse its
+    # noise every boosting iteration and the zero-mean errors the
+    # quant_certify envelope assumes would turn into a systematic bias
+    _qkey = (jnp.asarray(extras.key, jnp.uint32)[0].astype(I32)
+             if extras is not None else jnp.asarray(0, I32))
     p32 = params.cast(jnp.float32)
     f32 = jnp.float32
     # CPU (tests) runs the kernel in interpreter mode — the equivalence
@@ -606,7 +630,8 @@ def _make_eval_pair_fused(meta, params, feature_mask, cat, gc: GrowConfig,
             for c in range(2):
                 hist_c, win = _voting_reduce_hist(
                     hist2[c], out_l[c, 0, :F], meta, gc, axis_name,
-                    feat_nb, meta.is_categorical)
+                    feat_nb, meta.is_categorical, quant=quant,
+                    tag=quant_tag(_qkey, 2 * s + c))
                 hist_new.append(hist_c)
                 win_masks.append(win)
             hist2 = jnp.stack(hist_new)
@@ -860,7 +885,7 @@ def _record_split(tree: TreeArrays, k, do, l, cand, parent_value,
 
 @functools.partial(
     jax.jit,
-    static_argnames=("gc", "axis_name"),
+    static_argnames=("gc", "axis_name", "quant"),
     donate_argnums=(),
 )
 def _grow_tree_jit(layout: DataLayout, grad: jnp.ndarray, hess: jnp.ndarray,
@@ -869,7 +894,7 @@ def _grow_tree_jit(layout: DataLayout, grad: jnp.ndarray, hess: jnp.ndarray,
               axis_name=None, cat: CatLayout = None,
               extras: GrowExtras = None,
               forced: ForcedInfo = None,
-              row_feat_used=None) -> TreeArrays:
+              row_feat_used=None, quant=None) -> TreeArrays:
     """Grow one tree. grad/hess must already include bagging/GOSS weighting
     and be zero on padded/out-of-bag rows; bag_mask marks in-bag valid rows.
 
@@ -915,16 +940,26 @@ def _grow_tree_jit(layout: DataLayout, grad: jnp.ndarray, hess: jnp.ndarray,
             return x
         return jax.lax.psum(x, axis_name)
 
-    def hist_psum(x):
+    # quantization-seed base: the per-tree PRNG key is rank-uniform, so
+    # (key, split index) seeds identical stochastic rounding on every
+    # shard while varying across trees and splits
+    _qkey = jnp.asarray(extras.key, jnp.uint32)[0].astype(I32)
+
+    def hist_psum(x, stage):
+        """Histogram-plane reduction over the mesh — int16 codes on the
+        wire under ``quant`` (ops/quantize.plane_psum)."""
         if axis_name is None or gc.parallel_mode != "data":
             return x
-        return jax.lax.psum(x, axis_name)
+        g_r, h_r = plane_psum("psum:hist_plane", x[..., 0], x[..., 1],
+                              axis_name, quant, quant_tag(_qkey, stage))
+        return jnp.stack([g_r, h_r], axis=-1)
 
     # ---- root ----------------------------------------------------------
     hft = hist_ft(gc)
     root_hist = hist_psum(_hist_masked(
         layout, grad, hess, bag_mask, TB, gc.rows_per_chunk,
-        gc.packed_4bit, None, multival=gc.multival, dtype=hft))
+        gc.packed_4bit, None, multival=gc.multival, dtype=hft),
+        jnp.asarray(0, I32))
     sum_grad = psum(jnp.sum(grad, dtype=ft))
     sum_hess = psum(jnp.sum(hess, dtype=ft))
     root_count = psum(jnp.sum(bag_mask, dtype=I32))
@@ -937,11 +972,12 @@ def _grow_tree_jit(layout: DataLayout, grad: jnp.ndarray, hess: jnp.ndarray,
     feat_nb_e = meta.bin_end - meta.bin_start
     eval_leaf = _make_eval_leaf(meta, params, feature_mask, cat, gc,
                                 extras, feat_nb_e, axis_name=axis_name,
-                                fix=fix)
+                                fix=fix, quant=quant)
     eval_leaf.set_num_groups(layout.group_offset.shape[0])
     eval_pair_fused = (_make_eval_pair_fused(
         meta, params, feature_mask, cat, gc, axis_name=axis_name,
-        feat_nb=feat_nb_e, num_groups=layout.group_offset.shape[0])
+        feat_nb=feat_nb_e, num_groups=layout.group_offset.shape[0],
+        quant=quant, extras=extras)
         if gc.scan_impl == "pallas" else None)
     root_out = _leaf_output_unconstrained(
         sum_grad, sum_hess, pcast.lambda_l1, pcast.lambda_l2,
@@ -1028,7 +1064,7 @@ def _grow_tree_jit(layout: DataLayout, grad: jnp.ndarray, hess: jnp.ndarray,
         smaller_mask = in_leaf & (go_left == smaller_is_left)
         hist_smaller = hist_psum(_hist_masked(
             layout, grad, hess, smaller_mask, TB, gc.rows_per_chunk,
-            gc.packed_4bit, None, multival=gc.multival, dtype=hft))
+            gc.packed_4bit, None, multival=gc.multival, dtype=hft), s)
         sm_sum_grad = jnp.where(smaller_is_left, cand.left_sum_grad,
                                 cand.right_sum_grad)
         sm_sum_hess = jnp.where(smaller_is_left, cand.left_sum_hess,
@@ -1312,7 +1348,7 @@ def _hist_contiguous(binsP, grad, hess, layout: DataLayout, start, length,
 
 
 @functools.partial(
-    jax.jit, static_argnames=("gc", "axis_name"))
+    jax.jit, static_argnames=("gc", "axis_name", "quant"))
 def _grow_tree_partitioned_jit(layout: DataLayout, grad: jnp.ndarray,
                           hess: jnp.ndarray, bag_mask: jnp.ndarray,
                           meta: FeatureMeta, params: SplitParams,
@@ -1320,7 +1356,8 @@ def _grow_tree_partitioned_jit(layout: DataLayout, grad: jnp.ndarray,
                           gc: GrowConfig, gw_global=None, axis_name=None,
                           cat: CatLayout = None,
                           extras: GrowExtras = None,
-                          forced: ForcedInfo = None) -> TreeArrays:
+                          forced: ForcedInfo = None,
+                          quant=None) -> TreeArrays:
     """Leaf-wise growth with O(rows-in-child) per-split work and no gathers.
 
     Same trees as grow_tree (up to f32 summation order); see the section
@@ -1355,10 +1392,17 @@ def _grow_tree_partitioned_jit(layout: DataLayout, grad: jnp.ndarray,
             return x
         return jax.lax.psum(x, axis_name)
 
-    def hist_psum(x):
+    # rank-uniform quantization-seed base (see _grow_tree_jit)
+    _qkey = jnp.asarray(extras.key, jnp.uint32)[0].astype(I32)
+
+    def hist_psum(x, stage):
+        """Histogram-plane reduction over the mesh — int16 codes on the
+        wire under ``quant`` (ops/quantize.plane_psum)."""
         if axis_name is None or gc.parallel_mode != "data":
             return x
-        return jax.lax.psum(x, axis_name)
+        g_r, h_r = plane_psum("psum:hist_plane", x[..., 0], x[..., 1],
+                              axis_name, quant, quant_tag(_qkey, stage))
+        return jnp.stack([g_r, h_r], axis=-1)
 
     # ---- padded payload buffers ----------------------------------------
     # PAD covers the per-split C-windows, the CB copy-back windows, and the
@@ -1386,7 +1430,7 @@ def _grow_tree_partitioned_jit(layout: DataLayout, grad: jnp.ndarray,
                                  layout, jnp.asarray(0, I32),
                                  jnp.asarray(n, I32), root_chunk, gc,
                                  gw_global)
-    root_hist = hist_psum(root_hist)
+    root_hist = hist_psum(root_hist, jnp.asarray(0, I32))
     sum_grad = psum(jnp.sum(grad * bagf, dtype=ft))
     sum_hess = psum(jnp.sum(hess * bagf, dtype=ft))
     root_count = psum(jnp.sum(bag_mask, dtype=I32))
@@ -1401,11 +1445,12 @@ def _grow_tree_partitioned_jit(layout: DataLayout, grad: jnp.ndarray,
     pcast = params.cast(ft)
     eval_leaf = _make_eval_leaf(meta, params, feature_mask, cat, gc,
                                 extras, feat_nb, axis_name=axis_name,
-                                fix=fix)
+                                fix=fix, quant=quant)
     eval_leaf.set_num_groups(layout.group_offset.shape[0])
     eval_pair_fused = (_make_eval_pair_fused(
         meta, params, feature_mask, cat, gc, axis_name=axis_name,
-        feat_nb=feat_nb, num_groups=layout.group_offset.shape[0])
+        feat_nb=feat_nb, num_groups=layout.group_offset.shape[0],
+        quant=quant, extras=extras)
         if gc.scan_impl == "pallas" else None)
     feature_used0 = extras.feature_used
 
@@ -1548,7 +1593,8 @@ def _grow_tree_partitioned_jit(layout: DataLayout, grad: jnp.ndarray,
              _hist_acc_init(gc, layout.group_offset.shape[0], W)))
         n_right = n_l - n_left
 
-        hist_smaller = hist_psum(_hist_acc_finish(hacc, gc, gw_global))
+        hist_smaller = hist_psum(_hist_acc_finish(hacc, gc, gw_global),
+                                 s)
 
         left_cnt = psum(bag_left)
         right_cnt = st.leaf_count[l] - left_cnt

@@ -56,7 +56,9 @@ from .pallas_grow import (N_SCALARS, S_DB, S_DL, S_LE, S_LS, S_MASK, S_MF,
                           S_MT, S_NB, S_NCH, S_NL, S_S0, S_SH, S_SMALL_L,
                           S_THR, S_WG, make_root_hist, make_split_pass,
                           plane_health)
-from .pallas_scan import ScanLayout, margin_bucket_index, scan_pair
+from .pallas_scan import (ScanLayout, margin_bucket_index, scan_pair,
+                          topk_vote_indices)
+from .quantize import plane_psum, quant_tag, vote_allgather
 from .split import (K_MIN_SCORE, SplitParams, find_best_split_numerical,
                     find_best_split_numerical_batch, fix_histogram)
 
@@ -494,7 +496,16 @@ def _hash_uniform(rid, wkey):
     murmur3-style integer finalizer. Rows permute across iterations but the
     row id rides the payload, so the same window key reproduces the same
     per-ROW draw regardless of position — bagging_freq windows behave like
-    the reference's cached bag (gbdt.cpp:210-244) without a mask row."""
+    the reference's cached bag (gbdt.cpp:210-244) without a mask row.
+
+    Known quirk, deliberately kept: the raw u32->f32 cast rounds hash
+    values >= 2^32 - 128 UP, so u == 1.0 about one draw in 2^25 — for
+    bagging that merely drops a row that a true [0, 1) draw would keep
+    with probability `fraction` (a ~3e-8 rate bias, no invariant
+    broken). The quantizer's noise (ops/quantize._lane_uniform) uses
+    an exact 24-bit conversion instead because u == 1.0 WOULD break
+    its zero-preservation invariant; changing this hash to match would
+    silently re-draw every historical bag, so the two stay separate."""
     x = rid.astype(U32) ^ wkey[0]
     x = x * U32(0x85EB_CA6B)
     x = x ^ (x >> 13)
@@ -635,7 +646,8 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
                         stat_from_scan: bool = False,
                         state_dtype=None, fix=None,
                         level_mode: str = "auto",
-                        health: bool = True):
+                        health: bool = True,
+                        quant=None, comm_overlap: bool = False):
     """Build grow/score/gradient closures for one dataset + grow config.
 
     gc: GrowConfig (num_leaves, max_depth, num_features, scan_width used).
@@ -669,6 +681,23 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
     split, zero extra launches, zero host syncs (the transfer audit's
     contract). False zeroes the health tail of the stats vector
     (tpu_numerics_stats=off — the overhead-pin escape hatch).
+
+    quant: optional ops/quantize.HistQuant — the cross-device
+    histogram-plane reductions (root/level/split psums, the voting
+    winner-window reduce) ship int16 stochastic-rounded codes instead of
+    full-width floats (ROADMAP item 2; the spec must carry a green
+    quant_certify certificate, asserted by
+    parallel/distributed.resolve_hist_quant). Rank-uniform seeds per
+    (iteration, stage, plane) keep the reconstructed global planes
+    bit-identical on every rank. Inert when axis_name is None.
+
+    comm_overlap: double-buffer the level program's plane reductions as
+    two staged half-batches — the reduce of half A is dispatched before
+    half B's planes are touched, so on hardware with async collectives
+    the wire time of A hides under B's accumulate/quantize compute.
+    Bit-identical to the single full-batch reduce (rows reduce
+    independently; the stochastic-rounding noise is seeded by GLOBAL
+    slot position).
 
     stat_from_scan: leaf counts come from the scan's hessian-derived
     rounding (the reference's cnt_factor recovery,
@@ -805,12 +834,37 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
     weight_row = payload_weight_row(nbw, K, score64)  # only when has_w
 
     # PV-tree voting-parallel (voting_parallel_tree_learner.cpp:153-344):
-    # histogram planes stay shard-LOCAL; per split each shard proposes its
-    # top_k features from a local scan, a psum'd vote picks 2k winners,
-    # and only the winners' bins are globally summed before the real scan
+    # histogram planes stay shard-LOCAL; per split each shard proposes
+    # its top_k features from a LOCAL gain scan, the proposals cross the
+    # wire as a small top-k INDEX allgather (the LightSplitInfo exchange,
+    # :321 — k i32 words per rank per leaf, not an [F]-plane vote psum),
+    # and only the globally voted 2k winners' bin windows are reduced
+    # before the real scan
     voting = axis_name is not None and gc.parallel_mode == "voting"
     K_TOP = min(max(int(gc.top_k), 1), F)
     N_WIN = min(2 * K_TOP, F)
+    if axis_name is None:
+        quant = None      # unsharded: no wire, no quantization noise
+
+    def _global_vote(local_gains):
+        """PV-Tree vote over the wire: per-rank top-k proposal indices
+        -> vote_allgather -> rank-uniform winner ranking. Ties keep the
+        smaller feature id and the 2k quota always fills (GlobalVoting,
+        voting_parallel_tree_learner.cpp:153-184). Returns win_idx
+        [B, N_WIN] — identical on every rank."""
+        B = local_gains.shape[0]
+        neg = jnp.asarray(K_MIN_SCORE, local_gains.dtype)
+        prop = topk_vote_indices(local_gains, K_TOP, F, neg)  # [B, K_TOP]
+        gath = vote_allgather("allgather:vote_topk", prop,
+                              axis_name)                      # [S, B, K]
+        Sn = gath.shape[0]
+        bidx = jnp.broadcast_to(jnp.arange(B, dtype=I32)[None, :, None],
+                                (Sn, B, K_TOP))
+        votes = jnp.zeros((B, F), I32).at[bidx, gath].add(
+            1, mode="drop")            # F-sentinel proposals drop out
+        rank_key = votes * F - jnp.arange(F, dtype=I32)[None]
+        _, win_idx = jax.lax.top_k(rank_key, N_WIN)
+        return win_idx
 
     # padded meta for the dense scan: feature f's window sits inside its
     # storage group's [G, 256] block at the group-local offset (ls = 0 and
@@ -900,7 +954,7 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
                                        .multiply(fm_lane[None])
 
     def eval_batch_wide(gh, hh, rows, sgs, shs, cnts, depths, params,
-                        fmask):
+                        fmask, tag):
         """Widened split-find: the v1 f64 scan, batched over leaves.
 
         gh/hh are flat [L, TBe] f64 planes; rows: [B] i32 leaf-hist row
@@ -915,12 +969,12 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
         nd = cnts.astype(I32)
         fmask_b = None
         if voting:
-            # PV-tree proposal/vote in the flat layout: each shard scans
-            # its LOCAL planes with 1/S-scaled thresholds, a psum'd vote
-            # picks the 2k winners, and only winner features' bins go
-            # global. The Mosaic path ships a compact [B, 2k, W] gather
-            # over the wire; this emulation psums a winner-masked plane
-            # — same values, test-grade comms.
+            # PV-tree in the flat layout: each shard scans its LOCAL
+            # planes with 1/S-scaled thresholds, the top-k proposals
+            # cross as a small index allgather, and ONLY the globally
+            # voted winners' bin windows are reduced — a compact
+            # [B, 2, N_WIN, W_scan] buffer over the wire (int16 codes
+            # under quantization), never the full planes.
             B = rows.shape[0]
             Sn_f = jax.lax.psum(jnp.asarray(1.0, jnp.float64), axis_name)
             Sn_i = Sn_f.astype(I32)
@@ -944,24 +998,31 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
                                   use_mds=gc.use_mds,
                                   feat_gains_only=True))(
                 g2, h2, local_sg, local_sh, local_cnt)        # [B, F]
-            neg = jnp.asarray(K_MIN_SCORE, jnp.float64)
-            vl = []
-            for c in range(B):
-                lg_ = lg_all[c]
-                _, ti = jax.lax.top_k(lg_, K_TOP)
-                vl.append(jnp.zeros((F,), I32).at[ti].add(
-                    (lg_[ti] > neg).astype(I32)))
-            votes = jax.lax.psum(jnp.stack(vl), axis_name)     # [B, F]
-            rank_key = votes * F - jnp.arange(F, dtype=I32)[None]
-            _, win_idx = jax.lax.top_k(rank_key, N_WIN)
+            win_idx = _global_vote(lg_all)                    # [B, N_WIN]
             arB = jnp.arange(B, dtype=I32)[:, None]
             winb = jnp.zeros((B, F), BOOL).at[arB, win_idx].set(True)
-            win_lane = winb[:, meta.feat_id[:TBe]]             # [B, TBe]
-            red = jax.lax.psum(jnp.stack([
-                jnp.where(win_lane, g2, 0.0),
-                jnp.where(win_lane, h2, 0.0)]), axis_name)
-            g2 = jnp.where(win_lane, red[0], g2)
-            h2 = jnp.where(win_lane, red[1], h2)
+            # compact winner-window exchange: gather the voted features'
+            # [bs, be) bin windows out of the flat planes, reduce that
+            # buffer, scatter back; everything else stays shard-local
+            bs_w = meta.bin_start[win_idx].astype(I32)        # [B, N_WIN]
+            wid_w = (meta.bin_end[win_idx]
+                     - meta.bin_start[win_idx]).astype(I32)
+            lane_ar = jnp.arange(W_scan, dtype=I32)[None, None, :]
+            lane = bs_w[:, :, None] + lane_ar    # [B, N_WIN, W_scan]
+            lvalid = lane_ar < wid_w[:, :, None]
+            gidx = jnp.clip(lane, 0, TBe - 1).reshape(B, -1)
+            gw = jnp.take_along_axis(g2, gidx, axis=1) \
+                .reshape(B, N_WIN, W_scan)
+            hw = jnp.take_along_axis(h2, gidx, axis=1) \
+                .reshape(B, N_WIN, W_scan)
+            gw = jnp.where(lvalid, gw, 0.0)
+            hw = jnp.where(lvalid, hw, 0.0)
+            rg, rh = plane_psum("psum:vote_windows", gw, hw, axis_name,
+                                quant, tag)
+            scat = jnp.where(lvalid, lane, TBe)   # out-of-range drops
+            arB3 = jnp.broadcast_to(arB[:, :, None], lane.shape)
+            g2 = g2.at[arB3, scat].set(rg, mode="drop")
+            h2 = h2.at[arB3, scat].set(rh, mode="drop")
             fmask_b = fmask[None, :] & winb                    # [B, F]
         hist = jnp.stack([g2, h2], axis=-1)                    # [B, TBe, 2]
         if fmask_b is None:
@@ -994,7 +1055,7 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
         ], axis=1)                                             # [B, 12]
 
     def eval_batch(gh, hh, rows, sgs, shs, cnts, depths, params,
-                   layout):
+                   layout, tag):
         """Best splits for a BATCH of leaves from the per-plane hist
         tensors (gh/hh: [L, TBe] — separate grad/hess planes so no
         strided channel slices exist anywhere; a fused
@@ -1098,31 +1159,24 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
                               layout.keep_f, valid_r, valid_f, layout.aux,
                               interpret=interpret)
             local_gains = out_l[:, 0, :][:, :F]        # [B, F]
-            neg = jnp.asarray(K_MIN_SCORE, F32)
-            vl = []
-            for c in range(B):
-                lg_ = local_gains[c]
-                _, ti = jax.lax.top_k(lg_, K_TOP)
-                vl.append(jnp.zeros((F,), I32).at[ti].add(
-                    (lg_[ti] > neg).astype(I32)))
-            votes = jax.lax.psum(jnp.stack(vl), axis_name)     # [B, F]
-            # stable ranking: ties keep the smaller feature id; the 2k
-            # quota always fills (GlobalVoting, :153-184)
-            rank_key = votes * F - jnp.arange(F, dtype=I32)[None]
-            _, win_idx = jax.lax.top_k(rank_key, N_WIN)        # [B, N_WIN]
+            # the vote exchange: a [B, K_TOP] index allgather (not an
+            # [F]-plane psum), winners ranked identically on every rank
+            win_idx = _global_vote(local_gains)        # [B, N_WIN]
             # the ACTUAL communication compression: gather only the 2k
-            # winners' bin windows, psum that compact buffer, and scatter
-            # back — [B, 2, N_WIN, W] over the wire instead of the full
+            # winners' bin windows, reduce that compact buffer (int16
+            # codes under quantization), and scatter back —
+            # [B, 2, N_WIN, W] over the wire instead of the full
             # [B, 2, TBp] planes (CopyLocalHistogram + ReduceScatter,
             # voting_parallel_tree_learner.cpp:186-243)
             g3 = g2.reshape(B, G, W)
             h3 = h2.reshape(B, G, W)
             gw = jnp.take_along_axis(g3, win_idx[:, :, None], axis=1)
             hw = jnp.take_along_axis(h3, win_idx[:, :, None], axis=1)
-            red = jax.lax.psum(jnp.stack([gw, hw]), axis_name)
+            rg, rh = plane_psum("psum:vote_windows", gw, hw, axis_name,
+                                quant, tag)
             ar2 = jnp.arange(B, dtype=I32)[:, None]
-            g2 = g3.at[ar2, win_idx].set(red[0]).reshape(B, TBp)
-            h2 = h3.at[ar2, win_idx].set(red[1]).reshape(B, TBp)
+            g2 = g3.at[ar2, win_idx].set(rg).reshape(B, TBp)
+            h2 = h3.at[ar2, win_idx].set(rh).reshape(B, TBp)
             winb = jnp.zeros((B, F), BOOL).at[ar2, win_idx].set(True)
             winp = jnp.pad(winb, ((0, 0), (0, layout.Fp - G)))
             valid_r = valid_r[None] * winp[:, :, None].astype(F32)
@@ -1152,20 +1206,29 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
                       layout.forced_right[best_f])
 
     def evalB(gh, hh, rows, sgs, shs, cnts, depths, params, layout,
-              fmask):
+              fmask, tag=None):
         """Eval dispatcher: the widened v1 f64 find in xla mode, the
-        fused Mosaic scan kernels otherwise."""
+        fused Mosaic scan kernels otherwise. ``tag`` seeds the voting
+        winner-window quantization (rank-uniform, per grow stage)."""
         if wide:
             return eval_batch_wide(gh, hh, rows, sgs, shs, cnts, depths,
-                                   params, fmask)
+                                   params, fmask, tag)
         return eval_batch(gh, hh, rows, sgs, shs, cnts, depths, params,
-                          layout)
+                          layout, tag)
 
-    def grow(pay, params: SplitParams, fmask, bag_cnt=None):
+    # quantization-seed stage ids: root 0, level programs 1..md (+1 per
+    # level), per-split tail STAGE_SPLIT0 + s — disjoint ranges so every
+    # reduce of a tree draws independent rounding noise
+    STAGE_SPLIT0 = LEVEL_MAX_DEPTH + 2
+
+    def grow(pay, params: SplitParams, fmask, bag_cnt=None, it=None):
         """Grow one tree in place; returns (pay', lstate, tree, num_leaves,
         root_value, stats) where stats = [level_programs,
         fallback_splits] i32. bag_cnt: shard-local in-bag row count from
-        the bag transform (None = every live row in bag)."""
+        the bag transform (None = every live row in bag). ``it`` (the
+        boosting iteration, rank-uniform) seeds the quantized reduces'
+        stochastic rounding; None = 0 (single-tree callers)."""
+        it_q = jnp.asarray(0 if it is None else it, I32)
         layout = (None if wide else
                   (_BlockTreeLayout(fmask) if bundled
                    else ScanLayout(pad_meta, fmask, F, W, TBp)))
@@ -1180,8 +1243,9 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
             sums = jax.lax.psum(sums, axis_name)
             root_cnt = jax.lax.psum(root_cnt, axis_name)
             if not voting:
-                gh0 = jax.lax.psum(gh0, axis_name)
-                hh0 = jax.lax.psum(hh0, axis_name)
+                gh0, hh0 = plane_psum("psum:hist_root", gh0, hh0,
+                                      axis_name, quant,
+                                      quant_tag(it_q, 0))
         sum_grad = sums[0]
         sum_hess = sums[1]
         gh0, hh0 = fix_store(gh0, hh0, sum_grad.astype(EV),
@@ -1201,7 +1265,8 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
                       jnp.stack([sum_grad, sum_grad]),
                       jnp.stack([sum_hess, sum_hess]),
                       jnp.stack([root_cnt, root_cnt]),
-                      jnp.zeros((2,), F32), params, layout, fmask)
+                      jnp.zeros((2,), F32), params, layout, fmask,
+                      quant_tag(it_q, STAGE_SPLIT0 - 1))
         best = jnp.full((L, 12), K_MIN_SCORE, EV).at[0].set(pair0[0])
         health0 = jnp.zeros((HEALTH_LEN,), I32)
         if health:
@@ -1335,11 +1400,31 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
                 sm_h = jnp.where(act_h[:, None], sm_h, 0.0)
                 if axis_name is not None:
                     # ONE per-level histogram reduction for every
-                    # splitting leaf at once (the per-split path psums
-                    # per split — the level batch is also the collective
-                    # batching ROADMAP item 2 rides on)
-                    sm_g = jax.lax.psum(sm_g, axis_name)
-                    sm_h = jax.lax.psum(sm_h, axis_name)
+                    # splitting leaf at once — int16 codes over the wire
+                    # under tpu_hist_quant (the collective batching +
+                    # payload compression ROADMAP item 2 rides on)
+                    ltag = quant_tag(it_q, 1 + st.levels)
+                    if comm_overlap and S_MAXL >= 2:
+                        # double-buffered halves: the reduce of half A
+                        # is dispatched before half B's planes are
+                        # touched — async collectives hide A's wire
+                        # time under B's accumulate/quantize. The noise
+                        # seed is the GLOBAL slot position, so staged
+                        # and unstaged reduces are bit-identical.
+                        H = S_MAXL // 2
+                        ra_g, ra_h = plane_psum(
+                            "psum:hist_level", sm_g[:H], sm_h[:H],
+                            axis_name, quant, ltag, lane_offset=0)
+                        rb_g, rb_h = plane_psum(
+                            "psum:hist_level", sm_g[H:], sm_h[H:],
+                            axis_name, quant, ltag,
+                            lane_offset=H * TBe)
+                        sm_g = jnp.concatenate([ra_g, rb_g])
+                        sm_h = jnp.concatenate([ra_h, rb_h])
+                    else:
+                        sm_g, sm_h = plane_psum(
+                            "psum:hist_level", sm_g, sm_h, axis_name,
+                            quant, ltag)
                 if stat_from_scan:
                     left_cnt = bl[:, BC_LCNT].astype(I32)
                     right_cnt = bl[:, BC_RCNT].astype(I32)
@@ -1425,7 +1510,8 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
                 depths_b = jnp.concatenate([depth_child, depth_child])
                 pairs = evalB(gh, hh, rows_b, sgs_b, shs_b,
                               cnts_b, depths_b, params,
-                              layout, fmask)              # [2S, 12]
+                              layout, fmask,
+                              quant_tag(it_q, 1 + st.levels))  # [2S, 12]
                 best = st.best.at[slots].set(
                     jnp.where(actc, pairs[:S_MAXL], bl)) \
                     .at[new_ids].set(pairs[S_MAXL:], mode="drop")
@@ -1491,12 +1577,14 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
             n_right = n_l - n_left
             if axis_name is not None and not voting:
                 # per-split histogram reduction
-                # (data_parallel_tree_learner.cpp:163-234); n_left/n_right
+                # (data_parallel_tree_learner.cpp:163-234) — int16 codes
+                # over the wire under tpu_hist_quant; n_left/n_right
                 # stay shard-local for the payload segment geometry.
                 # Voting mode skips this: planes stay local and the eval
-                # psums only the globally voted features' bins
-                sm_g = jax.lax.psum(sm_g, axis_name)
-                sm_h = jax.lax.psum(sm_h, axis_name)
+                # reduces only the globally voted features' windows
+                sm_g, sm_h = plane_psum(
+                    "psum:hist_split", sm_g, sm_h, axis_name, quant,
+                    quant_tag(it_q, STAGE_SPLIT0 + s))
             if stat_from_scan:
                 # bagged: geometric segment counts include out-of-bag rows;
                 # the scan's hessian-derived counts are the statistics
@@ -1546,7 +1634,7 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
                 jnp.stack([bl[BC_LSH], bl[BC_RSH]]),
                 jnp.stack([left_cnt, right_cnt]),
                 jnp.stack([depth_child, depth_child]), params, layout,
-                fmask)
+                fmask, quant_tag(it_q, STAGE_SPLIT0 + s))
             best = st.best.at[l].set(jnp.where(do, pair[0], st.best[l])) \
                           .at[s].set(jnp.where(do, pair[1], st.best[s]))
 
@@ -1684,6 +1772,38 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
         gh = jax.lax.bitcast_convert_type(jnp.stack([g, h]), U32)
         return jax.lax.dynamic_update_slice(
             pay, gh, (jnp.asarray(grad_row, I32), jnp.asarray(0, I32)))
+
+    def wire_bytes_model(levels: int, splits: int, trees: int):
+        """(actual, fullwidth) estimated per-shard payload bytes for the
+        histogram exchanges of a batch: ``trees`` trees that ran
+        ``levels`` level programs and ``splits`` per-split reduces.
+
+        The model mirrors the plane_psum/vote_allgather call sites
+        exactly — data-parallel ships one (g, h) plane pair per root and
+        per split plus an [S_MAXL, TBe] pair batch per level program;
+        voting ships a [K_TOP] index allgather plus a compact
+        [2, N_WIN, W] winner-window pair per eval (root + every split).
+        ``fullwidth`` is what the historical full-width data-parallel
+        exchange would ship for the same tree geometry — the
+        denominator of ``hist_compress_ratio``. Reduction-algorithm
+        constant factors (ring vs tree) are identical on both sides and
+        cancel in the ratio."""
+        if axis_name is None:
+            return 0, 0
+        bpe_full = 8 if wide else 4
+        bpe = (quant.wire_bytes_per_value if quant is not None
+               else bpe_full)
+        full = (trees + splits) * 2 * TBe * bpe_full
+        if voting:
+            evals = trees + splits               # one B=2 eval each
+            vote_b = 2 * K_TOP * 4               # top-k index allgather
+            win_elems = 2 * 2 * N_WIN * (W_scan if wide else W)
+            actual = evals * (vote_b + win_elems * bpe)
+        else:
+            elems = ((trees + splits) + levels * S_MAXL) * 2 * TBe
+            actual = elems * bpe
+            full = full + levels * S_MAXL * 2 * TBe * bpe_full
+        return int(actual), int(full)
 
     def grad_health(pay):
         """[2] i32 non-finite counts over the live (grad, hess) payload
@@ -1843,6 +1963,10 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
     gr.health = health
     gr.axis_name = axis_name
     gr.voting = voting
+    gr.quant = quant
+    gr.comm_overlap = bool(comm_overlap)
+    gr.wire_bytes_model = wire_bytes_model
+    gr.reduced_feature_frac = (N_WIN / max(F, 1) if voting else 1.0)
     gr.grad_health = grad_health
     gr._eval_batch = evalB             # debug/testing hooks
     gr._eval_pair = evalB              # historical alias (B = 2)
@@ -1908,7 +2032,8 @@ def make_scan_driver(gr, gc, k: int, grad_fn, grad_mode: str = "payload",
                         # iteration, as in the reference
                         pay, bag_cnt = bag_fn(pay, wkey, it)
                     pay, lstate, tree, nl, _root, tstats = gr.grow(
-                        pay, params, fmask[cls], bag_cnt=bag_cnt)
+                        pay, params, fmask[cls], bag_cnt=bag_cnt,
+                        it=it * K + cls)
                     stats = stats + tstats
                     pay = gr.apply_scores(pay, lstate, nl, shrink, cls)
                     outs.append(gr.to_tree_arrays(lstate, tree, nl))
@@ -1928,7 +2053,7 @@ def make_scan_driver(gr, gc, k: int, grad_fn, grad_mode: str = "payload",
             if bag_fn is not None:
                 pay, bag_cnt = bag_fn(pay, wkey, it)
             pay, lstate, tree, nl, _root, stats = gr.grow(
-                pay, params, fmask, bag_cnt=bag_cnt)
+                pay, params, fmask, bag_cnt=bag_cnt, it=it)
             if gh2 is not None:
                 stats = stats.at[2 + H_NAN_GRAD].add(gh2[0]) \
                              .at[2 + H_NAN_HESS].add(gh2[1])

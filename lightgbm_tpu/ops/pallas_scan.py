@@ -108,6 +108,23 @@ def margin_bucket_index(margin):
     return jnp.clip(idx.astype(jnp.int32), 0, MARGIN_NB - 1)
 
 
+def topk_vote_indices(gains, k: int, num_features: int, neg):
+    """Per-rank PV-Tree vote proposal from a local gain scan: the top-k
+    feature ids of ``gains`` ([..., F], batched over leading axes), with
+    non-splitting proposals (gain <= ``neg``) replaced by the
+    ``num_features`` sentinel so the vote-count scatter drops them.
+
+    Shared by the v1 voting eval (ops/grow._voting_reduce_hist) and both
+    persist voting evals (ops/grow_persist) so the proposal ordering —
+    ``lax.top_k``'s stable smaller-index-on-ties rule, the reference's
+    GlobalVoting tie semantics — can never drift between growers. The
+    result is the ``vote_allgather`` wire payload: k i32 words per rank
+    per leaf instead of the historical [F]-plane vote psum."""
+    top_vals, top_idx = jax.lax.top_k(gains, k)
+    return jnp.where(top_vals > neg, top_idx.astype(jnp.int32),
+                     jnp.asarray(num_features, jnp.int32))
+
+
 def _scan_kernel(scal_ref, gb_ref, hb_ref, keepr_ref, keepf_ref,
                  validr_ref, validf_ref, aux_ref, out_ref):
     # validr/validf arrive as [1, F, W] child blocks

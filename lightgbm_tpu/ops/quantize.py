@@ -1,0 +1,243 @@
+"""int16-quantized histogram collectives: the ROADMAP item-2 wire format.
+
+Every distributed histogram reduction used to ship full-width f32/f64
+planes over ICI/DCN — the dominant cost at pod scale. This module owns
+the communication-efficient exchange the growers now route their plane
+reductions through:
+
+  * :func:`plane_psum` — the ONE entry point for histogram-plane
+    reductions (grad + hess planes together). With ``quant=None`` it is
+    a plain ``lax.psum``; with a :class:`HistQuant` it quantizes each
+    shard's planes to **int16 with rank-uniform seeded stochastic
+    rounding** before the reduce and dequantizes ONCE post-reduce. The
+    int16 codes are the wire payload (2 bytes/plane element vs 4 for
+    f32, 8 for the widened-f64 emulation); the reduction itself
+    accumulates the codes in i32 (worst-case |code| sum over R ranks
+    stays far below 2^31 for any real mesh), so every rank reconstructs
+    the bit-identical global plane and the PR 14 cross-rank hist-CRC
+    fingerprints stay exact.
+  * :func:`vote_allgather` — the PV-Tree vote exchange: an all-gather
+    of the per-rank top-k feature INDICES ([..., k] i32 — the
+    LightSplitInfo allgather of voting_parallel_tree_learner.cpp:321),
+    replacing the historical full [F]-plane vote psum.
+
+Stochastic rounding is **deterministic and rank-uniform**: the per-lane
+uniform comes from a murmur-style integer hash of (global lane index,
+tag), where the tag is a pure function of (iteration, grow stage,
+plane) built by :func:`quant_tag` — identical on every rank, varying
+across reduces so quantization errors stay independent (the zero-mean
+i.i.d. assumption behind the quant_certify Hoeffding envelope). Zeros
+quantize to exactly zero (``floor(0 + u) == 0`` for ``u in [0, 1)``),
+so empty bins stay empty through the wire.
+
+The shipped spec must be the exact spec the ``quant_certify``
+certificate blesses: :func:`runtime_quant_spec` builds the certificate
+input from the run's real geometry and
+``parallel/distributed.resolve_hist_quant`` refuses the knob at config
+time when the certificate does not certify it (int8 fails its
+SPLIT_DECISION_BUDGET by >100x; int16 passes at ~2.4x margin).
+
+NARROW_OK — blessed narrowing casts in this module (JG010 /
+precision_flow vocabulary): the ``astype(int16)`` of the stochastic
+rounder IS the certified quantization (its error is exactly what the
+certificate bounds), and the dequantize widens back immediately.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+I32 = jnp.int32
+U32 = jnp.uint32
+F32 = jnp.float32
+
+# blessed narrowings: (description, target dtype) — the quantizer's
+# int16 cast is the certified wire format itself
+NARROW_OK = (
+    ("stochastic-rounded histogram plane codes (certified wire format)",
+     "int16"),
+)
+
+
+class HistQuant(NamedTuple):
+    """Static quantization config for the histogram-plane exchanges.
+
+    ``scale_g``/``scale_h`` are the PER-SHARD plane scales from the
+    input contract (rows_per_rank * cap) — rank-uniform by construction,
+    so no extra collective is needed to agree on them. ``bits`` is the
+    wire width (16 is the only certified value; the symmetric code book
+    reserves one level: levels = 2^bits - 2)."""
+
+    bits: int
+    scale_g: float
+    scale_h: float
+    ranks: int
+
+    @property
+    def levels(self) -> int:
+        return (1 << self.bits) - 2
+
+    @property
+    def delta_g(self) -> float:
+        return 2.0 * self.scale_g / self.levels
+
+    @property
+    def delta_h(self) -> float:
+        return 2.0 * self.scale_h / self.levels
+
+    @property
+    def wire_bytes_per_value(self) -> int:
+        return self.bits // 8
+
+
+def runtime_quant_spec(target: str, rows_per_rank: int, ranks: int,
+                       lambda_l2: float = 0.0, bins: int = 256,
+                       g_max: float = 1.0, h_max: float = 0.25) -> dict:
+    """The quant_certify spec for THIS run's geometry — the same schema
+    ``analysis/quant_audit.default_specs`` certifies at the bench
+    geometries, so the config-time assertion and the static gate can
+    never certify different objects."""
+    return {
+        "name": "hist_%s_runtime" % target,
+        "kind": "histogram",
+        "target": target,
+        "stochastic": True,
+        "rows_per_rank": int(max(rows_per_rank, 1)),
+        "ranks": int(max(ranks, 1)),
+        "bins": int(bins),
+        "g_max": float(g_max),
+        "h_max": float(h_max),
+        "lambda": float(lambda_l2),
+    }
+
+
+def quant_from_spec(spec: dict) -> HistQuant:
+    """HistQuant carrying exactly the certified spec's scales."""
+    bits = {"int8": 8, "int16": 16}[spec["target"]]
+    return HistQuant(
+        bits=bits,
+        scale_g=float(spec["rows_per_rank"]) * float(spec["g_max"]),
+        scale_h=float(spec["rows_per_rank"]) * float(spec["h_max"]),
+        ranks=int(spec["ranks"]))
+
+
+# ---------------------------------------------------------------------------
+# deterministic per-lane uniforms (rank-uniform seeded stochastic rounding)
+# ---------------------------------------------------------------------------
+
+_PRIME_IT = 0x9E37_79B9
+_PRIME_STAGE = 0x85EB_CA6B
+_PLANE_H = 0xA5A5_A5A5
+
+
+def quant_tag(it, stage):
+    """u32 rounding seed, a pure function of (iteration, grow stage):
+    identical on every rank (both inputs are rank-uniform traced
+    scalars), different across reduces. The hess plane folds
+    :data:`_PLANE_H` on top inside :func:`plane_psum`."""
+    it_u = jnp.asarray(it, I32).astype(U32)
+    st_u = jnp.asarray(stage, I32).astype(U32)
+    return (it_u * U32(_PRIME_IT)) ^ (st_u * U32(_PRIME_STAGE))
+
+
+def _lane_uniform(shape, tag, lane_offset: int = 0):
+    """[shape] f32 uniforms in STRICTLY [0, 1) from (flat lane index,
+    tag) — the murmur3-style finalizer the bagging hash uses
+    (grow_persist._hash_uniform), seeded positionally so a plane batch
+    split into staged halves (``lane_offset``) draws the identical
+    noise the unsplit reduce would.
+
+    The top 24 hash bits convert exactly to f32 (a raw u32->f32 cast
+    rounds values >= 2^32 - 128 UP to 2^32, making u == 1.0 possible —
+    which would break the floor(0 + u) == 0 zero-preservation
+    invariant one lane in ~2^25)."""
+    n = 1
+    for d in shape:
+        n *= int(d)
+    idx = jax.lax.iota(U32, n) + U32(lane_offset)
+    x = idx ^ tag
+    x = x * U32(0x85EB_CA6B)
+    x = x ^ (x >> 13)
+    x = (x + tag) * U32(0xC2B2_AE35)
+    x = x ^ (x >> 16)
+    return ((x >> 8).astype(F32)
+            * F32(1.0 / (1 << 24))).reshape(shape)
+
+
+def quantize_plane(x, scale: float, levels: int, tag,
+                   lane_offset: int = 0):
+    """Stochastic-round one plane to int16 codes (the wire payload).
+
+    ``q = floor(clip(x)/delta + u)`` with u ~ U[0,1): zero-mean error
+    bounded by one step, zeros map to exactly zero, values beyond the
+    contract scale saturate symmetrically (the certificate's domain)."""
+    half = levels // 2
+    delta = 2.0 * scale / levels
+    xf = jnp.clip(x.astype(F32), F32(-scale), F32(scale))
+    u = _lane_uniform(x.shape, tag, lane_offset)
+    q = jnp.floor(xf * F32(1.0 / delta) + u)
+    q = jnp.clip(q, F32(-half), F32(half))
+    return q.astype(jnp.int16)
+
+
+def dequantize_plane(codes, scale: float, levels: int, dtype):
+    delta = 2.0 * scale / levels
+    return codes.astype(dtype) * jnp.asarray(delta, dtype)
+
+
+# ---------------------------------------------------------------------------
+# labeled collective wrappers (the mesh-collective trace vocabulary)
+# ---------------------------------------------------------------------------
+# Every histogram-plane reduction and vote exchange in the growers calls
+# one of these with a LITERAL label — analysis/collective_audit extracts
+# the labeled call sites into the `mesh_sites` section of the collective
+# trace, so the item-2 wire format diffs like the host-side DCN sites do.
+
+
+def plane_psum(label: str, g, h, axis_name,
+               quant: Optional[HistQuant] = None, tag=None,
+               lane_offset: int = 0):
+    """Reduce a (grad, hess) histogram-plane pair over the mesh axis.
+
+    quant=None: full-width psum (the historical exchange). With a
+    HistQuant: int16 stochastic-rounded codes go over the wire, i32
+    accumulation, one dequantize post-reduce — every rank reconstructs
+    the identical global plane. Returns (g_reduced, h_reduced) in the
+    input dtypes. ``axis_name=None`` is the unsharded identity (no
+    collective, no quantization noise)."""
+    del label   # trace vocabulary only
+    if axis_name is None:
+        return g, h
+    if quant is None:
+        red = jax.lax.psum(jnp.stack([g.astype(h.dtype), h]), axis_name)
+        return red[0].astype(g.dtype), red[1]
+    if tag is None:
+        tag = quant_tag(0, 0)
+    qg = quantize_plane(g, quant.scale_g, quant.levels, tag, lane_offset)
+    qh = quantize_plane(h, quant.scale_h, quant.levels,
+                        tag ^ U32(_PLANE_H), lane_offset)
+    # the int16 codes are the wire payload; the reduce accumulates them
+    # in i32 so R-rank code sums cannot wrap (R * 2^15 << 2^31)
+    red = jax.lax.psum(jnp.stack([qg.astype(I32), qh.astype(I32)]),
+                       axis_name)
+    return (dequantize_plane(red[0], quant.scale_g, quant.levels, g.dtype),
+            dequantize_plane(red[1], quant.scale_h, quant.levels, h.dtype))
+
+
+def vote_allgather(label: str, topk_idx, axis_name):
+    """All-gather the per-rank top-k feature ids ([..., k] i32, invalid
+    slots carrying the F sentinel) — the PV-Tree vote exchange. Wire
+    payload: k i32 words per rank per leaf, instead of the historical
+    [F]-plane vote psum."""
+    del label   # trace vocabulary only
+    return jax.lax.all_gather(topk_idx, axis_name)
+
+
+def wire_plane_bytes(elems: int, quant: Optional[HistQuant],
+                     full_bytes_per_value: int) -> int:
+    """Bytes one reduce ships per shard for ``elems`` plane values."""
+    bpe = (quant.wire_bytes_per_value if quant is not None
+           else full_bytes_per_value)
+    return int(elems) * int(bpe)

@@ -31,6 +31,113 @@ from ..telemetry import events as telemetry
 from ..utils.log import Log
 
 
+def _objective_grad_caps(config):
+    """Per-row (|grad|, hess) caps for the quantization contract, or
+    ``(None, why)`` when the objective has no static bound.
+
+    The caps ARE the certificate's domain assumption (``plane sums <=
+    rows * cap``) — shipping a spec whose caps the objective can exceed
+    would silently saturate the quantized histograms, so unbounded
+    objectives (regression-family: grad = pred - label, unbounded) and
+    data-dependent weightings (is_unbalance's count-ratio weights) are
+    refused loudly instead. GOSS's keep/amplify weighting scales both
+    caps by its (1-a)/b amplification (config-derived, rank-uniform)."""
+    obj = str(config.objective)
+    sig = float(getattr(config, "sigmoid", 1.0))
+    if bool(getattr(config, "is_unbalance", False)):
+        return None, ("is_unbalance weights the gradients by data-"
+                      "dependent count ratios — no static cap")
+    if obj in ("binary", "multiclassova"):
+        # |g| <= sigmoid * w, h <= (sigmoid^2 / 4) * w
+        w = max(float(getattr(config, "scale_pos_weight", 1.0)), 1.0)
+        caps = (sig * w, sig * sig / 4.0 * w)
+    elif obj == "multiclass":
+        # softmax: |p - onehot| <= 1, h = 2 p (1-p) <= 0.5
+        caps = (1.0, 0.5)
+    elif obj == "cross_entropy":
+        caps = (1.0, 0.25)
+    else:
+        return None, ("objective %s has no certified per-row gradient "
+                      "bound" % obj)
+    if str(config.boosting).lower() == "goss":
+        amp = ((1.0 - float(config.top_rate))
+               / max(float(config.other_rate), 1e-6))
+        caps = (caps[0] * max(amp, 1.0), caps[1] * max(amp, 1.0))
+    return caps, ""
+
+
+def resolve_hist_quant(config, rows_per_rank: int, ranks: int,
+                       weight_max: float = 1.0):
+    """``tpu_hist_quant`` -> a certified :class:`ops.quantize.HistQuant`
+    (or ``None`` when off / unsharded).
+
+    The shipped spec must be the EXACT spec the ``quant_certify``
+    certificate blesses, asserted here at config-application time: the
+    runtime spec is built from this run's real geometry
+    (rows-per-shard, mesh size, lambda_l2) and the OBJECTIVE's per-row
+    gradient caps (times the dataset's max sample weight — the caller
+    passes a rank-uniform value), then pushed through the same
+    ``analysis/quant_audit.certify`` the static gate runs — a target the
+    certificate refuses (int8 blows SPLIT_DECISION_BUDGET by >100x at
+    any real plane scale) is refused here with the certificate named,
+    before any program compiles; so is an objective with no static
+    gradient bound (the contract the caps encode would be a lie)."""
+    opt = str(getattr(config, "tpu_hist_quant", "off")).lower()
+    if opt in ("off", "false", "0", ""):
+        return None
+    if opt not in ("int8", "int16"):
+        Log.fatal("Unknown tpu_hist_quant=%s (expected off|int16)" % opt)
+    if ranks <= 1:
+        # unsharded: no wire, no quantization noise (the knob is inert,
+        # not an error — a world=1 elastic resume keeps its config)
+        return None
+    caps, why = _objective_grad_caps(config)
+    if caps is None:
+        Log.fatal("tpu_hist_quant=%s refused: %s — the quant_certify "
+                  "contract needs bounded per-row gradients (bounded "
+                  "objectives: binary, multiclass, multiclassova, "
+                  "cross_entropy)" % (opt, why))
+    if weight_max is None or not (weight_max > 0.0):
+        weight_max = 1.0
+    from ..analysis import quant_audit
+    from ..ops.quantize import quant_from_spec, runtime_quant_spec
+    spec = runtime_quant_spec(opt, rows_per_rank, ranks,
+                              lambda_l2=float(config.lambda_l2),
+                              g_max=caps[0] * float(weight_max),
+                              h_max=caps[1] * float(weight_max))
+    cert = quant_audit.certify(spec)
+    if not cert.get("ok"):
+        Log.fatal(
+            "tpu_hist_quant=%s refused by the quant_certify certificate: "
+            "split-gain perturbation bound %.3g exceeds "
+            "SPLIT_DECISION_BUDGET %.3g at this geometry (rows/rank=%d, "
+            "ranks=%d) — see the quant_certificate block of "
+            "`python -m lightgbm_tpu.analysis --json`; int16 is the "
+            "certified wire format"
+            % (opt, cert.get("bound", float("inf")),
+               quant_audit.SPLIT_DECISION_BUDGET, int(rows_per_rank),
+               int(ranks)))
+    Log.info("tpu_hist_quant=%s certified: bound %.3g within "
+             "SPLIT_DECISION_BUDGET %.3g (%.1fx margin)"
+             % (opt, cert["bound"], cert["budget"],
+                cert.get("margin", float("inf"))))
+    q = quant_from_spec(spec)
+    q_cert = dict(cert)
+    return q, q_cert
+
+
+def resolve_comm_overlap(config) -> bool:
+    """``tpu_comm_overlap``: 'auto'/'on' stage the level program's plane
+    reductions as two double-buffered half-batches (the reduce of the
+    first half is in flight while the second half's planes are still
+    being accumulated); 'off' keeps the single full-batch reduce.
+    Numerically neutral either way — each plane row reduces
+    independently and the stochastic-rounding noise is seeded by GLOBAL
+    slot position, so staged and unstaged reduces are bit-identical."""
+    opt = str(getattr(config, "tpu_comm_overlap", "auto")).lower()
+    return opt not in ("off", "false", "0")
+
+
 def parse_machine_list(config) -> List[str]:
     """machines= / machine_list_filename= -> ["host:port", ...]
     (reference Linkers::ParseMachineList, linkers_socket.cpp:80)."""

@@ -92,6 +92,22 @@ class DataParallelTreeLearner(SerialTreeLearner):
         n = dataset.num_data
         self._pad = (-n) % self.num_shards
         self._axis_name = AXIS
+        # communication-efficient exchange (ROADMAP item 2): int16
+        # quantized histogram reductions, certified at config time
+        # against the quant_certify budget (int8 is refused there), and
+        # double-buffered level-program reductions. Both knobs are
+        # wire-format choices — the reduced global planes are identical
+        # on every shard either way (bit-exact under a fixed mesh).
+        from .distributed import resolve_comm_overlap, resolve_hist_quant
+        # single-process sharding sees the FULL dataset, so the max
+        # sample weight is trivially rank-uniform (the contract scale
+        # must be identical on every shard)
+        w = dataset.metadata.weight
+        w_max = float(np.max(w)) if w is not None and len(w) else 1.0
+        hq = resolve_hist_quant(config, (n + self._pad) // self.num_shards,
+                                self.num_shards, weight_max=w_max)
+        self.hist_quant, self.hist_quant_cert = hq if hq else (None, None)
+        self.comm_overlap = resolve_comm_overlap(config)
         # pad the HBM-resident bins ONCE; per-tree inputs pad per call
         self._bins_padded = (jnp.pad(self.layout.bins, ((0, self._pad), (0, 0)))
                              if self._pad else self.layout.bins)
@@ -112,6 +128,7 @@ class DataParallelTreeLearner(SerialTreeLearner):
         use_part = n_shard >= PARTITION_MIN_ROWS and not gc.multival
         gw_global = self.gw_global
         mv = bool(gc.multival)
+        qc = self.hist_quant
         # ELL row-sparse arrays are row-aligned: shard them WITH the rows
         # (they ride as args, not closure constants, so shard_map splits
         # them; pad rows carry the G sentinel group = contribute nothing)
@@ -131,9 +148,10 @@ class DataParallelTreeLearner(SerialTreeLearner):
                 return grow_tree_partitioned(
                     layout, grad, hess, bag, meta, params, fmask, fix, gc,
                     gw_global=gw_global, axis_name=AXIS,
-                    cat=cat, extras=extras)
+                    cat=cat, extras=extras, quant=qc)
             return grow_tree(layout, grad, hess, bag, meta, params, fmask,
-                             fix, gc, axis_name=AXIS, cat=cat, extras=extras)
+                             fix, gc, axis_name=AXIS, cat=cat,
+                             extras=extras, quant=qc)
         return run
 
     def train_arrays(self, grad: jnp.ndarray, hess: jnp.ndarray,
@@ -243,13 +261,14 @@ class DataParallelTreeLearner(SerialTreeLearner):
         gc = self.grow_config
         health = self._persist_health_mode()
         gkey = ("grower_sharded", S, gc, stat_from_scan, kernel_impl,
-                level_mode, health)
+                level_mode, health, self.hist_quant, self.comm_overlap)
         wrapper = cache.get(gkey)
         if wrapper is None:
             inner = make_persist_grower(
                 assets, self.meta, gc, interpret=interpret, axis_name=AXIS,
                 kernel_impl=kernel_impl, stat_from_scan=stat_from_scan,
                 fix=self.fix, level_mode=level_mode, health=health,
+                quant=self.hist_quant, comm_overlap=self.comm_overlap,
                 # GLOBAL counts live in the leaf state: pick exactness by
                 # the total row count, not the per-shard one (the widened
                 # xla mode overrides to f64 internally)
@@ -262,6 +281,16 @@ class DataParallelTreeLearner(SerialTreeLearner):
 
             wrapper = _ShardedGrower()
             wrapper.inner = inner
+            # surface the comm-accounting facts the flush-time wire-byte
+            # telemetry reads (treelearner/serial.flush_level_stats);
+            # K included — the pending-tree tally multiplies by it
+            wrapper.K = inner.K
+            wrapper.axis_name = AXIS
+            wrapper.quant = inner.quant
+            wrapper.voting = inner.voting
+            wrapper.comm_overlap = inner.comm_overlap
+            wrapper.wire_bytes_model = inner.wire_bytes_model
+            wrapper.reduced_feature_frac = inner.reduced_feature_frac
             wrapper.init_carry = jax.jit(shard_map_compat(
                 inner.init_carry, mesh=mesh,
                 in_specs=(pay_spec, P(AXIS)), out_specs=pay_spec,
@@ -272,7 +301,8 @@ class DataParallelTreeLearner(SerialTreeLearner):
                 check_vma=False))
             cache[gkey] = wrapper
         dkey = ("driver_sharded", S, k, gc, objective.static_fingerprint(),
-                bag_spec, kernel_impl, level_mode, health)
+                bag_spec, kernel_impl, level_mode, health,
+                self.hist_quant, self.comm_overlap)
         driver = cache.get(dkey)
         if driver is None:
             bag_fn = (make_bag_transform(bag_spec, assets.geometry,

@@ -62,7 +62,8 @@ from ..resilience import faults as resilience_faults
 from ..resilience import retry as resilience_retry
 from ..telemetry import events as telemetry
 from ..utils.log import Log
-from .distributed import distributed_bin_mappers, init_network
+from .distributed import (distributed_bin_mappers, init_network,
+                          resolve_hist_quant)
 from .learners import AXIS, _tree_arrays_spec, shard_map_compat
 
 __all__ = ["init_network", "shard_rows", "train_multihost"]
@@ -521,6 +522,24 @@ def train_multihost(config: Config, X_local: np.ndarray,
     gc = learner.grow_config
     n_shard = pad_to * jax.process_count() // S
     use_part = n_shard >= PARTITION_MIN_ROWS and not use_mv
+    # int16-quantized histogram reductions over ICI/DCN (ROADMAP item
+    # 2): the runtime spec is certified against the quant_certify budget
+    # here, at config-application time — int8 (and any objective
+    # without a static gradient cap) is refused with the certificate
+    # named. The per-device shard size is rank-uniform (the padded
+    # global geometry), so every rank certifies the same spec and
+    # derives the same wire scales. Sample-weighted runs are refused:
+    # the contract scale would need the GLOBAL weight max, and each
+    # rank only sees its shard — a shard-local max would desync the
+    # dequantization scales across ranks.
+    if weight_local is not None \
+            and str(config.tpu_hist_quant).lower() not in ("off", ""):
+        Log.fatal("tpu_hist_quant with sample weights needs a rank-"
+                  "uniform weight cap, which the distributed driver "
+                  "does not exchange yet; drop the weights or "
+                  "tpu_hist_quant=off")
+    hq = resolve_hist_quant(config, n_shard, S)
+    hist_quant, hist_quant_cert = hq if hq else (None, None)
     meta, params, fix = learner.meta, learner.params, learner.fix
     cat = learner.cat_layout
     gw_global = learner.gw_global
@@ -550,9 +569,11 @@ def train_multihost(config: Config, X_local: np.ndarray,
         if use_part:
             return grow_tree_partitioned(
                 layout, grad, hess, bag, meta, params, fmask, fix, gc,
-                gw_global=gw_global, axis_name=AXIS, cat=cat, extras=extras)
+                gw_global=gw_global, axis_name=AXIS, cat=cat,
+                extras=extras, quant=hist_quant)
         return grow_tree(layout, grad, hess, bag, meta, params, fmask,
-                         fix, gc, axis_name=AXIS, cat=cat, extras=extras)
+                         fix, gc, axis_name=AXIS, cat=cat, extras=extras,
+                         quant=hist_quant)
 
     def _batch(k: int):
         """jitted K-iteration boosting scan under shard_map: gradients ->
@@ -824,6 +845,25 @@ def train_multihost(config: Config, X_local: np.ndarray,
                     for c in range(K):
                         vscore[c] += class_trees[c].predict(Xv)
         it += k
+        if batch_trees:
+            # estimated per-shard histogram-exchange payload of this
+            # batch (root + one smaller-child plane pair per split in
+            # data-parallel mode) — feeds the --perf sentinel's
+            # dcn_hist_bytes / hist_compress_ratio keys; int16 codes
+            # under tpu_hist_quant shrink it 2-4x vs the full planes
+            n_trees = sum(len(ct) for ct in batch_trees)
+            n_splits = sum(t.num_leaves - 1 for ct in batch_trees
+                           for t in ct)
+            bpe_full = 8 if gc.hist_dtype == "f64" else 4
+            bpe = (hist_quant.wire_bytes_per_value
+                   if hist_quant is not None else bpe_full)
+            # host-int arithmetic over already-materialized trees — no
+            # device value is touched here
+            units = (n_trees + n_splits) * 2 * int(gc.total_bins)
+            telemetry.count("collective::dcn_hist_bytes",
+                            units * bpe, category="collective")
+            telemetry.count("collective::dcn_hist_bytes_fullwidth",
+                            units * bpe_full, category="collective")
         fp_rows = None
         if probe_on and batch_trees and not stopped:
             # ONE deliberate batched D2H of the local score shard (the

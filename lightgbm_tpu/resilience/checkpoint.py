@@ -67,6 +67,13 @@ _VOLATILE_PARAMS = frozenset({
     # it — resuming with the probes reconfigured (e.g. ruling out probe
     # overhead after a crash) must not orphan the checkpoints
     "tpu_numerics_stats", "tpu_health_abort", "tpu_divergence_probe",
+    # the distributed wire format is an execution-regime choice like the
+    # mesh size (certified bounded-error, not a different computation):
+    # resuming with quantization or comm overlap flipped — e.g. ruling
+    # the quantized exchange out after a quality wobble, or turning it
+    # on mid-run at pod scale — must not orphan an existing resume
+    # (mirrors the PR 14 sentinel-knob treatment)
+    "tpu_hist_quant", "tpu_comm_overlap",
 })
 
 

@@ -650,6 +650,11 @@ class SerialTreeLearner:
         # fast path must not sync per batch just to bump a counter
         prev = getattr(self, "_level_stats_dev", None)
         self._level_stats_dev = stats if prev is None else prev + stats
+        # host-side tree tally feeding the flush-time wire-byte model
+        # (one root-plane exchange per tree on the sharded path)
+        self._persist_pending_trees = (
+            getattr(self, "_persist_pending_trees", 0)
+            + k * getattr(gr, "K", 1))
         self._persist_carry = pay
         self._persist_gr = gr
         return stacked
@@ -666,6 +671,8 @@ class SerialTreeLearner:
         if st is None:
             return
         self._level_stats_dev = None
+        trees = int(getattr(self, "_persist_pending_trees", 0))
+        self._persist_pending_trees = 0
         import jax
         # the device_get may drain the still-running async batch — that
         # wait is pipeline time (the callers' device_wait spans own it),
@@ -681,6 +688,26 @@ class SerialTreeLearner:
                                 float(v[1]), category="tree_learner")
             from ..telemetry import health as telemetry_health
             telemetry_health.flush_device_stats(v[2:])
+            gr = getattr(self, "_persist_gr", None)
+            if gr is not None and getattr(gr, "axis_name", None) \
+                    is not None and trees:
+                # estimated per-shard histogram-exchange payload for the
+                # flushed batches (mirrors the plane_psum/vote_allgather
+                # sites exactly — ops/grow_persist.wire_bytes_model);
+                # the full-width twin is the hist_compress_ratio
+                # denominator the --perf sentinel gates
+                actual, full = gr.wire_bytes_model(int(v[0]), int(v[1]),
+                                                   trees)
+                if actual:
+                    from ..telemetry import histo as telemetry_histo
+                    telemetry.count("collective::dcn_hist_bytes",
+                                    float(actual), category="collective")
+                    telemetry.count(
+                        "collective::dcn_hist_bytes_fullwidth",
+                        float(full), category="collective")
+                    telemetry_histo.observe("collective::psum::bytes",
+                                            float(actual), unit="bytes",
+                                            category="collective")
 
     def persist_finalize_scores(self):
         """Row-ordered f64 scores from the live carry (None when no carry).

@@ -900,6 +900,23 @@ def test_collective_trace_extracts_repo_sites():
             "allgather:resume_agree"} <= names
     assert all(s["guarded"] for s in trace["sites"])
     assert trace["findings"] == []
+    # the item-2 wire format: in-program mesh collectives (quantized
+    # plane reductions + the PV-Tree vote allgather) ride the trace as
+    # mesh_sites — the top-k vote exchange and every histogram-plane
+    # reduce site must be labeled and present in BOTH growers
+    mesh = trace["mesh_sites"]
+    assert all(s["mesh"] and s["name"] for s in mesh), \
+        "every mesh-collective wrapper call needs a literal label"
+    mesh_names = {s["name"] for s in mesh}
+    assert {"allgather:vote_topk", "psum:vote_windows",
+            "psum:vote_planes", "psum:hist_root", "psum:hist_level",
+            "psum:hist_split", "psum:hist_plane"} <= mesh_names
+    by_path = {}
+    for s in mesh:
+        by_path.setdefault(s["path"], set()).add(s["name"])
+    assert "allgather:vote_topk" in by_path["lightgbm_tpu/ops/grow.py"]
+    assert "allgather:vote_topk" \
+        in by_path["lightgbm_tpu/ops/grow_persist.py"]
 
 
 def test_resource_audit_tracks_kernel_formulas():

@@ -19,11 +19,19 @@ import json, os, sys
 import numpy as np
 sys.path.insert(0, %(repo)r)
 os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=2")
 import jax
 # the axon integration overrides JAX_PLATFORMS at import; force it back
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 2)
-jax.config.update("jax_cpu_collectives_implementation", "gloo")
+try:
+    jax.config.update("jax_num_cpu_devices", 2)
+except AttributeError:   # jax 0.4.x: the XLA_FLAGS above covers it
+    pass
+try:
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except AttributeError:
+    pass
 from lightgbm_tpu.config import Config
 from lightgbm_tpu.parallel.multihost import shard_rows, train_multihost
 
@@ -139,10 +147,18 @@ import json, os, sys
 import numpy as np
 sys.path.insert(0, %(repo)r)
 os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=2")
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 2)
-jax.config.update("jax_cpu_collectives_implementation", "gloo")
+try:
+    jax.config.update("jax_num_cpu_devices", 2)
+except AttributeError:   # jax 0.4.x: the XLA_FLAGS above covers it
+    pass
+try:
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except AttributeError:
+    pass
 
 rank = int(sys.argv[1])
 port = sys.argv[2]
@@ -292,10 +308,18 @@ import json, os, sys
 import numpy as np
 sys.path.insert(0, %(repo)r)
 os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=2")
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 2)
-jax.config.update("jax_cpu_collectives_implementation", "gloo")
+try:
+    jax.config.update("jax_num_cpu_devices", 2)
+except AttributeError:   # jax 0.4.x: the XLA_FLAGS above covers it
+    pass
+try:
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except AttributeError:
+    pass
 
 rank = int(sys.argv[1])
 port = sys.argv[2]
@@ -361,10 +385,18 @@ import json, os, sys
 import numpy as np
 sys.path.insert(0, %(repo)r)
 os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=2")
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 2)
-jax.config.update("jax_cpu_collectives_implementation", "gloo")
+try:
+    jax.config.update("jax_num_cpu_devices", 2)
+except AttributeError:   # jax 0.4.x: the XLA_FLAGS above covers it
+    pass
+try:
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except AttributeError:
+    pass
 
 rank = int(sys.argv[1])
 port = sys.argv[2]
@@ -439,10 +471,18 @@ import json, os, sys
 import numpy as np
 sys.path.insert(0, %(repo)r)
 os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=2")
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 2)
-jax.config.update("jax_cpu_collectives_implementation", "gloo")
+try:
+    jax.config.update("jax_num_cpu_devices", 2)
+except AttributeError:   # jax 0.4.x: the XLA_FLAGS above covers it
+    pass
+try:
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except AttributeError:
+    pass
 
 rank = int(sys.argv[1])
 port = sys.argv[2]
@@ -507,10 +547,18 @@ import json, os, sys
 import numpy as np
 sys.path.insert(0, %(repo)r)
 os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=2")
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 2)
-jax.config.update("jax_cpu_collectives_implementation", "gloo")
+try:
+    jax.config.update("jax_num_cpu_devices", 2)
+except AttributeError:   # jax 0.4.x: the XLA_FLAGS above covers it
+    pass
+try:
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except AttributeError:
+    pass
 
 rank = int(sys.argv[1])
 port = sys.argv[2]
@@ -570,3 +618,112 @@ def test_python_api_distributed_multival(tmp_path):
     r1 = json.load(open(outs[1]))
     assert r0["pred"] == r1["pred"]
     assert r0["acc"] > 0.8, r0["acc"]
+
+
+QUANT_WORKER = r"""
+import json, os, sys
+import numpy as np
+sys.path.insert(0, %(repo)r)
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=2")
+import jax
+jax.config.update("jax_platforms", "cpu")
+try:
+    jax.config.update("jax_num_cpu_devices", 2)
+except AttributeError:   # jax 0.4.x: the XLA_FLAGS above covers it
+    pass
+try:
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except AttributeError:
+    pass
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.parallel.fingerprint import DivergenceError
+from lightgbm_tpu.parallel.multihost import shard_rows, train_multihost
+from lightgbm_tpu.resilience import faults
+
+rank = int(sys.argv[1])
+port = sys.argv[2]
+out = sys.argv[3]
+
+rng = np.random.default_rng(9)
+n, nf = 3000, 8
+X = rng.normal(size=(n, nf))
+y = (X[:, 0] - 0.7 * X[:, 3] > 0).astype(float)
+idx = shard_rows(n, rank, 2, False)
+
+base = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+        "num_machines": 2,
+        "machines": "127.0.0.1:%%s,127.0.0.1:0" %% port,
+        "min_data_in_leaf": 5, "tree_learner": "data",
+        "tpu_hist_quant": "int16", "tpu_divergence_probe": "on"}
+
+# phase 1: quantized training must be bit-identical on every rank —
+# the PR 14 divergence probe (model CRC + hist CRC per iteration over
+# the metrics-values collective) must NOT fire
+cfg = Config(dict(base))
+faults.configure_from_config(cfg)
+trees, mappers, ds, score = train_multihost(
+    cfg, X[idx], y[idx], num_rounds=8, process_id=rank)
+digest = [[int(t.num_leaves),
+           [int(f) for f in t.split_feature[:t.num_leaves - 1]],
+           [round(float(v), 9) for v in t.leaf_value[:t.num_leaves]]]
+          for t in trees]
+
+# phase 2: a genuinely corrupted quantized payload must still be CAUGHT
+# — the corrupt_hist chaos verb perturbs rank 1's hist fingerprint at
+# round 2, and the probe must raise on BOTH ranks naming hist
+probe_fired = False
+named_hist = False
+cfg2 = Config(dict(base,
+                   tpu_fault_plan="corrupt_hist@round=2;rank=1"))
+faults.configure_from_config(cfg2)
+try:
+    train_multihost(cfg2, X[idx], y[idx], num_rounds=6, process_id=rank)
+except DivergenceError as e:
+    probe_fired = True
+    named_hist = "hist" in str(e)
+
+with open(out, "w") as fh:
+    json.dump({"rank": rank, "digest": digest,
+               "probe_fired": probe_fired,
+               "named_hist": named_hist}, fh)
+"""
+
+
+@pytest.mark.slow
+def test_two_process_quantized_bitexact_and_probe(tmp_path):
+    """tpu_hist_quant=int16 over two real processes: the rank-uniform
+    seeded stochastic rounding reconstructs the identical global
+    histograms on every rank, so training is BIT-IDENTICAL and the
+    divergence probe stays quiet — while a corrupt_hist chaos seed on
+    the same quantized path still trips the probe on both ranks
+    (quantization must not launder genuine corruption)."""
+    port = _free_port()
+    script = tmp_path / "quant_worker.py"
+    script.write_text(QUANT_WORKER % {"repo": REPO})
+    outs = [str(tmp_path / f"q_rank{r}.json") for r in range(2)]
+    procs = []
+    for r in range(2):
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env.pop("JAX_PLATFORMS", None)
+        procs.append(subprocess.Popen(
+            [sys.executable, str(script), str(r), str(port), outs[r]],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+    for p in procs:
+        try:
+            _, err = p.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("quantized multihost worker timed out")
+        assert p.returncode == 0, err.decode()[-2000:]
+    r0 = json.load(open(outs[0]))
+    r1 = json.load(open(outs[1]))
+    assert r0["digest"] == r1["digest"], \
+        "int16-quantized training diverged across ranks"
+    assert r0["digest"][0][1][0] in (0, 3)      # learned the signal
+    for r in (r0, r1):
+        assert r["probe_fired"], "corrupt_hist probe did not fire"
+        assert r["named_hist"], "probe must blame the hist component"
