@@ -512,6 +512,38 @@ def main():
                      slo["p99"] * 1e3, slo["queue_wait_p99"] * 1e3,
                      slo["qdepth_mean"], slo["qdepth_max"]),
                   file=sys.stderr)
+    serv = None
+    if os.environ.get("BENCH_SKIP_SERVING", "") != "1":
+        try:
+            if bench_telemetry:
+                telemetry.reset()
+            serv, serv_spread = _repeat_phase(run_serving, repeats,
+                                              reset=reset_fn)
+            if bench_telemetry:
+                phase_snaps["serving"] = _phase_stats(
+                    telemetry, work={"phase": "serving",
+                                     "requests": serv["requests"]})
+            _copy_spread(spread_out, serv_spread,
+                         rps="serving_rps",
+                         vs_sync="serving_vs_sync",
+                         deadline_miss_frac="serving_deadline_miss_frac")
+        except Exception as exc:
+            print("# serving phase failed: %r" % exc, file=sys.stderr)
+    if serv is not None:
+        result["serving_rps"] = serv["rps"]
+        result["serving_vs_sync"] = serv["vs_sync"]
+        result["serving_deadline_miss_frac"] = serv["deadline_miss_frac"]
+        print(json.dumps(result), flush=True)
+        print("# serving[async vs sync]: %d reqs x %d clients, %d trees "
+              "-> %.0f rps async (%.2fx sync), p50=%.1fms p99=%.1fms, "
+              "deadline>%.0fms miss %.1f%%; %d batches (coalesce %.2f "
+              "reqs/batch, qdepth max %d)"
+              % (serv["requests"], serv["clients"], serv["trees"],
+                 serv["rps"], serv["vs_sync"], serv["p50"] * 1e3,
+                 serv["p99"] * 1e3, serv["slo_ms"],
+                 100.0 * serv["deadline_miss_frac"], serv["batches"],
+                 serv["coalesce_ratio"], serv["qdepth_max"]),
+              file=sys.stderr)
     # the self-describing meta block rides the LAST printed json line —
     # the one last-JSON-line parsers archive as `parsed` — so every
     # recorded round is a comparable artifact (schema version, git SHA,
@@ -848,6 +880,94 @@ def run_predict():
     out["expo"] = _predict_one_shape(Xe, ye, params, n_trees,
                                      serve_rows // 2, "expo")[0]
     return out
+
+
+def run_serving():
+    """Serving-subsystem phase: the IDENTICAL request mix (sizes, row
+    offsets, client concurrency) driven through the synchronous
+    BatchServer and the continuous-batching AsyncBatchServer sharing one
+    compiled predictor (so the jit ladder is warm for both and the delta
+    is pure serving architecture). Clients are a thread pool — the sync
+    server serializes a device round-trip per request, the async server
+    coalesces concurrent sub-bucket requests into shared batches.
+
+    BENCH keys: serving_rps (sustained async requests/s), serving_vs_sync
+    (async speedup over sync on the same mix; acceptance floor 2x on a
+    coalescable mix), serving_deadline_miss_frac (fraction of async
+    requests over BENCH_SERVING_SLO_MS end-to-end)."""
+    import numpy as np
+    from concurrent.futures import ThreadPoolExecutor
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.predict import BatchServer
+    from lightgbm_tpu.serving import AsyncBatchServer
+
+    n_rows = int(os.environ.get("BENCH_SERVING_ROWS", 500_000))
+    n_trees = int(os.environ.get("BENCH_SERVING_TREES", 100))
+    n_leaves = int(os.environ.get("BENCH_SERVING_LEAVES", 255))
+    n_requests = int(os.environ.get("BENCH_SERVING_REQS", 400))
+    n_clients = int(os.environ.get("BENCH_SERVING_CLIENTS", 8))
+    slo_ms = float(os.environ.get("BENCH_SERVING_SLO_MS", 50.0))
+    max_wait_ms = float(os.environ.get("BENCH_SERVING_MAX_WAIT_MS", 5.0))
+    # single-user-sized requests: each pads to the 256-row min bucket on
+    # the sync path, so coalescing them is where continuous batching
+    # earns its keep (a 256-row mix would measure pure dispatch overlap)
+    req_lo = int(os.environ.get("BENCH_SERVING_REQ_LO", 1))
+    req_hi = int(os.environ.get("BENCH_SERVING_REQ_HI", 64))
+    params = _phase_params({"objective": "binary", "num_leaves": n_leaves,
+                            "max_bin": 255, "verbosity": -1,
+                            "metric": "none"})
+    X, y = make_higgs_like(n_rows)
+    ds = lgb.Dataset(X, y)
+    ds.construct()
+    bst = lgb.train(dict(params), ds, n_trees, verbose_eval=False)
+    pred = bst._booster.device_predictor()
+    # the request mix: single-user-sized slices, drawn ONCE and replayed
+    # verbatim through both servers
+    rng = np.random.default_rng(11)
+    sizes = rng.integers(req_lo, req_hi + 1, n_requests)
+    starts = rng.integers(0, max(n_rows - req_hi - 1, 1), n_requests)
+    reqs = [X[int(starts[i]):int(starts[i]) + int(sizes[i])]
+            for i in range(n_requests)]
+
+    def drive(predict_fn):
+        lat = np.empty(n_requests)
+
+        def one(i):
+            t0 = time.perf_counter()
+            predict_fn(reqs[i])
+            lat[i] = time.perf_counter() - t0
+
+        t0 = time.time()
+        with ThreadPoolExecutor(n_clients) as pool:
+            list(pool.map(one, range(n_requests)))
+        return time.time() - t0, lat
+
+    sync = BatchServer(pred, min_batch=256, max_batch=4096)
+    b = sync.min_batch
+    while b <= sync.max_batch:        # warm the shared ladder once
+        sync.predict(X[:b])
+        b <<= 1
+    t_sync, _lat_sync = drive(sync.predict)
+    with AsyncBatchServer(pred, min_batch=256, max_batch=4096,
+                          max_wait_ms=max_wait_ms) as server:
+        t_async, lat_async = drive(server.predict)
+        stats = server.stats()
+    return {
+        "rows": n_rows, "trees": bst.num_trees(),
+        "requests": n_requests, "clients": n_clients,
+        "slo_ms": slo_ms, "max_wait_ms": max_wait_ms,
+        "sync_s": round(t_sync, 4), "async_s": round(t_async, 4),
+        "rps": round(n_requests / t_async, 2),
+        "vs_sync": round(t_sync / t_async, 3),
+        "deadline_miss_frac": round(
+            float((lat_async > slo_ms / 1e3).mean()), 4),
+        "p50": round(float(np.percentile(lat_async, 50)), 6),
+        "p99": round(float(np.percentile(lat_async, 99)), 6),
+        "batches": int(stats["batches"]),
+        "coalesce_ratio": float(stats["coalesce_ratio"]),
+        "qdepth_max": int(stats["qdepth_max"]),
+    }
 
 
 def run_checkpoint():

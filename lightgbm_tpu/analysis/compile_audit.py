@@ -44,7 +44,7 @@ C_UNBOUNDED = "analysis::compile_unbounded"
 
 # directories whose jit sites form the training/serving compile surface
 AUDIT_ROOTS = ("lightgbm_tpu/ops", "lightgbm_tpu/predict",
-               "lightgbm_tpu/treelearner")
+               "lightgbm_tpu/treelearner", "lightgbm_tpu/serving")
 
 # static-argument value domains: name -> (size, why). A size of 1 means
 # "constant for a whole run" (dataset geometry, config); sizes > 1
@@ -70,6 +70,11 @@ DOMAINS: Dict[str, Tuple[int, str]] = {
              "snapshot alignment; bounded by the batch ladder"),
     "quant": (1, "one certified HistQuant (or None) per learner — "
                  "resolved from tpu_hist_quant at config time"),
+    # serving static args (serving/ rides predict's jitted entry points;
+    # these bound any future serving-local jit site the same way)
+    "quant_target": (2, "serving value grids: native + the certified "
+                        "f16 twin (coarser grids are refused at load)"),
+    "raw_score": (2, "serving transform flag: {True, False}"),
 }
 
 # site-specific domains for static_argnums on functions whose parameter
@@ -279,6 +284,12 @@ def compile_surface(config: Optional[GraftlintConfig] = None,
     total = sum(s.bound for s in sites) + ladder
     return {"sites": [s.to_dict() for s in sites],
             "serve_ladder_bound": ladder,
+            # each serving registry slot owns a TPUPredictor instance
+            # (its own executable cache), so a multi-model deployment
+            # spends `ladder` compiles PER ACTIVE SLOT — per-slot cost
+            # for capacity planning; the analytic ceiling stays a
+            # single-model-surface bound
+            "serving_ladder_per_slot": ladder,
             "total_bound": total}
 
 

@@ -31,7 +31,7 @@ class GraftlintConfig:
     # JG002: host-sync findings only fire inside these path fragments
     hot_paths: List[str] = field(default_factory=lambda: [
         "lightgbm_tpu/ops/", "lightgbm_tpu/predict/",
-        "lightgbm_tpu/parallel/"])
+        "lightgbm_tpu/parallel/", "lightgbm_tpu/serving/"])
     # JG001/JG003a: a function whose name matches one of these regexes is
     # treated as TPU kernel code (in addition to jit-decorated functions)
     kernel_names: List[str] = field(default_factory=lambda: [

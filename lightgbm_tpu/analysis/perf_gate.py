@@ -73,6 +73,11 @@ HIGHER_BETTER = (
     # exchanges — the int16 + PV-Tree voting compression the acceptance
     # criterion pins at >= 3x
     "hist_compress_ratio",
+    # async serving (serving/): sustained open-loop throughput through
+    # the continuous-batching server, and its ratio over the
+    # synchronous BatchServer at the same request mix (the acceptance
+    # criterion pins >= 2x)
+    "serving_rps", "serving_vs_sync",
 )
 LOWER_BETTER = (
     "predict_p50", "predict_p99", "checkpoint_overhead_frac",
@@ -84,6 +89,10 @@ LOWER_BETTER = (
     # voting: fraction of features whose planes cross the wire
     # (2*top_k/F) — the PV-Tree pre-selection ratio
     "reduced_feature_frac",
+    # serving rounds verdict automatically on the SLO keys: open-loop
+    # mean queue depth (load proxy) and the fraction of requests whose
+    # arrival->answer latency blew the deadline budget
+    "predict_qdepth", "serving_deadline_miss_frac",
 )
 # headline keys whose PRESENCE depends on a measurement-only knob
 # (margin_p01 only exists when BENCH_TELEMETRY recorded the margin
@@ -96,7 +105,11 @@ MEASUREMENT_CONDITIONAL = ("margin_p01",
                            # (bench run_voting -> counts_snapshot): a
                            # BENCH_TELEMETRY=0 round omits them without
                            # the phase having crashed
-                           "dcn_hist_bytes", "hist_compress_ratio")
+                           "dcn_hist_bytes", "hist_compress_ratio",
+                           # queue depth exists only when the open-loop
+                           # phases run (BENCH_SKIP_PREDICT/SERVING
+                           # skip them without a crash)
+                           "predict_qdepth")
 
 # per-key minimum noise bands: bucket-quantized keys can only move in
 # layout-growth steps. margin_p01 is a quantile of the 2.0-growth

@@ -173,8 +173,17 @@ def _certify_histogram(spec: dict) -> dict:
 
 
 def _certify_leaf(spec: dict) -> dict:
-    rel = _F16_REL if spec.get("target") in ("float16", "f16") \
-        else 2.0 ** -8      # bf16 serving would keep 8 bits
+    target = spec.get("target")
+    if target in ("float16", "f16"):
+        rel = _F16_REL
+    elif target == "int8":
+        # symmetric int8 value grid: step = 2*cap/254, worst relative
+        # error 1/127 (~2^-7) of the tensor scale — 8x the predict
+        # budget, so the serving registry's quantized-load seam refuses
+        # this certificate by name (leaf_int8)
+        rel = 1.0 / (((1 << _BITS["int8"]) - 2) // 2)
+    else:
+        rel = 2.0 ** -8     # bf16 serving would keep 8 bits
     trees = int(spec.get("num_trees", 1))
     leaf_cap = float(spec.get("leaf_abs_max", 1.0))
     out_abs = trees * leaf_cap * rel

@@ -218,6 +218,14 @@ PARAMS: List[_P] = [
     _P("tpu_predict_dtype", str, "f64"),     # f64 (exact parity) | f32
     _P("tpu_predict_min_batch", int, 256, lo=1),   # serve bucket ladder
     _P("tpu_predict_max_batch", int, 65536, lo=1),  # bounds (pow2-rounded)
+    # ---- async serving subsystem (serving/) ----
+    _P("tpu_serve_async", bool, False),      # task=predict via the async
+    #                                        # continuous-batching server
+    _P("tpu_serve_quant", str, "none"),      # none | f16 (certified) |
+    #                                        # int8 (refused by cert)
+    _P("tpu_serve_max_wait_ms", float, 5.0, lo=0.0),  # deadline budget a
+    #                                        # sub-bucket batch may wait
+    #                                        # to coalesce (SLO-derived)
     _P("tpu_multival", str, "auto"),         # auto | force | off: ELL row-
     #                                        # sparse device layout (the
     #                                        # MultiValBin/SparseBin analog)
@@ -495,6 +503,22 @@ class Config:
         self.tpu_predict_dtype = "f32" if pdt in ("f32", "float32") else "f64"
         if self.tpu_predict_max_batch < self.tpu_predict_min_batch:
             Log.fatal("tpu_predict_max_batch < tpu_predict_min_batch")
+        sq = str(self.tpu_serve_quant).lower()
+        if sq in ("", "false", "0", "off"):
+            sq = "none"
+        # int8 parses here but is refused at registry load by the
+        # quant_certify certificate (serving/quantized.py) with the
+        # bound named in the error — same seam as tpu_hist_quant
+        if sq not in ("none", "f16", "float16", "int8"):
+            Log.fatal("Unknown tpu_serve_quant %s (expected "
+                      "none|f16|int8)" % sq)
+        self.tpu_serve_quant = "f16" if sq == "float16" else sq
+        if self.tpu_serve_async and self.predict_device != "tpu":
+            # asking for the async service loop IS asking for the device
+            # runtime; without this the serving knobs silently fall
+            # through to the host walk
+            Log.info("tpu_serve_async=true implies predict_device=tpu")
+            self.predict_device = "tpu"
         hq = str(self.tpu_hist_quant).lower()
         if hq in ("", "false", "0"):
             hq = "off"

@@ -140,15 +140,37 @@ class Application:
             # bounded recompiles, chunked device memory, mesh fan-out
             from .predict import BatchServer, EnsembleCompileError
             Log.info("Serving predictions on the device runtime "
-                     "(predict_device=tpu)")
+                     "(predict_device=tpu%s)"
+                     % (", async" if cfg.tpu_serve_async else ""))
             try:
-                server = BatchServer(
-                    booster._booster.device_predictor(
-                        0, num_iteration if num_iteration else -1),
-                    min_batch=cfg.tpu_predict_min_batch,
-                    max_batch=cfg.tpu_predict_max_batch)
-                result = server.predict(loaded.X,
-                                        raw_score=cfg.predict_raw_score)
+                if cfg.tpu_serve_async:
+                    # the continuous-batching path: one CLI request is a
+                    # single admitted batch, but the server chunks,
+                    # coalesces and shards identically to a live
+                    # deployment — tpu_serve_quant rides the registry's
+                    # certified load seam
+                    from .serving import AsyncBatchServer, ModelRegistry
+                    registry = ModelRegistry(
+                        dtype=cfg.tpu_predict_dtype,
+                        min_rows=cfg.tpu_predict_min_batch)
+                    registry.load("cli", booster=booster,
+                                  quant=cfg.tpu_serve_quant)
+                    with AsyncBatchServer(
+                            registry,
+                            min_batch=cfg.tpu_predict_min_batch,
+                            max_batch=cfg.tpu_predict_max_batch,
+                            max_wait_ms=cfg.tpu_serve_max_wait_ms
+                            ) as server:
+                        result = server.predict(
+                            loaded.X, raw_score=cfg.predict_raw_score)
+                else:
+                    server = BatchServer(
+                        booster._booster.device_predictor(
+                            0, num_iteration if num_iteration else -1),
+                        min_batch=cfg.tpu_predict_min_batch,
+                        max_batch=cfg.tpu_predict_max_batch)
+                    result = server.predict(
+                        loaded.X, raw_score=cfg.predict_raw_score)
             except EnsembleCompileError as exc:
                 Log.warning("predict_device=tpu: %s; falling back to the "
                             "host predictor" % exc)

@@ -14,10 +14,11 @@ Selected through ``predict_device=tpu`` (config / Booster.predict kwarg);
 the default ``cpu`` keeps the vectorized numpy walk in models/tree.py.
 """
 from .compile import (CompiledEnsemble, EnsembleCompileError, TreeBucket,
-                      compile_ensemble)
+                      compile_ensemble, quant_spec, quantize_ensemble)
 from .runtime import TPUPredictor, make_device_transform
-from .serve import BatchServer
+from .serve import BatchServer, place_padded
 
 __all__ = ["CompiledEnsemble", "EnsembleCompileError", "TreeBucket",
-           "compile_ensemble", "TPUPredictor", "make_device_transform",
-           "BatchServer"]
+           "compile_ensemble", "quant_spec", "quantize_ensemble",
+           "TPUPredictor", "make_device_transform", "BatchServer",
+           "place_padded"]

@@ -121,9 +121,13 @@ def _pack_bucket(models: List, positions: List[int], depth: int) -> TreeBucket:
         cat_nwords=cat_nwords, cat_words=cat_words)
 
 
-# the device predictor performs no deliberate float narrowing today;
-# the f16 serving path (ROADMAP item 3) must extend this table when it
-# lands, certified by analysis/quant_audit against quant_spec below
+# the device predictor performs no deliberate IN-PROGRAM float
+# narrowing: the f16 serving path (serving/quantized.py) snaps leaf and
+# threshold VALUES onto the float16 grid on host before the tensors
+# ship — the jitted traversal still computes at the runtime dtype, so
+# the precision-flow audit sees no narrowing cast and this table stays
+# empty. The grid itself is certified by analysis/quant_audit against
+# quant_spec below (PREDICT_REL_BUDGET).
 NARROW_OK = ()
 
 
@@ -150,6 +154,45 @@ def quant_spec(ensemble: Optional[CompiledEnsemble] = None,
         "threshold_abs_max": thr_cap,
         "num_trees": max(n_trees, 1),
     }
+
+
+QUANT_TARGETS = ("float16", "f16")
+
+
+def quantize_ensemble(ensemble: CompiledEnsemble,
+                      target: str = "float16"
+                      ) -> Tuple[CompiledEnsemble, dict]:
+    """Snap an ensemble's leaf/threshold tensors onto the ``target``
+    value grid (serving ROADMAP item 3). Returns (quantized ensemble,
+    the :func:`quant_spec` describing it) — the caller is responsible
+    for certifying the spec through ``analysis/quant_audit`` BEFORE
+    serving the result (``serving/quantized.py`` is that seam; it
+    refuses uncertified grids by certificate name).
+
+    Only the float16 grid is buildable: every stored value rounds
+    through ``np.float16`` (relative error <= 2^-11), then widens back
+    so the runtime traverses at its usual dtype with halved effective
+    mantissa content. Grids the certifier rejects at any geometry
+    (int8) are not constructible here at all.
+    """
+    if target not in QUANT_TARGETS:
+        raise EnsembleCompileError(
+            "unsupported quantization target %r (buildable: %s; coarser "
+            "grids fail quant_certify before reaching this point)"
+            % (target, "/".join(QUANT_TARGETS)))
+    spec = quant_spec(ensemble, target="float16")
+
+    def _snap(a: np.ndarray) -> np.ndarray:
+        # host-side value snap, not an in-program narrowing: the program
+        # stays f64 end to end; admission requires the quant_audit
+        # certificate against PREDICT_REL_BUDGET (serving/quantized.py)
+        return a.astype(np.float16).astype(np.float64)  # graftlint: disable=JG010
+
+    buckets = tuple(
+        b._replace(threshold=_snap(b.threshold),
+                   leaf_value=_snap(b.leaf_value))
+        for b in ensemble.buckets)
+    return ensemble._replace(buckets=buckets), spec
 
 
 def compile_ensemble(models: List, num_tree_per_iteration: int = 1,

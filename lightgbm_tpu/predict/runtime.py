@@ -229,12 +229,21 @@ class TPUPredictor:
             telemetry.count(C_COMPILE, 1, category="predict")
         return jnp.asarray(X, dtype=self._dtype)
 
-    def predict_padded(self, X_dev, n_valid: int, raw_score: bool = False):
-        """Device rows [n_pad, F] (padding rows are dropped) -> host
-        predictions [n_valid(, K)]."""
+    def dispatch_padded(self, X_dev, raw_score: bool = False):
+        """Queue the traversal for device rows [n_pad, F] WITHOUT
+        blocking: returns the in-flight device output array immediately
+        (jax dispatch is async). The continuous-batching server admits
+        and coalesces the next batch while this one runs; pair with
+        :meth:`finalize_padded` at the one deliberate sync point."""
+        return self._raw_fn(X_dev, not raw_score)
+
+    def finalize_padded(self, out_dev, n_valid: int,
+                        raw_score: bool = False):
+        """Materialize a :meth:`dispatch_padded` result: the deliberate
+        end-of-pipeline host sync, plus the host-side transform/average
+        conversions and served-row accounting."""
         want_transform = not raw_score
-        out = self._raw_fn(X_dev, want_transform)
-        out = np.asarray(out)[:n_valid]
+        out = np.asarray(out_dev)[:n_valid]
         if not (want_transform and self._transform is not None) \
                 and self.ensemble.average_output:
             # host-side numpy division: bit-parity with predict_raw
@@ -247,6 +256,14 @@ class TPUPredictor:
         telemetry.count(C_ROWS, n_valid, category="predict")
         telemetry.count(C_BATCHES, 1, category="predict")
         return out[:, 0] if self.num_class == 1 else out
+
+    def predict_padded(self, X_dev, n_valid: int, raw_score: bool = False):
+        """Device rows [n_pad, F] (padding rows are dropped) -> host
+        predictions [n_valid(, K)]: dispatch + immediate finalize, the
+        synchronous path (serve.BatchServer)."""
+        return self.finalize_padded(
+            self.dispatch_padded(X_dev, raw_score=raw_score),
+            n_valid, raw_score=raw_score)
 
     def predict(self, X: np.ndarray, raw_score: bool = False) -> np.ndarray:
         X = np.ascontiguousarray(

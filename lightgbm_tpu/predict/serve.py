@@ -18,6 +18,7 @@ fans out across chips; input buffers are donated on accelerator backends
 from __future__ import annotations
 
 
+import threading
 import time
 
 import numpy as np
@@ -36,8 +37,32 @@ C_SERVE_HIT = "predict::serve_bucket_hit"
 C_SERVE_SHARDED = "predict::serve_sharded_batches"
 H_E2E = "predict::e2e_latency"
 H_QUEUE = "predict::queue_wait"
+H_QDEPTH = "predict::queue_depth"
 
 ROWS_AXIS = "rows"
+
+
+def build_mesh(devices) -> "Mesh | None":
+    """1-D row mesh over the given devices (None when a single device —
+    plain placement is then strictly cheaper than a degenerate mesh)."""
+    return Mesh(np.array(devices), (ROWS_AXIS,)) if len(devices) > 1 \
+        else None
+
+
+def place_padded(Xp: np.ndarray, dtype, mesh, devices,
+                 shard_min_rows: int):
+    """Padded host batch -> device array, row-sharded over the local
+    mesh when large enough and evenly divisible. Returns (X_dev,
+    sharded_flag); shared by the sync BatchServer and the async serving
+    admission loop so both take the identical pjit fan-out path."""
+    np_dt = np.float32 if dtype == jnp.float32 else np.float64
+    if (mesh is not None and Xp.shape[0] >= shard_min_rows
+            and Xp.shape[0] % len(devices) == 0):
+        telemetry.count(C_SERVE_SHARDED, 1, category="predict")
+        return jax.device_put(
+            Xp.astype(np_dt, copy=False),
+            NamedSharding(mesh, P(ROWS_AXIS, None))), True
+    return jnp.asarray(Xp, dtype=dtype), False
 
 
 class BatchServer:
@@ -60,8 +85,7 @@ class BatchServer:
         self.shard_min_rows = int(shard_min_rows)
         self.devices = list(devices) if devices is not None \
             else list(jax.local_devices())
-        self._mesh = (Mesh(np.array(self.devices), (ROWS_AXIS,))
-                      if len(self.devices) > 1 else None)
+        self._mesh = build_mesh(self.devices)
         # instance-local serving stats: stats() must work (and the bench
         # must report true compile counts) even with telemetry off, where
         # events.count() is a no-op
@@ -75,6 +99,17 @@ class BatchServer:
         # is on so they ride the metrics/prom exports.
         self._h_e2e = Histogram(H_E2E, unit="s", category="predict")
         self._h_queue = Histogram(H_QUEUE, unit="s", category="predict")
+        # queue depth is sampled at ADMISSION as well as at service
+        # start: depth that builds up between flushes (concurrent
+        # callers stacking behind an in-service batch) is real queueing
+        # the service-start sample alone never sees. _depth counts
+        # requests admitted but not yet answered; the running max is the
+        # stats() headline.
+        self._h_qdepth = Histogram(H_QDEPTH, unit="req",
+                                   category="predict")
+        self._depth = 0
+        self._qdepth_max = 0
+        self._depth_lock = threading.Lock()
 
     # ------------------------------------------------------------------
     def bucket_rows(self, n: int) -> int:
@@ -86,18 +121,14 @@ class BatchServer:
         return int(np.log2(self.max_batch // self.min_batch)) + 1
 
     def _place(self, Xp: np.ndarray):
-        """Padded host batch -> device array, row-sharded over the local
-        mesh when large enough and evenly divisible."""
-        dt = np.float32 if self.predictor._dtype == jnp.float32 \
-            else np.float64
-        if (self._mesh is not None and Xp.shape[0] >= self.shard_min_rows
-                and Xp.shape[0] % len(self.devices) == 0):
+        """Padded host batch -> device array (module helper; counts
+        sharded placements on this instance)."""
+        X_dev, sharded = place_padded(Xp, self.predictor._dtype,
+                                      self._mesh, self.devices,
+                                      self.shard_min_rows)
+        if sharded:
             self._sharded_batches += 1
-            telemetry.count(C_SERVE_SHARDED, 1, category="predict")
-            return jax.device_put(
-                Xp.astype(dt, copy=False),
-                NamedSharding(self._mesh, P(ROWS_AXIS, None)))
-        return jnp.asarray(Xp, dtype=self.predictor._dtype)
+        return X_dev
 
     def _serve_chunk(self, X: np.ndarray, raw_score: bool) -> np.ndarray:
         n = X.shape[0]
@@ -124,18 +155,27 @@ class BatchServer:
         arrival rather than from service start — the numbers an SLO is
         written against. Omitted, queue wait records as 0 and e2e is
         pure service time."""
+        d_adm = self._admit()
+        self._h_qdepth.record(float(d_adm))
+        telemetry_histo.observe(H_QDEPTH, float(d_adm), unit="req",
+                                category="predict")
         t_start = time.perf_counter()
-        q_wait = max(t_start - arrival_t, 0.0) \
-            if arrival_t is not None else 0.0
-        X = np.ascontiguousarray(np.asarray(X, dtype=np.float64))
-        if X.ndim == 1:
-            X = X.reshape(1, -1)
-        if X.shape[0] <= self.max_batch:
-            out = self._serve_chunk(X, raw_score)
-        else:
-            outs = [self._serve_chunk(X[i:i + self.max_batch], raw_score)
-                    for i in range(0, X.shape[0], self.max_batch)]
-            out = np.concatenate(outs, axis=0)
+        try:
+            q_wait = max(t_start - arrival_t, 0.0) \
+                if arrival_t is not None else 0.0
+            X = np.ascontiguousarray(np.asarray(X, dtype=np.float64))
+            if X.ndim == 1:
+                X = X.reshape(1, -1)
+            if X.shape[0] <= self.max_batch:
+                out = self._serve_chunk(X, raw_score)
+            else:
+                outs = [self._serve_chunk(X[i:i + self.max_batch],
+                                          raw_score)
+                        for i in range(0, X.shape[0], self.max_batch)]
+                out = np.concatenate(outs, axis=0)
+        finally:
+            with self._depth_lock:
+                self._depth -= 1
         e2e = time.perf_counter() - (arrival_t if arrival_t is not None
                                      else t_start)
         self._h_queue.record(q_wait)
@@ -144,6 +184,18 @@ class BatchServer:
                                 category="predict")
         telemetry_histo.observe(H_E2E, e2e, unit="s", category="predict")
         return out
+
+    def _admit(self) -> int:
+        """Count a request in; returns the post-admission depth — the
+        admission-time queue-depth sample. Depth that builds up behind
+        an in-service batch was invisible to service-start-only
+        sampling (the bench's probe), so the server samples at both
+        points and keeps the true max."""
+        with self._depth_lock:
+            self._depth += 1
+            if self._depth > self._qdepth_max:
+                self._qdepth_max = self._depth
+            return self._depth
 
     # ------------------------------------------------------------------
     def stats(self) -> dict:
@@ -161,6 +213,8 @@ class BatchServer:
             "latency_p50": self._h_e2e.percentile(0.50),
             "latency_p99": self._h_e2e.percentile(0.99),
             "queue_wait_p99": self._h_queue.percentile(0.99),
+            "qdepth_max": self._qdepth_max,
             "latency": self._h_e2e.to_dict(with_buckets=False),
             "queue_wait": self._h_queue.to_dict(with_buckets=False),
+            "queue_depth": self._h_qdepth.to_dict(with_buckets=False),
         }

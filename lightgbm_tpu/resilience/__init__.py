@@ -28,7 +28,8 @@ from .checkpoint import (CheckpointError, CheckpointWriter, TrainingSaver,
                          dataset_fingerprint)
 from .faults import FaultPlan, TrainingKilled, TrainingResized
 from .reshard import find_elastic, load_manifest
-from .restore import find_restorable, resume_booster
+from .restore import (find_restorable, model_text_from_checkpoint,
+                      resume_booster)
 from .retry import RetryPolicy, guard
 
 __all__ = [
@@ -36,5 +37,6 @@ __all__ = [
     "atomic_write_bytes", "atomic_write_text", "config_hash",
     "dataset_fingerprint", "FaultPlan", "TrainingKilled",
     "TrainingResized", "find_elastic", "load_manifest",
-    "find_restorable", "resume_booster", "RetryPolicy", "guard",
+    "find_restorable", "model_text_from_checkpoint", "resume_booster",
+    "RetryPolicy", "guard",
 ]

@@ -108,6 +108,22 @@ def extra_state(found: Tuple[Dict, Dict], key: str):
     return state.get(key)
 
 
+def model_text_from_checkpoint(path: str) -> Tuple[str, Dict]:
+    """Load the model text carried by one snapshot file -> (model_text,
+    meta). This is the serving registry's load path: a kind="model"
+    snapshot (the distributed/per-rank stream) stores the full model
+    string as a uint8 array, so a hot-swap load rides the same
+    magic/CRC/truncation validation as resume — a torn or corrupt
+    snapshot is a clean CheckpointError, never a half-loaded model."""
+    meta, arrays = load_checkpoint(path)
+    if "model_text" not in arrays:
+        raise CheckpointError(
+            "checkpoint %s carries no model_text (kind=%r — only "
+            "kind=model snapshots store the serialized model)"
+            % (path, meta.get("kind")))
+    return arrays["model_text"].tobytes().decode(), meta
+
+
 def find_distributed(config, rank: int, *shard_arrays,
                      global_fp: Optional[str] = None
                      ) -> Optional[Tuple[int, str, Dict]]:

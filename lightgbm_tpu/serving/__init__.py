@@ -1,0 +1,27 @@
+"""Async serving subsystem: the admission layer above :mod:`predict`.
+
+Three pieces (ROADMAP serving item; docs/COMPONENTS.md "Serving"):
+
+* :mod:`server`    — :class:`AsyncBatchServer`: async request queue with
+  continuous batching over the power-of-two bucket ladder, deadline-
+  aware partial flush, per-request futures, mesh row-sharding for large
+  admitted batches;
+* :mod:`registry`  — :class:`ModelRegistry`: named model slots, atomic
+  hot-swap (admission-time snapshots: in-flight requests finish on the
+  old model, zero drops), bit-exact rollback, loads from Booster /
+  model text / resilience checkpoints;
+* :mod:`quantized` — the f16 value-grid admission seam: quantized
+  ensembles serve only under a passing ``quant_certify`` certificate
+  against ``PREDICT_REL_BUDGET``; refusals (int8) name the certificate.
+
+The sync :class:`predict.serve.BatchServer` remains the simple
+one-caller path; this package is the shared-service path ("heavy
+traffic from millions of users").
+"""
+from .quantized import QuantRefusedError, quantized_for_serving
+from .registry import ModelRegistry, ModelSlot
+from .server import AsyncBatchServer, ServeFuture, ServingError
+
+__all__ = ["AsyncBatchServer", "ServeFuture", "ServingError",
+           "ModelRegistry", "ModelSlot", "QuantRefusedError",
+           "quantized_for_serving"]

@@ -1,0 +1,87 @@
+"""Quantized-ensemble admission seam: certify BEFORE the tensors serve.
+
+ROADMAP item 3's quantized serving variant rides the same
+certificate-gated pattern as the int16 histogram collectives (PR 15):
+the numerics auditor (:mod:`analysis.quant_audit`) owns the error
+algebra, this module owns the REFUSAL — a quantization target whose
+certificate bound exceeds the pinned ``PREDICT_REL_BUDGET`` never
+reaches the device, and the error names the certificate so the operator
+can read the exact bound that failed out of ``--json``'s
+``quant_certificate`` block.
+
+The f16 grid (relative error ``2^-11``) certifies with ~2x margin
+against the 1e-3 budget; int8 (``1/127`` ~ ``2^-7``) blows it by ~8x
+and is refused here — :func:`predict.compile.quantize_ensemble` cannot
+even build it, by design. Quantization is a HOST-side value snap: the
+jitted traversal still runs at the runtime dtype, so the precision-flow
+audit's ``NARROW_OK`` table stays empty and no new jit site appears on
+the compile surface.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from ..analysis import quant_audit
+from ..predict.compile import (CompiledEnsemble, quant_spec,
+                               quantize_ensemble)
+from ..telemetry import events as telemetry
+from ..utils.log import LightGBMError
+
+C_QUANT_ADMITTED = "serving::quant_admitted"
+C_QUANT_REFUSED = "serving::quant_refused"
+
+QUANT_NONE = "none"
+# aliases accepted from config/params; canonical targets are what
+# quant_spec names certificates after (leaf_float16 / leaf_int8)
+_CANONICAL = {"f16": "float16", "fp16": "float16", "float16": "float16",
+              "half": "float16", "int8": "int8"}
+
+
+class QuantRefusedError(LightGBMError):
+    """A quantization target failed (or lacks) certification; the
+    message names the certificate and the failing bound. The registry
+    guarantees the previously active model keeps serving."""
+
+    def __init__(self, msg: str, certificate: Optional[dict] = None):
+        super().__init__(msg)
+        self.certificate = certificate
+
+
+def certify_target(ensemble: CompiledEnsemble, target: str) -> dict:
+    """Certificate for serving `ensemble` on the `target` value grid —
+    the spec caps come from the actual packed tensors, not the contract
+    defaults, so the bound reflects the model being admitted."""
+    return quant_audit.certify(quant_spec(ensemble, target=target))
+
+
+def quantized_for_serving(ensemble: CompiledEnsemble, target: str
+                          ) -> Tuple[CompiledEnsemble, Optional[dict]]:
+    """(possibly-quantized ensemble, certificate) for a registry load.
+
+    ``target="none"`` passes the ensemble through untouched (no
+    certificate needed — nothing was narrowed). Any other target is
+    certified FIRST: a failing certificate raises
+    :class:`QuantRefusedError` naming it (e.g. ``leaf_int8``), before
+    any tensor is built, so refusal costs nothing and cannot leave a
+    half-quantized model behind.
+    """
+    if target in (None, "", QUANT_NONE):
+        return ensemble, None
+    canonical = _CANONICAL.get(str(target).lower())
+    if canonical is None:
+        raise QuantRefusedError(
+            "unknown quantization target %r (known: none, f16/float16, "
+            "int8 — and int8 is refused by its certificate)" % (target,))
+    cert = certify_target(ensemble, canonical)
+    name = cert["spec"].get("name", "leaf_%s" % canonical)
+    if not cert.get("ok", False):
+        telemetry.count(C_QUANT_REFUSED, 1, category="serving")
+        raise QuantRefusedError(
+            "quantized serving refused: certificate %s has bound %.3g > "
+            "PREDICT_REL_BUDGET %.3g (%.1fx over) — the model was NOT "
+            "swapped in" % (name, cert["bound"], cert["budget"],
+                            cert["bound"] / cert["budget"]),
+            certificate=cert)
+    quantized, _spec = quantize_ensemble(ensemble, target=canonical)
+    telemetry.count(C_QUANT_ADMITTED, 1, category="serving")
+    return quantized, cert

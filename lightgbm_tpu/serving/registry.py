@@ -1,0 +1,217 @@
+"""Multi-model registry with atomic hot-swap and rollback.
+
+Named model slots, each pinning one compiled :class:`TPUPredictor`
+(HBM-resident ensemble tensors). The ACTIVE slot is a single reference
+the admission path snapshots per request — swapping is one assignment
+under the registry lock, so:
+
+  * requests admitted before the swap finish on the model they were
+    admitted against (the async server pins the predictor snapshot at
+    admission; a request can never mix two models' trees);
+  * requests admitted after the swap route to the new model;
+  * nothing is ever dropped — there is no draining barrier, the old
+    predictor stays alive (and HBM-resident) until the last in-flight
+    batch against it finalizes and Python releases the reference.
+
+Load paths: an in-memory Booster, a model file / model string (the
+reference text format), or a resilience snapshot
+(:func:`resilience.model_text_from_checkpoint` — kind="model"
+checkpoints store the model text CRC-validated, so a torn file is a
+clean error, never a half-loaded slot). Quantized variants go through
+:mod:`serving.quantized`: certify-then-build, refusal leaves the
+previously active slot serving.
+
+``rollback()`` restores the previously active slot — bit-exact, because
+the old predictor object (same HBM tensors, same executables) is kept,
+not reloaded. Every swap/rollback bumps ``serving::swap`` /
+``serving::rollback`` and leaves a flight-note for the postmortem ring.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..predict.compile import compile_ensemble
+from ..predict.runtime import TPUPredictor
+from ..telemetry import events as telemetry
+from ..telemetry import flight
+from .quantized import QUANT_NONE, quantized_for_serving
+
+C_SWAP = "serving::swap"
+C_ROLLBACK = "serving::rollback"
+C_LOAD = "serving::model_load"
+
+
+class ModelSlot:
+    """One named, immutable registry entry."""
+
+    __slots__ = ("name", "predictor", "quant", "certificate", "source",
+                 "num_trees", "loaded_at")
+
+    def __init__(self, name: str, predictor: TPUPredictor, quant: str,
+                 certificate: Optional[dict], source: str):
+        self.name = name
+        self.predictor = predictor
+        self.quant = quant
+        self.certificate = certificate
+        self.source = source
+        self.num_trees = predictor.ensemble.num_trees
+        self.loaded_at = time.time()
+
+    def describe(self) -> dict:
+        d = {"name": self.name, "quant": self.quant,
+             "source": self.source, "num_trees": self.num_trees,
+             "loaded_at": self.loaded_at}
+        if self.certificate is not None:
+            d["certificate"] = {
+                "name": self.certificate["spec"].get("name"),
+                "bound": self.certificate["bound"],
+                "budget": self.certificate["budget"],
+                "margin": self.certificate.get("margin")}
+        return d
+
+
+class ModelRegistry:
+    """Named slots + one atomic active pointer (see the module doc)."""
+
+    def __init__(self, dtype: str = "f64", min_rows: int = 128,
+                 params: Optional[dict] = None):
+        self.dtype = dtype
+        self.min_rows = int(min_rows)
+        self.params = dict(params or {})
+        self._slots: Dict[str, ModelSlot] = {}
+        self._active: Optional[ModelSlot] = None
+        self._previous: Optional[ModelSlot] = None
+        self._swaps = 0
+        self._lock = threading.RLock()
+
+    # -- loading -------------------------------------------------------
+    def load(self, name: str, booster=None, model_file: str = None,
+             model_str: str = None, checkpoint: str = None,
+             quant: str = QUANT_NONE, activate: bool = False) -> ModelSlot:
+        """Compile a model into the named slot (exactly one source).
+
+        Certification happens BEFORE the slot is written: a refused
+        quantization (:class:`serving.quantized.QuantRefusedError`)
+        leaves the registry — including the active pointer — exactly as
+        it was. ``activate=True`` swaps the new slot in atomically; the
+        first successful load activates unconditionally so a fresh
+        registry is immediately servable.
+        """
+        sources = [s for s in (booster, model_file, model_str, checkpoint)
+                   if s is not None]
+        if len(sources) != 1:
+            raise ValueError(
+                "load() needs exactly one of booster/model_file/"
+                "model_str/checkpoint (got %d)" % len(sources))
+        if checkpoint is not None:
+            from ..resilience import model_text_from_checkpoint
+            model_str, _meta = model_text_from_checkpoint(checkpoint)
+            source = "checkpoint:%s" % checkpoint
+        elif model_file is not None:
+            source = "file:%s" % model_file
+        elif model_str is not None:
+            source = "string"
+        else:
+            source = "booster"
+        if booster is None:
+            from ..basic import Booster
+            booster = Booster(params=self.params, model_file=model_file,
+                              model_str=model_str)
+        gb = booster._booster
+        # _used_models materializes any pending async trees first — a
+        # live training booster is loadable mid-run
+        models = gb._used_models(0, -1)
+        ens = compile_ensemble(models, gb.num_tree_per_iteration,
+                               gb.average_output, gb.max_feature_idx)
+        ens, cert = quantized_for_serving(ens, quant)
+        pred = TPUPredictor(ens, gb.objective, dtype=self.dtype,
+                            min_rows=self.min_rows)
+        slot = ModelSlot(name, pred, quant or QUANT_NONE, cert, source)
+        telemetry.count(C_LOAD, 1, category="serving")
+        with self._lock:
+            self._slots[name] = slot
+            if activate or self._active is None:
+                self._swap_locked(slot, why="load")
+        return slot
+
+    # -- swap / rollback ----------------------------------------------
+    def _swap_locked(self, slot: ModelSlot, why: str) -> None:
+        prev = self._active
+        # the atomic flip: one reference assignment under the lock —
+        # admission snapshots (resolve()) see strictly-before or
+        # strictly-after, never a mix
+        self._active = slot
+        self._previous = prev
+        self._swaps += 1
+        telemetry.count(C_SWAP, 1, category="serving")
+        flight.note("serving::swap", model=slot.name, why=why,
+                    quant=slot.quant,
+                    prev=prev.name if prev is not None else None)
+
+    def swap(self, name: str) -> ModelSlot:
+        """Atomically make the named slot active; returns it."""
+        with self._lock:
+            slot = self._slots.get(name)
+            if slot is None:
+                raise KeyError("no model slot %r (have: %s)"
+                               % (name, sorted(self._slots) or "none"))
+            self._swap_locked(slot, why="swap")
+            return slot
+
+    def rollback(self) -> ModelSlot:
+        """Restore the previously active slot — the same predictor
+        object, so post-rollback scores are bit-exact with pre-swap."""
+        with self._lock:
+            if self._previous is None:
+                raise RuntimeError(
+                    "nothing to roll back to (fewer than two "
+                    "activations so far)")
+            slot = self._previous
+            self._swap_locked(slot, why="rollback")
+            telemetry.count(C_ROLLBACK, 1, category="serving")
+            return slot
+
+    # -- resolution ----------------------------------------------------
+    def resolve(self, name: Optional[str] = None) -> TPUPredictor:
+        """Predictor snapshot for admission: the active slot's (or a
+        named slot's) predictor, captured once — the caller keeps using
+        this exact object however many swaps happen afterwards."""
+        with self._lock:
+            slot = self._active if name is None else self._slots.get(name)
+            if slot is None:
+                raise RuntimeError(
+                    "no active model in the registry"
+                    if name is None else "no model slot %r" % name)
+            return slot.predictor
+
+    def active(self) -> Optional[ModelSlot]:
+        with self._lock:
+            return self._active
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._slots)
+
+    def drop(self, name: str) -> None:
+        """Remove a slot (refused while active — swap away first)."""
+        with self._lock:
+            if self._active is not None and self._active.name == name:
+                raise RuntimeError("cannot drop the active slot %r"
+                                   % name)
+            self._slots.pop(name, None)
+            if self._previous is not None and self._previous.name == name:
+                self._previous = None
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "slots": {n: s.describe()
+                          for n, s in self._slots.items()},
+                "active": (self._active.name
+                           if self._active is not None else None),
+                "previous": (self._previous.name
+                             if self._previous is not None else None),
+                "swaps": self._swaps,
+            }

@@ -1,0 +1,452 @@
+"""Async request queue with continuous batching over the bucket ladder.
+
+The synchronous :class:`predict.serve.BatchServer` answers one request
+per device program: a burst of K small requests costs K program
+invocations, each mostly padding. This server puts an admission queue in
+front of the same machinery and runs a dedicated service loop that
+
+  * **admits while a batch is in flight** — dispatch is jax-async (the
+    device array comes back before the work finishes), so the loop
+    builds the next coalesced batch while the chips chew the current
+    one, and only blocks at the one deliberate host sync per batch
+    (:meth:`TPUPredictor.finalize_padded`);
+  * **coalesces** the FIFO prefix of compatible requests (same model
+    snapshot, same raw flag, same feature width) into ONE padded
+    power-of-two bucket — the ladder, chunking and mesh row-sharding
+    (``shard_min_rows``, via :func:`predict.serve.place_padded`) are
+    exactly the sync server's, so the compile bound is unchanged;
+  * **flushes deadline-aware** — a sub-bucket batch is held for
+    coalescing only while the device is busy or until the oldest
+    request has waited ``max_wait`` (the SLO-derived budget); then it is
+    flushed PARTIAL rather than starved. A full bucket flushes
+    immediately; an idle device with a warm bucket flushes immediately.
+
+Callers get a :class:`ServeFuture` per request and block only on their
+own rows. Model identity is pinned at ADMISSION (a snapshot out of the
+:class:`serving.registry.ModelRegistry`): an atomic hot-swap lands
+between requests, never inside one — in-flight and queued requests
+finish on the model they were admitted against, new admissions route to
+the new model, and nothing is dropped.
+
+Request arrival-time SLO accounting mirrors the sync server: queue wait
+is admission -> service start, e2e is admission -> answer, both into
+instance histograms (``stats()``) and the global telemetry registry
+(``serving::*`` families, exported to Prometheus).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import List, Optional
+
+import numpy as np
+
+import jax
+
+from ..predict.runtime import TPUPredictor, _next_pow2
+from ..predict.serve import build_mesh, place_padded
+from ..telemetry import events as telemetry
+from ..telemetry import histo as telemetry_histo
+from ..telemetry.histo import Histogram
+
+C_REQUESTS = "serving::requests"
+C_BATCHES = "serving::batches"
+C_COALESCED = "serving::coalesced_requests"
+C_FLUSH_FULL = "serving::flush_full"
+C_FLUSH_DEADLINE = "serving::flush_deadline"
+C_FLUSH_IDLE = "serving::flush_idle"
+C_ERRORS = "serving::request_errors"
+H_E2E = "serving::e2e_latency"
+H_QUEUE = "serving::queue_wait"
+H_QDEPTH = "serving::queue_depth"
+H_BATCH_ROWS = "serving::batch_rows"
+
+# service-loop poll bound: how long the loop sleeps when the queue is
+# empty; also the deadline-check granularity while holding a partial
+# batch (a fraction of max_wait, floored so an idle server stays cheap)
+_MIN_POLL_S = 0.0005
+
+
+class ServingError(RuntimeError):
+    pass
+
+
+class ServeFuture:
+    """Per-request handle: the caller blocks only on its own rows.
+
+    Oversized requests (rows > max_batch) are admitted as several
+    chunked parts sharing one future; parts re-assemble in order."""
+
+    __slots__ = ("_event", "_parts", "_missing", "_exc", "_lock")
+
+    def __init__(self, parts: int = 1):
+        self._event = threading.Event()
+        self._parts: List[Optional[np.ndarray]] = [None] * parts
+        self._missing = parts
+        self._exc: Optional[BaseException] = None
+        self._lock = threading.Lock()
+
+    def _set_part(self, index: int, value: np.ndarray) -> None:
+        with self._lock:
+            if self._parts[index] is None:
+                self._parts[index] = value
+                self._missing -= 1
+            if self._missing <= 0:
+                self._event.set()
+
+    def _set_exception(self, exc: BaseException) -> None:
+        with self._lock:
+            if self._exc is None:
+                self._exc = exc
+            self._event.set()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        if not self._event.wait(timeout):
+            raise TimeoutError("serving request not finished within %r s"
+                               % timeout)
+        if self._exc is not None:
+            raise self._exc
+        if len(self._parts) == 1:
+            return self._parts[0]
+        return np.concatenate(self._parts, axis=0)
+
+
+class _Request:
+    """One admitted chunk: rows + routing snapshot + its future part."""
+
+    __slots__ = ("X", "n", "raw_score", "predictor", "arrival_t",
+                 "future", "part")
+
+    def __init__(self, X, n, raw_score, predictor, arrival_t, future,
+                 part):
+        self.X = X
+        self.n = n
+        self.raw_score = raw_score
+        self.predictor = predictor
+        self.arrival_t = arrival_t
+        self.future = future
+        self.part = part
+
+
+class _Inflight:
+    """One dispatched batch awaiting its finalize sync."""
+
+    __slots__ = ("out_dev", "group", "rows", "predictor", "raw_score")
+
+    def __init__(self, out_dev, group, rows, predictor, raw_score):
+        self.out_dev = out_dev
+        self.group = group
+        self.rows = rows
+        self.predictor = predictor
+        self.raw_score = raw_score
+
+
+class AsyncBatchServer:
+    """Continuous-batching server over one model source.
+
+    ``model`` is either a fixed :class:`TPUPredictor` or a
+    :class:`serving.registry.ModelRegistry` (hot-swap: each request
+    snapshots the then-active predictor at admission).
+
+    ``max_wait_ms`` is the deadline budget a sub-bucket batch may spend
+    waiting to coalesce (derive it from the SLO: a p99 budget of B ms
+    splits into wait + service, so B/4 is a sane default split).
+    """
+
+    def __init__(self, model, min_batch: int = 256,
+                 max_batch: int = 1 << 16, shard_min_rows: int = 8192,
+                 devices=None, max_wait_ms: float = 5.0):
+        if max_batch < min_batch:
+            raise ValueError("max_batch %d < min_batch %d"
+                             % (max_batch, min_batch))
+        self._registry = model if not isinstance(model, TPUPredictor) \
+            else None
+        self._fixed = model if isinstance(model, TPUPredictor) else None
+        self.min_batch = _next_pow2(max(int(min_batch), 1))
+        self.max_batch = _next_pow2(int(max_batch))
+        self.shard_min_rows = int(shard_min_rows)
+        self.max_wait = max(float(max_wait_ms), 0.0) / 1e3
+        self.devices = list(devices) if devices is not None \
+            else list(jax.local_devices())
+        self._mesh = build_mesh(self.devices)
+        self._poll = max(self.max_wait / 4.0, _MIN_POLL_S)
+        # admission state (guarded by _cond's lock)
+        self._cond = threading.Condition()
+        self._pending: deque = deque()
+        self._depth = 0              # admitted, not yet answered
+        self._qdepth_max = 0
+        self._stopping = False
+        self._thread: Optional[threading.Thread] = None
+        # in-flight pipeline (service-loop private, depth <= 2: one
+        # batch on device, one being built/finalized)
+        self._inflight: deque = deque()
+        # instance-local stats (work with telemetry off, like the sync
+        # server's)
+        self._requests = 0
+        self._batches = 0
+        self._flushes = {"full": 0, "deadline": 0, "idle": 0}
+        self._errors = 0
+        self._compiled_buckets = set()
+        self._h_e2e = Histogram(H_E2E, unit="s", category="serving")
+        self._h_queue = Histogram(H_QUEUE, unit="s", category="serving")
+        self._h_qdepth = Histogram(H_QDEPTH, unit="req",
+                                   category="serving")
+        self._h_batch_rows = Histogram(H_BATCH_ROWS, lo=1.0, hi=1e7,
+                                       unit="rows", category="serving")
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self) -> "AsyncBatchServer":
+        if self._thread is None or not self._thread.is_alive():
+            self._stopping = False
+            self._thread = threading.Thread(
+                target=self._loop, name="serving-loop", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self, drain: bool = True) -> None:
+        """Stop the loop; with drain (default) every queued request is
+        answered first — the zero-drop guarantee covers shutdown too."""
+        with self._cond:
+            self._stopping = True
+            if not drain:
+                err = ServingError("server stopped without drain")
+                while self._pending:
+                    self._pending.popleft().future._set_exception(err)
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+
+    def __enter__(self) -> "AsyncBatchServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- admission -----------------------------------------------------
+    def _resolve(self) -> TPUPredictor:
+        if self._fixed is not None:
+            return self._fixed
+        return self._registry.resolve()
+
+    def submit(self, X, raw_score: bool = False,
+               arrival_t: Optional[float] = None) -> ServeFuture:
+        """Admit one request; returns its future. The model snapshot is
+        taken HERE: whatever swap lands later, this request's rows run
+        on the model that was active at admission. Requests larger than
+        max_batch are chunked into parts behind one future."""
+        arrival = arrival_t if arrival_t is not None \
+            else time.perf_counter()
+        X = np.ascontiguousarray(np.asarray(X, dtype=np.float64))
+        if X.ndim == 1:
+            X = X.reshape(1, -1)
+        if X.shape[0] == 0:
+            raise ValueError("empty request")
+        predictor = self._resolve()
+        n_parts = (X.shape[0] + self.max_batch - 1) // self.max_batch
+        future = ServeFuture(parts=n_parts)
+        reqs = [_Request(X[i * self.max_batch:(i + 1) * self.max_batch],
+                         min(self.max_batch,
+                             X.shape[0] - i * self.max_batch),
+                         bool(raw_score), predictor, arrival, future, i)
+                for i in range(n_parts)]
+        with self._cond:
+            if self._stopping:
+                raise ServingError("server is stopped")
+            self._pending.extend(reqs)
+            self._depth += 1
+            if self._depth > self._qdepth_max:
+                self._qdepth_max = self._depth
+            depth = self._depth
+            self._requests += 1
+            self._cond.notify()
+        self._h_qdepth.record(float(depth))
+        telemetry.count(C_REQUESTS, 1, category="serving")
+        telemetry_histo.observe(H_QDEPTH, float(depth), unit="req",
+                                category="serving")
+        return future
+
+    def predict(self, X, raw_score: bool = False,
+                arrival_t: Optional[float] = None) -> np.ndarray:
+        """Blocking convenience: submit + wait on this request only."""
+        return self.submit(X, raw_score=raw_score,
+                           arrival_t=arrival_t).result()
+
+    # -- service loop ---------------------------------------------------
+    def _loop(self) -> None:
+        # the loop body is helper calls only: the deliberate per-batch
+        # host sync lives in _finalize (graftlint JG002 polices this
+        # file — no sync may sit lexically in the hot loop)
+        while self._step():
+            pass
+
+    def _step(self) -> bool:
+        group = self._admit_wave()
+        if group:
+            self._inflight.append(self._dispatch(group))
+        if self._inflight and (len(self._inflight) >= 2 or not group):
+            self._finalize(self._inflight.popleft())
+        with self._cond:
+            if self._stopping and not self._pending \
+                    and not self._inflight:
+                return False
+        return True
+
+    def _admit_wave(self) -> Optional[List[_Request]]:
+        """Take the FIFO prefix of coalescible requests when the flush
+        policy says go; None to hold (or when the queue is idle)."""
+        with self._cond:
+            if not self._pending and not self._inflight \
+                    and not self._stopping:
+                self._cond.wait(timeout=self._poll)
+            if not self._pending:
+                return None
+            head = self._pending[0]
+            key = (id(head.predictor), head.raw_score, head.X.shape[1])
+            rows = 0
+            take = 0
+            for r in self._pending:
+                if (id(r.predictor), r.raw_score, r.X.shape[1]) != key \
+                        or rows + r.n > self.max_batch:
+                    break
+                rows += r.n
+                take += 1
+            full = rows >= self.max_batch or take < len(self._pending)
+            waited = time.perf_counter() - head.arrival_t
+            deadline = waited >= self.max_wait
+            idle = not self._inflight
+            if self._stopping:
+                cause = "idle"
+            elif full:
+                cause = "full"
+            elif deadline:
+                cause = "deadline"
+            elif idle and rows >= self.min_batch:
+                cause = "idle"
+            else:
+                # hold: device busy, or a sub-bucket batch still inside
+                # its coalescing window — the deadline branch above
+                # guarantees no request waits past max_wait. With an
+                # idle device, sleep out (a slice of) the window on the
+                # condition instead of spinning; a new arrival wakes us.
+                if idle:
+                    self._cond.wait(timeout=min(
+                        max(self.max_wait - waited, 0.0) + 1e-4,
+                        self._poll))
+                return None
+            group = [self._pending.popleft() for _ in range(take)]
+            self._flushes[cause] += 1
+        telemetry.count({"full": C_FLUSH_FULL,
+                         "deadline": C_FLUSH_DEADLINE,
+                         "idle": C_FLUSH_IDLE}[cause], 1,
+                        category="serving")
+        return group
+
+    def _dispatch(self, group: List[_Request]) -> _Inflight:
+        """Pad + place + queue one coalesced batch on device (async —
+        returns before the device finishes)."""
+        pred = group[0].predictor
+        raw = group[0].raw_score
+        rows = sum(r.n for r in group)
+        bucket = min(max(_next_pow2(rows), self.min_batch),
+                     self.max_batch)
+        Xp = np.zeros((bucket, group[0].X.shape[1]), dtype=np.float64)
+        off = 0
+        t_svc = time.perf_counter()
+        for r in group:
+            Xp[off:off + r.n] = r.X
+            off += r.n
+            self._h_queue.record(max(t_svc - r.arrival_t, 0.0))
+        self._record_queue_waits(group, t_svc)
+        key = (id(pred), bucket)
+        if key not in self._compiled_buckets:
+            self._compiled_buckets.add(key)
+        X_dev, _sharded = place_padded(Xp, pred._dtype, self._mesh,
+                                       self.devices, self.shard_min_rows)
+        out_dev = pred.dispatch_padded(X_dev, raw_score=raw)
+        self._batches += 1
+        self._h_batch_rows.record(float(rows))
+        telemetry.count(C_BATCHES, 1, category="serving")
+        telemetry.count(C_COALESCED, len(group), category="serving")
+        telemetry_histo.observe(H_BATCH_ROWS, float(rows), unit="rows",
+                                category="serving")
+        return _Inflight(out_dev, group, rows, pred, raw)
+
+    def _record_queue_waits(self, group: List[_Request],
+                            t_svc: float) -> None:
+        for r in group:
+            telemetry_histo.observe(H_QUEUE,
+                                    max(t_svc - r.arrival_t, 0.0),
+                                    unit="s", category="serving")
+
+    def _finalize(self, inf: _Inflight) -> None:
+        """The one host sync per batch: materialize, scatter each
+        request's rows to its future, record e2e from arrival."""
+        try:
+            out = inf.predictor.finalize_padded(inf.out_dev, inf.rows,
+                                                raw_score=inf.raw_score)
+        except Exception as exc:           # noqa: BLE001 — futures must
+            self._fail_group(inf.group, exc)   # never hang on any error
+            return
+        off = 0
+        t_done = time.perf_counter()
+        for r in inf.group:
+            r.future._set_part(r.part, out[off:off + r.n])
+            off += r.n
+            self._h_e2e.record(max(t_done - r.arrival_t, 0.0))
+        self._record_e2e(inf.group, t_done)
+        with self._cond:
+            self._depth -= len({id(r.future) for r in inf.group
+                                if r.part == 0})
+
+    def _record_e2e(self, group: List[_Request], t_done: float) -> None:
+        for r in group:
+            telemetry_histo.observe(H_E2E,
+                                    max(t_done - r.arrival_t, 0.0),
+                                    unit="s", category="serving")
+
+    def _fail_group(self, group: List[_Request],
+                    exc: BaseException) -> None:
+        self._errors += len(group)
+        telemetry.count(C_ERRORS, len(group), category="serving")
+        for r in group:
+            r.future._set_exception(exc)
+        with self._cond:
+            self._depth -= len({id(r.future) for r in group
+                                if r.part == 0})
+
+    # -- stats ----------------------------------------------------------
+    def stats(self) -> dict:
+        """Telemetry-independent serving stats, the async analog of
+        BatchServer.stats() (same SLO shortcut keys)."""
+        with self._cond:
+            depth = self._depth
+            qmax = self._qdepth_max
+        d = {
+            "requests": self._requests,
+            "batches": self._batches,
+            "coalesce_ratio": (self._requests / self._batches
+                               if self._batches else 0.0),
+            "flushes": dict(self._flushes),
+            "errors": self._errors,
+            "depth": depth,
+            "qdepth_max": qmax,
+            "buckets_compiled": sorted(b for _, b in
+                                       self._compiled_buckets),
+            "latency_p50": self._h_e2e.percentile(0.50),
+            "latency_p99": self._h_e2e.percentile(0.99),
+            "queue_wait_p99": self._h_queue.percentile(0.99),
+            "queue_wait_max": (self._h_queue.vmax
+                               if self._h_queue.count else None),
+            "max_wait": self.max_wait,
+            "latency": self._h_e2e.to_dict(with_buckets=False),
+            "queue_wait": self._h_queue.to_dict(with_buckets=False),
+            "batch_rows": self._h_batch_rows.to_dict(with_buckets=False),
+        }
+        if self._registry is not None:
+            d["registry"] = self._registry.stats()
+        return d

@@ -93,6 +93,28 @@ def render() -> str:
     lines.append("lgbtpu_health_divergence_total %.9g"
                  % counts.get("numerics::divergence", 0.0))
 
+    # serving families (serving/): explicit zeros for the same reason —
+    # an alert on swap/refusal/deadline-flush rates must distinguish
+    # "no swaps yet" from "exporter gone"
+    lines.append("# TYPE lgbtpu_serving_total counter")
+    for kind, cname in (("requests", "serving::requests"),
+                        ("batches", "serving::batches"),
+                        ("coalesced", "serving::coalesced_requests"),
+                        ("flush_full", "serving::flush_full"),
+                        ("flush_deadline", "serving::flush_deadline"),
+                        ("flush_idle", "serving::flush_idle"),
+                        ("errors", "serving::request_errors")):
+        lines.append('lgbtpu_serving_total{kind="%s"} %.9g'
+                     % (kind, counts.get(cname, 0.0)))
+    lines.append("# TYPE lgbtpu_serving_model_total counter")
+    for kind, cname in (("load", "serving::model_load"),
+                        ("swap", "serving::swap"),
+                        ("rollback", "serving::rollback"),
+                        ("quant_admitted", "serving::quant_admitted"),
+                        ("quant_refused", "serving::quant_refused")):
+        lines.append('lgbtpu_serving_model_total{kind="%s"} %.9g'
+                     % (kind, counts.get(cname, 0.0)))
+
     lines.append("# TYPE lgbtpu_histo summary")
     lines.append("# TYPE lgbtpu_histo_dist histogram")
     lines.append("# TYPE lgbtpu_histo_saturated_total counter")

@@ -40,7 +40,10 @@ def test_pack_plan_and_storage_width():
     np.testing.assert_array_equal(logical, ds.binned.astype(np.int32))
 
 
-@pytest.mark.parametrize("force_partitioned", [False, True])
+@pytest.mark.parametrize("force_partitioned", [
+    False,
+    pytest.param(True, marks=pytest.mark.slow),  # tier-1 870s budget
+])
 def test_packed_model_identical(monkeypatch, force_partitioned):
     X, y = _narrow_wide_data()
     if force_partitioned:

@@ -158,6 +158,7 @@ def test_elastic_resume_parity(tmp_path):
     assert reshard.load_manifest(d)["world"] == 1
 
 
+@pytest.mark.slow  # tier-1 870s budget: cheaper sibling tests cover this area
 def test_same_mesh_kill_resume_through_driver(tmp_path):
     """The distributed driver's own kill/resume at world=1 (the
     degenerate mesh) stays bit-exact — the baseline the elastic path

@@ -63,6 +63,7 @@ def test_expo_bundle_fast_path_engages_and_matches_v1():
     assert abs(acc_p - acc_v) < 0.02, (acc_p, acc_v)
 
 
+@pytest.mark.slow  # tier-1 870s budget: profile --merge --run is covered in tier-1
 def test_profile_cli_expo_smoke(tmp_path):
     """`python -m lightgbm_tpu.profile --shape expo` runs tier-1-safe on
     CPU (xplane off) and writes a BENCH_phases.json-style snapshot with

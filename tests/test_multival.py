@@ -53,6 +53,7 @@ def test_forced_multival_matches_dense():
     np.testing.assert_allclose(b0.predict(X), b1.predict(X), atol=1e-4)
 
 
+@pytest.mark.slow  # tier-1 870s budget: cheaper sibling tests cover this area
 def test_forced_multival_matches_dense_regression_bundles():
     # EFB-bundled one-hot blocks + continuous features: sentinel groups
     # and single-feature groups both omit their default bins

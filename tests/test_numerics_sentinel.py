@@ -131,7 +131,10 @@ def test_margin_count_equals_splits_per_split_and_level():
             ({"num_leaves": 15}, False),
             ({"num_leaves": 16, "max_depth": 4}, True)):
         telemetry.reset()
-        b = _train_persist(dict(extra, min_data_in_leaf=5), 16)
+        # 16 iters engages the batched scan (K=16); 2000 rows is enough
+        # — the count==splits equality is exact at any size
+        b = _train_persist(dict(extra, min_data_in_leaf=5), 16,
+                           rows=2000)
         splits = sum(t.num_leaves - 1
                      for t in b._booster.models if t is not None)
         h = histo.get(health.MARGIN_HISTO)
@@ -144,7 +147,8 @@ def test_margin_count_equals_splits_per_split_and_level():
 
 
 def test_numerics_stats_off_disables_accumulation():
-    _train_persist({"num_leaves": 7, "tpu_numerics_stats": "off"}, 16)
+    _train_persist({"num_leaves": 7, "tpu_numerics_stats": "off"}, 16,
+                   rows=2000)
     assert histo.get(health.MARGIN_HISTO) is None
     counts = events.counts_snapshot()
     assert not any(k.startswith("numerics::nan") for k in counts)
@@ -176,7 +180,11 @@ def test_flush_overhead_under_2_percent():
     """The numerics sentinel's ONLY host-side cost is the finalize
     flush — pinned like the checkpoint write ceiling."""
     t0 = time.time()
-    _train_persist({"num_leaves": 15}, 16)
+    # same geometry as the margin-count run above: the scan program is
+    # already jit-cached, so the wall measured here is dominated by the
+    # iterations the flush accounts against, not a fresh compile
+    _train_persist({"num_leaves": 15, "min_data_in_leaf": 5}, 16,
+                   rows=2000)
     wall = time.time() - t0
     scopes = events.snapshot_full()
     flush_s, n, _ = scopes.get("numerics::flush", (0.0, 0, ""))

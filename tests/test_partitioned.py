@@ -46,7 +46,11 @@ def _grow_both(X, y, leaves, wc, cat_cols=()):
     return ds, le, a1, a2
 
 
-@pytest.mark.parametrize("wc,leaves", [(256, 31), (1024, 63), (256, 4)])
+@pytest.mark.parametrize("wc,leaves", [
+    (256, 31),
+    pytest.param(1024, 63,
+                 marks=pytest.mark.slow),  # tier-1 870s budget:
+    (256, 4)])                             # smaller variants stay
 def test_partitioned_matches_masked_numerical(wc, leaves):
     X, y = _make(4000)
     _, _, a1, a2 = _grow_both(X, y, leaves, wc)
@@ -75,6 +79,7 @@ def test_partitioned_row_leaf_is_consistent_partition():
         np.asarray(a2.leaf_count)[:int(a2.num_leaves)])
 
 
+@pytest.mark.slow  # tier-1 870s budget: cheaper sibling tests cover this area
 def test_partitioned_categorical_close():
     X, y = _make(4000, cats=True)
     _, _, a1, a2 = _grow_both(X, y, 63, 1024, cat_cols=[2])

@@ -1,0 +1,430 @@
+"""Async serving subsystem (lightgbm_tpu/serving/).
+
+Contracts under test:
+
+* continuous batching — coalesced and chunked requests reproduce the
+  sync path's RAW scores bit-for-bit (raw device scores are bit-exact
+  across batch shapes; the transformed sigmoid may differ by 1 ulp, so
+  bit-exact assertions here always use ``raw_score=True``);
+* deadline-aware flush — a lone sub-bucket request is flushed within
+  ``max_wait`` (pinned via the arrival-time queue-wait histogram), not
+  starved waiting for a full bucket;
+* atomic hot-swap — under concurrent load, every answered request is
+  EXACTLY one model's output (never a mix), nothing is dropped, and
+  rollback restores bit-exact pre-swap scores (same predictor object);
+* quantized admission — f16 is certified against PREDICT_REL_BUDGET and
+  admitted; int8's certificate fails and the load is REFUSED with the
+  certificate named, leaving the old model serving.
+
+Feature values live on a coarse grid (k/4 for small integer k) so the
+f16 threshold snap cannot flip any decision — tree routing is identical
+between the native and quantized ensembles and only leaf precision
+differs.
+
+The three trained models are module-scoped (tier-1 wall-time budget):
+tests only predict through them and load them into registries — nothing
+mutates a shared Booster.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.serving import (AsyncBatchServer, ModelRegistry,
+                                  QuantRefusedError, ServingError)
+from lightgbm_tpu.telemetry import events
+
+
+@pytest.fixture
+def counters():
+    prev_mode = events.mode()
+    events.enable("timers")
+    events.reset()
+    yield events.counts_snapshot
+    events.reset()
+    if prev_mode == events.OFF:
+        events.disable()
+
+
+def _grid_data(seed=3, n=1500, nf=8):
+    """Coarse-grid features (k/4): f16 threshold snaps cannot reorder
+    any feature value around a split, so quantized trees route rows
+    identically and only leaf values carry quantization error."""
+    rng = np.random.default_rng(seed)
+    X = (rng.integers(0, 16, size=(n, nf)) / 4.0).astype(np.float64)
+    y = (X[:, 0] - X[:, 2] + 0.25 * X[:, 5] > 0.5).astype(float)
+    return X, y
+
+
+def _train(X, y, n_trees=12, seed=0, leaves=15):
+    params = {"objective": "binary", "num_leaves": leaves,
+              "verbosity": -1, "min_data_in_leaf": 5,
+              "feature_fraction": 0.9, "seed": seed,
+              "deterministic": True}
+    return lgb.train(dict(params), lgb.Dataset(X, y, params=params),
+                     n_trees, verbose_eval=False)
+
+
+@pytest.fixture(scope="module")
+def data():
+    return _grid_data()
+
+
+@pytest.fixture(scope="module")
+def model(data):
+    """Default 12-tree model + its raw reference scores."""
+    X, y = data
+    b = _train(X, y)
+    return b, b.predict(X, raw_score=True)
+
+
+@pytest.fixture(scope="module")
+def model_pair(data):
+    """Two distinguishable models for swap tests (+ raw references)."""
+    X, y = data
+    ba = _train(X, y, seed=1)
+    bb = _train(X, y, n_trees=20, seed=9)
+    ref_a = ba.predict(X, raw_score=True)
+    ref_b = bb.predict(X, raw_score=True)
+    # distinguishable (else "no mixed outputs" is vacuous)
+    assert not np.array_equal(ref_a, ref_b)
+    return (ba, ref_a), (bb, ref_b)
+
+
+# ---------------------------------------------------------------------
+# continuous batching
+
+
+def test_async_parity_single_request(data, model):
+    X, _ = data
+    b, ref_raw = model
+    with AsyncBatchServer(b._booster.device_predictor(),
+                          min_batch=256, max_batch=1024) as server:
+        np.testing.assert_array_equal(
+            server.predict(X[:300], raw_score=True), ref_raw[:300])
+        # transformed output: float-ulp level (batch-shape dependent)
+        np.testing.assert_allclose(server.predict(X[:300]),
+                                   b.predict(X[:300]),
+                                   rtol=0, atol=1e-12)
+
+
+def test_coalesces_queued_requests_into_one_batch(counters, data, model):
+    X, _ = data
+    b, ref_raw = model
+    server = AsyncBatchServer(b._booster.device_predictor(),
+                              min_batch=256, max_batch=1024)
+    # deterministic coalescing: all 8 requests are queued BEFORE the
+    # loop starts, so the first admit wave takes the whole prefix
+    futs = [(i, server.submit(X[i * 40:(i + 1) * 40], raw_score=True))
+            for i in range(8)]
+    server.start()
+    try:
+        for i, f in futs:
+            np.testing.assert_array_equal(
+                f.result(timeout=30), ref_raw[i * 40:(i + 1) * 40])
+    finally:
+        server.stop()
+    st = server.stats()
+    assert st["batches"] == 1, st
+    assert st["requests"] == 8
+    assert st["coalesce_ratio"] == 8.0
+    assert st["errors"] == 0
+    counts = counters()
+    assert counts.get("serving::batches", 0) == 1
+    assert counts.get("serving::coalesced_requests", 0) == 8
+
+
+def test_oversized_request_chunked_multi_part(data, model):
+    X, _ = data
+    b, ref_raw = model
+    with AsyncBatchServer(b._booster.device_predictor(),
+                          min_batch=64, max_batch=256) as server:
+        out = server.predict(X, raw_score=True)   # 1500 rows -> 6 parts
+    np.testing.assert_array_equal(out, ref_raw)
+
+
+def test_deadline_flush_lone_subbucket_request(data, model):
+    """A lone 32-row request (min bucket 256) must NOT starve: the
+    deadline branch flushes it within max_wait. Pinned on the
+    arrival-time queue-wait histogram: the wait shows the hold (the
+    request really was held for coalescing) but stays within the
+    budget plus scheduling slack."""
+    X, _ = data
+    b, ref_raw = model
+    max_wait_ms = 50.0
+    with AsyncBatchServer(b._booster.device_predictor(), min_batch=256,
+                          max_batch=1024,
+                          max_wait_ms=max_wait_ms) as server:
+        t0 = time.perf_counter()
+        out = server.predict(X[:32], raw_score=True)
+        e2e = time.perf_counter() - t0
+    np.testing.assert_array_equal(out, ref_raw[:32])
+    st = server.stats()
+    assert st["flushes"]["deadline"] >= 1, st["flushes"]
+    # held for (most of) the coalescing window...
+    assert st["queue_wait_max"] >= 0.5 * max_wait_ms / 1e3, st
+    # ...but flushed within the budget (+ generous scheduler slack)
+    assert st["queue_wait_max"] <= max_wait_ms / 1e3 + 0.3, st
+    assert e2e < 5.0
+
+
+def test_stop_drains_queue(data, model):
+    X, _ = data
+    b, _ = model
+    server = AsyncBatchServer(b._booster.device_predictor(),
+                              min_batch=256, max_batch=1024)
+    futs = [server.submit(X[i * 30:(i + 1) * 30]) for i in range(6)]
+    server.start()
+    server.stop()         # drain=True: every queued request answered
+    assert all(f.done() for f in futs)
+    ref = b.predict(X[:180])
+    for i, f in enumerate(futs):
+        np.testing.assert_allclose(f.result(), ref[i * 30:(i + 1) * 30],
+                                   rtol=0, atol=1e-12)
+    with pytest.raises(ServingError):
+        server.submit(X[:8])
+
+
+# ---------------------------------------------------------------------
+# hot-swap registry
+
+
+def test_registry_swap_rollback_bit_exact(counters, data, model_pair):
+    X, _ = data
+    (ba, ref_a), (bb, ref_b) = model_pair
+    reg = ModelRegistry()
+    reg.load("a", booster=ba)          # first load auto-activates
+    reg.load("b", booster=bb)          # loaded, NOT active
+    assert reg.active().name == "a"
+    pred_a = reg.resolve()
+    with AsyncBatchServer(reg, min_batch=64, max_batch=512) as server:
+        np.testing.assert_array_equal(
+            server.predict(X[:100], raw_score=True), ref_a[:100])
+        reg.swap("b")
+        np.testing.assert_array_equal(
+            server.predict(X[:100], raw_score=True), ref_b[:100])
+        reg.rollback()
+        # bit-exact: the rollback restores the SAME predictor object
+        assert reg.resolve() is pred_a
+        np.testing.assert_array_equal(
+            server.predict(X[:100], raw_score=True), ref_a[:100])
+    st = reg.stats()
+    assert st["active"] == "a" and st["previous"] == "b"
+    assert st["swaps"] == 3            # load-a activate, swap-b, rollback
+    counts = counters()
+    assert counts.get("serving::swap", 0) >= 3
+    assert counts.get("serving::rollback", 0) == 1
+    assert counts.get("serving::model_load", 0) == 2
+
+
+def test_hot_swap_under_load_no_mixed_outputs_no_drops(data, model_pair):
+    """Concurrent clients + repeated swaps: every answered request must
+    equal EXACTLY one model's raw output over its rows — a request that
+    mixed two models' trees would match neither — and every submitted
+    request is answered (zero drops)."""
+    X, _ = data
+    (ba, ref_a), (bb, ref_b) = model_pair
+    reg = ModelRegistry()
+    reg.load("a", booster=ba)
+    reg.load("b", booster=bb)
+    n_clients, per_client = 6, 15
+    results = [[] for _ in range(n_clients)]
+    errors = []
+    stop_swapping = threading.Event()
+
+    def client(ci, server, rng):
+        for _ in range(per_client):
+            k = int(rng.integers(5, 120))
+            i0 = int(rng.integers(0, len(X) - k))
+            try:
+                out = server.predict(X[i0:i0 + k], raw_score=True)
+                results[ci].append((i0, k, out))
+            except Exception as exc:   # noqa: BLE001 — recorded, failed
+                errors.append(exc)     # below with full context
+
+    def swapper(reg):
+        flip = True
+        while not stop_swapping.is_set():
+            reg.swap("b" if flip else "a")
+            flip = not flip
+            time.sleep(0.002)
+
+    with AsyncBatchServer(reg, min_batch=64, max_batch=1024,
+                          max_wait_ms=2.0) as server:
+        threads = [threading.Thread(
+            target=client,
+            args=(ci, server, np.random.default_rng(100 + ci)))
+            for ci in range(n_clients)]
+        sw = threading.Thread(target=swapper, args=(reg,))
+        for t in threads:
+            t.start()
+        sw.start()
+        for t in threads:
+            t.join()
+        stop_swapping.set()
+        sw.join()
+        st = server.stats()
+    assert errors == [], errors
+    # zero drops: every submitted request produced an answer
+    assert sum(len(r) for r in results) == n_clients * per_client
+    assert st["requests"] == n_clients * per_client
+    assert st["errors"] == 0 and st["depth"] == 0
+    for ci in range(n_clients):
+        for i0, k, out in results[ci]:
+            from_a = np.array_equal(out, ref_a[i0:i0 + k])
+            from_b = np.array_equal(out, ref_b[i0:i0 + k])
+            assert from_a or from_b, (
+                "request rows [%d:%d] matches NEITHER model bit-exactly "
+                "— a mixed-model batch" % (i0, i0 + k))
+
+
+def test_registry_load_sources_and_drop(tmp_path, data, model):
+    X, _ = data
+    b, ref = model
+    txt = b._booster.save_model_to_string()
+    reg = ModelRegistry()
+    reg.load("from_str", model_str=txt)
+    mf = tmp_path / "m.txt"
+    mf.write_text(txt)
+    reg.load("from_file", model_file=str(mf))
+    # checkpoint source: the resilience kind=model snapshot format
+    from lightgbm_tpu.resilience.checkpoint import CheckpointWriter
+    w = CheckpointWriter(str(tmp_path / "ckpt"), keep=2, cfg_hash="x")
+    path = w.write_model_text(txt, iteration=7)
+    reg.load("from_ckpt", checkpoint=path)
+    assert reg.names() == ["from_ckpt", "from_file", "from_str"]
+    for name in reg.names():
+        pred = reg.resolve(name)
+        with AsyncBatchServer(pred, min_batch=64,
+                              max_batch=512) as server:
+            np.testing.assert_array_equal(
+                server.predict(X[:64], raw_score=True), ref[:64])
+    with pytest.raises(ValueError):
+        reg.load("two", booster=b, model_str=txt)
+    with pytest.raises(RuntimeError):
+        reg.drop(reg.active().name)
+    reg.swap("from_file")
+    reg.drop("from_str")
+    assert "from_str" not in reg.names()
+
+
+# ---------------------------------------------------------------------
+# quantized ensembles
+
+
+def test_f16_quantized_admitted_and_within_budget(counters, data,
+                                                  model_pair):
+    from lightgbm_tpu.analysis.quant_audit import PREDICT_REL_BUDGET
+    X, _ = data
+    (bb, ref) = model_pair[1]          # the deeper 20-tree model
+    reg = ModelRegistry()
+    slot = reg.load("q", booster=bb, quant="f16")
+    assert slot.certificate is not None
+    assert slot.certificate["ok"]
+    assert slot.certificate["bound"] <= PREDICT_REL_BUDGET
+    with AsyncBatchServer(reg, min_batch=256, max_batch=1024) as server:
+        out = server.predict(X, raw_score=True)
+    # coarse-grid features: routing identical, leaf precision is the
+    # only error source. The certificate bounds each stored VALUE's
+    # relative error; end-to-end that bounds the summed score relative
+    # to the score SCALE (element-wise ratios diverge where opposing
+    # trees cancel to a near-zero raw score — not what is certified)
+    rel = float(np.max(np.abs(out - ref)) / np.max(np.abs(ref)))
+    assert rel <= PREDICT_REL_BUDGET, rel
+    assert counters().get("serving::quant_admitted", 0) == 1
+
+
+def test_int8_refused_names_certificate_old_model_serves(counters, data,
+                                                         model_pair):
+    X, _ = data
+    ba, ref_a = model_pair[0]
+    reg = ModelRegistry()
+    reg.load("a", booster=ba)
+    with AsyncBatchServer(reg, min_batch=64, max_batch=512) as server:
+        with pytest.raises(QuantRefusedError,
+                           match="leaf_int8") as ei:
+            reg.load("crushed", booster=ba, quant="int8",
+                     activate=True)
+        assert ei.value.certificate["ok"] is False
+        # the refused load left the registry untouched: old model
+        # active and still serving bit-exact
+        assert reg.active().name == "a"
+        assert "crushed" not in reg.names()
+        np.testing.assert_array_equal(
+            server.predict(X[:80], raw_score=True), ref_a[:80])
+    assert counters().get("serving::quant_refused", 0) == 1
+    with pytest.raises(QuantRefusedError,
+                       match="unknown quantization target"):
+        reg.load("x", booster=ba, quant="int4")
+
+
+# ---------------------------------------------------------------------
+# satellites: sync-server qdepth, lint scope, audit domains
+
+
+def test_batchserver_qdepth_sampled_at_admission(data, model):
+    from lightgbm_tpu.predict import BatchServer
+    X, _ = data
+    b, _ = model
+    server = BatchServer(b._booster.device_predictor(), min_batch=64,
+                         max_batch=512)
+    barrier = threading.Barrier(3)
+
+    def one():
+        barrier.wait()
+        server.predict(X[:64])
+
+    threads = [threading.Thread(target=one) for _ in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    st = server.stats()
+    # admission-time sampling: 3 concurrent requests were all admitted
+    # before any finished, so the max depth must see the pile-up (the
+    # old post-serve sampling always read back ~1)
+    assert st["qdepth_max"] >= 2, st["qdepth_max"]
+    assert st["queue_depth"]["count"] == 3
+    server.predict(X[:64])
+    assert server.stats()["qdepth_max"] >= 2   # max is sticky
+
+
+def test_jg002_scope_covers_serving():
+    from lightgbm_tpu.analysis.config import GraftlintConfig
+    cfg = GraftlintConfig()
+    assert any("serving" in p for p in cfg.hot_paths), cfg.hot_paths
+    # and the serving loop passes its own lint: no lexical host sync
+    # in the service loop (the deliberate per-batch sync lives in
+    # helper methods)
+    import io
+    import os
+    from lightgbm_tpu.analysis.lint import lint_source
+    src_path = os.path.join(os.path.dirname(lgb.__file__),
+                            "serving", "server.py")
+    with io.open(src_path, "r", encoding="utf-8") as f:
+        findings = lint_source(f.read(),
+                               relpath="lightgbm_tpu/serving/server.py",
+                               config=cfg)
+    assert [f for f in findings if f.rule == "JG002"] == []
+
+
+def test_compile_audit_serving_domains():
+    from lightgbm_tpu.analysis import compile_audit
+    assert "lightgbm_tpu/serving" in compile_audit.AUDIT_ROOTS
+    assert "quant_target" in compile_audit.DOMAINS
+    assert "raw_score" in compile_audit.DOMAINS
+    from lightgbm_tpu.analysis.config import GraftlintConfig
+    surf = compile_audit.compile_surface()
+    assert surf["serving_ladder_per_slot"] >= 1
+    assert surf["serving_ladder_per_slot"] == surf["serve_ladder_bound"]
+    ceiling = int(getattr(GraftlintConfig(), "compile_ceiling", 64))
+    assert surf["total_bound"] <= ceiling
+
+
+def test_prom_export_serving_families_explicit_zero():
+    from lightgbm_tpu.telemetry import promexport
+    text = promexport.render()
+    assert 'lgbtpu_serving_total{kind="requests"}' in text
+    assert 'lgbtpu_serving_model_total{kind="quant_refused"}' in text
