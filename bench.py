@@ -327,6 +327,10 @@ def main():
                 expo["level_fallback_splits"]
             result["expo_level_launches_per_tree"] = \
                 expo["level_launches_per_tree"]
+        if "launches_per_iter" in expo:
+            # fused-iteration phase key (PR 17): device launches per
+            # boosting iteration — the whole-iteration fusion target
+            result["launches_per_iter"] = expo["launches_per_iter"]
         print(json.dumps(result), flush=True)
         print("# Expo-like EFB-bundled (%d groups for %d features): rows=%d "
               "iters=%d train=%.1fs -> %.2fM row-iters/s, vs anchor "
@@ -677,6 +681,13 @@ def run_expo():
         out["level_launches_per_tree"] = round(
             (out["level_programs"] + out["level_fallback_splits"])
             / max(trees, 1), 2)
+        # fused-iteration pin: compiled-program launches the training
+        # loop dispatched per boosting iteration (scan-driver programs +
+        # score-delta applies; k-batched gbdt amortizes to ~1/k). LOWER
+        # is better — the whole-iteration fusion headline
+        out["launches_per_iter"] = round(
+            counts.get("tree_learner::iter_launches", 0)
+            / max(n_iters, 1), 3)
     return out
 
 

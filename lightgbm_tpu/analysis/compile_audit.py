@@ -70,6 +70,19 @@ DOMAINS: Dict[str, Tuple[int, str]] = {
              "snapshot alignment; bounded by the batch ladder"),
     "quant": (1, "one certified HistQuant (or None) per learner — "
                  "resolved from tpu_hist_quant at config time"),
+    # fused boosting iteration (PR 17): the scan-driver factory caches
+    # one compiled program per (mode, objective-kernel id, k,
+    # bag_spec) — `mode` and the kernel id are factory-closure axes
+    # today, but registering them here makes the compile cost of any
+    # future static-arg promotion a reviewed decision, and bounds the
+    # per-learner driver-cache fan-out the same way
+    "mode": (2, "driver program family: {gbdt, rf} (dart rides gbdt "
+                "k=1 programs)"),
+    "grad_kernel": (1, "one objective per learner -> one device "
+                       "gradient kernel per driver cache"),
+    "cls": (4, "DART delta gather-add compiles once per class id it "
+               "touches; bounded by num_class (1 for the audited "
+               "binary/regression surface, small for multiclass)"),
     # serving static args (serving/ rides predict's jitted entry points;
     # these bound any future serving-local jit site the same way)
     "quant_target": (2, "serving value grids: native + the certified "

@@ -390,6 +390,111 @@ def audit_serve_ladder() -> AuditResult:
                        detail="; ".join(problems))
 
 
+def build_fused_iteration_programs():
+    """Trace the fused boosting-iteration drivers (PR 17) on a toy
+    binary dataset: the gbdt k-batch scan and the RF variant, both as
+    unjitted bodies (``wrap_jit=False`` — the jaxpr walk needs the
+    scan structure, not the launch wrapper), plus the lowered-IR
+    donation witness for the jitted gbdt driver (the payload carry
+    must alias input to output or every batch pays a full payload
+    copy). Built once per process through ``precision_audit._memo``
+    so transfer_audit walks the SAME traces. Returns
+    ``{"programs": [(name, ClosedJaxpr), ...], "donated": bool}``."""
+    import warnings
+
+    from ..config import Config
+    from ..data.dataset import BinnedDataset
+    from ..objectives.base import create_objective
+    from ..ops.grow_persist import (build_assets, make_persist_grower,
+                                    make_scan_driver)
+    from ..treelearner.serial import SerialTreeLearner
+
+    rng = np.random.RandomState(7)
+    n, F, k = 256, 6, 2
+    X = rng.rand(n, F)
+    y = (rng.rand(n) > 0.5).astype(np.float64)
+    cfg = Config({"objective": "binary", "num_leaves": 7,
+                  "max_bin": 63, "verbosity": -1})
+    ds = BinnedDataset.from_matrix(X, cfg, label=y)
+    learner = SerialTreeLearner(cfg, ds)
+    obj = create_objective("binary", cfg)
+    obj.init(ds.metadata, ds.num_data)
+    # score64: the off-TPU trace carries the v1-parity f64 score
+    # emulation — the mode DART/RF bit-exactness rides on
+    assets = build_assets(ds, ds.metadata.label, score64=True)
+    gr = make_persist_grower(assets, learner.meta, learner.grow_config,
+                             kernel_impl="xla")
+    gmode, gfn = obj.device_gradients()
+    gc = learner.grow_config
+    pay = gr.init_carry(jnp.asarray(assets.pay0),
+                        jnp.zeros((n,), jnp.float64))
+    fmasks = jnp.ones((k, gc.num_features), bool)
+    iters = jnp.arange(k, dtype=jnp.int32)
+    run = make_scan_driver(gr, gc, k, gfn, grad_mode=gmode,
+                           wrap_jit=False)
+    gbdt_args = (pay, fmasks, jnp.zeros((k, 2), jnp.uint32), iters,
+                 learner.params, jnp.asarray(0.1, jnp.float64), ())
+    run_rf = make_scan_driver(gr, gc, k, gfn, mode="rf",
+                              wrap_jit=False)
+    t = jnp.arange(k, dtype=jnp.float64)
+    closed_r = jax.make_jaxpr(run_rf)(
+        pay, fmasks, jnp.ones((k, n), jnp.float32),
+        jnp.stack([t, 1.0 / (t + 1.0)], axis=1), iters,
+        learner.params, jnp.asarray(0.25, jnp.float64))
+    with warnings.catch_warnings():
+        # CPU warns about donated buffers it cannot honor; the audit
+        # reads the IR, not the backend support
+        warnings.simplefilter("ignore")
+        # one trace serves both the jaxpr walk and the donation
+        # witness in the lowered IR
+        traced = jax.jit(run, donate_argnums=(0,)).trace(*gbdt_args)
+        closed_g = traced.jaxpr
+        txt = traced.lower().as_text()
+    donated = ("tf.aliasing_output" in txt) or ("jax.buffer_donor" in txt)
+    # the fixture only traces the drivers — no stats ever accumulate —
+    # but the flush discipline the health audit pins still applies to
+    # the owner of any driver site, and on an untrained learner this is
+    # an immediate no-op
+    learner.flush_level_stats()
+    return {"programs": [("fused_iter_gbdt", closed_g),
+                         ("fused_iter_rf", closed_r)],
+            "donated": donated}
+
+
+def audit_fused_iteration() -> AuditResult:
+    """The whole-iteration fused programs (PR 17): the objectives'
+    device gradient kernels must trace strictly f64-free in the
+    persist-f32 contract; the gbdt and RF k-iteration drivers must
+    keep their scan bodies free of host callbacks/transfers (tree
+    boundaries never leave the device); and the jitted gbdt driver
+    must witness payload donation in the lowered IR (the carry
+    aliasing the whole fast path leans on). The driver traces run the
+    score64 emulation, so the f64 ban applies to the standalone
+    gradient kernels — the only new math the fusion moved on-device —
+    not the (deliberately) widened score rows."""
+    from . import precision_audit as pa
+    name = "fused_iteration"
+    problems: List[str] = []
+    for gname, closed, _rng, _bless in pa._memo(
+            "fused_grads", pa._fused_grad_programs):
+        r = _audit_jaxpr(gname, closed, strict_f64=True)
+        if not r.ok:
+            problems.append("%s: %s" % (gname, r.detail))
+    art = pa._memo("fused_drivers", build_fused_iteration_programs)
+    for dname, closed in art["programs"]:
+        loops = find_host_prims_in_loops(closed.jaxpr)
+        if loops:
+            problems.append(
+                "%s: host/transfer primitives inside the iteration "
+                "scan: %s" % (dname, ", ".join(sorted(set(loops)))))
+    if not art["donated"]:
+        problems.append("fused_iter_gbdt: donation produced no payload "
+                        "input-output aliasing in the lowered IR "
+                        "(every batch would copy the payload)")
+    return AuditResult(name=name, ok=not problems,
+                       detail="; ".join(problems[:3]))
+
+
 def build_custom_jvp_f64_fixture():
     """The satellite regression fixture: an f64 constant closed over
     inside a ``jax.custom_jvp`` body, narrowed to f32 before use — no
@@ -433,6 +538,7 @@ AUDITS: Tuple[Callable[[], AuditResult], ...] = (
     audit_predict_traversal,
     audit_predict_donation,
     audit_serve_ladder,
+    audit_fused_iteration,
 )
 
 

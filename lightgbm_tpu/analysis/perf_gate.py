@@ -82,6 +82,11 @@ HIGHER_BETTER = (
 LOWER_BETTER = (
     "predict_p50", "predict_p99", "checkpoint_overhead_frac",
     "expo_level_launches_per_tree",
+    # fused boosting iteration (PR 17): device launches per boosting
+    # iteration (tree_learner::iter_launches / iters) — the fusion
+    # target the whole-iteration program exists to shrink (gbdt lands
+    # at 1/k for k-iteration scan batches)
+    "launches_per_iter",
     # estimated histogram-exchange bytes actually shipped per run
     # (collective::dcn_hist_bytes) — the payload the quantized wire
     # format exists to shrink
@@ -109,7 +114,11 @@ MEASUREMENT_CONDITIONAL = ("margin_p01",
                            # queue depth exists only when the open-loop
                            # phases run (BENCH_SKIP_PREDICT/SERVING
                            # skip them without a crash)
-                           "predict_qdepth")
+                           "predict_qdepth",
+                           # launch accounting reads the telemetry
+                           # counter snapshot, so a BENCH_TELEMETRY=0
+                           # round omits it without the phase crashing
+                           "launches_per_iter")
 
 # per-key minimum noise bands: bucket-quantized keys can only move in
 # layout-growth steps. margin_p01 is a quantile of the 2.0-growth

@@ -113,6 +113,57 @@ def _predict_program():
              NARROW_OK)]
 
 
+def _fused_grad_programs():
+    """The fused boosting iteration's device gradient kernels (PR 17)
+    traced in the persist-f32 payload contract: f32 score/label rows
+    in, (grad, hess) out — binary and regression in 'payload' mode,
+    multiclass softmax in the K-class snapshot mode. Input ranges
+    mirror ops/grow_persist.persist_input_contract (scores bounded by
+    the boosting trajectory, labels by their encoding); the strict
+    f64-free check on the same traces lives in
+    jaxpr_audit.audit_fused_iteration."""
+    from types import SimpleNamespace
+
+    import numpy as np
+
+    from ..config import Config
+    from ..objectives.base import create_objective
+
+    f32 = jnp.float32
+    vec = jax.ShapeDtypeStruct((128,), f32)
+    score_rng = (-256.0, 256.0)
+    progs = []
+    lab = np.asarray([0.0, 1.0] * 8, np.float32)
+    meta = SimpleNamespace(label=lab, weight=None)
+
+    obj_b = create_objective("binary", Config(
+        {"objective": "binary", "verbosity": -1}))
+    obj_b.init(meta, len(lab))
+    _mode, fn_b = obj_b.device_gradients()
+    progs.append(("fused_grad_binary", jax.make_jaxpr(fn_b)(vec, vec),
+                  {0: score_rng, 1: (0.0, 1.0)}, ()))
+
+    obj_r = create_objective("regression", Config(
+        {"objective": "regression", "verbosity": -1}))
+    obj_r.init(SimpleNamespace(label=np.zeros(16, np.float32),
+                               weight=None), 16)
+    _mode, fn_r = obj_r.device_gradients()
+    progs.append(("fused_grad_regression",
+                  jax.make_jaxpr(fn_r)(vec, vec),
+                  {0: score_rng, 1: score_rng}, ()))
+
+    obj_m = create_objective("multiclass", Config(
+        {"objective": "multiclass", "num_class": 3, "verbosity": -1}))
+    obj_m.init(SimpleNamespace(
+        label=(np.arange(16) % 3).astype(np.float32), weight=None), 16)
+    _mode, fn_m = obj_m.device_gradients()
+    progs.append(("fused_grad_multiclass",
+                  jax.make_jaxpr(lambda s, l: fn_m(s, l, 1))(
+                      jax.ShapeDtypeStruct((3, 128), f32), vec),
+                  {0: score_rng, 1: (0.0, 2.0)}, ()))
+    return progs
+
+
 def _tie_flip_program():
     """The seeded true-positive: split gains computed in f64, narrowed
     to f32 BEFORE the argmax — the exact tie-flip geometry.  The
@@ -186,6 +237,7 @@ def _programs(include_seeded: bool) -> List[Tuple]:
         progs += _memo("hist_prologue", _hist_prologue)
         progs += _memo("scan_pair", _scan_pair_program)
     progs += _memo("predict", _predict_program)
+    progs += _memo("fused_grads", _fused_grad_programs)
     if include_seeded:
         progs += _tie_flip_program()
     return progs

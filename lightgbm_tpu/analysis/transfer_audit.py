@@ -89,6 +89,18 @@ def _shared_programs() -> List[Tuple[str, object]]:
     return [(name, closed) for name, closed, _rng, _bless in progs]
 
 
+def _fused_iteration_programs() -> List[Tuple[str, object]]:
+    """The whole-iteration persist drivers (PR 17) — gbdt k-batch scan
+    and the RF variant, same memoized traces as
+    jaxpr_audit.audit_fused_iteration: a transfer anywhere between
+    tree boundaries is a per-batch host stall on the fused fast
+    path."""
+    from .jaxpr_audit import build_fused_iteration_programs
+    art = precision_audit._memo("fused_drivers",
+                                build_fused_iteration_programs)
+    return list(art["programs"])
+
+
 # fixture programs ----------------------------------------------------------
 
 def _callback_in_scan():
@@ -148,7 +160,8 @@ def _violations(name: str, closed,
 
 
 def compute_artifact(config: Optional[GraftlintConfig] = None) -> dict:
-    programs = _persist_programs() + _shared_programs()
+    programs = _persist_programs() + _shared_programs() \
+        + _fused_iteration_programs()
     violations: List[str] = []
     for name, closed in programs:
         violations += _violations(name, closed)

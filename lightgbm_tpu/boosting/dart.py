@@ -29,9 +29,37 @@ class DART(GBDT):
         Log.info("Using DART")
 
     def _fast_path_ok(self) -> bool:
-        # DART mutates past trees every iteration (drop + renormalize);
-        # the async pipeline cannot defer their materialization
-        return False
+        # DART mutates past trees every iteration (drop + renormalize),
+        # so the generic async pipeline cannot defer materialization —
+        # but on the persist driver the iteration still fuses: trees
+        # materialize eagerly (k=1 batches), drop/normalize deltas land
+        # on the payload carry as device gather-adds, and the gradient
+        # fill reads the post-drop scores inside the compiled program
+        learner = self.tree_learner
+        return (super()._fast_path_ok()
+                and getattr(learner, "can_persist_scan", None) is not None
+                and learner.can_persist_scan(self.objective))
+
+    def _train_one_iter_fast(self) -> bool:
+        # drops need every past tree materialized (predict_binned), and
+        # they must land BEFORE the fused program's gradient fill reads
+        # the payload scores (GetTrainingScore override, dart.hpp:78-86)
+        self._materialize_pending()
+        self._dropping_trees()
+        return self._train_multi_iter_fast(1)
+
+    def _add_score_delta(self, values, tree_id: int) -> None:
+        """Route a drop/normalize score delta to wherever the train
+        scores LIVE: the payload carry when the fused path holds one
+        (device gather-add, no host round trip), the row-ordered
+        ScoreUpdater otherwise. Both are one f64 add per row, so the
+        two routes are bit-identical."""
+        learner = self.tree_learner
+        if getattr(learner, "_persist_carry", None) is not None:
+            learner.persist_add_score_delta(values, tree_id)
+            self._persist_scores_dirty = True
+        else:
+            self.train_score.add_score_np(values, tree_id)
 
     def _compute_gradients(self):
         # drop trees before gradients are taken (GetTrainingScore override,
@@ -69,7 +97,7 @@ class DART(GBDT):
     def _subtract_tree(self, model_idx: int, tree_id: int) -> None:
         tree = self.models[model_idx]
         tree.shrink(-1.0)
-        self.train_score.add_score_np(
+        self._add_score_delta(
             tree.predict_binned(self.train_data), tree_id)
 
     def _dropping_trees(self) -> None:
@@ -125,14 +153,14 @@ class DART(GBDT):
                     for su in self.valid_score:
                         su.add_tree(tree, t)
                     tree.shrink(-k)
-                    self.train_score.add_score_np(
+                    self._add_score_delta(
                         tree.predict_binned(self.train_data), t)
                 else:
                     tree.shrink(self.shrinkage_rate)
                     for su in self.valid_score:
                         su.add_tree(tree, t)
                     tree.shrink(-k / cfg.learning_rate)
-                    self.train_score.add_score_np(
+                    self._add_score_delta(
                         tree.predict_binned(self.train_data), t)
             if not cfg.uniform_drop:
                 j = i - self.num_init_iteration

@@ -63,7 +63,8 @@ class GBDT:
         self.best_score_by_metric: Dict[str, float] = {}
         self.evals_output: List[tuple] = []   # (iter, dataset, name, value)
         self._pending: List[tuple] = []       # async fast-path device trees
-        self._pending_batches: List[tuple] = []  # (start_pos, stacked, shrink)
+        # (start_pos, stacked, shrink, init0s, mode) — mode 'gbdt'|'rf'
+        self._pending_batches: List[tuple] = []
         # engine sets allow_batch when no before-iteration callbacks/evals
         # exist; then K iterations fuse into one jitted lax.scan dispatch
         self.allow_batch = False
@@ -439,7 +440,7 @@ class GBDT:
             self.train_score._score[0] = scoreK
         start = len(self.models)
         self._pending_batches.append((start, stacked, self.shrinkage_rate,
-                                      init0s))
+                                      init0s, "gbdt"))
         self.models.extend([None] * (k * ntpi))
         self.iter += k
         self._batch_credit = k - 1
@@ -572,7 +573,7 @@ class GBDT:
 
         # batch-scan entries are already stacked on device: one transfer
         ntpi = self.num_tree_per_iteration
-        for start, stacked, shrink, init0s in self._pending_batches:
+        for start, stacked, shrink, init0s, bmode in self._pending_batches:
             if not isinstance(init0s, tuple):
                 init0s = (init0s,)
             host_b = get_packed(stacked)
@@ -582,12 +583,19 @@ class GBDT:
                 ha = jax.tree.map(lambda a, i=i: a[i], host_b)
                 tree = Tree.from_grower(ha, self.train_data)
                 if tree.num_leaves > 1:
-                    tree.shrink(shrink)
-                    if i < ntpi and abs(init0s[cls]) > K_EPSILON:
-                        tree.add_bias(init0s[cls])
+                    if bmode == "rf":
+                        # rf.hpp:103-160: no shrinkage, EVERY tree gets
+                        # the constant init-score bias (the device dance
+                        # already folded it into the payload scores)
+                        if abs(init0s[cls]) > K_EPSILON:
+                            tree.add_bias(init0s[cls])
+                    else:
+                        tree.shrink(shrink)
+                        if i < ntpi and abs(init0s[cls]) > K_EPSILON:
+                            tree.add_bias(init0s[cls])
                 else:
                     tree = Tree(1)
-                    if start + i < ntpi:
+                    if bmode != "rf" and start + i < ntpi:
                         # reference keeps the iteration-0 constant tree at
                         # the boosted-from-average output (gbdt.cpp:396-411)
                         tree.leaf_value[0] = init0s[cls]

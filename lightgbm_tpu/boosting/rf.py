@@ -19,7 +19,9 @@ from .gbdt import GBDT, K_EPSILON
 
 class RF(GBDT):
 
-    supports_batch = False  # per-iteration host work (drop/sample RNG)
+    # RF batches through the persist driver's rf mode: the per-iteration
+    # host work (bag RNG) ships as traced [k, n] weight vectors
+    supports_batch = True
     sub_model_name = "tree"   # reference RF still writes "tree"
     average_output = True
 
@@ -46,10 +48,79 @@ class RF(GBDT):
             g, h = self.objective.get_gradients(score)
         self._rf_grad = (g, h)
 
+    # -- fused device path (ops/grow_persist rf driver mode) -----------
+    def _fast_path_ok(self) -> bool:
+        """RF rides the persist driver when the whole iteration fits the
+        compiled rf program: constant-init-score gradient kernel
+        (payload fill contract), host-RNG bag masks as traced weight
+        vectors, and the running-average dance inside the scan. The
+        1-leaf guard in apply_scores_avg skips the dance exactly like
+        the host mid-run stub path, so an init-score FILE (whose
+        contributions the host's score *= 0 at iteration 0 would zero)
+        is the one configuration routed back to the host loop."""
+        from ..treelearner.serial import SerialTreeLearner
+        learner = self.tree_learner
+        return (super()._fast_path_ok()
+                and self.num_tree_per_iteration == 1
+                and not self.train_score.has_init_score
+                and type(learner) is SerialTreeLearner
+                and getattr(learner, "can_persist_scan", None) is not None
+                and learner.can_persist_scan(self.objective)
+                and self.objective.persist_grad_mode() == "payload")
+
+    def _train_one_iter_fast(self) -> bool:
+        # every k lands on the rf driver — the generic v1 fallback would
+        # boost from average and shrink, neither of which RF does
+        if self._batch_credit > 0:
+            self._batch_credit -= 1
+            return False
+        return self._train_multi_iter_fast(max(self._batch_size(), 1))
+
+    def _train_multi_iter_fast(self, k: int) -> bool:
+        learner = self.tree_learner
+        fmasks = jnp.asarray(
+            np.stack([learner.col_sampler.sample() for _ in range(k)]))
+        masks, ts = [], []
+        for j in range(k):
+            # the HOST bag RNG, consumed in the host path's exact order:
+            # the masks ride into the compiled program as per-iteration
+            # weight vectors, so device and host paths draw identical
+            # bags (bit-exact parity, unlike the hash-keyed device bags)
+            self.bagging(self.iter + j)
+            masks.append(np.asarray(self._bag_mask_dev))
+            ts.append(float(self.iter + j + self.num_init_iteration))
+        bagw = np.stack(masks).astype(np.float32)
+        tvec = np.asarray(ts, np.float64)
+        aux = np.stack([tvec, 1.0 / (tvec + 1.0)], axis=1)
+        if getattr(learner, "_persist_carry", None) is None:
+            score0 = self.train_score.score_device(0)
+        else:
+            score0 = None
+        stacked = learner.train_arrays_scan_persist_rf(
+            self.objective, score0, fmasks, bagw, aux,
+            float(self.init_scores[0]), k)
+        self._persist_scores_dirty = True
+        start = len(self.models)
+        self._pending_batches.append(
+            (start, stacked, 1.0, (float(self.init_scores[0]),), "rf"))
+        self.models.extend([None] * k)
+        self.iter += k
+        self._batch_credit = k - 1
+        return False
+
+    def _truncate_if_stopped(self) -> None:
+        # a 1-leaf tree is NOT a stop for RF: the reference appends a
+        # constant stub and keeps sampling (rf.hpp:145-155)
+        return
+
     def train_one_iter(self, gradients=None, hessians=None) -> bool:
         if gradients is not None or hessians is not None:
             Log.fatal("RF mode does not support custom objective functions")
         self._invalidate_predictors()
+        if self._fast_path_ok():
+            self._rounds_done += 1
+            return self._train_one_iter_fast()
+        self._materialize_pending()
         self.bagging(self.iter)
         g_dev, h_dev = self._rf_grad
         bag_mask = self._bag_mask_dev

@@ -6,6 +6,16 @@ array (the reference keeps a flat double buffer); the fast AddScore path —
 adding leaf outputs through the tree learner's partition without
 re-predicting (score_updater.hpp:84-99) — becomes a device gather of
 leaf_values[row_leaf]. Validation sets use the binned inner tree walk.
+
+Fused-iteration note (PR 17): while a persist-driver carry is live, the
+AUTHORITATIVE training scores are the payload's score rows inside the
+tree learner's scan carry — this cache only re-materializes them at
+carry finalize (persist_finalize_scores) or through the delta router
+(DART's _add_score_delta applies drop/normalize deltas to the carry via
+persist_add_score_delta, bit-compatible with add_score_np on the f64
+score64 rows). Reading score_host()/score_device() mid-carry without a
+materialize returns the pre-batch snapshot, which is exactly what the
+boosting loop's host fallbacks expect.
 """
 from __future__ import annotations
 

@@ -61,10 +61,35 @@ class ObjectiveFunction:
     def is_renew_tree_output(self) -> bool:
         return False
 
-    # objectives whose gradients need fresh per-iteration host inputs
-    # (e.g. rank_xendcg's randomization) opt out of the fused K-iteration
-    # device scan, whose traced inputs are fixed for the whole batch
-    supports_fused_scan = True
+    def device_gradients(self):
+        """THE capability surface for the fused boosting scan: the
+        device-side gradient kernel as (mode, fn), or None when this
+        objective is host-only. mode selects the scan driver's fill
+        contract — 'payload' (label-only, fastest; also the
+        K-tree-per-iteration snapshot fill), 'pos' (payload-order with
+        row-id scatter, lambdarank), 'row' (full row-order round trip
+        through the objective's standard grad_fn). Objectives whose
+        gradients need fresh per-iteration HOST inputs (rank_xendcg's
+        randomization) override this to return None — the traced
+        inputs of the compiled K-iteration program are fixed for the
+        whole batch. `supports_fused_scan` derives from this; the two
+        flags are one surface."""
+        if getattr(self, "num_model_per_iteration", 1) > 1:
+            fn = self.payload_grad_fn_multi()
+            return ("payload", fn) if fn is not None else None
+        fn = self.payload_grad_fn()
+        if fn is not None:
+            return ("payload", fn)
+        fn = self.payload_pos_fn()
+        if fn is not None:
+            return ("pos", fn)
+        return ("row", self.grad_fn())
+
+    @property
+    def supports_fused_scan(self) -> bool:
+        """Derived view of device_gradients() — kept for the booster's
+        batch gate; never override this, override device_gradients."""
+        return self.device_gradients() is not None
 
     @property
     def average_output(self) -> bool:
@@ -106,16 +131,11 @@ class ObjectiveFunction:
         return None
 
     def persist_grad_mode(self) -> str:
-        """Which gradient mode the persist scan driver should use:
-        'payload' (label-only, fastest), 'pos' (payload-order with row-id
-        scatter), or 'row' (full row-order round trip)."""
-        if getattr(self, "num_model_per_iteration", 1) > 1:
-            return "payload" if self.payload_grad_fn_multi() else "row"
-        if self.payload_grad_fn() is not None:
-            return "payload"
-        if self.payload_pos_fn() is not None:
-            return "pos"
-        return "row"
+        """Which gradient mode the persist scan driver should use —
+        a view of device_gradients(); 'row' for host-only objectives
+        (they never reach the driver, can_persist_scan gates them)."""
+        dg = self.device_gradients()
+        return dg[0] if dg is not None else "row"
 
     def persist_grad_args(self) -> tuple:
         """Extra traced args for the persist driver's gradient fill,

@@ -64,10 +64,16 @@ class BinaryLogloss(ObjectiveFunction):
             if not need_train:
                 z = jnp.zeros_like(score)
                 return z, z
-            # dtype-following ±1: a dtype-defaulted select is f64 under
-            # x64 and would drag persist-path f32 grads through f64
-            y = jnp.where(pos_mask, 1.0, -1.0).astype(score.dtype)
-            lw = jnp.where(pos_mask, w_pos, w_neg)
+            # dtype-following ±1 and weights: python-float select
+            # branches materialize a weak f64 under x64 (narrowed back
+            # at the next multiply — same bits, since ±1 is exact and
+            # the weights round identically either way — but the
+            # persist-f32 audit rightly refuses f64 intermediates in
+            # the device gradient kernel)
+            y = jnp.where(pos_mask, jnp.asarray(1.0, score.dtype),
+                          jnp.asarray(-1.0, score.dtype))
+            lw = jnp.where(pos_mask, jnp.asarray(w_pos, score.dtype),
+                           jnp.asarray(w_neg, score.dtype))
             response = -y * sig / (1.0 + jnp.exp(y * sig * score))
             abs_resp = jnp.abs(response)
             g = response * lw

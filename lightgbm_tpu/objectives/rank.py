@@ -330,9 +330,11 @@ class LambdarankNDCG(RankingObjective):
 class RankXENDCG(RankingObjective):
     name = "rank_xendcg"
 
-    # per-iteration fresh randomization cannot ride the fused K-iteration
-    # scan (its traced inputs are fixed across the batch)
-    supports_fused_scan = False
+    def device_gradients(self):
+        # per-iteration fresh randomization cannot ride the fused
+        # K-iteration scan (its traced inputs are fixed across the
+        # batch): host-only, on the ONE capability surface
+        return None
 
     # the reference's LCG (include/LightGBM/utils/random.h:101-110):
     # x = 214013 x + 2531011 (mod 2^32); NextFloat = ((x>>16) & 0x7fff)/2^15

@@ -305,6 +305,14 @@ class RegressionMAPELoss(RegressionL1Loss):
             return g, weight
         return fn
 
+    def payload_grad_fn(self):
+        # the label-only payload contract cannot carry label_weight
+        # (and the inherited L2 wrapper would call the 4-arg grad_fn
+        # with 3 args — a trace-time crash); MAPE's device capability
+        # is the row-order kernel, which device_gradients picks up
+        # through grad_fn/_grad_args
+        return None
+
     def _grad_args(self):
         label, weight = super()._grad_args()
         return (label, weight, jnp.asarray(self.label_weight))

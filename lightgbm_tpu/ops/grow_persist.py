@@ -75,14 +75,18 @@ def _f32r(row):
 EXACT_F32_ROWS = 1 << 24
 
 # device stats vector the scan driver returns: [level_programs,
-# level_fallback_splits] + the numerics health vector (NaN-grad/NaN-hess/
-# Inf-hist counts + the split-margin histogram buckets —
-# telemetry/health.py owns the layout). Carried through the scan as i32
-# and flushed ONCE at finalize (serial.flush_level_stats); the health
-# tail is all-zero when the grower is built with health=False
-# (tpu_numerics_stats=off).
-STAT_LEVELS, STAT_FALLBACK = 0, 1
-STATS_LEN = 2 + HEALTH_LEN
+# level_fallback_splits, iter_launches] + the numerics health vector
+# (NaN-grad/NaN-hess/Inf-hist counts + the split-margin histogram
+# buckets — telemetry/health.py owns the layout). iter_launches counts
+# the compiled-program launches the fused boosting path dispatched (one
+# per scan-driver invocation + one per payload score-delta apply) — the
+# numerator of the launches_per_iter bench key. Carried through the
+# scan as i32 and flushed ONCE at finalize (serial.flush_level_stats);
+# the health tail is all-zero when the grower is built with
+# health=False (tpu_numerics_stats=off).
+STAT_LEVELS, STAT_FALLBACK, STAT_ITER_LAUNCH = 0, 1, 2
+STAT_HEALTH0 = 3
+STATS_LEN = STAT_HEALTH0 + HEALTH_LEN
 
 # deepest max_depth the level-parallel phase takes on: the frontier-slot
 # matrices are sized 2^(max_depth-1) and the no-bind certificate's
@@ -1673,8 +1677,11 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
                 health=hv)
 
         final = jax.lax.while_loop(cond, body, state)
+        # the iter-launch slot is the DRIVER's (one bump per compiled
+        # program invocation, not per tree) — grow leaves it zero
         stats = jnp.concatenate(
-            [jnp.stack([final.levels, final.s - s_after_level]),
+            [jnp.stack([final.levels, final.s - s_after_level,
+                        jnp.zeros((), I32)]),
              final.health])
         return (final.pay, final.lstate, final.tree, final.s, root_out,
                 stats)
@@ -1764,6 +1771,76 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
         sc = _read_score(pay, cls)
         sc = sc + jnp.where(num_leaves > 1, cum, 0.0)
         return _write_score(pay, sc, cls)
+
+    def apply_scores_avg(pay, lstate, num_leaves, t, inv, bias, cls=0):
+        """RF running-average score update (rf.hpp:103-160) fused into
+        the scan: the host sequence is score *= t; score +=
+        (leaf_value + bias)[leaf_of_position]; score *= 1/(t+1), with
+        `bias` (the constant init score) folded into the gathered leaf
+        value exactly as the host's tree.add_bias mutates the tree
+        BEFORE its leaf gather — one f64 add, then the same two
+        multiplies and one add per row as the three ScoreUpdater
+        dispatches it replaces. 1-leaf trees leave the average
+        untouched (the reference appends a stub and keeps going)."""
+        starts = lstate[:, LS_START]
+        nrows = lstate[:, LS_NROWS]
+        live = (nrows > 0) & (jnp.arange(L, dtype=I32) < num_leaves)
+        vals = lstate[:, LS_VAL]
+        # host add_bias only fires for |init| > eps; skip the +0.0 too
+        # so a -0.0 leaf keeps its sign exactly like the host path
+        vals = jnp.where(bias != 0.0, vals + bias.astype(ST), vals)
+        key = jnp.where(live, starts, jnp.inf)
+        order = jnp.argsort(key)
+        sstart = key[order]
+        svals = vals[order]
+        slive = live[order]
+        pos = jnp.arange(NP, dtype=I32).astype(ST)
+        idx = jnp.clip(jnp.searchsorted(sstart, pos, side="right") - 1,
+                       0, L - 1)
+        upd = jnp.where(slive[idx], svals[idx], 0.0)
+        sc = _read_score(pay, cls)
+        sc2 = ((sc * t.astype(sc.dtype) + upd.astype(sc.dtype))
+               * inv.astype(sc.dtype))
+        sc = jnp.where(num_leaves > 1, sc2, sc)
+        return _write_score(pay, sc, cls)
+
+    def _rid_pos(pay):
+        """(shard-local row id, live mask) for row-order <-> payload-order
+        gathers; dead lanes carry the total-row sentinel."""
+        rid = pay[nbw + 1].astype(I32)
+        if axis_name is not None:
+            rid = rid - jax.lax.axis_index(axis_name).astype(I32) * n
+        live = jnp.arange(NP, dtype=I32) < n
+        return jnp.minimum(rid, n - 1), live
+
+    def add_score_delta(pay, delta_row, cls=0):
+        """Class `cls` score row += a host-computed ROW-ordered delta
+        ([n], f64), gathered through the rid row — ONE add per row in
+        the payload score dtype, the exact ScoreUpdater.add_score_np
+        contract, so DART's drop/normalize deltas land bit-identically
+        on the payload carry (widened mode) instead of forcing the
+        scores off-device between trees."""
+        idx, live = _rid_pos(pay)
+        sc = _read_score(pay, cls)
+        d = jnp.where(live, delta_row.astype(sc.dtype)[idx], 0.0)
+        return _write_score(pay, sc + d, cls)
+
+    def apply_row_weights(pay, w_row):
+        """Multiply the payload grad/hess rows by a host-computed
+        per-row weight vector in ROW order ([n] f32; RF's host-RNG bag
+        masks, per-iteration mode weights), gathered through the rid
+        row. Returns (pay', in-bag count) — the same contract as the
+        device bag transforms (make_bag_transform), so the grow call
+        wires identically. f32(g) * m equals f32(g * m) for the 0/1
+        masks this carries, keeping host-path bit parity."""
+        idx, live = _rid_pos(pay)
+        w = jnp.where(live, w_row.astype(F32)[idx], 0.0)
+        g = _f32r(pay[grad_row]) * w
+        h = _f32r(pay[grad_row + 1]) * w
+        gh = jax.lax.bitcast_convert_type(jnp.stack([g, h]), U32)
+        pay = jax.lax.dynamic_update_slice(
+            pay, gh, (jnp.asarray(grad_row, I32), jnp.asarray(0, I32)))
+        return pay, jnp.sum((w > 0).astype(F32))
 
     def _write_grads(pay, g, h):
         live = jnp.arange(NP, dtype=I32) < n
@@ -1862,6 +1939,20 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
         g, h = _apply_weight(g, h, pay)
         return _write_grads(pay, g, h)
 
+    def fill_grad_const(pay, payload_grad_fn, c):
+        """RF gradient fill: the reference computes gradients ONCE from
+        the constant init score (rf.hpp:81-101), never from the running
+        average the score rows hold — broadcast the traced scalar as
+        the score vector and run the objective's device kernel on it,
+        leaving the live payload scores untouched. Elementwise in
+        (score, label), so payload order reproduces the host's
+        row-order gradients bit for bit."""
+        label = jax.lax.bitcast_convert_type(pay[nbw], F32)
+        score = jnp.full((NP,), c, dtype=SDT)
+        g, h = payload_grad_fn(score, label)
+        g, h = _apply_weight(g, h, pay)
+        return _write_grads(pay, g, h)
+
     def finalize_scores(pay):
         """Payload-order scores -> row order (one scatter per batch);
         [n] for one class, [K, n] for multiclass. Row ids are global;
@@ -1948,6 +2039,10 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
     gr.fill_grad_pos = fill_grad_pos
     gr.fill_grad_row = fill_grad_row
     gr.fill_grad_multi = fill_grad_multi
+    gr.fill_grad_const = fill_grad_const
+    gr.apply_scores_avg = apply_scores_avg
+    gr.apply_row_weights = apply_row_weights
+    gr.add_score_delta = add_score_delta
     gr.snapshot_scores = snapshot_scores
     gr.finalize_scores = finalize_scores
     gr.set_scores = set_scores
@@ -1976,7 +2071,8 @@ def make_persist_grower(assets: PersistAssets, meta, gc,
 
 
 def make_scan_driver(gr, gc, k: int, grad_fn, grad_mode: str = "payload",
-                     wrap_jit: bool = True, bag_fn=None):
+                     wrap_jit: bool = True, bag_fn=None,
+                     mode: str = "gbdt"):
     """K fused boosting iterations over the persistent payload.
 
     grad_fn is baked statically; grad_mode selects its contract:
@@ -1987,14 +2083,24 @@ def make_scan_driver(gr, gc, k: int, grad_fn, grad_mode: str = "payload",
     through the rid row. Returns fn(pay, fmasks [k, F], wkeys [k, 2]u32,
     iters [k]i32, params, shrink, gargs) -> (pay', stacked TreeArrays,
     stats [STATS_LEN] i32 = summed [level_programs,
-    level_fallback_splits] + the numerics health vector (NaN/Inf
-    counts + split-margin buckets, telemetry/health layout) over the
-    batch — the learner converts them to telemetry counters/histograms
-    at finalize time, keeping the dispatch fully async).
+    level_fallback_splits, iter_launches] + the numerics health vector
+    (NaN/Inf counts + split-margin buckets, telemetry/health layout)
+    over the batch — the learner converts them to telemetry
+    counters/histograms at finalize time, keeping the dispatch fully
+    async).
 
     bag_fn: optional make_bag_transform closure run between the gradient
     fill and the grow (bagging masks / GOSS weights applied to the payload
     grad rows; its in-bag count feeds the root statistics).
+
+    mode='rf' compiles the random-forest iteration instead: gradients
+    from the constant init score (fill_grad_const), host-RNG bag masks
+    applied as traced per-iteration [n] weight vectors, and the
+    running-average score dance (apply_scores_avg) riding the scan —
+    signature run(pay, fmasks [k, F], bagw [k, n] f32, aux [k, 2] f64
+    = (total_iter, 1/(total_iter+1)), iters [k]i32, params, bias) with
+    `bias` the objective's constant init score. Serial-learner only
+    (the booster gates it).
 
     wrap_jit=False returns the untraced body for callers that wrap it
     themselves (the sharded learner puts it under shard_map and jits with
@@ -2010,8 +2116,35 @@ def make_scan_driver(gr, gc, k: int, grad_fn, grad_mode: str = "payload",
         if not use_health:
             return stats
         gh2 = gr.grad_health(pay)
-        return stats.at[2 + H_NAN_GRAD].add(gh2[0]) \
-                    .at[2 + H_NAN_HESS].add(gh2[1])
+        return stats.at[STAT_HEALTH0 + H_NAN_GRAD].add(gh2[0]) \
+                    .at[STAT_HEALTH0 + H_NAN_HESS].add(gh2[1])
+
+    def run_rf(pay, fmasks, bagw, aux, iters, params, bias):
+        def body(pay, per):
+            fmask, w_row, ax, it = per
+            pay = gr.fill_grad_const(pay, grad_fn, bias)
+            gh2 = gr.grad_health(pay) if use_health else None
+            pay, bag_cnt = gr.apply_row_weights(pay, w_row)
+            pay, lstate, tree, nl, _root, stats = gr.grow(
+                pay, params, fmask, bag_cnt=bag_cnt, it=it)
+            if gh2 is not None:
+                stats = stats.at[STAT_HEALTH0 + H_NAN_GRAD].add(gh2[0]) \
+                             .at[STAT_HEALTH0 + H_NAN_HESS].add(gh2[1])
+            pay = gr.apply_scores_avg(pay, lstate, nl, ax[0], ax[1], bias)
+            out = gr.to_tree_arrays(lstate, tree, nl)
+            return pay, (out, stats)
+        payK, (stacked, stats_k) = jax.lax.scan(
+            body, pay, (fmasks, bagw, aux, iters), length=k)
+        stats = jnp.sum(stats_k, axis=0).at[STAT_ITER_LAUNCH].add(1)
+        return payK, stacked, stats
+
+    if mode == "rf":
+        if wrap_jit:
+            return telemetry.launch_wrapper(
+                jax.jit(run_rf, donate_argnums=(0,)),
+                "ops::persist_scan(launch)", category="ops",
+                histogram="ops::persist_program_wall", k=k)
+        return run_rf
 
     def run(pay, fmasks, wkeys, iters, params, shrink, gargs):
         def body(pay, per):
@@ -2055,8 +2188,8 @@ def make_scan_driver(gr, gc, k: int, grad_fn, grad_mode: str = "payload",
             pay, lstate, tree, nl, _root, stats = gr.grow(
                 pay, params, fmask, bag_cnt=bag_cnt, it=it)
             if gh2 is not None:
-                stats = stats.at[2 + H_NAN_GRAD].add(gh2[0]) \
-                             .at[2 + H_NAN_HESS].add(gh2[1])
+                stats = stats.at[STAT_HEALTH0 + H_NAN_GRAD].add(gh2[0]) \
+                             .at[STAT_HEALTH0 + H_NAN_HESS].add(gh2[1])
             pay = gr.apply_scores(pay, lstate, nl, shrink)
             out = gr.to_tree_arrays(lstate, tree, nl)
             return pay, (out, stats)
@@ -2068,7 +2201,7 @@ def make_scan_driver(gr, gc, k: int, grad_fn, grad_mode: str = "payload",
             stacked = jax.tree.map(
                 lambda a: a.reshape((a.shape[0] * a.shape[1],)
                                     + a.shape[2:]), stacked)
-        stats = jnp.sum(stats_k, axis=0)
+        stats = jnp.sum(stats_k, axis=0).at[STAT_ITER_LAUNCH].add(1)
         if use_health and getattr(gr, "axis_name", None) is not None:
             # the gradient probe counted shard-LOCAL rows; one tiny psum
             # per BATCH keeps the replicated stats output replicated.
@@ -2077,11 +2210,14 @@ def make_scan_driver(gr, gc, k: int, grad_fn, grad_mode: str = "payload",
             # but VOTING keeps its histogram planes shard-local, so
             # there the inf_hist slot is local too and must ride the
             # same psum (an Inf on one shard's plane would otherwise be
-            # silently dropped by the replicated out-spec)
-            hi = (2 + NUM_HEALTH if getattr(gr, "voting", False)
-                  else 2 + H_INF_HIST)
-            part = jax.lax.psum(stats[2:hi], gr.axis_name)
-            stats = stats.at[2:hi].set(part)
+            # silently dropped by the replicated out-spec). The
+            # iter-launch slot stays OUT of the psum: every shard bumps
+            # it identically, so it is already replicated
+            hi = (STAT_HEALTH0 + NUM_HEALTH
+                  if getattr(gr, "voting", False)
+                  else STAT_HEALTH0 + H_INF_HIST)
+            part = jax.lax.psum(stats[STAT_HEALTH0:hi], gr.axis_name)
+            stats = stats.at[STAT_HEALTH0:hi].set(part)
         return payK, stacked, stats
 
     if wrap_jit:

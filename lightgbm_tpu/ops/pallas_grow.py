@@ -16,6 +16,16 @@ Mosaic kernels over a single transposed payload matrix:
      row  nbw+3      hessian   (f32 bitcast)
      row  nbw+4      score     (f32 bitcast; permutes WITH the rows, so the
                                 boosting state follows the partition)
+     optional tail rows (grow_persist.payload_weight_row is the index
+     authority): u32-pair f64 scores in score64 mode, a per-class score +
+     snapshot block for multiclass (K > 1), and a sample-weight row.
+     The fused boosting iteration (PR 17) also multiplies per-tree
+     RF bagging weights into the grad/hess rows between the gradient
+     fill and the grow (traced [n] vectors gathered through the rid
+     row, grow_persist.apply_row_weights) — so bagged iterations ride
+     these SAME kernels with zero extra launches
+     (tree_learner::iter_launches counts whole-driver dispatches,
+     not trees).
 
   * split_pass (one call per split, DYNAMIC grid over chunks): streams the
     splitting leaf's contiguous payload segment once, and per chunk

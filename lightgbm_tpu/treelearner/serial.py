@@ -465,10 +465,11 @@ class SerialTreeLearner:
         return self.dataset.num_data < (1 << 31) - (1 << 16)
 
     def _persist_obj_ok(self, objective) -> bool:
-        if getattr(objective, "num_model_per_iteration", 1) > 1:
-            return objective.payload_grad_fn_multi() is not None
-        return (objective.payload_grad_fn() is not None
-                or getattr(objective, "supports_fused_scan", False))
+        """ONE capability probe: the objective's device_gradients()
+        surface (objectives/base.py) decides fused-scan eligibility —
+        None means host-only (fresh per-iteration inputs)."""
+        dg = getattr(objective, "device_gradients", None)
+        return dg is not None and dg() is not None
 
     def persist_bag_ok(self, bag_spec) -> bool:
         """Which device-side bag transforms this learner's persist path
@@ -493,6 +494,17 @@ class SerialTreeLearner:
         opt = str(getattr(self.config, "tpu_persist_scan", "auto")).lower()
         if opt in ("false", "0", "off"):
             return False
+        if (opt == "force" and objective is not None
+                and not self._persist_obj_ok(objective)):
+            # the config REQUESTED the fused path; refuse loudly instead
+            # of silently training on the v1 host path (the two would
+            # diverge in launch count and, for quantized modes, in bits)
+            Log.fatal(
+                "tpu_persist_scan=force: objective '%s' has no device "
+                "gradient kernel (device_gradients() is None — it needs "
+                "fresh per-iteration host inputs); drop the force or "
+                "pick a fused-scan-capable objective"
+                % getattr(objective, "name", type(objective).__name__))
         if opt != "force":
             if not (HAS_PALLAS
                     and jax.default_backend() in ("tpu", "axon")):
@@ -568,7 +580,8 @@ class SerialTreeLearner:
             kernel_impl = "xla"
         return kernel_impl, interpret, kernel_impl == "xla"
 
-    def _persist_cached(self, objective, k: int, bag_spec=("none",)):
+    def _persist_cached(self, objective, k: int, bag_spec=("none",),
+                        mode: str = "gbdt"):
         from ..ops.grow_persist import (build_assets, make_bag_transform,
                                         make_persist_grower,
                                         make_scan_driver)
@@ -589,7 +602,12 @@ class SerialTreeLearner:
                                   num_scores=K, use_weight_row=use_w_row,
                                   score64=score64)
             cache[akey] = assets
-        stat_from_scan = bag_spec[0] != "none"
+        # RF bags through per-iteration weight vectors (apply_row_weights)
+        # rather than a bag_spec, but the count semantics are the same:
+        # out-of-bag rows still ride the payload segments, so leaf counts
+        # must come from the hessian-derived scan recovery, not the
+        # geometric partition counts
+        stat_from_scan = bag_spec[0] != "none" or mode == "rf"
         gkey = ("grower", K, use_w_row, self.grow_config,
                 stat_from_scan, kernel_impl, level_mode, health)
         gr = cache.get(gkey)
@@ -606,23 +624,23 @@ class SerialTreeLearner:
             cache[gkey] = gr
         dkey = ("driver", K, use_w_row, k, self.grow_config,
                 objective.static_fingerprint(), bag_spec, kernel_impl,
-                level_mode, health)
+                level_mode, health, mode)
         driver = cache.get(dkey)
         if driver is None:
             bag_fn = (make_bag_transform(bag_spec, assets.geometry)
                       if stat_from_scan else None)
-            if K > 1:
-                driver = make_scan_driver(gr, self.grow_config, k,
-                                          objective.payload_grad_fn_multi(),
+            # the objective's ONE capability surface hands the driver
+            # both the fill contract and the kernel
+            gmode, gfn = objective.device_gradients()
+            if mode == "rf":
+                driver = make_scan_driver(gr, self.grow_config, k, gfn,
+                                          mode="rf")
+            elif K > 1:
+                driver = make_scan_driver(gr, self.grow_config, k, gfn,
                                           bag_fn=bag_fn)
             else:
-                mode = objective.persist_grad_mode()
-                fns = {"payload": objective.payload_grad_fn,
-                       "pos": objective.payload_pos_fn,
-                       "row": objective.grad_fn}
-                driver = make_scan_driver(gr, self.grow_config, k,
-                                          fns[mode](), grad_mode=mode,
-                                          bag_fn=bag_fn)
+                driver = make_scan_driver(gr, self.grow_config, k, gfn,
+                                          grad_mode=gmode, bag_fn=bag_fn)
             cache[dkey] = driver
         return assets, gr, driver
 
@@ -659,6 +677,59 @@ class SerialTreeLearner:
         self._persist_gr = gr
         return stacked
 
+    @telemetry.timed("tree_learner::TrainScanPersistRF(launch)",
+                     category="tree_learner")
+    def train_arrays_scan_persist_rf(self, objective, score0, fmasks,
+                                     bagw, aux, bias: float, k: int):
+        """K random-forest iterations fused into one persist-driver
+        program: constant-init-score gradients, host-RNG bag masks as
+        traced [k, n] weight vectors, and the running-average score
+        dance all inside the scan (the RF half of the fused boosting
+        iteration). aux is [k, 2] f64 = (total_iter, 1/(total_iter+1));
+        bias is the objective's constant init score."""
+        telemetry.count("tree_learner::persist_scan_trees", float(k),
+                        category="tree_learner")
+        assets, gr, driver = self._persist_cached(objective, k,
+                                                  mode="rf")
+        pay = getattr(self, "_persist_carry", None)
+        if pay is None:
+            pay = gr.init_carry(assets.pay0, jnp.asarray(score0))
+        pay, stacked, stats = driver(pay, jnp.asarray(fmasks),
+                                     jnp.asarray(bagw, jnp.float32),
+                                     jnp.asarray(aux, jnp.float64),
+                                     jnp.arange(k, dtype=jnp.int32),
+                                     self.params,
+                                     jnp.asarray(bias, jnp.float64))
+        prev = getattr(self, "_level_stats_dev", None)
+        self._level_stats_dev = stats if prev is None else prev + stats
+        self._persist_pending_trees = (
+            getattr(self, "_persist_pending_trees", 0) + k)
+        self._persist_carry = pay
+        self._persist_gr = gr
+        return stacked
+
+    def persist_add_score_delta(self, values, cls: int = 0):
+        """Apply a host-computed row-ordered f64 score delta to the live
+        payload carry (DART's drop/normalize between fused iterations)
+        WITHOUT leaving the device: one gather-add program per call,
+        counted into the iter_launches stat. Caller guarantees a live
+        carry (boosting/dart.py routes through train_score otherwise)."""
+        import jax
+        from ..ops.grow_persist import STAT_ITER_LAUNCH, STATS_LEN
+        gr = self._persist_gr
+        fn = getattr(gr, "_add_delta_jit", None)
+        if fn is None:
+            fn = gr._add_delta_jit = jax.jit(
+                gr.add_score_delta, donate_argnums=(0,),
+                static_argnames=("cls",))
+        self._persist_carry = fn(self._persist_carry,
+                                 jnp.asarray(values, jnp.float64),
+                                 cls=cls)
+        st = getattr(self, "_level_stats_dev", None)
+        if st is None:
+            st = jnp.zeros((STATS_LEN,), jnp.int32)
+        self._level_stats_dev = st.at[STAT_ITER_LAUNCH].add(1)
+
     def flush_level_stats(self):
         """Convert the accumulated device-side stats (level-program
         counters + the numerics health vector) into telemetry counters
@@ -686,8 +757,14 @@ class SerialTreeLearner:
             if v[1]:
                 telemetry.count("tree_learner::level_fallback_splits",
                                 float(v[1]), category="tree_learner")
+            if v[2]:
+                # compiled-program launches the fused path dispatched
+                # (scan-driver invocations + DART score-delta applies):
+                # the launches_per_iter bench numerator
+                telemetry.count("tree_learner::iter_launches",
+                                float(v[2]), category="tree_learner")
             from ..telemetry import health as telemetry_health
-            telemetry_health.flush_device_stats(v[2:])
+            telemetry_health.flush_device_stats(v[3:])
             gr = getattr(self, "_persist_gr", None)
             if gr is not None and getattr(gr, "axis_name", None) \
                     is not None and trees:

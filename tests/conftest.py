@@ -13,8 +13,21 @@ os.environ["XLA_FLAGS"] = (
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-# no persistent compile cache on CPU: XLA:CPU AOT executable serialization
-# segfaults when the runtime host's ISA differs from the client build's
-# target features (jax compilation_cache.put_executable_and_time); the
-# cache only pays off for the slow remote-TPU compiles anyway
-jax.config.update("jax_compilation_cache_dir", None)
+# persistent compile cache, repo-local (gitignored). The old blanket
+# opt-out guarded against XLA:CPU AOT serialization segfaults when the
+# runtime host's ISA differs from the client build's target features —
+# a cross-host hazard that cannot occur on the same-host populate/
+# consume cycle the test suite actually runs, and the fused
+# whole-iteration programs (PR 17) push tier-1 compile time to where
+# warm repeat runs matter. LGBM_TPU_JAX_CACHE=0 restores the opt-out
+# (set it when shipping a populated cache dir across machines);
+# LGBM_TPU_JAX_CACHE=<dir> relocates the cache.
+_cache_dir = os.environ.get(
+    "LGBM_TPU_JAX_CACHE",
+    os.path.join(os.path.dirname(os.path.dirname(__file__)),
+                 ".cache", "jax"))
+if _cache_dir and _cache_dir != "0":
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+else:
+    jax.config.update("jax_compilation_cache_dir", None)

@@ -585,7 +585,7 @@ def test_audits_all_green():
         "hist_window_f32", "scan_pair_f32", "scan_blocks_f32",
         "persist_split_pass", "persist_level_pass",
         "predict_traversal_f32", "predict_donation",
-        "serve_ladder_bound"}
+        "serve_ladder_bound", "fused_iteration"}
     bad = {n: r.detail for n, r in results.items() if not r.ok}
     assert not bad, bad
 

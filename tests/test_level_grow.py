@@ -19,8 +19,10 @@ moment best-first admission could be budget-truncated. These tests pin:
     budget (num_leaves >= 2^max_depth) a tree costs <= max_depth level
     programs and ZERO per-split fallback launches, counter-pinned via
     tree_learner::level_programs / level_fallback_splits;
-  * DART/RF never take the persist driver (supports_batch=False), so the
-    flag must be a no-op there.
+  * DART and RF ride the persist driver too (PR 17: per-tree weight
+    vectors traced into the fused iteration program) — device vs host
+    paths are BIT-EXACT, pinned on bundled and unbundled shapes along
+    with the iter-launch counter the fusion exists to shrink.
 """
 import numpy as np
 import pytest
@@ -187,6 +189,12 @@ def test_expo_level_launches_per_tree_bounded():
     n_splits = sum(
         bst._booster.models[t].num_leaves - 1 for t in range(rounds))
     assert lv < n_splits, (lv, n_splits)
+    # the whole-iteration fusion (PR 17): 16 gbdt iterations batch into
+    # ceil(16/16) = 1 driver invocation — the iter-launch counter must
+    # show the amortization, not one launch per tree
+    il = c.get("tree_learner::iter_launches", 0)
+    assert 0 < il <= (rounds + 15) // 16 + 1, c
+    assert il < rounds, c
 
 
 # ---------------------------------------------------------------------------
@@ -220,24 +228,43 @@ def test_level_mosaic_kernels_interpret_match_emulation(monkeypatch):
 
 
 # ---------------------------------------------------------------------------
-# non-persist modes: the flag must be inert
+# DART / RF on the fused persist driver (PR 17)
 # ---------------------------------------------------------------------------
 
+def _trees_only(bst):
+    """Model string minus the parameters block (the two runs differ in
+    tpu_persist_scan by construction; the TREES must not)."""
+    return bst.model_to_string().split("\nparameters:")[0]
+
+
 @pytest.mark.slow
+@pytest.mark.parametrize("shape", ["higgs_unbundled", "expo_bundled"])
 @pytest.mark.parametrize("extra", [
     {"boosting": "dart", "drop_rate": 0.3},
     {"boosting": "rf", "bagging_freq": 1, "bagging_fraction": 0.7},
 ], ids=["dart", "rf"])
-def test_level_flag_inert_on_v1_modes(extra):
-    X, y = _higgs_small(3000)
+def test_dart_rf_device_host_parity(extra, shape):
+    """Pre-PR-17 these modes pinned the persist driver INERT
+    (supports_batch=False). Now DART's drop/normalize deltas and RF's
+    bagged-average iterations run inside the fused iteration program —
+    per-tree weight vectors computed host-side, applied as traced
+    vectors — and the device path must match the host path BIT-EXACTLY:
+    same trees (model string minus params) and same raw scores, on both
+    the EFB-bundled Expo shape and the unbundled HIGGS shape."""
+    if shape == "higgs_unbundled":
+        X, y = _higgs_small(3000)
+    else:
+        X, y = _expo_small(2048)
     base = {"objective": "binary", "num_leaves": 15, "max_depth": 4,
             "verbosity": -1, "min_data_in_leaf": 10, "max_bin": 63,
             "learning_rate": 0.2, **extra}
-    bst_a, c_a = _train_counted(base, X, y, rounds=8)
-    bst_b, _ = _train_counted({**base, "tpu_level_grow": "off"}, X, y,
-                              rounds=8)
-    # DART/RF run per-iteration host work (supports_batch=False), so the
-    # persist driver — and with it the level program — never engages
-    assert c_a.get("tree_learner::level_programs", 0) == 0, c_a
-    assert c_a.get("tree_learner::persist_scan_trees", 0) == 0, c_a
-    np.testing.assert_array_equal(_raw(bst_a, X), _raw(bst_b, X))
+    bst_dev, c_dev = _train_counted(
+        {**base, "tpu_persist_scan": "force"}, X, y, rounds=8)
+    bst_host, c_host = _train_counted(
+        {**base, "tpu_persist_scan": "off"}, X, y, rounds=8)
+    # positive device-path pins (replacing the old inert assertions)
+    assert c_dev.get("tree_learner::persist_scan_trees", 0) >= 8, c_dev
+    assert c_dev.get("tree_learner::iter_launches", 0) > 0, c_dev
+    assert c_host.get("tree_learner::persist_scan_trees", 0) == 0, c_host
+    assert _trees_only(bst_dev) == _trees_only(bst_host)
+    np.testing.assert_array_equal(_raw(bst_dev, X), _raw(bst_host, X))
