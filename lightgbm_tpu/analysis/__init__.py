@@ -6,7 +6,7 @@ tests/test_known_divergence.py) and recompile/host-sync hazards on the
 serving path — are invisible to pytest until they bite at scale. This
 package machine-checks them on every run:
 
-* :mod:`lint` — an AST rule engine (rules JG001-JG010, see
+* :mod:`lint` — an AST rule engine (rules JG001-JG012, see
   :mod:`rules`) scanning the package for JAX/TPU pitfalls specific to
   this codebase, with inline suppressions, a checked-in baseline for
   grandfathered findings, and an autofix mode (unused imports).
@@ -43,7 +43,14 @@ package machine-checks them on every run:
   intermediates in the persist/level/scan/predict programs;
   :mod:`quant_audit` statically bounds the split-gain / leaf-output
   error of the declared int8/int16/f16 quantization specs and ships
-  the ``quant_certificate`` artifact in ``--json``.
+  the ``quant_certificate`` artifact in ``--json``;
+  :mod:`concurrency_audit` discovers every thread root in the threaded
+  host layer (serving / predict-serve / resilience / telemetry),
+  infers per-site lock sets for all shared mutable state
+  (lint twins: JG011 unguarded mutation, JG012 blocking call under a
+  held lock), keeps the global lock-acquisition-order graph acyclic,
+  and ships the per-root abstract trace as ``concurrency_trace`` in
+  ``--json``.
 
 Gate: ``python -m lightgbm_tpu.analysis`` exits non-zero on any
 unsuppressed finding or failed audit; ``tests/test_analysis.py`` runs

@@ -26,8 +26,9 @@ import argparse
 import json
 import sys
 
-from . import (auditors, collective_audit, compile_audit, perf_gate,
-               quant_audit, resource_audit)
+from . import (auditors, collective_audit, compile_audit,
+               concurrency_audit, perf_gate, quant_audit,
+               resource_audit)
 from .config import load_config
 from . import jaxpr_audit
 from .jaxpr_audit import run_audits
@@ -202,6 +203,12 @@ def main(argv=None) -> int:
             payload["quant_certificate"] = \
                 quant_audit.certificate_payload(
                     config, artifact=art.get("quant_certify"))
+            # the abstract per-root concurrency trace: thread roots,
+            # the shared-site/lock-set table, the acquisition-order
+            # graph (the threaded host layer's analogue of
+            # collective_trace)
+            payload["concurrency_trace"] = concurrency_audit.extract_trace(
+                config, artifact=art.get("concurrency"))
         if perf_rep is not None:
             payload["perf_tables"] = perf_gate.tables(
                 config, artifact=perf_rep)

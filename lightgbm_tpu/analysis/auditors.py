@@ -1,6 +1,6 @@
 """Registry of the whole-program auditors behind the analysis gate.
 
-Seven source/program-level audit engines complement the jaxpr audits
+Eight source/program-level audit engines complement the jaxpr audits
 (:mod:`jaxpr_audit` traces real programs; these reason about the
 source/geometry/dataflow statically):
 
@@ -22,7 +22,11 @@ source/geometry/dataflow statically):
 * ``health_covered`` — every module that builds a persist/level scan
   driver must flush its device-side ``numerics::*`` health stats
   (:mod:`health_audit` — the runtime numerics sentinel's coverage
-  gate).
+  gate);
+* ``concurrency`` — lock discipline, blocking-hold, and acquisition
+  order for the threaded host layer (serving loop, registry hot-swap,
+  retry watchdog, telemetry registries), shipped as the ``--json``
+  ``concurrency_trace`` artifact (:mod:`concurrency_audit`).
 
 Each module exposes ``run(config) -> List[AuditResult]`` (the gate) and
 ``check_fixture(payload) -> List[str]`` (the seeded-violation hook the
@@ -33,9 +37,9 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional
 
-from . import (collective_audit, compile_audit, health_audit,
-               precision_audit, quant_audit, resource_audit,
-               transfer_audit)
+from . import (collective_audit, compile_audit, concurrency_audit,
+               health_audit, precision_audit, quant_audit,
+               resource_audit, transfer_audit)
 from .config import GraftlintConfig
 from .jaxpr_audit import AuditResult
 
@@ -47,6 +51,7 @@ AUDITORS: Dict[str, object] = {
     "transfer": transfer_audit,
     "quant_certify": quant_audit,
     "health_covered": health_audit,
+    "concurrency": concurrency_audit,
 }
 
 
@@ -72,6 +77,7 @@ def compute_artifacts(config: Optional[GraftlintConfig] = None
         "transfer": transfer_audit.compute_artifact(config),
         "quant_certify": quant_audit.compute_artifact(config),
         "health_covered": health_audit.compute_artifact(config),
+        "concurrency": concurrency_audit.compute_artifact(config),
     }
 
 

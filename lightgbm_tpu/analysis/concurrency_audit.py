@@ -1,0 +1,1103 @@
+"""Concurrency auditor: lock discipline for the threaded host layer.
+
+PRs 12 and 16 made the host layer genuinely multi-threaded — the
+``AsyncBatchServer`` condition-wait service loop with per-request
+futures, ``ModelRegistry`` hot-swap under live load, the straggler
+watchdog in ``resilience/retry.py``, and the process-global telemetry
+registries (``events.py`` counters, ``histo.py`` histograms, the
+``flight.py`` ring) fed from every one of those threads. This module
+statically certifies that layer the way ``collective_audit`` certifies
+DCN ordering: AST only — no threads are started, no devices touched.
+
+Three analyses per module in the configured ``concurrency_paths``:
+
+* **thread-root discovery** — every ``threading.Thread(target=...)`` /
+  ``Timer`` spawn, plus escaping callbacks (a function handed to
+  another call or installed by a decorator runs on whoever holds the
+  reference — the flight-recorder sinks, the atexit report), plus the
+  implicit ``main`` root (the module's public surface). Each root gets
+  a reachable-call-graph closure (the ``collective_audit`` fixpoint
+  idiom, one intra-module hop per edge).
+
+* **lock-discipline inference** — the shared mutable inventory is the
+  module-level mutables plus instance attributes of lock-owning
+  classes; every non-blessed write site must hold a consistent lock
+  set. Locks are tracked lexically (``with self._lock`` /
+  ``with _lock``) and through ONE call level (a helper whose every
+  call site holds L is analyzed as holding L — ``_swap_locked``).
+  Blessed without a lock: writes inside ``__init__`` (pre-publication),
+  single-reference publishes (a plain ``name = value`` rebind is one
+  atomic store under the GIL), the GIL-atomic method table
+  (``deque.append``/``popleft``, ``set.add``, ``list.append``,
+  ``dict.setdefault``, plain subscript stores), and sites carrying a
+  ``# guarded-by: <lock|root|GIL>`` annotation — the documented-
+  invariant escape hatch, validated against the module's lock and root
+  inventory so a typo is itself a finding. Everything else unguarded
+  is a finding (lint twin: rule JG011).
+
+* **blocking-hold + lock order** — a lock held across a blocking
+  operation (``time.sleep``, ``join``, a future ``.result()``, a
+  ``wait`` on a foreign object, device syncs like
+  ``block_until_ready``/``finalize_padded``, a retry-guarded
+  collective) serializes every thread behind a slow operation, or
+  deadlocks outright; each such site is a finding (lint twin: JG012;
+  ``Condition.wait`` on the very lock being held is the sanctioned
+  pattern and stays silent). Every lock acquisition nested inside
+  another contributes an edge to the global lock-acquisition-order
+  graph — including cross-module edges through the telemetry entry
+  points (``events.count`` takes the events lock, ``histo.observe``
+  the histo lock, ``flight.note`` the flight ring lock) — and that
+  graph must be cycle-free. A plain ``Lock`` re-acquired while already
+  held is reported as a self-deadlock.
+
+A module with no thread spawns and no lock objects is out of scope by
+construction — owning a lock or starting a thread is how code declares
+concurrent intent, and only declared-concurrent modules are audited.
+
+The per-root abstract trace (roots, shared-site table, lock-order
+edges) ships in the CLI's ``--json`` payload as ``concurrency_trace``,
+the way ``collective_trace`` does today. Counters:
+``analysis::concurrency_roots`` / ``shared_sites`` / ``unguarded`` /
+``hold_blocking``.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..telemetry import events as telemetry
+from .config import GraftlintConfig, load_config
+from .core import ModuleContext
+from .jaxpr_audit import AuditResult
+
+C_ROOTS = "analysis::concurrency_roots"
+C_SHARED = "analysis::shared_sites"
+C_UNGUARDED = "analysis::unguarded"
+C_HOLD = "analysis::hold_blocking"
+
+# threading spawn constructors -> which argument names the root callable
+_THREAD_CTORS = {"Thread": "target", "Timer": "function"}
+
+# lock-object constructors (threading.*); Condition wraps an RLock, so
+# re-entry through it is legal — only a plain Lock self-nests fatally
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore",
+               "BoundedSemaphore"}
+_REENTRANT_CTORS = {"RLock", "Condition"}
+
+# fallback: a with-context whose final attribute looks like a lock is
+# treated as one even when its constructor is out of sight (a lock
+# passed in as a parameter)
+_LOCK_NAME_RE = re.compile(r"(^|_)(lock|cond|condition|mutex)$", re.I)
+
+# mutating container/object methods (non-exhaustive on purpose: only
+# what the audited layer actually uses)
+_MUTATORS = {"append", "appendleft", "extend", "extendleft", "insert",
+             "remove", "pop", "popleft", "popitem", "clear", "update",
+             "setdefault", "add", "discard", "record", "merge", "sort",
+             "reverse"}
+
+# GIL-atomic single-bytecode mutations, blessed without a lock, keyed
+# by the container kind inferred from the defining assignment
+_ATOMIC_METHODS = {
+    "deque": {"append", "appendleft", "pop", "popleft", "clear"},
+    "set": {"add", "discard"},
+    "list": {"append"},
+    "dict": {"setdefault"},
+    "defaultdict": {"setdefault"},
+}
+
+# blocking operations by final attribute / callable name: holding a
+# lock across any of these is JG012. `wait` on the HELD lock itself
+# (Condition.wait releases it) is the sanctioned exception.
+_BLOCKING = {
+    "sleep": "time.sleep",
+    "join": "thread join",
+    "result": "future result",
+    "wait": "wait",
+    "acquire": "nested blocking acquire",
+    "block_until_ready": "device sync",
+    "device_wait": "device sync",
+    "finalize_padded": "device sync",
+    "predict_padded": "device sync",
+    "guard": "retry-guarded collective",
+    "process_allgather": "DCN collective",
+    "broadcast_one_to_all": "DCN collective",
+    "sync_global_devices": "DCN collective",
+}
+
+# cross-module lock identity of the telemetry entry points: calling one
+# of these while holding a lock contributes a lock-order edge into the
+# named module's registry lock
+_EXTERNAL_LOCKS = {
+    "telemetry.events": ("lightgbm_tpu/telemetry/events.py::_lock",
+                         {"count", "add", "scope", "record_iteration",
+                          "snapshot", "snapshot_full", "counts_snapshot",
+                          "category_totals", "events_snapshot", "reset",
+                          "clear_counts_prefix", "set_flight_sinks"}),
+    "telemetry.histo": ("lightgbm_tpu/telemetry/histo.py::_lock",
+                        {"observe", "merge_counts", "get",
+                         "histograms_snapshot", "saturation_total",
+                         "reset", "reset_prefix"}),
+    "telemetry.flight": ("lightgbm_tpu/telemetry/flight.py::_lock",
+                         {"note", "dump", "arm", "disarm", "reset",
+                          "snapshot"}),
+}
+
+#   x += 1          # guarded-by: ClassName._lock
+#   # guarded-by: GIL (single-writer: serving-loop)   (line above works)
+_GUARDED_BY_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z0-9_.\-]+)")
+
+
+@dataclass
+class ThreadRoot:
+    """One concurrent entry into a module: a spawned thread, an
+    escaping callback, or the implicit main (public-API) root."""
+
+    name: str                       # root label ("main", target qualname)
+    kind: str                       # thread | timer | callback | main
+    path: str
+    line: int                       # spawn/registration site (0 = main)
+    reach: Tuple[str, ...] = ()     # reachable function qualnames
+    cond_wait: bool = False         # reach contains a condition-wait loop
+
+    def to_dict(self) -> dict:
+        return {"name": self.name, "kind": self.kind, "path": self.path,
+                "line": self.line, "reach": sorted(self.reach),
+                "cond_wait": self.cond_wait}
+
+
+@dataclass
+class SharedSite:
+    """One write access to a piece of shared mutable state."""
+
+    obj: str                        # "_counts" | "AsyncBatchServer._depth"
+    path: str
+    line: int
+    func: str                       # enclosing function qualname
+    access: str                     # augassign | assign | subscript | ...
+    locks: Tuple[str, ...] = ()     # lock set held (incl. inherited)
+    blessed: str = ""               # "" | init | publish | atomic | guarded-by:<x>
+    roots: Tuple[str, ...] = ()     # roots reaching the enclosing func
+
+    def to_dict(self) -> dict:
+        return {"obj": self.obj, "path": self.path, "line": self.line,
+                "func": self.func, "access": self.access,
+                "locks": list(self.locks), "blessed": self.blessed,
+                "roots": list(self.roots)}
+
+
+@dataclass
+class ConcFinding:
+    """One lock-discipline / blocking-hold / lock-order hazard."""
+
+    rule: str                       # JG011 | JG012 | lock-order
+    path: str
+    line: int
+    func: str
+    message: str
+    node: Optional[ast.AST] = field(default=None, repr=False,
+                                    compare=False)
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "func": self.func, "message": self.message}
+
+
+class _ModuleConcurrency:
+    """Roots + shared-site table + findings for one parsed module."""
+
+    def __init__(self, ctx: ModuleContext):
+        self.ctx = ctx
+        self.roots: List[ThreadRoot] = []
+        self.shared: List[SharedSite] = []
+        self.findings: List[ConcFinding] = []
+        # lock-order edges: (outer lock id, inner lock id, line)
+        self.lock_edges: List[Tuple[str, str, int]] = []
+        self.locks: Dict[str, str] = {}       # lock id -> ctor name
+        self.concurrent = False
+        self._funcs: Dict[str, ast.AST] = {}  # qualname -> def node
+        self._func_of_node: Dict[ast.AST, str] = {}
+        self._calls: Dict[str, Set[str]] = {}
+        self._inherited: Dict[str, Set[str]] = {}
+        self._main_reach: Set[str] = set()
+        self._root_reach: Dict[str, Set[str]] = {}
+        self._globals: Dict[str, str] = {}    # name -> container kind
+        self._attr_kind: Dict[str, str] = {}  # "Cls.attr" -> kind
+        self._run()
+
+    # -- structure ------------------------------------------------------
+    def _qualname(self, node: ast.AST) -> str:
+        parts: List[str] = []
+        cur: Optional[ast.AST] = node
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef)):
+                parts.append(cur.name)
+            cur = self.ctx.parent.get(cur)
+        return ".".join(reversed(parts))
+
+    def _owner_class(self, node: ast.AST) -> Optional[str]:
+        cur = self.ctx.parent.get(node)
+        while cur is not None:
+            if isinstance(cur, ast.ClassDef):
+                return cur.name
+            cur = self.ctx.parent.get(cur)
+        return None
+
+    def _collect_functions(self) -> None:
+        for node in ast.walk(self.ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._funcs[self._qualname(node)] = node
+        for qn, fn in self._funcs.items():
+            for sub in ast.walk(fn):
+                if self.ctx.enclosing_function(sub) is fn:
+                    self._func_of_node[sub] = qn
+
+    def _enclosing_qualname(self, node: ast.AST) -> str:
+        fn = self.ctx.enclosing_function(node)
+        while isinstance(fn, ast.Lambda):
+            fn = self.ctx.enclosing_function(fn)
+        if fn is None:
+            return ""
+        return self._qualname(fn)
+
+    # -- locks ----------------------------------------------------------
+    def _ctor_leaf(self, value: ast.AST) -> Optional[str]:
+        if isinstance(value, ast.Call):
+            t = self.ctx.call_target(value)
+            if t is not None:
+                return t.split(".")[-1]
+        return None
+
+    def _collect_locks(self) -> None:
+        for node in ast.walk(self.ctx.tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            leaf = self._ctor_leaf(node.value)
+            if leaf not in _LOCK_CTORS:
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Name) \
+                        and self.ctx.parent.get(node) is self.ctx.tree:
+                    self.locks[t.id] = leaf
+                elif isinstance(t, ast.Attribute) \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id == "self":
+                    cls = self._owner_class(node)
+                    if cls is not None:
+                        self.locks["%s.%s" % (cls, t.attr)] = leaf
+
+    def _lock_of_expr(self, expr: ast.AST) -> Optional[str]:
+        """Canonical lock id of a with/wait context expression, or None
+        when it is not lock-shaped."""
+        d = self.ctx.dotted(expr)
+        if d is None:
+            return None
+        leaf = d.split(".")[-1]
+        if d.startswith("self."):
+            cls = self._owner_class(expr) or "?"
+            lid = "%s.%s" % (cls, d[len("self."):])
+        else:
+            lid = d
+        if lid in self.locks or _LOCK_NAME_RE.search(leaf):
+            return lid
+        return None
+
+    def _lexical_locks(self, node: ast.AST) -> List[Tuple[str, ast.With]]:
+        """Locks held lexically at `node` (innermost last), stopping at
+        the enclosing function boundary."""
+        held: List[Tuple[str, ast.With]] = []
+        cur = self.ctx.parent.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.With, ast.AsyncWith)):
+                for item in cur.items:
+                    lid = self._lock_of_expr(item.context_expr)
+                    if lid is not None:
+                        held.append((lid, cur))
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                break
+            cur = self.ctx.parent.get(cur)
+        held.reverse()
+        return held
+
+    def _locks_at(self, node: ast.AST) -> Set[str]:
+        held = {lid for lid, _ in self._lexical_locks(node)}
+        held |= self._inherited.get(self._enclosing_qualname(node), set())
+        return held
+
+    def _compute_inherited(self) -> None:
+        """One-call-level lock propagation: a module function whose
+        EVERY call site holds lock L is analyzed as holding L
+        (``_swap_locked``); functions never called intra-module (or
+        handed to a thread/callback) inherit nothing."""
+        sites: Dict[str, List[Set[str]]] = {}
+        for node in ast.walk(self.ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = self._resolve_callee(node)
+            if callee is None:
+                continue
+            sites.setdefault(callee, []).append(
+                {lid for lid, _ in self._lexical_locks(node)})
+        for qn, lock_sets in sites.items():
+            common = set.intersection(*lock_sets) if lock_sets else set()
+            if common:
+                self._inherited[qn] = common
+
+    # -- call graph -----------------------------------------------------
+    def _resolve_callee(self, call: ast.Call) -> Optional[str]:
+        """Qualname of a same-module callee: a bare name (preferring a
+        sibling nested def), or a ``self.m`` method of the enclosing
+        class."""
+        f = call.func
+        if isinstance(f, ast.Name):
+            enclosing = self._enclosing_qualname(call)
+            if enclosing:
+                nested = "%s.%s" % (enclosing, f.id)
+                if nested in self._funcs:
+                    return nested
+            if f.id in self._funcs:
+                return f.id
+            return None
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+                and f.value.id == "self":
+            cls = self._owner_class(call)
+            if cls is not None and "%s.%s" % (cls, f.attr) in self._funcs:
+                return "%s.%s" % (cls, f.attr)
+        return None
+
+    def _build_call_graph(self) -> None:
+        for qn, fn in self._funcs.items():
+            out: Set[str] = set()
+            for sub in ast.walk(fn):
+                if isinstance(sub, ast.Call) \
+                        and self._func_of_node.get(sub) == qn:
+                    callee = self._resolve_callee(sub)
+                    if callee is not None:
+                        out.add(callee)
+            self._calls[qn] = out
+
+    def _reach(self, start: str) -> Set[str]:
+        seen: Set[str] = set()
+        frontier = [start]
+        while frontier:
+            qn = frontier.pop()
+            if qn in seen or qn not in self._funcs:
+                continue
+            seen.add(qn)
+            frontier.extend(self._calls.get(qn, ()))
+        return seen
+
+    # -- roots ----------------------------------------------------------
+    def _resolve_func_ref(self, expr: ast.AST,
+                          at: ast.AST) -> Optional[str]:
+        """A Name/Attribute expression that references a same-module
+        function (``target=self._loop`` / ``target=run``)."""
+        if isinstance(expr, ast.Name):
+            enclosing = self._enclosing_qualname(at)
+            if enclosing and "%s.%s" % (enclosing, expr.id) in self._funcs:
+                return "%s.%s" % (enclosing, expr.id)
+            if expr.id in self._funcs:
+                return expr.id
+        if isinstance(expr, ast.Attribute) \
+                and isinstance(expr.value, ast.Name) \
+                and expr.value.id == "self":
+            cls = self._owner_class(at)
+            if cls is not None \
+                    and "%s.%s" % (cls, expr.attr) in self._funcs:
+                return "%s.%s" % (cls, expr.attr)
+        return None
+
+    def _has_cond_wait(self, reach: Set[str]) -> bool:
+        for qn in reach:
+            fn = self._funcs.get(qn)
+            if fn is None:
+                continue
+            for sub in ast.walk(fn):
+                if isinstance(sub, ast.Call) \
+                        and isinstance(sub.func, ast.Attribute) \
+                        and sub.func.attr == "wait":
+                    return True
+        return False
+
+    def _discover_roots(self) -> None:
+        targeted: Set[str] = set()
+        # spawned threads / timers
+        for node in ast.walk(self.ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            t = self.ctx.call_target(node)
+            leaf = (t or "").split(".")[-1]
+            if leaf not in _THREAD_CTORS:
+                continue
+            target_expr = None
+            for kw in node.keywords:
+                if kw.arg == _THREAD_CTORS[leaf]:
+                    target_expr = kw.value
+            if target_expr is None and leaf == "Timer" \
+                    and len(node.args) >= 2:
+                target_expr = node.args[1]
+            qn = (self._resolve_func_ref(target_expr, node)
+                  if target_expr is not None else None)
+            name = qn or (self.ctx.dotted(target_expr)
+                          if target_expr is not None else None) \
+                or "<unresolved>"
+            reach = self._reach(qn) if qn else set()
+            self.roots.append(ThreadRoot(
+                name=name, kind="thread" if leaf == "Thread" else "timer",
+                path=self.ctx.relpath, line=node.lineno,
+                reach=tuple(sorted(reach)),
+                cond_wait=self._has_cond_wait(reach)))
+            if qn:
+                targeted.add(qn)
+        # escaping callbacks: a function handed to another call as an
+        # argument, or installed by a decorator (atexit.register) — it
+        # runs on whichever thread ends up holding the reference
+        for node in ast.walk(self.ctx.tree):
+            if isinstance(node, ast.Call):
+                for arg in list(node.args) + \
+                        [kw.value for kw in node.keywords]:
+                    qn = self._resolve_func_ref(arg, node)
+                    if qn and qn not in targeted:
+                        targeted.add(qn)
+                        reach = self._reach(qn)
+                        self.roots.append(ThreadRoot(
+                            name=qn, kind="callback",
+                            path=self.ctx.relpath, line=node.lineno,
+                            reach=tuple(sorted(reach)),
+                            cond_wait=self._has_cond_wait(reach)))
+        for qn, fn in self._funcs.items():
+            for dec in getattr(fn, "decorator_list", []):
+                d = self.ctx.dotted(dec.func if isinstance(dec, ast.Call)
+                                    else dec)
+                if d is not None and d.split(".")[-1] == "register" \
+                        and qn not in targeted:
+                    targeted.add(qn)
+                    reach = self._reach(qn)
+                    self.roots.append(ThreadRoot(
+                        name=qn, kind="callback", path=self.ctx.relpath,
+                        line=fn.lineno, reach=tuple(sorted(reach)),
+                        cond_wait=self._has_cond_wait(reach)))
+        for r in self.roots:
+            self._root_reach[r.name] = set(r.reach)
+        # the implicit main root: the public surface plus its closure
+        entries = [qn for qn, fn in self._funcs.items()
+                   if (not fn.name.startswith("_")
+                       or (fn.name.startswith("__")
+                           and fn.name.endswith("__")
+                           and fn.name != "__init__"))
+                   and "." not in qn.replace(
+                       (self._owner_class(fn) or "") + ".", "", 1)]
+        main: Set[str] = set()
+        for qn in entries:
+            main |= self._reach(qn)
+        self._main_reach = main
+        self.roots.append(ThreadRoot(
+            name="main", kind="main", path=self.ctx.relpath, line=0,
+            reach=tuple(sorted(main)),
+            cond_wait=self._has_cond_wait(main)))
+
+    def _roots_of(self, qualname: str) -> Tuple[str, ...]:
+        out = [r.name for r in self.roots if r.kind != "main"
+               and qualname in self._root_reach.get(r.name, ())]
+        if qualname in self._main_reach or qualname == "" or not out:
+            out.append("main")
+        return tuple(out)
+
+    # -- self-concurrency ----------------------------------------------
+    def _self_concurrent(self, owner: str) -> bool:
+        """A lock-owning class (or a module with a module-level lock)
+        declares that its public surface is called from multiple
+        threads — its main root is concurrent with itself."""
+        if owner:
+            return any(lid.startswith(owner + ".") for lid in self.locks)
+        return any("." not in lid for lid in self.locks)
+
+    # -- shared-state inventory ----------------------------------------
+    def _container_kind(self, value: ast.AST) -> str:
+        leaf = self._ctor_leaf(value)
+        if leaf in ("deque", "set", "dict", "list", "defaultdict",
+                    "frozenset", "Counter", "OrderedDict"):
+            return {"frozenset": "set", "Counter": "dict",
+                    "OrderedDict": "dict"}.get(leaf, leaf)
+        if isinstance(value, ast.Dict):
+            return "dict"
+        if isinstance(value, (ast.List, ast.ListComp)):
+            return "list"
+        if isinstance(value, (ast.Set, ast.SetComp)):
+            return "set"
+        return ""
+
+    def _collect_inventory(self) -> None:
+        for node in self.ctx.tree.body:
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = [t for t in node.targets
+                           if isinstance(t, ast.Name)]
+                value = node.value
+            elif isinstance(node, ast.AnnAssign) \
+                    and isinstance(node.target, ast.Name) \
+                    and node.value is not None:
+                targets = [node.target]
+                value = node.value
+            else:
+                continue
+            for t in targets:
+                if t.id in self.locks:
+                    continue
+                self._globals[t.id] = self._container_kind(value)
+        for node in ast.walk(self.ctx.tree):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    self._note_attr(t, node.value)
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                self._note_attr(node.target, node.value)
+
+    def _note_attr(self, target: ast.AST, value: Optional[ast.AST]) -> None:
+        if isinstance(target, ast.Attribute) \
+                and isinstance(target.value, ast.Name) \
+                and target.value.id == "self":
+            cls = self._owner_class(target)
+            if cls is None:
+                return
+            key = "%s.%s" % (cls, target.attr)
+            if key in self.locks:
+                return
+            kind = (self._container_kind(value)
+                    if value is not None else "")
+            if key not in self._attr_kind or kind:
+                self._attr_kind[key] = kind
+
+    def _func_globals(self, qualname: str) -> Set[str]:
+        fn = self._funcs.get(qualname)
+        out: Set[str] = set()
+        if fn is None:
+            return out
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.Global) \
+                    and self._func_of_node.get(sub) == qualname:
+                out.update(sub.names)
+        return out
+
+    def _obj_of_expr(self, expr: ast.AST,
+                     qualname: str) -> Optional[Tuple[str, str]]:
+        """(object key, container kind) when `expr` denotes a tracked
+        shared object (a module global or a self attribute)."""
+        if isinstance(expr, ast.Name):
+            if expr.id in self._globals:
+                return expr.id, self._globals[expr.id]
+            return None
+        if isinstance(expr, ast.Attribute) \
+                and isinstance(expr.value, ast.Name) \
+                and expr.value.id == "self":
+            cls = self._owner_class(expr)
+            if cls is None:
+                return None
+            key = "%s.%s" % (cls, expr.attr)
+            if key in self._attr_kind:
+                return key, self._attr_kind[key]
+        return None
+
+    # -- blessing -------------------------------------------------------
+    def _annotation(self, line: int) -> Optional[str]:
+        for ln in (line, line - 1):
+            if not (0 < ln <= len(self.ctx.lines)):
+                continue
+            text = self.ctx.lines[ln - 1]
+            if ln == line - 1 and not text.lstrip().startswith("#"):
+                continue
+            m = _GUARDED_BY_RE.search(text)
+            if m:
+                return m.group(1)
+        return None
+
+    def _known_guards(self) -> Set[str]:
+        known = {"GIL"} | set(self.locks)
+        known.update(lid.split(".")[-1] for lid in self.locks)
+        known.update(r.name for r in self.roots)
+        known.update(r.name.split(".")[-1] for r in self.roots)
+        return known
+
+    def _bless(self, site_node: ast.AST, qualname: str, access: str,
+               kind: str) -> str:
+        if qualname.split(".")[-1] == "__init__":
+            return "init"
+        ann = self._annotation(site_node.lineno)
+        if ann is not None:
+            if ann not in self._known_guards():
+                self.findings.append(ConcFinding(
+                    rule="JG011", path=self.ctx.relpath,
+                    line=site_node.lineno, func=qualname,
+                    message="guarded-by names unknown lock/root %r "
+                            "(known: %s)"
+                            % (ann, ", ".join(sorted(
+                                self._known_guards()))),
+                    node=site_node))
+            return "guarded-by:%s" % ann
+        if access == "assign":
+            return "publish"
+        if access == "subscript":
+            return "atomic"          # one STORE_SUBSCR bytecode
+        if access.startswith("method:"):
+            meth = access.split(":", 1)[1]
+            if meth in _ATOMIC_METHODS.get(kind, ()):
+                return "atomic"
+        return ""
+
+    # -- write-site walk -----------------------------------------------
+    def _add_site(self, obj: str, kind: str, node: ast.AST,
+                  access: str) -> None:
+        qualname = self._func_of_node.get(node,
+                                          self._enclosing_qualname(node))
+        locks = self._locks_at(node)
+        self.shared.append(SharedSite(
+            obj=obj, path=self.ctx.relpath, line=node.lineno,
+            func=qualname, access=access, locks=tuple(sorted(locks)),
+            blessed=self._bless(node, qualname, access, kind),
+            roots=self._roots_of(qualname)))
+
+    def _collect_sites(self) -> None:
+        reads: Dict[str, Set[str]] = {}
+        for node in ast.walk(self.ctx.tree):
+            qualname = self._func_of_node.get(node)
+            if qualname is None:
+                continue          # module-level statements: main, cold
+            fn_globals = self._func_globals(qualname)
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    self._site_for_target(t, node, qualname, fn_globals,
+                                          rmw=False)
+            elif isinstance(node, ast.AugAssign):
+                self._site_for_target(node.target, node, qualname,
+                                      fn_globals, rmw=True)
+            elif isinstance(node, ast.Delete):
+                for t in node.targets:
+                    if isinstance(t, ast.Subscript):
+                        info = self._obj_of_expr(t.value, qualname)
+                        if info is not None:
+                            self._add_site(info[0], info[1], node,
+                                           "subscript-del")
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _MUTATORS:
+                info = self._obj_of_expr(node.func.value, qualname)
+                if info is not None:
+                    self._add_site(info[0], info[1], node,
+                                   "method:%s" % node.func.attr)
+            elif isinstance(node, (ast.Name, ast.Attribute)) \
+                    and isinstance(getattr(node, "ctx", None), ast.Load):
+                if isinstance(node, ast.Name) \
+                        and node.id in self._globals \
+                        and node.id not in fn_globals \
+                        and self._assigned_locally(qualname, node.id):
+                    continue      # shadowed local, not the module global
+                info = self._obj_of_expr(node, qualname)
+                if info is not None:
+                    reads.setdefault(info[0], set()).update(
+                        self._roots_of(qualname))
+        self._read_roots = reads
+
+    def _assigned_locally(self, qualname: str, name: str) -> bool:
+        fn = self._funcs.get(qualname)
+        if fn is None:
+            return False
+        for sub in ast.walk(fn):
+            if self._func_of_node.get(sub) != qualname:
+                continue
+            if isinstance(sub, ast.Assign):
+                for t in sub.targets:
+                    if isinstance(t, ast.Name) and t.id == name:
+                        return True
+            elif isinstance(sub, (ast.AugAssign, ast.AnnAssign)) \
+                    and isinstance(sub.target, ast.Name) \
+                    and sub.target.id == name:
+                return True
+        return False
+
+    def _site_for_target(self, target: ast.AST, stmt: ast.AST,
+                         qualname: str, fn_globals: Set[str],
+                         rmw: bool) -> None:
+        if isinstance(target, ast.Name):
+            if target.id in self._globals and target.id in fn_globals:
+                self._add_site(target.id, self._globals[target.id],
+                               stmt, "augassign" if rmw else "assign")
+        elif isinstance(target, ast.Attribute):
+            info = self._obj_of_expr(target, qualname)
+            if info is not None:
+                self._add_site(info[0], info[1], stmt,
+                               "augassign" if rmw else "assign")
+        elif isinstance(target, ast.Subscript):
+            info = self._obj_of_expr(target.value, qualname)
+            if info is not None:
+                self._add_site(info[0], info[1], stmt,
+                               "subscript-rmw" if rmw else "subscript")
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                self._site_for_target(el, stmt, qualname, fn_globals,
+                                      rmw)
+
+    # -- lock-discipline verdicts --------------------------------------
+    def _check_discipline(self) -> None:
+        by_obj: Dict[str, List[SharedSite]] = {}
+        for s in self.shared:
+            by_obj.setdefault(s.obj, []).append(s)
+        for obj, sites in sorted(by_obj.items()):
+            owner = obj.rsplit(".", 1)[0] if "." in obj else ""
+            roots: Set[str] = set(self._read_roots.get(obj, ()))
+            for s in sites:
+                roots.update(s.roots)
+            multi = len(roots) >= 2 or (
+                self._self_concurrent(owner)
+                and any("main" in s.roots for s in sites))
+            if not multi:
+                continue
+            live = [s for s in sites if s.blessed != "init"]
+            for s in live:
+                if s.blessed or s.locks:
+                    continue
+                self.findings.append(ConcFinding(
+                    rule="JG011", path=s.path, line=s.line, func=s.func,
+                    message="unguarded mutation of shared `%s` "
+                            "(%s; reached from roots: %s): hold its "
+                            "lock, or bless with `# guarded-by: <lock>`"
+                            % (obj, s.access, ", ".join(sorted(roots))),
+                    node=s))
+            locked = [set(s.locks) for s in live
+                      if s.locks and not s.blessed]
+            if len(locked) >= 2 and not set.intersection(*locked):
+                first = next(s for s in live
+                             if s.locks and not s.blessed)
+                self.findings.append(ConcFinding(
+                    rule="JG011", path=first.path, line=first.line,
+                    func=first.func,
+                    message="inconsistent lock sets guarding `%s`: %s "
+                            "— sites share no common lock, so they do "
+                            "not exclude each other"
+                            % (obj, " vs ".join(
+                                sorted("{%s}" % ",".join(sorted(ls))
+                                       for ls in locked))),
+                    node=first))
+
+    # -- blocking-hold --------------------------------------------------
+    def _blocking_leaf(self, call: ast.Call) -> Optional[str]:
+        if isinstance(call.func, ast.Attribute):
+            leaf = call.func.attr
+        elif isinstance(call.func, ast.Name):
+            leaf = (self.ctx.dotted(call.func) or call.func.id
+                    ).split(".")[-1]
+        else:
+            return None
+        return leaf if leaf in _BLOCKING else None
+
+    def _check_blocking(self) -> None:
+        blocking_funcs: Set[str] = set()
+        direct: List[Tuple[ast.Call, str, Set[str]]] = []
+        for node in ast.walk(self.ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            leaf = self._blocking_leaf(node)
+            if leaf is None:
+                continue
+            held = self._locks_at(node)
+            if leaf in ("wait", "acquire") \
+                    and isinstance(node.func, ast.Attribute):
+                lid = self._lock_of_expr(node.func.value)
+                if lid is not None and lid in held:
+                    continue      # Condition.wait on the held lock
+            qn = self._func_of_node.get(node, "")
+            if qn:
+                blocking_funcs.add(qn)
+            direct.append((node, leaf, held))
+        for node, leaf, held in direct:
+            if not held:
+                continue
+            self.findings.append(ConcFinding(
+                rule="JG012", path=self.ctx.relpath, line=node.lineno,
+                func=self._func_of_node.get(node, ""),
+                message="lock(s) {%s} held across blocking %s (`%s`): "
+                        "every thread contending for the lock stalls "
+                        "behind it — move the blocking call outside "
+                        "the critical section"
+                        % (",".join(sorted(held)), _BLOCKING[leaf],
+                           leaf), node=node))
+        # one call level: calling a function that blocks, lock in hand
+        for node in ast.walk(self.ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = self._resolve_callee(node)
+            if callee is None or callee not in blocking_funcs:
+                continue
+            held = {lid for lid, _ in self._lexical_locks(node)}
+            if not held:
+                continue
+            self.findings.append(ConcFinding(
+                rule="JG012", path=self.ctx.relpath, line=node.lineno,
+                func=self._func_of_node.get(node, ""),
+                message="lock(s) {%s} held across call to `%s`, whose "
+                        "body performs a blocking operation"
+                        % (",".join(sorted(held)), callee), node=node))
+
+    # -- lock order -----------------------------------------------------
+    def _node_id(self, lock_id: str) -> str:
+        return "%s::%s" % (self.ctx.relpath, lock_id)
+
+    def _external_lock(self, call: ast.Call) -> Optional[str]:
+        t = self.ctx.call_target(call)
+        if t is None:
+            return None
+        leaf = t.split(".")[-1]
+        for frag, (node_id, api) in _EXTERNAL_LOCKS.items():
+            if frag in t and leaf in api:
+                # the telemetry modules themselves hold their own lock
+                # legitimately; only cross-module callers edge into it
+                if node_id.split("::")[0] != self.ctx.relpath:
+                    return node_id
+        return None
+
+    def _collect_lock_edges(self) -> None:
+        for node in ast.walk(self.ctx.tree):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                inner = [self._lock_of_expr(i.context_expr)
+                         for i in node.items]
+                inner = [lid for lid in inner if lid is not None]
+                if not inner:
+                    continue
+                outer = self._locks_at(node)
+                for lid in inner:
+                    if lid in outer:
+                        if self.locks.get(lid) not in _REENTRANT_CTORS \
+                                and lid in self.locks:
+                            self.findings.append(ConcFinding(
+                                rule="lock-order",
+                                path=self.ctx.relpath, line=node.lineno,
+                                func=self._func_of_node.get(node, ""),
+                                message="non-reentrant lock `%s` "
+                                        "re-acquired while already "
+                                        "held: self-deadlock" % lid,
+                                node=node))
+                        continue
+                    for o in outer:
+                        self.lock_edges.append(
+                            (self._node_id(o), self._node_id(lid),
+                             node.lineno))
+            elif isinstance(node, ast.Call):
+                ext = self._external_lock(node)
+                if ext is not None:
+                    for o in self._locks_at(node):
+                        self.lock_edges.append(
+                            (self._node_id(o), ext, node.lineno))
+
+    # -- driver ---------------------------------------------------------
+    def _run(self) -> None:
+        self._collect_functions()
+        self._collect_locks()
+        self._build_call_graph()
+        self._discover_roots()
+        self.concurrent = bool(self.locks) or any(
+            r.kind in ("thread", "timer") for r in self.roots)
+        if not self.concurrent:
+            self.roots = []
+            return
+        self._compute_inherited()
+        self._collect_inventory()
+        self._collect_sites()
+        self._check_discipline()
+        self._check_blocking()
+        self._collect_lock_edges()
+
+
+# ---------------------------------------------------------------------------
+# cycle detection over the global acquisition-order graph
+# ---------------------------------------------------------------------------
+
+def detect_cycles(edges: List[Tuple[str, str, int]]) -> List[List[str]]:
+    """Cycles in the lock-order graph (each as the node list of one
+    cycle); deterministic order for stable reports."""
+    graph: Dict[str, Set[str]] = {}
+    for a, b, _line in edges:
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+    cycles: List[List[str]] = []
+    seen_cycles: Set[Tuple[str, ...]] = set()
+    color: Dict[str, int] = {}
+    stack: List[str] = []
+
+    def visit(n: str) -> None:
+        color[n] = 1
+        stack.append(n)
+        for m in sorted(graph.get(n, ())):
+            if color.get(m, 0) == 1:
+                cyc = stack[stack.index(m):] + [m]
+                key = tuple(sorted(cyc[:-1]))
+                if key not in seen_cycles:
+                    seen_cycles.add(key)
+                    cycles.append(cyc)
+            elif color.get(m, 0) == 0:
+                visit(m)
+        stack.pop()
+        color[n] = 2
+
+    for n in sorted(graph):
+        if color.get(n, 0) == 0:
+            visit(n)
+    return cycles
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+def analyze_source(source: str, relpath: str,
+                   config: Optional[GraftlintConfig] = None
+                   ) -> _ModuleConcurrency:
+    """Audit one in-memory module (the fixture-test entry point)."""
+    config = config or GraftlintConfig()
+    return _ModuleConcurrency(ModuleContext(source, relpath, config))
+
+
+def module_findings(ctx: ModuleContext) -> List[ConcFinding]:
+    """Per-module findings for the JG011/JG012 lint rules; the analysis
+    is cached on the context so both rules share one pass."""
+    cached = getattr(ctx, "_concurrency_audit", None)
+    if cached is None:
+        cached = _ModuleConcurrency(ctx)
+        ctx._concurrency_audit = cached
+    out = list(cached.findings)
+    for cyc in detect_cycles(cached.lock_edges):
+        line = min((ln for a, b, ln in cached.lock_edges
+                    if a in cyc and b in cyc), default=1)
+        out.append(ConcFinding(
+            rule="lock-order", path=ctx.relpath, line=line, func="",
+            message="lock-acquisition-order cycle: %s — two threads "
+                    "taking these locks in opposite orders deadlock"
+                    % " -> ".join(c.split("::")[-1] for c in cyc)))
+    return out
+
+
+def check_fixture(source: str) -> List[str]:
+    """Uniform fixture hook: concurrency findings for a source snippet
+    placed in the serving layer."""
+    ctx = ModuleContext(source, "lightgbm_tpu/serving/fixture.py",
+                        GraftlintConfig())
+    return [f.message for f in module_findings(ctx)]
+
+
+def _audited_files(config: GraftlintConfig) -> List[str]:
+    out: List[str] = []
+    for frag in config.concurrency_paths:
+        ap = os.path.join(config.root, frag)
+        if os.path.isfile(ap):
+            out.append(frag)
+            continue
+        if not os.path.isdir(ap):
+            continue
+        for dirpath, dirnames, filenames in os.walk(ap):
+            dirnames[:] = [d for d in sorted(dirnames)
+                           if d != "__pycache__"]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    rel = os.path.relpath(
+                        os.path.join(dirpath, fn),
+                        config.root).replace(os.sep, "/")
+                    out.append(rel)
+    return out
+
+
+def audit_repo(config: Optional[GraftlintConfig] = None
+               ) -> Tuple[List[ThreadRoot], List[SharedSite],
+                          List[ConcFinding],
+                          List[Tuple[str, str, int]]]:
+    config = config or load_config()
+    roots: List[ThreadRoot] = []
+    shared: List[SharedSite] = []
+    findings: List[ConcFinding] = []
+    edges: List[Tuple[str, str, int]] = []
+    for rel in _audited_files(config):
+        with open(os.path.join(config.root, rel), "r",
+                  encoding="utf-8") as f:
+            src = f.read()
+        ctx = ModuleContext(src, rel, config)
+        audit = _ModuleConcurrency(ctx)
+        roots.extend(audit.roots)
+        shared.extend(audit.shared)
+        # inline suppression works at the gate layer too, so one
+        # `# graftlint: disable=JG011` blesses both the lint rule and
+        # the auditor verdict (the baseline stays empty either way)
+        findings.extend(
+            f for f in audit.findings
+            if not (f.rule in ("JG011", "JG012")
+                    and ctx.is_inline_suppressed(f.rule, f.line)))
+        edges.extend(audit.lock_edges)
+    return roots, shared, findings, edges
+
+
+def compute_artifact(config: Optional[GraftlintConfig] = None):
+    return audit_repo(config)
+
+
+def extract_trace(config: Optional[GraftlintConfig] = None,
+                  artifact=None) -> dict:
+    """The abstract per-root concurrency trace for the --json payload:
+    thread roots with their reachable closures, the shared-site table
+    with lock sets and blessings, the lock-order graph, findings."""
+    roots, shared, findings, edges = artifact if artifact is not None \
+        else audit_repo(config)
+    return {
+        "roots": [r.to_dict() for r in roots],
+        "shared_sites": [s.to_dict() for s in shared],
+        "lock_order": {
+            "edges": sorted({(a, b) for a, b, _ in edges}),
+            "cycles": detect_cycles(edges),
+        },
+        "findings": [f.to_dict() for f in findings],
+    }
+
+
+def run(config: Optional[GraftlintConfig] = None,
+        artifact=None) -> List[AuditResult]:
+    """The gate entry point: three AuditResults (discipline /
+    blocking-hold / lock order). ``artifact`` takes a precomputed
+    :func:`audit_repo` result so the --json CLI walks once."""
+    roots, shared, findings, edges = artifact if artifact is not None \
+        else audit_repo(config)
+    unguarded = [f for f in findings if f.rule == "JG011"]
+    holds = [f for f in findings if f.rule == "JG012"]
+    order_findings = [f for f in findings if f.rule == "lock-order"]
+    cycles = detect_cycles(edges)
+    thread_roots = [r for r in roots if r.kind != "main"]
+    telemetry.count(C_ROOTS, len(thread_roots), category="analysis")
+    telemetry.count(C_SHARED, len(shared), category="analysis")
+    if unguarded:
+        telemetry.count(C_UNGUARDED, len(unguarded), category="analysis")
+    if holds:
+        telemetry.count(C_HOLD, len(holds), category="analysis")
+    discipline = AuditResult(
+        name="concurrency_discipline",
+        ok=not unguarded,
+        detail=("%d shared write site(s) across %d root(s), all "
+                "guarded or blessed" % (len(shared),
+                                        len(thread_roots) or 1))
+        if not unguarded else "; ".join(
+            "%s:%d %s" % (f.path, f.line, f.message)
+            for f in unguarded[:3]))
+    blocking = AuditResult(
+        name="concurrency_blocking_hold",
+        ok=not holds,
+        detail="no lock held across a blocking operation"
+        if not holds else "; ".join(
+            "%s:%d %s" % (f.path, f.line, f.message)
+            for f in holds[:3]))
+    n_edges = len({(a, b) for a, b, _ in edges})
+    order = AuditResult(
+        name="concurrency_lock_order",
+        ok=not cycles and not order_findings,
+        detail=("%d acquisition-order edge(s), acyclic" % n_edges)
+        if not cycles and not order_findings else "; ".join(
+            ["cycle: %s" % " -> ".join(c.split("::")[-1] for c in cyc)
+             for cyc in cycles[:2]]
+            + ["%s:%d %s" % (f.path, f.line, f.message)
+               for f in order_findings[:2]]))
+    return [discipline, blocking, order]

@@ -66,6 +66,12 @@ class GraftlintConfig:
         "lightgbm_tpu/ops/pallas_histogram.py",
         "lightgbm_tpu/ops/pallas_scan.py",
         "lightgbm_tpu/ops/quantize.py"])
+    # concurrency auditor + JG011/JG012: the threaded host layer —
+    # modules here that own locks or spawn threads get lock-discipline,
+    # blocking-hold, and lock-order analysis
+    concurrency_paths: List[str] = field(default_factory=lambda: [
+        "lightgbm_tpu/serving/", "lightgbm_tpu/predict/serve.py",
+        "lightgbm_tpu/resilience/", "lightgbm_tpu/telemetry/"])
     # resource auditor: device profile the VMEM/HBM budgets come from
     # (telemetry/devices.py; "auto" = detect attached accelerator)
     audit_device: str = "v5e"

@@ -42,3 +42,5 @@ from . import jg007_unused_imports  # noqa: E402,F401
 from . import jg008_nonatomic_write  # noqa: E402,F401
 from . import jg009_unguarded_collective  # noqa: E402,F401
 from . import jg010_unblessed_narrowing  # noqa: E402,F401
+from . import jg011_unguarded_shared  # noqa: E402,F401
+from . import jg012_blocking_hold  # noqa: E402,F401

@@ -1,0 +1,56 @@
+"""JG011 — unguarded mutation of state shared between thread roots.
+
+The threaded host layer (serving loop, registry hot-swap, retry
+watchdog, telemetry registries) keeps its shared mutable state behind
+locks; this rule is the file:line lint form of the whole-program
+``concurrency`` auditor's lock-discipline analysis. A write to a module
+global or a lock-owning class's instance attribute that is reachable
+from two thread roots (or sits on a lock-owning — hence declared
+multi-threaded — surface) must either hold the object's lock, be
+GIL-atomic (single-reference publish, ``deque.append``-class container
+ops), happen in ``__init__`` (pre-publication), or carry an explicit
+``# guarded-by: <lock|root|GIL>`` annotation naming the documented
+invariant. Anything else is a data race in waiting::
+
+    with self._cond:
+        self._depth += 1          # fine: lock held
+    self._errors += len(group)    # JG011: read-modify-write, no lock
+
+The rule and its JG012 sibling share one cached per-module analysis
+(:func:`~lightgbm_tpu.analysis.concurrency_audit.module_findings`), so
+the pair costs a single AST pass. Scoped to ``concurrency_paths``.
+"""
+from __future__ import annotations
+
+from typing import List
+
+from .. import concurrency_audit
+from ..core import Finding, ModuleContext
+from . import register
+
+
+def _to_finding(ctx: ModuleContext, rule_id: str, f) -> Finding:
+    snippet = (ctx.lines[f.line - 1].strip()
+               if 0 < f.line <= len(ctx.lines) else "")
+    return Finding(rule=rule_id, path=f.path, line=f.line, col=0,
+                   message=f.message, snippet=snippet)
+
+
+def _scoped(ctx: ModuleContext) -> bool:
+    return any(frag in ctx.relpath
+               for frag in ctx.config.concurrency_paths)
+
+
+@register
+class UnguardedShared:
+    id = "JG011"
+    name = "unguarded-shared-mutation"
+    description = ("mutation of thread-shared state without its lock, "
+                   "a GIL-atomic blessing, or a # guarded-by: note")
+
+    def check(self, ctx: ModuleContext) -> List[Finding]:
+        if not _scoped(ctx):
+            return []
+        return [_to_finding(ctx, self.id, f)
+                for f in concurrency_audit.module_findings(ctx)
+                if f.rule == "JG011"]

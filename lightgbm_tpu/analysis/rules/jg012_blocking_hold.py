@@ -1,0 +1,44 @@
+"""JG012 — lock held across a blocking operation.
+
+A critical section should be a few loads and stores; holding a lock
+across ``time.sleep``, a thread ``join``, a future ``.result()``, a
+device sync (``block_until_ready`` / ``finalize_padded``), or a
+retry-guarded DCN collective turns every contending thread into a
+convoy behind that one slow operation — and a ``join`` on a thread that
+itself needs the lock is a deadlock, not a slowdown. The sanctioned
+exception is ``Condition.wait`` on the very lock being held (wait
+releases it; that is the condition-variable protocol)::
+
+    with self._cond:
+        self._cond.wait(timeout=0.01)       # fine: wait releases _cond
+        fut.result()                        # JG012: convoy / deadlock
+
+One call level is tracked: invoking a same-module helper whose body
+blocks, with a lock in hand, is flagged too. Shares the cached
+per-module analysis with JG011; scoped to ``concurrency_paths``. The
+whole-program twin (lock-order cycles included) is the ``concurrency``
+auditor.
+"""
+from __future__ import annotations
+
+from typing import List
+
+from .. import concurrency_audit
+from ..core import Finding, ModuleContext
+from . import register
+from .jg011_unguarded_shared import _scoped, _to_finding
+
+
+@register
+class BlockingHold:
+    id = "JG012"
+    name = "lock-held-across-blocking"
+    description = ("lock held across a blocking operation (sleep/join/"
+                   "result/device sync/collective) convoys or deadlocks")
+
+    def check(self, ctx: ModuleContext) -> List[Finding]:
+        if not _scoped(ctx):
+            return []
+        return [_to_finding(ctx, self.id, f)
+                for f in concurrency_audit.module_findings(ctx)
+                if f.rule == "JG012"]

@@ -127,18 +127,23 @@ class BatchServer:
                                       self._mesh, self.devices,
                                       self.shard_min_rows)
         if sharded:
-            self._sharded_batches += 1
+            with self._depth_lock:
+                self._sharded_batches += 1
         return X_dev
 
     def _serve_chunk(self, X: np.ndarray, raw_score: bool) -> np.ndarray:
         n = X.shape[0]
         bucket = self.bucket_rows(n)
-        if bucket in self._compiled_buckets:
-            self._bucket_hits += 1
-            telemetry.count(C_SERVE_HIT, 1, category="predict")
-        else:
-            self._compiled_buckets.add(bucket)
-            telemetry.count(C_SERVE_COMPILE, 1, category="predict")
+        with self._depth_lock:
+            # check-then-act on the bucket set: two concurrent callers
+            # hitting a fresh bucket must not both count a compile
+            hit = bucket in self._compiled_buckets
+            if hit:
+                self._bucket_hits += 1
+            else:
+                self._compiled_buckets.add(bucket)
+        telemetry.count(C_SERVE_HIT if hit else C_SERVE_COMPILE, 1,
+                        category="predict")
         Xp = np.zeros((bucket, X.shape[1]), dtype=np.float64)
         Xp[:n] = X
         return self.predictor.predict_padded(self._place(Xp), n,
@@ -156,7 +161,6 @@ class BatchServer:
         written against. Omitted, queue wait records as 0 and e2e is
         pure service time."""
         d_adm = self._admit()
-        self._h_qdepth.record(float(d_adm))
         telemetry_histo.observe(H_QDEPTH, float(d_adm), unit="req",
                                 category="predict")
         t_start = time.perf_counter()
@@ -178,8 +182,11 @@ class BatchServer:
                 self._depth -= 1
         e2e = time.perf_counter() - (arrival_t if arrival_t is not None
                                      else t_start)
-        self._h_queue.record(q_wait)
-        self._h_e2e.record(e2e)
+        with self._depth_lock:
+            # histogram record is a multi-field read-modify-write; the
+            # instance histograms share _depth_lock with the depth state
+            self._h_queue.record(q_wait)
+            self._h_e2e.record(e2e)
         telemetry_histo.observe(H_QUEUE, q_wait, unit="s",
                                 category="predict")
         telemetry_histo.observe(H_E2E, e2e, unit="s", category="predict")
@@ -195,6 +202,7 @@ class BatchServer:
             self._depth += 1
             if self._depth > self._qdepth_max:
                 self._qdepth_max = self._depth
+            self._h_qdepth.record(float(self._depth))
             return self._depth
 
     # ------------------------------------------------------------------
@@ -203,18 +211,22 @@ class BatchServer:
         figures also land on the telemetry counters/histograms when
         enabled). `latency`/`queue_wait` carry the full histogram dicts;
         the p50/p99 shortcuts are what the bench SLO keys read."""
-        return {
-            "buckets_compiled": sorted(self._compiled_buckets),
-            "compiles": len(self._compiled_buckets),
-            "compile_bound": self.max_compiles(),
-            "bucket_hits": self._bucket_hits,
-            "sharded_batches": self._sharded_batches,
-            "requests": self._h_e2e.count,
-            "latency_p50": self._h_e2e.percentile(0.50),
-            "latency_p99": self._h_e2e.percentile(0.99),
-            "queue_wait_p99": self._h_queue.percentile(0.99),
-            "qdepth_max": self._qdepth_max,
-            "latency": self._h_e2e.to_dict(with_buckets=False),
-            "queue_wait": self._h_queue.to_dict(with_buckets=False),
-            "queue_depth": self._h_qdepth.to_dict(with_buckets=False),
-        }
+        with self._depth_lock:
+            # consistent snapshot vs concurrent predict() callers (and
+            # no set-changed-during-iteration on _compiled_buckets)
+            return {
+                "buckets_compiled": sorted(self._compiled_buckets),
+                "compiles": len(self._compiled_buckets),
+                "compile_bound": self.max_compiles(),
+                "bucket_hits": self._bucket_hits,
+                "sharded_batches": self._sharded_batches,
+                "requests": self._h_e2e.count,
+                "latency_p50": self._h_e2e.percentile(0.50),
+                "latency_p99": self._h_e2e.percentile(0.99),
+                "queue_wait_p99": self._h_queue.percentile(0.99),
+                "qdepth_max": self._qdepth_max,
+                "latency": self._h_e2e.to_dict(with_buckets=False),
+                "queue_wait": self._h_queue.to_dict(with_buckets=False),
+                "queue_depth": self._h_qdepth.to_dict(
+                    with_buckets=False),
+            }

@@ -187,9 +187,12 @@ def _call_with_deadline(fn, args, kwargs, timeout_s: float, name: str,
     worker.join(remaining)
     if worker.is_alive():
         # the thread is abandoned (collectives are not cancelable); the
-        # caller decides whether to retry or raise
-        raise CollectiveTimeout(
+        # caller decides whether to retry or raise — and reaps the
+        # worker via the exception (guard's _reap_abandoned sweep)
+        exc = CollectiveTimeout(
             "collective '%s' exceeded %.1fs" % (name, timeout_s))
+        exc.worker = worker
+        raise exc
     if "error" in result:
         raise result["error"]
     return result["value"]
@@ -200,6 +203,36 @@ def _call_with_deadline(fn, args, kwargs, timeout_s: float, name: str,
 # RuntimeError (XlaRuntimeError) on DCN faults
 _RETRYABLE = (OSError, ConnectionError, TimeoutError, RuntimeError,
               CollectiveTimeout)
+
+
+# shutdown sweep of deadline-abandoned watchdog workers: how long the
+# guard's exit path waits for each before declaring it leaked (tests
+# monkeypatch this down)
+_REAP_GRACE_S = 0.1
+C_THREAD_LEAK = "collective::thread_leak"
+
+
+def _reap_abandoned(abandoned, name: str,
+                    grace_s: Optional[float] = None) -> int:
+    """Join-with-timeout every watchdog thread a guard abandoned on a
+    deadline miss. A guard exiting — especially by exception — must not
+    silently leave workers running; one still alive after the grace is
+    a LEAK: counted (``collective::thread_leak``) and flight-noted so
+    the module-doc caveat about uncancelable collectives is observable
+    instead of invisible. Returns the leak count."""
+    grace = _REAP_GRACE_S if grace_s is None else grace_s
+    leaked = 0
+    for t in abandoned:
+        if t is None:
+            continue
+        t.join(grace)
+        if t.is_alive():
+            leaked += 1
+    if leaked:
+        telemetry.count(C_THREAD_LEAK, leaked, category="collective")
+        telemetry_flight.note("collective_thread_leak", name=name,
+                              leaked=leaked)
+    return leaked
 
 
 def _payload_bytes(args, kwargs) -> int:
@@ -239,6 +272,7 @@ def guard(name: str, fn, *args, **kwargs):
     nbytes = _payload_bytes(args, kwargs)
     plan = faults.active()
     last_err: Optional[BaseException] = None
+    abandoned: list = []
     for attempt in range(pol.retries + 1):
         if plan is not None and plan.collective_should_drop(round_idx):
             telemetry.count("faults::injected", 1, category="faults")
@@ -276,6 +310,7 @@ def guard(name: str, fn, *args, **kwargs):
                 # its recent history BEFORE the retry/backoff dance, so
                 # even a kill -9 during the backoff leaves a record
                 telemetry_flight.dump("collective_timeout:%s" % name)
+                abandoned.append(getattr(exc, "worker", None))
                 last_err = exc
             except _RETRYABLE as exc:
                 telemetry_histo.observe(
@@ -294,6 +329,10 @@ def guard(name: str, fn, *args, **kwargs):
                 telemetry_flight.note("collective", name=name, op=kind,
                                       round=round_idx, dur=dt,
                                       bytes=nbytes)
+                if abandoned:
+                    # a retry succeeded after an earlier deadline miss:
+                    # sweep the abandoned worker(s) before returning
+                    _reap_abandoned(abandoned, name)
                 return result
         if attempt < pol.retries:
             telemetry.count("collective::retry", 1, category="collective")
@@ -306,6 +345,9 @@ def guard(name: str, fn, *args, **kwargs):
     telemetry_flight.note("collective_failed", name=name, op=kind,
                           round=round_idx, error=repr(last_err))
     telemetry_flight.dump("collective_failed:%s" % name)
+    # the exception exit must not outrun its watchdogs: join each with
+    # the grace timeout, count what would not die
+    _reap_abandoned(abandoned, name)
     err = LightGBMError(
         "collective '%s' failed after %d attempt(s): %r (a peer is likely "
         "gone; %s)" % (name, pol.retries + 1, last_err,

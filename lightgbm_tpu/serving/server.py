@@ -263,8 +263,10 @@ class AsyncBatchServer:
                 self._qdepth_max = self._depth
             depth = self._depth
             self._requests += 1
+            # instance stats share _cond with admission state: submit()
+            # races the service loop's _dispatch/_finalize records
+            self._h_qdepth.record(float(depth))
             self._cond.notify()
-        self._h_qdepth.record(float(depth))
         telemetry.count(C_REQUESTS, 1, category="serving")
         telemetry_histo.observe(H_QDEPTH, float(depth), unit="req",
                                 category="serving")
@@ -360,16 +362,18 @@ class AsyncBatchServer:
         for r in group:
             Xp[off:off + r.n] = r.X
             off += r.n
-            self._h_queue.record(max(t_svc - r.arrival_t, 0.0))
         self._record_queue_waits(group, t_svc)
-        key = (id(pred), bucket)
-        if key not in self._compiled_buckets:
-            self._compiled_buckets.add(key)
         X_dev, _sharded = place_padded(Xp, pred._dtype, self._mesh,
                                        self.devices, self.shard_min_rows)
         out_dev = pred.dispatch_padded(X_dev, raw_score=raw)
-        self._batches += 1
-        self._h_batch_rows.record(float(rows))
+        with self._cond:
+            # service-loop stats vs submit()'s _h_qdepth record and
+            # stats() snapshots — all instance stats live under _cond
+            for r in group:
+                self._h_queue.record(max(t_svc - r.arrival_t, 0.0))
+            self._compiled_buckets.add((id(pred), bucket))
+            self._batches += 1
+            self._h_batch_rows.record(float(rows))
         telemetry.count(C_BATCHES, 1, category="serving")
         telemetry.count(C_COALESCED, len(group), category="serving")
         telemetry_histo.observe(H_BATCH_ROWS, float(rows), unit="rows",
@@ -397,9 +401,10 @@ class AsyncBatchServer:
         for r in inf.group:
             r.future._set_part(r.part, out[off:off + r.n])
             off += r.n
-            self._h_e2e.record(max(t_done - r.arrival_t, 0.0))
         self._record_e2e(inf.group, t_done)
         with self._cond:
+            for r in inf.group:
+                self._h_e2e.record(max(t_done - r.arrival_t, 0.0))
             self._depth -= len({id(r.future) for r in inf.group
                                 if r.part == 0})
 
@@ -411,11 +416,11 @@ class AsyncBatchServer:
 
     def _fail_group(self, group: List[_Request],
                     exc: BaseException) -> None:
-        self._errors += len(group)
         telemetry.count(C_ERRORS, len(group), category="serving")
         for r in group:
             r.future._set_exception(exc)
         with self._cond:
+            self._errors += len(group)
             self._depth -= len({id(r.future) for r in group
                                 if r.part == 0})
 
@@ -424,29 +429,34 @@ class AsyncBatchServer:
         """Telemetry-independent serving stats, the async analog of
         BatchServer.stats() (same SLO shortcut keys)."""
         with self._cond:
-            depth = self._depth
-            qmax = self._qdepth_max
-        d = {
-            "requests": self._requests,
-            "batches": self._batches,
-            "coalesce_ratio": (self._requests / self._batches
-                               if self._batches else 0.0),
-            "flushes": dict(self._flushes),
-            "errors": self._errors,
-            "depth": depth,
-            "qdepth_max": qmax,
-            "buckets_compiled": sorted(b for _, b in
-                                       self._compiled_buckets),
-            "latency_p50": self._h_e2e.percentile(0.50),
-            "latency_p99": self._h_e2e.percentile(0.99),
-            "queue_wait_p99": self._h_queue.percentile(0.99),
-            "queue_wait_max": (self._h_queue.vmax
-                               if self._h_queue.count else None),
-            "max_wait": self.max_wait,
-            "latency": self._h_e2e.to_dict(with_buckets=False),
-            "queue_wait": self._h_queue.to_dict(with_buckets=False),
-            "batch_rows": self._h_batch_rows.to_dict(with_buckets=False),
-        }
+            # one consistent snapshot: the service loop mutates all of
+            # these under _cond, so reading them here cannot tear (or
+            # hit a set-changed-during-iteration on _compiled_buckets)
+            d = {
+                "requests": self._requests,
+                "batches": self._batches,
+                "coalesce_ratio": (self._requests / self._batches
+                                   if self._batches else 0.0),
+                "flushes": dict(self._flushes),
+                "errors": self._errors,
+                "depth": self._depth,
+                "qdepth_max": self._qdepth_max,
+                "buckets_compiled": sorted(b for _, b in
+                                           self._compiled_buckets),
+                "latency_p50": self._h_e2e.percentile(0.50),
+                "latency_p99": self._h_e2e.percentile(0.99),
+                "queue_wait_p99": self._h_queue.percentile(0.99),
+                "queue_wait_max": (self._h_queue.vmax
+                                   if self._h_queue.count else None),
+                "max_wait": self.max_wait,
+                "latency": self._h_e2e.to_dict(with_buckets=False),
+                "queue_wait": self._h_queue.to_dict(with_buckets=False),
+                "batch_rows": self._h_batch_rows.to_dict(
+                    with_buckets=False),
+            }
+        # registry.stats() takes the registry lock (which edges into
+        # the telemetry locks); call it outside _cond to keep the
+        # acquisition-order graph a simple fan-out
         if self._registry is not None:
             d["registry"] = self._registry.stats()
         return d

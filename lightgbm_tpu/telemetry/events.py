@@ -160,10 +160,17 @@ def set_out_path(path: Optional[str]) -> None:
 
 def set_flight_sinks(span_sink: Optional[Callable],
                      count_sink: Optional[Callable]) -> None:
-    """Install/remove the flight-recorder sinks (flight.arm/disarm)."""
+    """Install/remove the flight-recorder sinks (flight.arm/disarm).
+
+    Published as a pair under the lock so concurrent arm/disarm calls
+    serialize; the hot paths deliberately read the sink WITHOUT the lock
+    (one local snapshot each — see :func:`count` / :func:`scope`), so a
+    disarm landing mid-bump means that bump goes to the old sink, never
+    to a half-installed pair and never through a None."""
     global _flight_span, _flight_count
-    _flight_span = span_sink
-    _flight_count = count_sink
+    with _lock:
+        _flight_span = span_sink
+        _flight_count = count_sink
 
 
 def reset() -> None:
@@ -208,8 +215,11 @@ def count(name: str, inc: float = 1.0, category: str = "count") -> None:
     with _lock:
         _counts[name] += inc
         _count_cat.setdefault(name, category)
-    if _flight_count is not None:
-        _flight_count(name, inc, category)
+    # snapshot the sink once: two separate reads of the global would
+    # race flight.disarm() between the None check and the call
+    sink = _flight_count          # guarded-by: GIL
+    if sink is not None:
+        sink(name, inc, category)
 
 
 def clear_counts_prefix(prefixes) -> None:
@@ -284,8 +294,11 @@ def scope(name: str, category: str = "misc", sync_value=None, **tags):
             _cat.setdefault(name, category)
         if _mode == TRACE:
             _record_event(name, category, t0, t1, parent, tags or None)
-        if _flight_span is not None:
-            _flight_span(name, category, t0 + _EPOCH, elapsed)
+        # same single-snapshot discipline as count(): never two reads
+        # of the global sink around a call
+        sink = _flight_span       # guarded-by: GIL
+        if sink is not None:
+            sink(name, category, t0 + _EPOCH, elapsed)
 
 
 def timed(name: str, category: str = "misc") -> Callable:
@@ -444,12 +457,15 @@ def _install_compile_hook() -> None:
     """Count XLA backend compiles via jax.monitoring (idempotent; the
     listener itself no-ops when telemetry is OFF)."""
     global _compile_hook_on
-    if _compile_hook_on:
-        return
+    with _lock:
+        # check-then-set under the lock: two threads enabling telemetry
+        # at once must not double-register the jax listener
+        if _compile_hook_on:
+            return
+        _compile_hook_on = True
     try:
         import jax
         jax.monitoring.register_event_duration_secs_listener(_on_jax_duration)
-        _compile_hook_on = True
     except Exception:  # pragma: no cover - very old jax
         pass
 

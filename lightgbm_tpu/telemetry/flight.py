@@ -68,6 +68,12 @@ def arm(dump_dir: Optional[str] = None,
         if dump_dir is not None:
             _dump_dir = str(dump_dir)
         _armed = True
+    # sink install happens OUTSIDE _lock: set_flight_sinks takes the
+    # events lock, and the sinks themselves take _lock — installing
+    # under _lock would put a flight->events edge into the acquisition
+    # graph for no benefit. Order matters: _armed flips first, so a
+    # bump racing the install is dropped by the sink's armed check,
+    # never recorded into a disarmed ring.
     events.set_flight_sinks(_span_sink, _count_sink)
 
 
@@ -76,6 +82,9 @@ def disarm() -> None:
     from . import events
     with _lock:
         _armed = False
+    # mirror of arm(): _armed drops first, so a bump that still reaches
+    # an installed sink (events snapshots the pointer before calling)
+    # no-ops instead of landing in a ring the owner believes is off
     events.set_flight_sinks(None, None)
 
 
@@ -112,7 +121,7 @@ def reset() -> None:
 # ---------------------------------------------------------------------------
 
 def _span_sink(name: str, category: str, ts: float, dur: float) -> None:
-    if not _armed:
+    if not _armed:              # guarded-by: GIL (one atomic bool load)
         return
     with _lock:
         _ring.append({"kind": "span", "name": name, "cat": category,
@@ -120,7 +129,7 @@ def _span_sink(name: str, category: str, ts: float, dur: float) -> None:
 
 
 def _count_sink(name: str, inc: float, category: str) -> None:
-    if not _armed:
+    if not _armed:              # guarded-by: GIL (one atomic bool load)
         return
     with _lock:
         _ring.append({"kind": "count", "name": name, "inc": inc,

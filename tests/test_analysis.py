@@ -304,6 +304,84 @@ FIXTURES = {
                     return json.load(f)
             """,
     },
+    # JG011/JG012 are scoped to the threaded host layer
+    # (concurrency_paths); their fixtures live in serving/
+    "JG011": {
+        "relpath": "lightgbm_tpu/serving/fake.py",
+        "positive": """
+            import threading
+
+            class Server:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._count = 0
+                    self._thread = None
+
+                def start(self):
+                    self._thread = threading.Thread(target=self._loop,
+                                                    daemon=True)
+                    self._thread.start()
+
+                def _loop(self):
+                    self._count += 1          # racing submit(), no lock
+
+                def submit(self):
+                    self._count += 1
+            """,
+        "negative": """
+            import threading
+
+            class Server:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._count = 0
+                    self._thread = None
+
+                def start(self):
+                    self._thread = threading.Thread(target=self._loop,
+                                                    daemon=True)
+                    self._thread.start()
+
+                def _loop(self):
+                    with self._lock:
+                        self._count += 1
+
+                def submit(self):
+                    with self._lock:
+                        self._count += 1
+            """,
+    },
+    "JG012": {
+        "relpath": "lightgbm_tpu/serving/fake.py",
+        "positive": """
+            import threading
+
+            class Pool:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._done = 0
+
+                def flush(self, fut):
+                    with self._lock:
+                        out = fut.result()    # convoy: blocks lock-holders
+                        self._done += 1
+                    return out
+            """,
+        "negative": """
+            import threading
+
+            class Pool:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._done = 0
+
+                def flush(self, fut):
+                    out = fut.result()        # block FIRST, then lock
+                    with self._lock:
+                        self._done += 1
+                    return out
+            """,
+    },
 }
 
 
@@ -311,7 +389,7 @@ def test_every_rule_has_fixtures():
     ids = {r.id for r in all_rules()}
     assert ids == set(FIXTURES), "every JG rule needs fixture snippets"
     assert ids == {"JG001", "JG002", "JG003", "JG004", "JG005", "JG006",
-                   "JG007", "JG008", "JG009", "JG010"}
+                   "JG007", "JG008", "JG009", "JG010", "JG011", "JG012"}
 
 
 def test_jg010_scope_and_allowlist():
@@ -804,6 +882,54 @@ AUDITOR_FIXTURES = {
                      "bins": 256, "g_max": 1.0, "h_max": 0.25,
                      "lambda": 1.0},
     },
+    # a service-loop thread and submit() racing on an unguarded counter
+    # vs the same pair sharing the lock (the deeper per-analysis cases —
+    # blocking-hold, lock-order cycles, guarded-by — live in
+    # tests/test_concurrency_audit.py)
+    "concurrency": {
+        "positive": """
+            import threading
+
+            class Server:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._count = 0
+                    self._thread = None
+
+                def start(self):
+                    self._thread = threading.Thread(target=self._loop,
+                                                    daemon=True)
+                    self._thread.start()
+
+                def _loop(self):
+                    self._count += 1
+
+                def submit(self):
+                    self._count += 1
+            """,
+        "negative": """
+            import threading
+
+            class Server:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._count = 0
+                    self._thread = None
+
+                def start(self):
+                    self._thread = threading.Thread(target=self._loop,
+                                                    daemon=True)
+                    self._thread.start()
+
+                def _loop(self):
+                    with self._lock:
+                        self._count += 1
+
+                def submit(self):
+                    with self._lock:
+                        self._count += 1
+            """,
+    },
 }
 
 
@@ -966,7 +1092,10 @@ def test_auditors_all_green_on_repo():
                             "collective_observed", "vmem_budget",
                             "hbm_budget", "compile_surface",
                             "precision_flow", "transfer",
-                            "quant_certify", "health_covered"}
+                            "quant_certify", "health_covered",
+                            "concurrency_discipline",
+                            "concurrency_blocking_hold",
+                            "concurrency_lock_order"}
     bad = {n: r.detail for n, r in results.items() if not r.ok}
     assert not bad, bad
 
