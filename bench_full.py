@@ -15,6 +15,7 @@ Wall-clock anchors (BASELINE.md): HIGGS 238.5 s, MS-LTR 215.3 s
 """
 import json
 import os
+import sys
 import time
 
 import numpy as np
@@ -286,6 +287,66 @@ def run_yahoo(n_rows, n_iters):
                 * (len(y) / YAHOO_ROWS_REF), 3)}
 
 
+# ---- grower-knob sweep (absorbed from the retired repo-root ----
+# ---- sweep_perf.py so the perf gate sees one bench surface)  ----
+
+KNOB_SWEEP_CONFIGS = [
+    # decompose: fixed-per-split vs row-cost
+    (1_000_000, 15, 255, 2048, "f32"),
+    (1_000_000, 15, 63, 2048, "f32"),
+    (250_000, 15, 255, 2048, "f32"),
+    (1_000_000, 15, 255, 1024, "f32"),
+    (1_000_000, 15, 255, 2048, "bf16x2"),
+    (1_000_000, 15, 255, 4096, "f32"),
+]
+
+
+def run_knob_sweep_config(n_rows, n_iters, leaves, wc, hd, ds_cache={}):
+    """One grower-knob config on the real chip (dev tool, not CI)."""
+    import lightgbm_tpu as lgb
+    if n_rows not in ds_cache:
+        X, y = make_higgs_like(n_rows)
+        t0 = time.time()
+        ds = lgb.Dataset(X, y)
+        ds.construct()
+        print(f"# binning {n_rows} rows: {time.time()-t0:.1f}s", flush=True)
+        ds_cache[n_rows] = ds
+    ds = ds_cache[n_rows]
+    params = {"objective": "binary", "num_leaves": leaves, "max_bin": 255,
+              "verbosity": -1, "metric": "none",
+              "tpu_window_chunk": wc, "tpu_hist_dtype": hd}
+    t0 = time.time()
+    # 17 = one fused 16-iteration scan + one single-tree program: compiles
+    # BOTH steady-state paths so the measured run is compile-free
+    warm = lgb.train(dict(params), ds, 17, verbose_eval=False)
+    warm._booster._materialize_pending()
+    compile_s = time.time() - t0
+    del warm
+    t0 = time.time()
+    bst = lgb.train(dict(params), ds, n_iters, verbose_eval=False)
+    bst._booster._materialize_pending()
+    import jax
+    jax.block_until_ready(bst._booster.train_score.score_device(0))
+    train_s = time.time() - t0
+    thr = n_rows * n_iters / train_s / 1e6
+    print(f"rows={n_rows:8d} iters={n_iters} leaves={leaves:3d} wc={wc:6d} "
+          f"hist={hd:6s} compile={compile_s:5.1f}s train={train_s:6.1f}s "
+          f"({train_s/n_iters*1000:7.1f} ms/tree) {thr:7.3f} Mri/s",
+          flush=True)
+
+
+def knob_sweep(argv):
+    configs = KNOB_SWEEP_CONFIGS
+    if argv:
+        configs = [tuple(int(x) if x.isdigit() else x for x in a.split(","))
+                   for a in argv]
+    for cfg in configs:
+        run_knob_sweep_config(*cfg)
+
+
 if __name__ == "__main__":
     # at the END so direct execution sees every run_* defined above
-    main()
+    if len(sys.argv) > 1 and sys.argv[1] == "--knob-sweep":
+        knob_sweep(sys.argv[2:])
+    else:
+        main()

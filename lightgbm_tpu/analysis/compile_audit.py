@@ -44,7 +44,8 @@ C_UNBOUNDED = "analysis::compile_unbounded"
 
 # directories whose jit sites form the training/serving compile surface
 AUDIT_ROOTS = ("lightgbm_tpu/ops", "lightgbm_tpu/predict",
-               "lightgbm_tpu/treelearner", "lightgbm_tpu/serving")
+               "lightgbm_tpu/treelearner", "lightgbm_tpu/serving",
+               "lightgbm_tpu/multimodel")
 
 # static-argument value domains: name -> (size, why). A size of 1 means
 # "constant for a whole run" (dataset geometry, config); sizes > 1
@@ -246,6 +247,19 @@ def serve_ladder_bound(min_batch: int = 256,
     return int(np.log2(max(max_batch // max(min_batch, 1), 1))) + 1
 
 
+def mm_ladder_bound() -> int:
+    """The multimodel batch-axis compile bound: the vmapped drivers take
+    NO static args (B and k are inferred from argument shapes), so their
+    only compile axis is the leading model-axis extent — and
+    ``multimodel.driver.bucket_for`` pads every batch up to a power-of-two
+    bucket in [MM_MIN_BUCKET, MM_MAX_BUCKET] (wider sweeps chunk at the
+    cap), so a run sees at most log2(max/min)+1 distinct batch shapes per
+    program family. The value domain of the model-batch static axis, in
+    ladder form — the exact analog of :func:`serve_ladder_bound`."""
+    from ..multimodel.driver import MM_MAX_BUCKET, MM_MIN_BUCKET
+    return int(np.log2(max(MM_MAX_BUCKET // max(MM_MIN_BUCKET, 1), 1))) + 1
+
+
 def iter_jit_sites(config: Optional[GraftlintConfig] = None
                    ) -> List[JitSite]:
     config = config or load_config()
@@ -294,9 +308,11 @@ def compile_surface(config: Optional[GraftlintConfig] = None,
     """The full surface: sites, the analytic total, the serve ladder."""
     sites = artifact if artifact is not None else iter_jit_sites(config)
     ladder = serve_ladder_bound()
-    total = sum(s.bound for s in sites) + ladder
+    mm_ladder = mm_ladder_bound()
+    total = sum(s.bound for s in sites) + ladder + mm_ladder
     return {"sites": [s.to_dict() for s in sites],
             "serve_ladder_bound": ladder,
+            "mm_ladder_bound": mm_ladder,
             # each serving registry slot owns a TPUPredictor instance
             # (its own executable cache), so a multi-model deployment
             # spends `ladder` compiles PER ACTIVE SLOT — per-slot cost
@@ -315,7 +331,8 @@ def run(config: Optional[GraftlintConfig] = None,
     config = config or load_config()
     sites = artifact if artifact is not None else iter_jit_sites(config)
     ladder = serve_ladder_bound()
-    total = sum(s.bound for s in sites) + ladder
+    mm_ladder = mm_ladder_bound()
+    total = sum(s.bound for s in sites) + ladder + mm_ladder
     ceiling = int(getattr(config, "compile_ceiling", 64))
     unbounded = [(s, n) for s in sites for n in s.unbounded]
     telemetry.count(C_ENTRIES, len(sites), category="analysis")
@@ -334,7 +351,7 @@ def run(config: Optional[GraftlintConfig] = None,
         ok = False
     else:
         detail = ("%d jit sites, compile bound %d <= ceiling %d "
-                  "(serve ladder %d)" % (len(sites), total, ceiling,
-                                         ladder))
+                  "(serve ladder %d, mm ladder %d)"
+                  % (len(sites), total, ceiling, ladder, mm_ladder))
         ok = True
     return [AuditResult(name="compile_surface", ok=ok, detail=detail)]

@@ -78,6 +78,10 @@ HIGHER_BETTER = (
     # synchronous BatchServer at the same request mix (the acceptance
     # criterion pins >= 2x)
     "serving_rps", "serving_vs_sync",
+    # multi-model sweeps (multimodel/): models trained per wall-second
+    # through the vmapped fused iteration — the whole point of batching
+    # the model axis is that this scales past 1/t_serial
+    "models_per_sec",
 )
 LOWER_BETTER = (
     "predict_p50", "predict_p99", "checkpoint_overhead_frac",
@@ -98,6 +102,10 @@ LOWER_BETTER = (
     # mean queue depth (load proxy) and the fraction of requests whose
     # arrival->answer latency blew the deadline budget
     "predict_qdepth", "serving_deadline_miss_frac",
+    # programs compiled by the WARM sweep call (tree_learner::mm_programs
+    # counter delta): the bucket ladder exists so this stays 0 — any
+    # growth means a sweep shape started recompiling
+    "sweep_compiles",
 )
 # headline keys whose PRESENCE depends on a measurement-only knob
 # (margin_p01 only exists when BENCH_TELEMETRY recorded the margin
@@ -118,7 +126,11 @@ MEASUREMENT_CONDITIONAL = ("margin_p01",
                            # launch accounting reads the telemetry
                            # counter snapshot, so a BENCH_TELEMETRY=0
                            # round omits it without the phase crashing
-                           "launches_per_iter")
+                           "launches_per_iter",
+                           # compile accounting for the sweep phase reads
+                           # the same counter snapshot (BENCH_SKIP_SWEEP /
+                           # BENCH_TELEMETRY=0 rounds omit it)
+                           "sweep_compiles")
 
 # per-key minimum noise bands: bucket-quantized keys can only move in
 # layout-growth steps. margin_p01 is a quantile of the 2.0-growth

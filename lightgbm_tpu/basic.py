@@ -528,7 +528,10 @@ class Booster:
         if not isinstance(data, Dataset):
             raise TypeError("Validation data should be Dataset instance, "
                             "met %s" % type(data).__name__)
-        data.set_reference(self.train_set)
+        if data is not self.train_set:
+            # the training set itself may ride as a named valid set (cv's
+            # eval_train_metric folds); it is its own reference
+            data.set_reference(self.train_set)
         data.construct()
         self._valid_sets.append(data)
         self.name_valid_sets.append(name)

@@ -229,6 +229,10 @@ PARAMS: List[_P] = [
     _P("tpu_multival", str, "auto"),         # auto | force | off: ELL row-
     #                                        # sparse device layout (the
     #                                        # MultiValBin/SparseBin analog)
+    # ---- multi-model subsystem (multimodel/) ----
+    _P("tpu_cv", str, "auto"),               # auto | device | off: engine.cv
+    #                                        # folds as lanes of the batched
+    #                                        # driver over one shared layout
     # ---- resilience subsystem (resilience/) ----
     # snapshot_freq (reference save_period) above gates HOW OFTEN; these
     # gate WHERE full training-state checkpoints land and how many stay

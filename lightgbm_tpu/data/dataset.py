@@ -773,6 +773,10 @@ class BinnedDataset:
         # compiled programs are shaped by the old layout
         if hasattr(self, "_scan_cache"):
             self._scan_cache = {}
+        if hasattr(self, "_mm_scan_cache"):
+            self._mm_scan_cache = {}
+        if hasattr(self, "_device_layout_cache"):
+            self._device_layout_cache = {}
         self._group_default_cache = None
 
     # ------------------------------------------------------------------
@@ -1082,7 +1086,26 @@ class BinnedDataset:
 
     def to_device(self, config: Config):
         """Produce (DataLayout, FeatureMeta) jnp structures. Sets
-        self.device_packed for the learner's GrowConfig."""
+        self.device_packed for the learner's GrowConfig.
+
+        Cached per (tpu_multival, tpu_4bit_packing) — the only config
+        knobs the layout depends on — so B boosters sweeping over one
+        Dataset share a single HBM-resident copy of the binned matrix
+        instead of re-uploading it per member."""
+        key = (str(getattr(config, "tpu_multival", "auto")).lower(),
+               bool(config.tpu_4bit_packing))
+        cache = getattr(self, "_device_layout_cache", None)
+        if cache is None:
+            cache = self._device_layout_cache = {}
+        hit = cache.get(key)
+        if hit is not None:
+            self.device_packed = hit[2]
+            return hit[0], hit[1]
+        layout, meta = self._build_device_layout(config)
+        cache[key] = (layout, meta, self.device_packed)
+        return layout, meta
+
+    def _build_device_layout(self, config: Config):
         import jax.numpy as jnp
         from ..ops.grow import DataLayout
         from ..ops.split import FeatureMeta

@@ -889,9 +889,10 @@ def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
         # cv needs subsetting: keep the raw matrix
         train_set.free_raw_data = False
 
-    ensemble = _build_fold_boosters(train_set, folds, nfold, params, seed,
-                                    fpreproc, stratified, shuffle,
-                                    eval_train_metric)
+    # fold indices may come from a one-shot generator: materialize once so
+    # the device fast path and the host fold loop see the same folds
+    fold_pairs = list(_fold_indices(train_set, folds, nfold, params, seed,
+                                    stratified, shuffle))
 
     registry = _CallbackRegistry(callbacks)
     if early_stopping_rounds is not None and early_stopping_rounds > 0:
@@ -903,6 +904,17 @@ def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
     elif isinstance(verbose_eval, int) and not isinstance(verbose_eval, bool):
         registry.add(callback.print_evaluation(verbose_eval, show_stdv))
     registry.seal()
+
+    from .multimodel.cv import maybe_device_cv
+    res = maybe_device_cv(params, train_set, num_boost_round, fold_pairs,
+                          registry, eval_train_metric, fobj, feval,
+                          fpreproc, return_cvbooster)
+    if res is not None:
+        return res
+
+    ensemble = _build_fold_boosters(train_set, fold_pairs, nfold, params,
+                                    seed, fpreproc, stratified, shuffle,
+                                    eval_train_metric)
 
     def env_for(round_no: int, evals) -> callback.CallbackEnv:
         return callback.CallbackEnv(

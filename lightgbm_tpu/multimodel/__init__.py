@@ -1,0 +1,74 @@
+"""Multi-model training: B boosters, ONE shared binned Dataset, one
+compiled program per program-shape (never per model).
+
+Public surface:
+
+* ``sweep(params_grid, train_set, num_boost_round)`` — train one
+  Booster per grid point. Models sharing compile-time attributes train
+  batched through a model-axis ``vmap`` of the fused-iteration scan
+  (multimodel/driver.py); per-model knobs (learning_rate, lambda_l1/l2,
+  min_gain_to_split, min_data_in_leaf, seeds, bagging) ride as traced
+  ``[B]`` inputs. Model texts are bit-exact vs the serial outer loop.
+* ``maybe_device_cv(...)`` (multimodel/cv.py) — engine.cv's
+  device-resident fast path: folds become lanes of the same batched
+  driver, sharing the full binned Dataset via per-fold bag masks
+  instead of re-materialized fold datasets.
+
+See multimodel/batch.py for the orchestration and the exactness
+argument; driver.py for the compiled-program shapes and the bucket
+ladder that keeps the compile surface independent of B.
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Sequence, Union
+
+from .batch import Member, train_members
+from .driver import MM_MAX_BUCKET, MM_MIN_BUCKET, bucket_for
+
+__all__ = ["sweep", "expand_grid", "MM_MAX_BUCKET", "MM_MIN_BUCKET",
+           "bucket_for"]
+
+
+def expand_grid(params_grid: Union[Dict, Sequence[Dict]]) -> List[Dict]:
+    """A sequence of param dicts passes through; a single dict expands
+    list-valued entries into their cartesian product (insertion order),
+    scalars broadcasting to every combination."""
+    if isinstance(params_grid, dict):
+        keys = [k for k, v in params_grid.items()
+                if isinstance(v, (list, tuple))]
+        fixed = {k: v for k, v in params_grid.items()
+                 if not isinstance(v, (list, tuple))}
+        if not keys:
+            return [dict(params_grid)]
+        out = []
+        for combo in itertools.product(
+                *[params_grid[k] for k in keys]):
+            p = dict(fixed)
+            p.update(dict(zip(keys, combo)))
+            out.append(p)
+        return out
+    return [dict(p) for p in params_grid]
+
+
+def sweep(params_grid: Union[Dict, Sequence[Dict]], train_set,
+          num_boost_round: int = 100) -> List:
+    """Train one Booster per grid point over one shared Dataset.
+
+    Returns the Boosters in grid order. Each is a fully independent,
+    ordinary Booster (own objective/config/model text); only the tree
+    growth was dispatched batched. Grid points whose configuration
+    cannot batch (DART/RF, CEGB, custom learners, ...) train through
+    their own serial loop transparently.
+    """
+    from ..basic import Booster
+    grid = expand_grid(params_grid)
+    if not grid:
+        raise ValueError("empty params grid")
+    members = []
+    for p in grid:
+        bst = Booster(dict(p), train_set)
+        bst.best_iteration = 0
+        members.append(Member(bst, dict(p)))
+    train_members(members, num_boost_round)
+    return [m.booster for m in members]

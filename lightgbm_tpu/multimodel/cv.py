@@ -1,0 +1,303 @@
+"""Device-resident cross-validation: folds as lanes of the batched driver.
+
+The host cv loop trains nfold independent boosters, each on a
+re-materialized row-subset Dataset. Here the folds instead become lanes
+of the multimodel scan program over the ONE full binned Dataset: a
+fold's training rows are expressed as a per-fold bag mask (fold mask
+AND that fold's own bagging draw), so no fold dataset, no fold device
+layout, and no per-fold compiled programs exist. Everything fold-
+specific that the host path computes on the host is replicated here
+bit-for-bit from the same code or the same RNG recipes:
+
+* per-fold boost-from-average comes from a per-fold objective instance
+  initialized on the fold's metadata slice, exactly like the host fold
+  booster's;
+* per-fold bagging replicates GBDT.bagging/_refresh_bagging_config on
+  the fold's n_f rows (same seed, same draw cadence, same zero-count
+  fallback) and scatters the mask onto the fold's full-dataset rows;
+* column masks and per-tree RNG keys: every host fold booster draws
+  identical streams (same config seeds), and so do the lane members;
+* metric evaluation is replayed on the host from the materialized
+  trees through HostScoreUpdater — the identical walk the host fold
+  booster's valid-set updater performs.
+
+Exactness rests on the masked-training identity: with exact f64
+histogram accumulation (the CPU lineage's hist_dtype=f64 + use_dp),
+out-of-bag rows contribute exact +/-0.0 to every histogram bin and the
+in-bag leaf counts drive min_data_in_leaf, so training on the full
+layout under a fold mask is bit-identical to training on the fold's
+subset layout. `tpu_cv=auto` therefore only engages when the exact-
+histogram conditions hold (and falls back silently otherwise);
+`tpu_cv=device` forces the path and warns when it cannot; `tpu_cv=off`
+always uses host folds.
+
+Known divergence (degenerate regime only, same as multimodel/batch.py):
+a host fold booster that hits a no-split tree at round >= 1 rewinds and
+keeps redrawing, occasionally re-splitting; the lane freezes at the
+first stub.
+"""
+from __future__ import annotations
+
+import collections
+from typing import List, Optional
+
+import numpy as np
+
+from ..utils.log import Log
+from . import batch
+
+#: the partitioned grower engages at this row count and re-chunks by
+#: num_data, so full-layout-vs-subset program shapes would diverge;
+#: the fold fast path stays below it (lightgbm_tpu/treelearner/serial.py)
+from ..treelearner.serial import PARTITION_MIN_ROWS
+
+
+class _FoldBagger:
+    """GBDT.bagging / _refresh_bagging_config replicated on a fold's
+    n_f rows (boosting/gbdt.py): same seed, same redraw cadence, same
+    zero-count fallback — the mask sequence a host fold booster would
+    draw, without instantiating one."""
+
+    def __init__(self, cfg, n_f: int):
+        self.cfg = cfg
+        self.n_f = n_f
+        self.rng = np.random.default_rng(cfg.bagging_seed)
+        self.bag_data_cnt = n_f
+        self.bag_on = bool(cfg.bagging_fraction < 1.0
+                           and cfg.bagging_freq > 0)
+        if self.bag_on:
+            self.bag_data_cnt = max(1, int(cfg.bagging_fraction * n_f))
+        self.need_re = self.bag_on
+        self._mask = np.ones(n_f, bool)
+
+    def mask(self, it: int) -> np.ndarray:
+        cfg = self.cfg
+        do_bag = self.bag_data_cnt < self.n_f
+        if not ((do_bag and cfg.bagging_freq > 0
+                 and it % cfg.bagging_freq == 0) or self.need_re):
+            return self._mask
+        self.need_re = False
+        u = self.rng.random(self.n_f)
+        m = u < cfg.bagging_fraction
+        self.bag_data_cnt = int(m.sum())
+        if self.bag_data_cnt == 0:
+            m[self.rng.integers(self.n_f)] = True
+            self.bag_data_cnt = 1
+        self._mask = m
+        return m
+
+
+def _eval_entries(data_name: str, su, metrics, obj) -> List:
+    """Booster._eval_one's record shape, replayed from a host score."""
+    score = su.score_host()
+    out = []
+    for m in metrics:
+        vals = m.eval(score, obj)
+        for name, v in zip(m.names, vals):
+            out.append((data_name, name, v, m.factor_to_bigger_better > 0))
+    return out
+
+
+def _make_metrics(cfg, inner):
+    from ..basic import Booster
+    ms = Booster._make_metrics(cfg, inner)
+    for m in ms:
+        m.init(inner.metadata, inner.num_data)
+    return ms
+
+
+def maybe_device_cv(params: dict, train_set, num_boost_round: int,
+                    fold_pairs, registry, eval_train_metric: bool,
+                    fobj, feval, fpreproc, return_cvbooster: bool
+                    ) -> Optional[dict]:
+    """Run cv through the batched driver; None means 'use host folds'.
+
+    Called by engine.cv after param normalization and fold-index
+    materialization, before the host fold boosters would be built. The
+    returned dict is exactly engine.cv's return (history of -mean/-stdv
+    series, plus 'cvbooster' when requested).
+    """
+    from ..basic import params_to_config
+
+    cfg = params_to_config(params)
+    mode = str(getattr(cfg, "tpu_cv", "auto")).lower()
+    if mode == "off":
+        return None
+
+    def bail(reason: str):
+        if mode == "device":
+            Log.warning("tpu_cv=device: falling back to host cv folds "
+                        "(%s)" % reason)
+        else:
+            Log.debug("device cv unavailable (%s); using host folds"
+                      % reason)
+        return None
+
+    if fobj is not None or feval is not None or fpreproc is not None:
+        return bail("custom objective/metric/preprocessor")
+    if getattr(registry, "has_pre_stage", False):
+        return bail("before-iteration callbacks")
+
+    from ..basic import Booster
+    from ..boosting.gbdt import GBDT
+    from ..objectives.base import create_objective
+
+    # driver booster: eligibility gates + the full-dataset learner and
+    # objective the compiled programs trace against
+    drv = Booster(params, train_set)
+    driver_m = batch.Member(drv, params)
+    kind, reason = batch.eligibility(driver_m)
+    if kind != "scan" or type(driver_m.inner) is not GBDT:
+        return bail(reason or "boosting mode")
+    obj = driver_m.objective
+    if obj.name not in ("regression", "binary"):
+        return bail("objective %s" % obj.name)
+    inner0 = driver_m.inner
+    n = inner0.num_data
+    if n >= PARTITION_MIN_ROWS:
+        return bail("row count engages the partitioned grower")
+    md = inner0.train_data.metadata
+    if md is not None and md.init_score is not None:
+        return bail("init_score")
+    if (cfg.pos_bagging_fraction < 1.0 or cfg.neg_bagging_fraction < 1.0):
+        return bail("balanced bagging")
+    if obj.name == "binary" and (
+            bool(getattr(cfg, "is_unbalance", False))
+            or abs(float(getattr(cfg, "scale_pos_weight", 1.0)) - 1.0)
+            > 0):
+        return bail("is_unbalance/scale_pos_weight")
+    gc = driver_m.learner.grow_config
+    if mode != "device" and not (gc.hist_dtype == "f64" and gc.use_dp):
+        # masked-full-layout == subset-layout only holds under exact f64
+        # histogram accumulation; auto never risks inexact parity
+        return bail("histograms are not exact-f64")
+
+    fold_pairs = [(np.sort(np.asarray(tr)).astype(np.int64),
+                   np.sort(np.asarray(te)).astype(np.int64))
+                  for tr, te in fold_pairs]
+    nfold = len(fold_pairs)
+    if nfold < 1 or nfold > batch.driver.MM_MAX_BUCKET:
+        return bail("nfold outside the batch bucket ladder")
+
+    # per-fold state: subset datasets for metric replay, fold objectives
+    # for boost-from-average, fold baggers, lane members
+    members: List[batch.Member] = []
+    fold_objs = []
+    baggers = []
+    fold_masks = []
+    fit_inners = []
+    held_inners = []
+    for tr_idx, te_idx in fold_pairs:
+        fit_part = train_set.subset(tr_idx)
+        held_part = train_set.subset(te_idx)
+        fit_part.construct()
+        held_part.construct()
+        fit_inners.append(fit_part._inner)
+        held_inners.append(held_part._inner)
+        m = Booster(params, train_set)
+        inner = m._booster
+        obj_f = create_objective(inner.config.objective, inner.config)
+        obj_f.init(fit_part._inner.metadata, fit_part._inner.num_data)
+        if not getattr(obj_f, "need_train", True):
+            return bail("a fold contains a single class")
+        inner.objective = obj_f
+        inner.class_need_train = [
+            obj_f.class_need_train(k)
+            for k in range(inner.num_tree_per_iteration)]
+        fold_objs.append(obj_f)
+        baggers.append(_FoldBagger(inner.config, len(tr_idx)))
+        fm = np.zeros(n, bool)
+        fm[tr_idx] = True
+        fold_masks.append((fm, tr_idx))
+        members.append(batch.Member(m, params))
+
+    Log.debug("device cv: %d folds as one batched program chain" % nfold)
+    from ..telemetry import events as telemetry
+    telemetry.count("tree_learner::mm_models", float(nfold),
+                    category="tree_learner")
+
+    def bag_fn(mi: int, it: int) -> np.ndarray:
+        fm, tr_idx = fold_masks[mi]
+        sub = baggers[mi].mask(it)
+        full = np.zeros(n, bool)
+        full[tr_idx] = sub
+        return full
+
+    batch.train_scan_group(members, num_boost_round, bag_fn=bag_fn,
+                           prog_member=driver_m)
+
+    # ---- host-side eval replay (the host fold loop's per-round evals,
+    # walked from the materialized trees) --------------------------------
+    from ..boosting.score_updater import HostScoreUpdater
+    from .. import engine as _engine
+    from .. import callback as _callback
+
+    ensemble = _engine.CVBooster()
+    for m in members:
+        ensemble.append(m.booster)
+
+    held_sus = [HostScoreUpdater(held_inners[f], 1) for f in range(nfold)]
+    fit_sus = ([HostScoreUpdater(fit_inners[f], 1) for f in range(nfold)]
+               if eval_train_metric else None)
+    held_metrics = [_make_metrics(members[f].inner.config, held_inners[f])
+                    for f in range(nfold)]
+    fit_metrics = ([_make_metrics(members[f].inner.config, fit_inners[f])
+                    for f in range(nfold)] if eval_train_metric else None)
+    train_metrics = ([_make_metrics(members[f].inner.config,
+                                    fit_inners[f])
+                      for f in range(nfold)] if eval_train_metric
+                     else None)
+
+    def env_for(round_no: int, evals):
+        return _callback.CallbackEnv(
+            model=ensemble, params=params, iteration=round_no,
+            begin_iteration=0, end_iteration=num_boost_round,
+            evaluation_result_list=evals)
+
+    history = collections.defaultdict(list)
+    stopped_at = None
+    for round_no in range(num_boost_round):
+        registry.fire_pre(env_for(round_no, None))
+        per_fold = []
+        for f in range(nfold):
+            models = members[f].inner.models
+            if round_no < len(models):
+                tree = models[round_no]
+                held_sus[f].add_tree(tree, 0)
+                if fit_sus is not None:
+                    fit_sus[f].add_tree(tree, 0)
+            entries: List = []
+            if eval_train_metric:
+                # the host booster's eval_train reads its device train
+                # score; restricted to fold rows it equals this walk
+                entries.extend(_eval_entries(
+                    "training", fit_sus[f], train_metrics[f],
+                    fold_objs[f]))
+                entries.extend(_eval_entries(
+                    "train", fit_sus[f], fit_metrics[f], fold_objs[f]))
+            entries.extend(_eval_entries(
+                "valid", held_sus[f], held_metrics[f], fold_objs[f]))
+            per_fold.append(entries)
+        pooled = _engine._pool_fold_evals(per_fold, eval_train_metric)
+        for _, key, mean, _, std in pooled:
+            history[key + "-mean"].append(mean)
+            history[key + "-stdv"].append(std)
+        try:
+            registry.fire_post(env_for(round_no, pooled))
+        except _callback.EarlyStopException as stop:
+            ensemble.best_iteration = stop.best_iteration + 1
+            for key in history:
+                history[key] = history[key][:ensemble.best_iteration]
+            stopped_at = round_no
+            break
+    if return_cvbooster:
+        if stopped_at is not None:
+            # host fold boosters stop training at the early-stop round;
+            # drop the lanes' extra trees so the ensembles agree
+            for m in members:
+                inner = m.inner
+                if len(inner.models) > stopped_at + 1:
+                    del inner.models[stopped_at + 1:]
+                    inner.iter = len(inner.models)
+        history["cvbooster"] = ensemble
+    return dict(history)
